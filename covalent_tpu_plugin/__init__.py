@@ -1,0 +1,20 @@
+"""covalent_tpu_plugin — TPU-native Covalent executor framework.
+
+Public surface mirrors the reference package export
+(``covalent_ssh_plugin/__init__.py:17`` re-exports ``SSHExecutor``): here the
+executor is :class:`TPUExecutor`, used as ``@ct.electron(executor="tpu")``
+once registered, or constructed directly.
+
+Beyond the executor, the package ships the TPU compute stack the north star
+requires: ``parallel`` (meshes, shardings, jax.distributed bootstrap),
+``ops`` (attention kernels, ring attention), ``models`` (Flax MNIST +
+transformer LM), and — when the upstream ``covalent`` package is absent — a
+built-in minimal workflow layer (``electron``/``lattice``/``dispatch``/
+``get_result``) so the framework runs standalone.
+"""
+
+from .tpu import EXECUTOR_PLUGIN_NAME, TPUExecutor
+
+__all__ = ["TPUExecutor", "EXECUTOR_PLUGIN_NAME"]
+
+__version__ = "0.1.0"

@@ -1,0 +1,130 @@
+"""Remote execution harness — runs ON each TPU-VM worker.
+
+TPU-native counterpart of the reference's ``covalent_ssh_plugin/exec.py``
+template.  Two structural changes:
+
+* The reference ``.format()``-instantiates its script per task
+  (``ssh.py:160-171``), which forbids literal braces anywhere in the file
+  (``exec.py`` header comment).  This harness is instead a *static* module
+  copied verbatim to the worker and invoked as
+  ``python harness.py <task_spec.json>`` — all per-task parameters travel in
+  a small JSON spec, so one upload is reusable and the brace constraint
+  disappears.
+* Before touching the pickled function it wires up the multi-host data
+  plane: ``jax.distributed.initialize(coordinator_address, num_processes,
+  process_id)`` (SURVEY §2.4), then after the task it materialises device
+  arrays to host memory and lets only process 0 write the result pickle.
+
+The file protocol is otherwise the reference's: read ``(fn, args, kwargs)``
+(``exec.py:29-30``), chdir into the task workdir (``exec.py:33-35``), run the
+function catching any exception (``exec.py:37-40``), always write the
+``(result, exception)`` pair (``exec.py:45-46``) — written atomically via a
+temp file + rename so the dispatcher's status probe never sees a torn file.
+
+MUST remain standalone: stdlib + cloudpickle (+ jax when present) only, since
+it runs on workers where this package is not installed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def _fallback_result(result_file: str, error: BaseException) -> None:
+    """Best-effort ``(None, error)`` write with stdlib pickle, mirroring the
+    reference's cloudpickle-ImportError path (``exec.py:16-24``)."""
+    import pickle
+
+    tmp = result_file + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump((None, error), f)
+    os.replace(tmp, result_file)
+
+
+def _to_host(tree):
+    """Materialise jax arrays onto the host before pickling."""
+    try:
+        import jax
+    except Exception:
+        return tree
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if hasattr(leaf, "block_until_ready"):
+            leaf.block_until_ready()
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_get(x) if hasattr(x, "devices") else x, tree
+    )
+
+
+def run_task(spec: dict) -> int:
+    """Execute one staged task described by ``spec``.  Returns the exit code."""
+    result_file = spec["result_file"]
+
+    for key, value in (spec.get("env") or {}).items():
+        os.environ[key] = str(value)
+
+    distributed = spec.get("distributed")
+    process_id = int(distributed["process_id"]) if distributed else 0
+
+    try:
+        import cloudpickle as pickle
+    except ImportError as import_error:
+        if process_id == 0:
+            _fallback_result(result_file, import_error)
+        return 1
+
+    if distributed:
+        # Data-plane bootstrap: after this, in-electron jax code sees every
+        # chip in the slice and XLA collectives ride ICI/DCN (SURVEY §2.4).
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=distributed["coordinator_address"],
+            num_processes=int(distributed["num_processes"]),
+            process_id=process_id,
+        )
+
+    with open(spec["function_file"], "rb") as f:
+        fn, args, kwargs = pickle.load(f)
+
+    workdir = spec.get("workdir")
+    current_dir = os.getcwd()
+    result, exception = None, None
+    try:
+        if workdir:
+            os.makedirs(workdir, exist_ok=True)
+            os.chdir(workdir)
+        result = fn(*args, **kwargs)
+        result = _to_host(result)
+    except Exception as task_error:  # noqa: BLE001 - transported to dispatcher
+        exception = task_error
+    finally:
+        os.chdir(current_dir)
+
+    # Replicated outputs: one writer suffices (process 0); the others emit a
+    # done-marker the control plane can watch for all-workers-finished.
+    if process_id == 0:
+        tmp = result_file + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump((result, exception), f)
+        os.replace(tmp, result_file)
+    else:
+        done = f"{result_file}.done.{process_id}"
+        with open(done, "w") as f:
+            f.write("done\n")
+
+    return 0
+
+
+def main(argv: list[str]) -> int:
+    if len(argv) != 2:
+        print("usage: harness.py <task_spec.json>", file=sys.stderr)
+        return 2
+    with open(argv[1]) as f:
+        spec = json.load(f)
+    return run_task(spec)
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -1,0 +1,30 @@
+"""Control-plane transports.
+
+The reference hardwires its control plane to asyncssh — connect at
+``covalent_ssh_plugin/ssh.py:263-268``, exec at ``ssh.py:383``, scp at
+``ssh.py:360-361,451``.  Here the control plane is an abstraction with three
+backends so the executor logic is transport-agnostic:
+
+* :class:`LocalTransport` — subprocess on the dispatcher host; powers the
+  localhost functional tier (BASELINE config 1) with no sshd required.
+* :class:`SSHTransport` — asyncssh when importable, else the OpenSSH client
+  binaries; targets TPU-VM workers in production.
+* :class:`TransportPool` — connection reuse across electrons, a structural
+  fix for the reference's ~10 round-trips + fresh handshake per electron
+  (SURVEY §3.1 hot-spot analysis).
+"""
+
+from .base import CommandResult, Transport, TransportError
+from .local import LocalTransport
+from .pool import TransportPool
+from .ssh import SSHTransport, connect_with_retries
+
+__all__ = [
+    "CommandResult",
+    "Transport",
+    "TransportError",
+    "LocalTransport",
+    "SSHTransport",
+    "TransportPool",
+    "connect_with_retries",
+]

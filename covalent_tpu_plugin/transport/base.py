@@ -1,0 +1,63 @@
+"""Transport interface.
+
+Distills the three operations the reference performs over its connection
+object — ``conn.run(cmd)`` (``covalent_ssh_plugin/ssh.py:383``),
+``asyncssh.scp(local, (conn, remote))`` upload (``ssh.py:360-361``), and
+``asyncssh.scp((conn, remote), local)`` download (``ssh.py:451``) — into an
+abstract base class every backend implements.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from dataclasses import dataclass
+
+
+class TransportError(RuntimeError):
+    """Raised for connection/copy/exec failures on the control plane."""
+
+
+@dataclass
+class CommandResult:
+    """Shape-compatible stand-in for asyncssh's ``SSHCompletedProcess``.
+
+    The executor reads ``exit_status``/``stdout``/``stderr`` exactly as the
+    reference does (``ssh.py:383-386``, ``ssh.py:402-406``, ``ssh.py:553-555``).
+    """
+
+    exit_status: int
+    stdout: str
+    stderr: str
+
+    @property
+    def returncode(self) -> int:
+        return self.exit_status
+
+
+class Transport(ABC):
+    """One control-plane channel to one worker host."""
+
+    #: Human-readable address for logs ("user@host" or "localhost").
+    address: str = "?"
+
+    @abstractmethod
+    async def run(self, command: str, timeout: float | None = None) -> CommandResult:
+        """Execute a shell command on the worker and capture its output."""
+
+    @abstractmethod
+    async def put(self, local_path: str, remote_path: str) -> None:
+        """Copy a file from the dispatcher to the worker."""
+
+    @abstractmethod
+    async def get(self, remote_path: str, local_path: str) -> None:
+        """Copy a file from the worker back to the dispatcher."""
+
+    @abstractmethod
+    async def close(self) -> None:
+        """Release the channel (idempotent)."""
+
+    async def __aenter__(self) -> "Transport":
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()

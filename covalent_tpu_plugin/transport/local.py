@@ -1,0 +1,56 @@
+"""Local (subprocess) transport.
+
+The reference's only degraded mode is running the electron in-process on the
+dispatcher (``covalent_ssh_plugin/ssh.py:202-204``).  This backend is
+stronger: it drives the *full* stage/submit/poll/fetch lifecycle through a
+local subprocess, so the entire executor path is exercised end-to-end with no
+sshd — the localhost tier of the test strategy (SURVEY §4.2b) and BASELINE
+config 1.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import shutil
+
+from .base import CommandResult, Transport, TransportError
+
+
+class LocalTransport(Transport):
+    """Runs commands via ``asyncio.create_subprocess_shell`` and copies files
+    with ``shutil`` on the dispatcher host itself."""
+
+    def __init__(self) -> None:
+        self.address = "localhost"
+        self._closed = False
+
+    async def run(self, command: str, timeout: float | None = None) -> CommandResult:
+        if self._closed:
+            raise TransportError("transport is closed")
+        proc = await asyncio.create_subprocess_shell(
+            command,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.PIPE,
+        )
+        try:
+            stdout, stderr = await asyncio.wait_for(proc.communicate(), timeout)
+        except asyncio.TimeoutError:
+            proc.kill()
+            await proc.wait()
+            raise TransportError(f"command timed out after {timeout}s: {command!r}")
+        return CommandResult(
+            exit_status=proc.returncode if proc.returncode is not None else -1,
+            stdout=stdout.decode(errors="replace"),
+            stderr=stderr.decode(errors="replace"),
+        )
+
+    async def put(self, local_path: str, remote_path: str) -> None:
+        if local_path != remote_path:
+            await asyncio.to_thread(shutil.copyfile, local_path, remote_path)
+
+    async def get(self, remote_path: str, local_path: str) -> None:
+        if local_path != remote_path:
+            await asyncio.to_thread(shutil.copyfile, remote_path, local_path)
+
+    async def close(self) -> None:
+        self._closed = True

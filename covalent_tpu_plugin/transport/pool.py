@@ -1,0 +1,61 @@
+"""Connection pooling across electrons.
+
+The reference opens a fresh SSH connection per ``run()`` call
+(``covalent_ssh_plugin/ssh.py:497``) and closes it at the end
+(``ssh.py:585-587``) — with the handshake alone eating a large slice of the
+<2 s overhead budget and the connection leaking on the exception path
+(``ssh.py:581-583``).  The pool amortises the handshake across all electrons
+of a lattice: transports are keyed by address, handed out shared, and closed
+once at executor teardown (or via the async context manager).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Awaitable, Callable
+
+from .base import Transport
+
+
+class TransportPool:
+    """Keyed cache of live transports with single-flight connection setup."""
+
+    def __init__(self) -> None:
+        self._transports: dict[str, Transport] = {}
+        self._locks: dict[str, asyncio.Lock] = {}
+        self._guard = asyncio.Lock()
+
+    async def acquire(
+        self, key: str, factory: Callable[[], Awaitable[Transport]]
+    ) -> Transport:
+        """Return the pooled transport for ``key``, creating it via
+        ``factory`` exactly once even under concurrent electron fan-out."""
+        async with self._guard:
+            lock = self._locks.setdefault(key, asyncio.Lock())
+        async with lock:
+            transport = self._transports.get(key)
+            if transport is not None:
+                return transport
+            transport = await factory()
+            self._transports[key] = transport
+            return transport
+
+    async def discard(self, key: str) -> None:
+        """Drop (and close) a broken transport so the next acquire redials."""
+        transport = self._transports.pop(key, None)
+        if transport is not None:
+            await transport.close()
+
+    async def close_all(self) -> None:
+        transports = list(self._transports.values())
+        self._transports.clear()
+        await asyncio.gather(*(t.close() for t in transports), return_exceptions=True)
+
+    def __len__(self) -> int:
+        return len(self._transports)
+
+    async def __aenter__(self) -> "TransportPool":
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close_all()

@@ -1,0 +1,44 @@
+"""Per-stage wall-clock timing for dispatch-overhead accounting.
+
+The reference has no timing capture at all (SURVEY §5: only ``app_log.debug``
+breadcrumbs at ``covalent_ssh_plugin/ssh.py:158,382,424,...``).  The TPU
+build's north star is <2 s dispatch overhead per electron, so every
+``TPUExecutor.run()`` records how long each lifecycle stage took; the bench
+harness and tests read these numbers back.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+
+
+class StageTimer:
+    """Accumulates named stage durations for one executor run."""
+
+    def __init__(self) -> None:
+        self.stages: dict[str, float] = {}
+        self._t0 = time.perf_counter()
+
+    @contextmanager
+    def stage(self, name: str):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.stages[name] = self.stages.get(name, 0.0) + (
+                time.perf_counter() - start
+            )
+
+    def total(self) -> float:
+        return time.perf_counter() - self._t0
+
+    def overhead(self, exclude: tuple[str, ...] = ("execute",)) -> float:
+        """Dispatch overhead = everything except the task's own runtime."""
+        return sum(v for k, v in self.stages.items() if k not in exclude)
+
+    def summary(self) -> dict[str, float]:
+        out = dict(self.stages)
+        out["total"] = self.total()
+        out["overhead"] = self.overhead()
+        return out

@@ -1,0 +1,46 @@
+"""Test harness configuration.
+
+Mirrors the reference's decoupling of unit tests from live services
+(``COVALENT_PLUGIN_LOAD=false``, ``tests.yml:87-89``) and adds the CPU
+simulated-mesh tier from SURVEY §4.2c: an 8-device virtual CPU mesh via
+``--xla_force_host_platform_device_count`` so all pjit/shard_map fan-out
+logic is tested without TPUs.  Environment must be set before jax first
+initializes its backends, hence module level, before any test imports jax.
+"""
+
+import asyncio
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# Isolate the config system from any real user config file.
+os.environ.setdefault("COVALENT_TPU_CONFIG", "/tmp/covalent-tpu-test-config.toml")
+
+import pytest
+
+
+@pytest.fixture()
+def run_async():
+    """Drive a coroutine to completion (no pytest-asyncio in this image)."""
+
+    def runner(coro):
+        return asyncio.run(coro)
+
+    return runner
+
+
+@pytest.fixture()
+def tmp_config(tmp_path, monkeypatch):
+    """Point the config system at a fresh file and reset its cache."""
+    from covalent_tpu_plugin.utils import config as config_mod
+
+    path = tmp_path / "config.toml"
+    monkeypatch.setenv("COVALENT_TPU_CONFIG", str(path))
+    config_mod._reset_cache_for_tests()
+    yield path
+    config_mod._reset_cache_for_tests()

@@ -1,0 +1,40 @@
+"""Config system tests (reference analog: config mocking at ssh_test.py:29-52)."""
+
+from covalent_tpu_plugin.utils import config as config_mod
+
+
+def test_get_config_missing_returns_default(tmp_config):
+    assert config_mod.get_config("executors.tpu.nope", "fallback") == "fallback"
+
+
+def test_set_then_get_roundtrip(tmp_config):
+    config_mod.set_config("executors.tpu.python_path", "/opt/py/bin/python3")
+    assert config_mod.get_config("executors.tpu.python_path") == "/opt/py/bin/python3"
+
+
+def test_set_persists_to_toml(tmp_config):
+    config_mod.set_config("executors.tpu.poll_freq", 0.25)
+    config_mod.set_config("executors.tpu.create_unique_workdir", True)
+    config_mod._reset_cache_for_tests()
+    assert config_mod.get_config("executors.tpu.poll_freq") == 0.25
+    assert config_mod.get_config("executors.tpu.create_unique_workdir") is True
+
+
+def test_update_config_does_not_clobber_user_values(tmp_config):
+    config_mod.set_config("executors.tpu.remote_workdir", "/custom")
+    config_mod.update_config({"remote_workdir": "/default", "new_key": "v"})
+    assert config_mod.get_config("executors.tpu.remote_workdir") == "/custom"
+    assert config_mod.get_config("executors.tpu.new_key") == "v"
+
+
+def test_update_config_without_file_stays_in_memory(tmp_config):
+    # No config file on disk: defaults must merge in memory but not create one.
+    config_mod.update_config({"some_default": 1})
+    assert config_mod.get_config("executors.tpu.some_default") == 1
+    assert not tmp_config.exists()
+
+
+def test_nested_sections_and_list_values(tmp_config):
+    config_mod.set_config("executors.tpu.workers", ["h1", "h2"])
+    config_mod._reset_cache_for_tests()
+    assert config_mod.get_config("executors.tpu.workers") == ["h1", "h2"]

@@ -1,0 +1,131 @@
+"""Harness tests — the reference never executes ``exec.py`` in its test
+suite (excluded from coverage, ``codecov.yml:1-3``); here the harness is a
+real importable module, so the file protocol (``exec.py:29-46``) is tested
+directly *and* via a true subprocess round-trip.
+"""
+
+import json
+import os
+import pickle
+import subprocess
+import sys
+
+import cloudpickle
+import pytest
+
+from covalent_tpu_plugin import harness
+from covalent_tpu_plugin.utils.serialize import dump_task, load_result
+
+
+def _stage(tmp_path, fn, args=(), kwargs=None, **spec_extra):
+    function_file = tmp_path / "function.pkl"
+    result_file = tmp_path / "result.pkl"
+    dump_task(fn, args, kwargs or {}, function_file)
+    spec = {
+        "function_file": str(function_file),
+        "result_file": str(result_file),
+        "workdir": str(tmp_path / "workdir"),
+        **spec_extra,
+    }
+    return spec, result_file
+
+
+def test_run_task_success(tmp_path):
+    spec, result_file = _stage(tmp_path, lambda a, b: a + b, (2, 3))
+    assert harness.run_task(spec) == 0
+    result, exception = load_result(result_file)
+    assert result == 5 and exception is None
+
+
+def test_run_task_transports_user_exception(tmp_path):
+    def boom():
+        raise ValueError("user error")
+
+    spec, result_file = _stage(tmp_path, boom)
+    assert harness.run_task(spec) == 0  # harness itself succeeds (exec.py:45-46)
+    result, exception = load_result(result_file)
+    assert result is None
+    assert isinstance(exception, ValueError) and "user error" in str(exception)
+
+
+def test_run_task_chdirs_into_workdir_and_restores(tmp_path):
+    spec, result_file = _stage(tmp_path, lambda: os.getcwd())
+    before = os.getcwd()
+    harness.run_task(spec)
+    assert os.getcwd() == before  # cwd restored (exec.py:41-42)
+    result, _ = load_result(result_file)
+    assert result == str(tmp_path / "workdir")
+    assert (tmp_path / "workdir").is_dir()  # created on demand (exec.py:33-35)
+
+
+def test_run_task_applies_env(tmp_path, monkeypatch):
+    monkeypatch.delenv("CTPU_TEST_VAR", raising=False)
+    spec, result_file = _stage(
+        tmp_path, lambda: os.environ.get("CTPU_TEST_VAR"), env={"CTPU_TEST_VAR": "42"}
+    )
+    harness.run_task(spec)
+    result, _ = load_result(result_file)
+    assert result == "42"
+
+
+def test_run_task_nonzero_process_writes_done_marker(tmp_path, monkeypatch):
+    import jax
+
+    calls = {}
+
+    def fake_init(**kwargs):
+        calls.update(kwargs)
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+    spec, result_file = _stage(
+        tmp_path,
+        lambda: "replicated",
+        distributed={
+            "coordinator_address": "w0:8476",
+            "num_processes": 2,
+            "process_id": 1,
+        },
+    )
+    assert harness.run_task(spec) == 0
+    # Only process 0 writes the result pickle; others drop a done marker.
+    assert not result_file.exists()
+    assert (tmp_path / "result.pkl.done.1").exists()
+    assert calls == {
+        "coordinator_address": "w0:8476",
+        "num_processes": 2,
+        "process_id": 1,
+    }
+
+
+def test_result_write_is_atomic_no_tmp_left(tmp_path):
+    spec, result_file = _stage(tmp_path, lambda: 1)
+    harness.run_task(spec)
+    assert result_file.exists()
+    assert not (tmp_path / "result.pkl.tmp").exists()
+
+
+def test_to_host_materialises_jax_arrays(tmp_path):
+    import jax.numpy as jnp
+    import numpy as np
+
+    out = harness._to_host({"x": jnp.ones((4,)), "y": 3})
+    assert isinstance(out["x"], np.ndarray)
+    assert out["y"] == 3
+
+
+@pytest.mark.functional_tests
+def test_harness_subprocess_roundtrip(tmp_path):
+    """Full machine-boundary simulation: fresh python process runs the staged
+    harness file exactly as a worker would (reference flow ssh.py:377-383)."""
+    spec, result_file = _stage(tmp_path, lambda x: x * 10, (7,))
+    spec_file = tmp_path / "spec.json"
+    spec_file.write_text(json.dumps(spec))
+    proc = subprocess.run(
+        [sys.executable, harness.__file__, str(spec_file)],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    result, exception = pickle.loads(result_file.read_bytes())
+    assert result == 70 and exception is None

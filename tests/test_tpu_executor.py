@@ -1,0 +1,367 @@
+"""TPUExecutor unit tests — the reference's ``tests/ssh_test.py`` inventory
+(SURVEY §4.1) rebuilt for the TPU lifecycle: constructor/config resolution,
+fallback policy both ways, staged file layout, unique workdirs, orchestration
+against scripted fake transports, failure routing, cancel, and timings.
+No network, no TPU.
+"""
+
+import asyncio
+
+import pytest
+
+from covalent_tpu_plugin.tpu import (
+    _EXECUTOR_PLUGIN_DEFAULTS,
+    EXECUTOR_PLUGIN_NAME,
+    TaskStatus,
+    TPUExecutor,
+)
+from covalent_tpu_plugin.transport import TransportError
+from covalent_tpu_plugin.transport.base import CommandResult
+
+from .helpers import FakeTransport, scripted_ok_responses
+
+
+def make_executor(tmp_path, fake: FakeTransport | None = None, **kwargs):
+    """Executor wired to a FakeTransport (method-level patch pattern,
+    ssh_test.py:139-146)."""
+    kwargs.setdefault("transport", "local")
+    kwargs.setdefault("cache_dir", str(tmp_path / "cache"))
+    kwargs.setdefault("remote_cache", str(tmp_path / "remote"))
+    kwargs.setdefault("poll_freq", 0.05)
+    ex = TPUExecutor(**kwargs)
+    if fake is not None:
+
+        async def fake_connect(address):
+            return fake
+
+        ex._client_connect = fake_connect
+    return ex
+
+
+METADATA = {"dispatch_id": "d123", "node_id": 1}
+
+
+# --------------------------------------------------------------------- #
+# Constructor / config resolution (reference: test_init, ssh_test.py:46-69)
+# --------------------------------------------------------------------- #
+
+
+def test_plugin_identity():
+    assert EXECUTOR_PLUGIN_NAME == "TPUExecutor"
+    assert set(_EXECUTOR_PLUGIN_DEFAULTS) >= {
+        "username",
+        "hostname",
+        "ssh_key_file",
+        "python_path",
+        "conda_env",
+        "remote_cache",
+        "remote_workdir",
+        "create_unique_workdir",
+        "run_local_on_dispatch_fail",
+    }
+
+
+def test_init_explicit_args_win(tmp_path, tmp_config):
+    from covalent_tpu_plugin.utils.config import set_config
+
+    set_config("executors.tpu.python_path", "/from/config")
+    ex = make_executor(tmp_path, python_path="/explicit")
+    assert ex.python_path == "/explicit"
+
+
+def test_init_falls_back_to_config(tmp_path, tmp_config):
+    from covalent_tpu_plugin.utils.config import set_config
+
+    set_config("executors.tpu.python_path", "/from/config")
+    ex = make_executor(tmp_path)
+    assert ex.python_path == "/from/config"
+
+
+def test_init_falls_back_to_default(tmp_path, tmp_config):
+    ex = make_executor(tmp_path)
+    assert ex.python_path == "python3"
+    assert ex.poll_freq == 0.05  # explicit in make_executor
+    assert ex.create_unique_workdir is False
+
+
+def test_reference_compat_alias_run_local_on_ssh_fail(tmp_path):
+    ex = make_executor(tmp_path, run_local_on_ssh_fail=True)
+    assert ex.run_local_on_dispatch_fail is True
+
+
+def test_ssh_key_file_expanded(tmp_path):
+    ex = make_executor(tmp_path, ssh_key_file="~/somekey")
+    assert "~" not in ex.ssh_key_file
+
+
+# --------------------------------------------------------------------- #
+# Credentials (reference: test_client_connect, ssh_test.py:170-190)
+# --------------------------------------------------------------------- #
+
+
+def test_validate_credentials_missing_key_raises(tmp_path, run_async):
+    ex = make_executor(
+        tmp_path, transport="ssh", hostname="tpu-vm", ssh_key_file=str(tmp_path / "nope")
+    )
+    with pytest.raises(RuntimeError, match="no SSH key"):
+        run_async(ex._validate_credentials())
+
+
+def test_validate_credentials_local_transport_skips_key(tmp_path, run_async):
+    ex = make_executor(tmp_path, ssh_key_file=str(tmp_path / "nope"))
+    assert run_async(ex._validate_credentials()) is True
+
+
+def test_worker_addresses_require_topology(tmp_path):
+    ex = make_executor(tmp_path, transport="ssh")
+    with pytest.raises(ValueError, match="hostname"):
+        ex._worker_addresses()
+
+
+def test_worker_addresses_explicit_workers_win(tmp_path):
+    ex = make_executor(tmp_path, hostname="solo", workers=["w0", "w1"])
+    assert ex._worker_addresses() == ["w0", "w1"]
+    assert ex._num_processes() == 2
+    assert ex._coordinator_address() == f"w0:{ex.coordinator_port}"
+
+
+def test_coordinator_address_strips_username(tmp_path):
+    ex = make_executor(tmp_path, workers=["alice@w0", "alice@w1"], coordinator_port=9000)
+    assert ex._coordinator_address() == "w0:9000"
+
+
+# --------------------------------------------------------------------- #
+# Fallback policy (reference: test_on_ssh_fail, ssh_test.py:72-110)
+# --------------------------------------------------------------------- #
+
+
+def test_on_dispatch_fail_runs_locally_when_enabled(tmp_path):
+    ex = make_executor(tmp_path, run_local_on_dispatch_fail=True)
+    assert ex._on_dispatch_fail(lambda x: x + 1, (41,), {}, "oops") == 42
+
+
+def test_on_dispatch_fail_raises_when_disabled(tmp_path):
+    ex = make_executor(tmp_path, run_local_on_dispatch_fail=False)
+    with pytest.raises(RuntimeError, match="oops"):
+        ex._on_dispatch_fail(lambda: None, (), {}, "oops")
+
+
+# --------------------------------------------------------------------- #
+# Staging (reference: test_file_writes ssh_test.py:319-360,
+#          test_current_remote_workdir ssh_test.py:260-316)
+# --------------------------------------------------------------------- #
+
+
+def test_file_writes_single_worker(tmp_path):
+    ex = make_executor(tmp_path)
+    staged = ex._write_function_files("d123_1", lambda: 1, (), {}, "/wd")
+    assert staged.function_file.endswith("function_d123_1.pkl")
+    assert staged.remote_function_file.endswith("/function_d123_1.pkl")
+    assert staged.remote_result_file.endswith("/result_d123_1.pkl")
+    assert len(staged.local_spec_files) == 1
+    import json
+
+    spec = json.load(open(staged.local_spec_files[0]))
+    assert spec["workdir"] == "/wd"
+    assert "distributed" not in spec  # single process: no data plane
+
+
+def test_file_writes_multi_worker_specs(tmp_path):
+    ex = make_executor(tmp_path, workers=["w0", "w1", "w2"], coordinator_port=8111)
+    staged = ex._write_function_files("op", lambda: 1, (), {}, "/wd")
+    assert len(staged.local_spec_files) == 3
+    import json
+
+    for process_id, path in enumerate(staged.local_spec_files):
+        spec = json.load(open(path))
+        assert spec["distributed"] == {
+            "coordinator_address": "w0:8111",
+            "num_processes": 3,
+            "process_id": process_id,
+        }
+
+
+def test_unique_workdir_layout(tmp_path, run_async):
+    fake = FakeTransport(scripted_ok_responses())
+    fake.result_payload = ("ok", None)
+    ex = make_executor(
+        tmp_path, fake, create_unique_workdir=True, remote_workdir="/base"
+    )
+    captured = {}
+    original = ex._write_function_files
+
+    def spy(op_id, fn, args, kwargs, workdir):
+        captured["workdir"] = workdir
+        return original(op_id, fn, args, kwargs, workdir)
+
+    ex._write_function_files = spy
+    run_async(ex.run(lambda: "ok", [], {}, METADATA))
+    # {workdir}/{dispatch_id}/node_{node_id} — ssh.py:486-491
+    assert captured["workdir"] == "/base/d123/node_1"
+
+
+# --------------------------------------------------------------------- #
+# Pre-flight batching
+# --------------------------------------------------------------------- #
+
+
+def test_preflight_is_one_round_trip(tmp_path, run_async):
+    fake = FakeTransport({"mkdir -p": CommandResult(0, "3\n", "")})
+    ex = make_executor(tmp_path, fake)
+    run_async(ex._preflight(fake))
+    assert len(fake.commands) == 1  # vs the reference's 3 (ssh.py:508-532)
+    assert "mkdir -p" in fake.commands[0]
+    assert ex.python_path in fake.commands[0]
+
+
+def test_preflight_includes_conda_activation(tmp_path):
+    ex = make_executor(tmp_path, conda_env="tpu-env")
+    cmd = ex._preflight_command()
+    assert "conda activate tpu-env" in cmd  # pattern: ssh.py:379-380, 508-519
+
+
+def test_preflight_rejects_python2(tmp_path, run_async):
+    fake = FakeTransport({"mkdir -p": CommandResult(0, "2\n", "")})
+    ex = make_executor(tmp_path, fake)
+    with pytest.raises(TransportError, match="not python3"):
+        run_async(ex._preflight(fake))
+
+
+# --------------------------------------------------------------------- #
+# Status probe / poll
+# --------------------------------------------------------------------- #
+
+
+def test_get_status_ready_running_dead(tmp_path, run_async):
+    ex = make_executor(tmp_path)
+    for token in ("READY", "RUNNING", "DEAD"):
+        fake = FakeTransport({"if test -f": CommandResult(0, f"{token}\n", "")})
+        assert run_async(ex.get_status(fake, "/r.pkl", 1)) is TaskStatus(token)
+
+
+def test_poll_task_waits_until_ready(tmp_path, run_async):
+    ex = make_executor(tmp_path)
+    countdown = {"n": 3}
+
+    def probe(command):
+        countdown["n"] -= 1
+        return CommandResult(0, "READY\n" if countdown["n"] <= 0 else "RUNNING\n", "")
+
+    fake = FakeTransport({"if test -f": probe})
+    assert run_async(ex._poll_task(fake, "/r.pkl", 1)) is TaskStatus.READY
+
+
+def test_poll_task_detects_dead_process(tmp_path, run_async):
+    fake = FakeTransport({"if test -f": CommandResult(0, "DEAD\n", "")})
+    ex = make_executor(tmp_path)
+    assert run_async(ex._poll_task(fake, "/r.pkl", 1)) is TaskStatus.DEAD
+
+
+def test_poll_task_timeout(tmp_path, run_async):
+    fake = FakeTransport({"if test -f": CommandResult(0, "RUNNING\n", "")})
+    ex = make_executor(tmp_path, task_timeout=0.15, poll_freq=0.05)
+    assert run_async(ex._poll_task(fake, "/r.pkl", 1)) is TaskStatus.DEAD
+
+
+# --------------------------------------------------------------------- #
+# Orchestration (reference: run()-level tests, ssh_test.py:113-167, 284-316)
+# --------------------------------------------------------------------- #
+
+
+def test_run_happy_path_returns_result(tmp_path, run_async):
+    fake = FakeTransport(scripted_ok_responses())
+    fake.result_payload = ({"loss": 0.5}, None)
+    ex = make_executor(tmp_path, fake)
+    result = run_async(ex.run(lambda: None, [], {}, METADATA))
+    assert result == {"loss": 0.5}
+    # staged files cleaned up locally (ssh.py:310-312)
+    assert not any((tmp_path / "cache").glob("function_*"))
+    # remote cleanup issued (ssh.py:313-315)
+    assert any(c.startswith("rm -f") for c in fake.commands)
+
+
+def test_run_reraises_remote_exception(tmp_path, run_async):
+    fake = FakeTransport(scripted_ok_responses())
+    fake.result_payload = (None, KeyError("remote boom"))
+    ex = make_executor(tmp_path, fake)
+    with pytest.raises(KeyError, match="remote boom"):
+        run_async(ex.run(lambda: None, [], {}, METADATA))
+    # timings recorded even on the exception path (vs leak at ssh.py:581-587)
+    assert "overhead" in ex.last_timings
+
+
+def test_run_dead_task_routes_to_fallback_raise(tmp_path, run_async):
+    fake = FakeTransport(scripted_ok_responses(status="DEAD"))
+    ex = make_executor(tmp_path, fake, run_local_on_dispatch_fail=False)
+    with pytest.raises(RuntimeError, match="log tail"):
+        run_async(ex.run(lambda: None, [], {}, METADATA))
+
+
+def test_run_dead_task_falls_back_locally(tmp_path, run_async):
+    fake = FakeTransport(scripted_ok_responses(status="DEAD"))
+    ex = make_executor(tmp_path, fake, run_local_on_dispatch_fail=True)
+    assert run_async(ex.run(lambda: "local-result", [], {}, METADATA)) == "local-result"
+
+
+def test_run_submit_failure_routes_to_fallback(tmp_path, run_async):
+    responses = scripted_ok_responses()
+    responses["nohup"] = CommandResult(1, "", "launch denied")
+    fake = FakeTransport(responses)
+    ex = make_executor(tmp_path, fake, run_local_on_dispatch_fail=True)
+    assert run_async(ex.run(lambda: 11, [], {}, METADATA)) == 11
+
+
+def test_run_records_stage_timings(tmp_path, run_async):
+    fake = FakeTransport(scripted_ok_responses())
+    fake.result_payload = (1, None)
+    ex = make_executor(tmp_path, fake)
+    run_async(ex.run(lambda: None, [], {}, METADATA))
+    for stage in ("validate", "connect", "preflight", "stage", "upload", "submit",
+                  "execute", "fetch", "cleanup", "overhead", "total"):
+        assert stage in ex.last_timings
+
+
+def test_run_no_cleanup_when_disabled(tmp_path, run_async):
+    fake = FakeTransport(scripted_ok_responses())
+    fake.result_payload = (1, None)
+    ex = make_executor(tmp_path, fake, do_cleanup=False)
+    run_async(ex.run(lambda: None, [], {}, METADATA))
+    assert not any(c.startswith("rm -f") for c in fake.commands)
+
+
+# --------------------------------------------------------------------- #
+# Cancel (the reference stubs this — ssh.py:460-464)
+# --------------------------------------------------------------------- #
+
+
+def test_cancel_kills_active_pids(tmp_path, run_async):
+    fake = FakeTransport()
+    ex = make_executor(tmp_path, fake)
+    ex._active["op1"] = {"fake-worker": 999}
+    run_async(ex.cancel("op1"))
+    assert any("kill" in c and "999" in c for c in fake.commands)
+    assert "op1" not in ex._active
+
+
+def test_launch_all_is_all_or_nothing(tmp_path, run_async):
+    """If one worker fails to launch, started workers are killed
+    (SURVEY §7 'multi-host launch atomicity')."""
+    good = FakeTransport(scripted_ok_responses(pid=111), address="w0")
+    bad = FakeTransport(
+        {**scripted_ok_responses(), "nohup": CommandResult(1, "", "denied")},
+        address="w1",
+    )
+    ex = make_executor(tmp_path, workers=["w0", "w1"])
+
+    async def fake_connect(address):
+        return good if address == "w0" else bad
+
+    ex._client_connect = fake_connect
+    staged = ex._write_function_files("op", lambda: 1, (), {}, "/wd")
+
+    async def flow():
+        with pytest.raises(TransportError, match="launch failed"):
+            await ex._launch_all([good, bad], staged)
+
+    run_async(flow())
+    assert any("kill" in c and "111" in c for c in good.commands)

@@ -1,0 +1,159 @@
+"""Transport-layer tests: local backend, retry envelope, pooling.
+
+The retry tests replicate the reference's scripted flaky-network simulation
+(``tests/ssh_test.py:199-257``): a connect that fails a set number of times
+with classified-retryable errors, asserting immediate success, eventual
+success, immediate failure with ``retry_connect=False``, and exhausted
+retries.
+"""
+
+import pytest
+
+from covalent_tpu_plugin.transport import (
+    LocalTransport,
+    TransportError,
+    TransportPool,
+    connect_with_retries,
+)
+from covalent_tpu_plugin.transport.base import Transport
+
+
+class FlakyTransport(Transport):
+    """Raises retryable errors until the Nth open attempt succeeds."""
+
+    def __init__(self, succeed_after: int):
+        self.address = "flaky"
+        self.succeed_after = succeed_after
+        self.attempts = 0
+
+    async def _open(self):
+        self.attempts += 1
+        if self.attempts < self.succeed_after:
+            # Alternate the two retryable classes like ssh_test.py:199-219.
+            raise (ConnectionRefusedError if self.attempts % 2 else OSError)("boom")
+
+    async def run(self, command, timeout=None):
+        raise NotImplementedError
+
+    async def put(self, a, b):
+        raise NotImplementedError
+
+    async def get(self, a, b):
+        raise NotImplementedError
+
+    async def close(self):
+        pass
+
+
+def test_local_run_captures_output(run_async):
+    t = LocalTransport()
+    result = run_async(t.run("echo hello && echo err >&2"))
+    assert result.exit_status == 0
+    assert result.stdout.strip() == "hello"
+    assert result.stderr.strip() == "err"
+
+
+def test_local_run_nonzero_exit(run_async):
+    result = run_async(LocalTransport().run("exit 7"))
+    assert result.exit_status == 7
+
+
+def test_local_run_timeout(run_async):
+    with pytest.raises(TransportError):
+        run_async(LocalTransport().run("sleep 5", timeout=0.1))
+
+
+def test_local_put_get_roundtrip(run_async, tmp_path):
+    src = tmp_path / "a.txt"
+    src.write_text("payload")
+    dst = tmp_path / "b.txt"
+    fetched = tmp_path / "c.txt"
+
+    async def flow():
+        t = LocalTransport()
+        await t.put(str(src), str(dst))
+        await t.get(str(dst), str(fetched))
+
+    run_async(flow())
+    assert fetched.read_text() == "payload"
+
+
+def test_closed_transport_rejects_commands(run_async):
+    async def flow():
+        t = LocalTransport()
+        await t.close()
+        await t.run("echo hi")
+
+    with pytest.raises(TransportError):
+        run_async(flow())
+
+
+def test_connect_immediate_success(run_async):
+    t = FlakyTransport(succeed_after=1)
+    run_async(connect_with_retries(t, max_attempts=5, retry_wait_time=0))
+    assert t.attempts == 1
+
+
+def test_connect_eventual_success(run_async):
+    t = FlakyTransport(succeed_after=3)
+    run_async(connect_with_retries(t, max_attempts=5, retry_wait_time=0))
+    assert t.attempts == 3
+
+
+def test_connect_no_retry_reraises_immediately(run_async):
+    t = FlakyTransport(succeed_after=4)
+    with pytest.raises(ConnectionRefusedError):
+        run_async(
+            connect_with_retries(t, max_attempts=5, retry_wait_time=0, retry_connect=False)
+        )
+    assert t.attempts == 1
+
+
+def test_connect_exhausted_retries(run_async):
+    t = FlakyTransport(succeed_after=100)
+    with pytest.raises(TransportError):
+        run_async(connect_with_retries(t, max_attempts=4, retry_wait_time=0))
+    assert t.attempts == 4
+
+
+def test_pool_reuses_transport_and_single_flight(run_async):
+    pool = TransportPool()
+    created = []
+
+    async def factory():
+        t = LocalTransport()
+        created.append(t)
+        return t
+
+    async def flow():
+        import asyncio
+
+        results = await asyncio.gather(
+            *(pool.acquire("k", factory) for _ in range(8))
+        )
+        assert all(r is results[0] for r in results)
+        other = await pool.acquire("k2", factory)
+        assert other is not results[0]
+        await pool.close_all()
+
+    run_async(flow())
+    assert len(created) == 2
+
+
+def test_pool_discard_forces_redial(run_async):
+    pool = TransportPool()
+    created = []
+
+    async def factory():
+        t = LocalTransport()
+        created.append(t)
+        return t
+
+    async def flow():
+        first = await pool.acquire("k", factory)
+        await pool.discard("k")
+        second = await pool.acquire("k", factory)
+        assert first is not second
+
+    run_async(flow())
+    assert len(created) == 2
