@@ -45,6 +45,10 @@ def _fallback_result(result_file: str, error: BaseException) -> None:
 
 def _to_host(tree):
     """Materialise jax arrays onto the host before pickling."""
+    # If the task never imported jax there can be no device arrays in the
+    # result — skip the (multi-second) jax import entirely.
+    if "jax" not in sys.modules:
+        return tree
     try:
         import jax
     except Exception:

@@ -1,0 +1,45 @@
+"""Built-in minimal workflow layer (layers 1-2 of SURVEY §1).
+
+The reference delegates these layers to the upstream ``covalent`` package —
+``@ct.electron``/``@ct.lattice`` decorators, ``ct.dispatch``,
+``ct.get_result`` (usage at ``tests/functional_tests/basic_workflow_test.py:
+8-29``) — and only ships the executor.  This framework must run standalone
+on machines without a Covalent server, so it carries a small engine with the
+same user-facing shape:
+
+    import covalent_tpu_plugin.workflow as ct
+
+    @ct.electron(executor="tpu")
+    def train(x): ...
+
+    @ct.lattice
+    def flow(x):
+        return train(x)
+
+    dispatch_id = ct.dispatch(flow)(x)
+    result = ct.get_result(dispatch_id, wait=True)
+
+When the real ``covalent`` package is installed, use it instead — the
+``TPUExecutor`` registers there via the entry point in ``setup.py`` and this
+module is simply not needed.
+"""
+
+from .dag import Electron, Lattice, Node, electron, lattice
+from .executors import LocalExecutor, register_executor, resolve_executor
+from .runner import Result, Status, dispatch, get_result, dispatch_sync
+
+__all__ = [
+    "electron",
+    "lattice",
+    "dispatch",
+    "dispatch_sync",
+    "get_result",
+    "Electron",
+    "Lattice",
+    "Node",
+    "Result",
+    "Status",
+    "LocalExecutor",
+    "register_executor",
+    "resolve_executor",
+]

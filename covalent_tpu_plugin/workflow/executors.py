@@ -1,0 +1,64 @@
+"""Executor registry and alias resolution.
+
+Upstream Covalent resolves ``executor="ssh"`` through the setuptools entry
+point group ``covalent.executor.executor_plugins``
+(``setup.py:36,74-76`` in the reference); the standalone engine keeps a
+plain registry with the same semantics — a string alias maps to an executor
+class, instantiated from config defaults, and instances pass through
+unchanged (both spellings appear in the reference README, lines 46-60).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Callable
+
+
+class LocalExecutor:
+    """Default executor: runs the electron in-process on the dispatcher.
+
+    The upstream analog is Covalent's local/dask default executor, which the
+    reference's mixed-executor test relies on
+    (``tests/functional_tests/svm_workflow.py:11-29`` — some electrons
+    local, some remote).
+    """
+
+    SHORT_NAME = "local"
+
+    async def run(
+        self, function: Callable, args: list, kwargs: dict, task_metadata: dict
+    ) -> Any:
+        return await asyncio.to_thread(function, *tuple(args or ()), **(kwargs or {}))
+
+    async def close(self) -> None:
+        pass
+
+
+_REGISTRY: dict[str, type] = {}
+
+
+def register_executor(alias: str, cls: type) -> None:
+    _REGISTRY[alias] = cls
+
+
+def resolve_executor(spec: Any) -> Any:
+    """alias string -> new instance; instance -> itself."""
+    if isinstance(spec, str):
+        try:
+            cls = _REGISTRY[spec]
+        except KeyError:
+            raise ValueError(
+                f"unknown executor alias {spec!r}; registered: {sorted(_REGISTRY)}"
+            ) from None
+        return cls()
+    return spec
+
+
+def _register_builtins() -> None:
+    from ..tpu import TPUExecutor
+
+    register_executor("local", LocalExecutor)
+    register_executor("tpu", TPUExecutor)
+
+
+_register_builtins()

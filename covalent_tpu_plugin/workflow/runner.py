@@ -1,0 +1,197 @@
+"""Async DAG runner: ``dispatch`` / ``get_result``.
+
+The upstream flow the reference tests exercise is
+``ct.dispatch(lattice)(args)`` -> dispatch_id -> ``ct.get_result(id,
+wait=True)`` (``basic_workflow_test.py:23-24``), with independent electrons
+dispatched concurrently by the server (SURVEY §2.4 "task-level
+parallelism").  This standalone runner reproduces that: every node becomes
+an asyncio task that awaits its dependency futures, so independent
+electrons' control-plane sessions interleave on the event loop exactly as
+the reference's async executor does.
+
+Executor aliases are resolved once per dispatch and shared across that
+dispatch's nodes, so a ``TPUExecutor``'s pooled connections and cached
+pre-flight amortise across the whole lattice (the <2 s overhead budget).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import traceback
+import uuid
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Callable
+
+from ..utils.log import app_log
+from .dag import Graph, Lattice, Node
+from .executors import resolve_executor
+
+
+class Status(str, Enum):
+    NEW = "NEW"
+    RUNNING = "RUNNING"
+    COMPLETED = "COMPLETED"
+    FAILED = "FAILED"
+    CANCELLED = "CANCELLED"
+
+
+@dataclass
+class Result:
+    """What ``get_result`` returns — shaped like Covalent's result object:
+    ``.status``, ``.result``, ``.error`` (asserted at
+    ``basic_workflow_test.py:25-31,46-49``)."""
+
+    dispatch_id: str
+    status: Status = Status.NEW
+    result: Any = None
+    error: str | None = None
+    node_outputs: dict[int, Any] = field(default_factory=dict)
+    node_errors: dict[int, str] = field(default_factory=dict)
+    _done: threading.Event = field(default_factory=threading.Event, repr=False)
+
+    def wait(self, timeout: float | None = None) -> bool:
+        return self._done.wait(timeout)
+
+
+_RESULTS: dict[str, Result] = {}
+
+
+class _DependencyFailed(Exception):
+    """Raised inside a node task whose upstream dependency failed — marks the
+    node as skipped, not failed, so errors aren't misattributed downstream."""
+
+
+def _resolve_value(value: Any, outputs: dict[int, Any]) -> Any:
+    """Substitute Node placeholders with their computed outputs."""
+    if isinstance(value, Node):
+        return outputs[value.node_id]
+    if isinstance(value, list):
+        return [_resolve_value(v, outputs) for v in value]
+    if isinstance(value, tuple):
+        return tuple(_resolve_value(v, outputs) for v in value)
+    if isinstance(value, set):
+        return {_resolve_value(v, outputs) for v in value}
+    if isinstance(value, dict):
+        return {k: _resolve_value(v, outputs) for k, v in value.items()}
+    return value
+
+
+async def _execute_graph(graph: Graph, result: Result) -> None:
+    dispatch_id = result.dispatch_id
+    futures: dict[int, asyncio.Future] = {}
+    executors: dict[Any, Any] = {}
+    created: list[Any] = []
+
+    def executor_for(spec: Any) -> Any:
+        key = spec if isinstance(spec, str) else id(spec)
+        if key not in executors:
+            instance = resolve_executor(spec)
+            executors[key] = instance
+            if isinstance(spec, str):
+                created.append(instance)
+        return executors[key]
+
+    async def run_node(spec) -> Any:
+        deps = spec.dependencies()
+        if deps:
+            dep_results = await asyncio.gather(
+                *(futures[d] for d in deps), return_exceptions=True
+            )
+            failed = [d for d, r in zip(deps, dep_results) if isinstance(r, BaseException)]
+            if failed:
+                raise _DependencyFailed(f"upstream node(s) {sorted(failed)} failed")
+        args = _resolve_value(list(spec.args), result.node_outputs)
+        kwargs = _resolve_value(dict(spec.kwargs), result.node_outputs)
+        executor = executor_for(spec.executor)
+        task_metadata = {"dispatch_id": dispatch_id, "node_id": spec.node_id}
+        output = await executor.run(spec.fn, args, kwargs, task_metadata)
+        result.node_outputs[spec.node_id] = output
+        return output
+
+    try:
+        loop = asyncio.get_running_loop()
+        for spec in graph.nodes:
+            futures[spec.node_id] = loop.create_task(run_node(spec))
+        node_results = await asyncio.gather(*futures.values(), return_exceptions=True)
+
+        failed = False
+        for spec, node_result in zip(graph.nodes, node_results):
+            if isinstance(node_result, BaseException):
+                if isinstance(node_result, (_DependencyFailed, asyncio.CancelledError)):
+                    continue  # skipped, not failed — real error sits upstream
+                failed = True
+                result.node_errors[spec.node_id] = "".join(
+                    traceback.format_exception(node_result)
+                )
+        if failed:
+            result.status = Status.FAILED
+            result.error = "\n".join(result.node_errors.values())
+        else:
+            result.result = _resolve_value(graph.output, result.node_outputs)
+            result.status = Status.COMPLETED
+    except Exception as err:  # noqa: BLE001 - engine-level failure
+        result.status = Status.FAILED
+        result.error = "".join(traceback.format_exception(err))
+        app_log.error("dispatch %s failed: %s", dispatch_id, err)
+    finally:
+        for instance in created:
+            closer = getattr(instance, "close", None)
+            if closer is not None:
+                try:
+                    await closer()
+                except Exception:  # noqa: BLE001
+                    pass
+        result._done.set()
+
+
+def dispatch(lattice: Lattice) -> Callable[..., str]:
+    """``dispatch(lattice)(*args, **kwargs) -> dispatch_id`` (non-blocking).
+
+    Runs the DAG on a dedicated event-loop thread — the standalone stand-in
+    for the Covalent server process (``tests.yml:80``).
+    """
+
+    def submit(*args, **kwargs) -> str:
+        dispatch_id = str(uuid.uuid4())
+        graph = lattice.build_graph(*args, **kwargs)
+        result = Result(dispatch_id=dispatch_id, status=Status.RUNNING)
+        _RESULTS[dispatch_id] = result
+
+        def runner() -> None:
+            asyncio.run(_execute_graph(graph, result))
+
+        threading.Thread(
+            target=runner, name=f"dispatch-{dispatch_id[:8]}", daemon=True
+        ).start()
+        return dispatch_id
+
+    return submit
+
+
+def dispatch_sync(lattice: Lattice) -> Callable[..., Result]:
+    """Convenience: dispatch and block until the Result is final."""
+
+    def submit(*args, **kwargs) -> Result:
+        return get_result(dispatch(lattice)(*args, **kwargs), wait=True)
+
+    return submit
+
+
+def get_result(
+    dispatch_id: str, wait: bool = False, timeout: float | None = None
+) -> Result:
+    """Fetch a dispatch's Result; with ``wait=True`` block until final
+    (``ct.get_result(dispatch_id, wait=True)``, basic_workflow_test.py:24)."""
+    try:
+        result = _RESULTS[dispatch_id]
+    except KeyError:
+        raise ValueError(f"unknown dispatch_id {dispatch_id!r}") from None
+    if wait:
+        finished = result.wait(timeout)
+        if not finished:
+            raise TimeoutError(
+                f"dispatch {dispatch_id} not finished within {timeout}s"
+            )
+    return result

@@ -1,0 +1,186 @@
+"""Workflow-layer unit tests: DAG tracing, dependency edges, concurrent
+scheduling, alias resolution.  (The upstream layers the reference leans on —
+SURVEY §1 layers 1-2 — here exercised against the built-in engine.)"""
+
+import time
+
+import pytest
+
+import covalent_tpu_plugin.workflow as ct
+
+
+def test_electron_direct_call_runs_inline():
+    @ct.electron
+    def add(a, b):
+        return a + b
+
+    assert add(2, 3) == 5
+
+
+def test_lattice_direct_call_runs_eagerly():
+    @ct.electron
+    def add(a, b):
+        return a + b
+
+    @ct.lattice
+    def flow(x):
+        return add(x, 1)
+
+    assert flow(4) == 5
+
+
+def test_trace_records_nodes_and_edges():
+    @ct.electron
+    def add(a, b):
+        return a + b
+
+    @ct.electron
+    def mul(a, b):
+        return a * b
+
+    @ct.lattice
+    def flow(x):
+        s = add(x, 1)
+        return mul(s, 2)
+
+    graph = flow.build_graph(3)
+    assert len(graph.nodes) == 2
+    assert graph.nodes[0].name == "add"
+    assert graph.nodes[1].dependencies() == {0}
+    assert isinstance(graph.output, ct.Node)
+
+
+def test_dependencies_found_in_containers():
+    @ct.electron
+    def make(x):
+        return x
+
+    @ct.electron
+    def consume(items, mapping):
+        return sum(items) + mapping["k"]
+
+    @ct.lattice
+    def flow():
+        a = make(1)
+        b = make(2)
+        return consume([a, b], {"k": a})
+
+    graph = flow.build_graph()
+    assert graph.nodes[2].dependencies() == {0, 1}
+
+
+def test_dispatch_success_end_to_end():
+    @ct.electron
+    def add(a, b):
+        return a + b
+
+    @ct.electron
+    def square(a):
+        return a * a
+
+    @ct.lattice
+    def flow(x, y):
+        return square(add(x, y))
+
+    dispatch_id = ct.dispatch(flow)(2, 3)
+    result = ct.get_result(dispatch_id, wait=True, timeout=30)
+    assert result.status is ct.Status.COMPLETED
+    assert result.result == 25
+    assert result.node_outputs == {0: 5, 1: 25}
+
+
+def test_dispatch_failure_semantics():
+    """Failure lattice per the reference functional test
+    (basic_workflow_test.py:32-49): status FAILED, error recorded."""
+
+    @ct.electron
+    def boom():
+        raise ValueError("workflow failure")
+
+    @ct.electron
+    def downstream(x):
+        return x
+
+    @ct.lattice
+    def failing_flow():
+        return downstream(boom())
+
+    result = ct.dispatch_sync(failing_flow)()
+    assert result.status is ct.Status.FAILED
+    assert "workflow failure" in result.error
+    assert 0 in result.node_errors
+
+
+def test_independent_electrons_run_concurrently():
+    @ct.electron
+    def slow(tag):
+        time.sleep(0.3)
+        return tag
+
+    @ct.lattice
+    def fan_out():
+        return [slow(i) for i in range(4)]
+
+    start = time.perf_counter()
+    result = ct.dispatch_sync(fan_out)()
+    elapsed = time.perf_counter() - start
+    assert result.status is ct.Status.COMPLETED
+    assert result.result == [0, 1, 2, 3]
+    # 4 × 0.3 s serial would be 1.2 s; concurrent should be well under.
+    assert elapsed < 1.0
+
+
+def test_unknown_executor_alias_fails_dispatch():
+    @ct.electron(executor="warp-drive")
+    def task():
+        return 1
+
+    @ct.lattice
+    def flow():
+        return task()
+
+    result = ct.dispatch_sync(flow)()
+    assert result.status is ct.Status.FAILED
+    assert "warp-drive" in result.error
+
+
+def test_downstream_of_failure_marked_skipped_not_failed():
+    """Only the actually-failing node carries an error; dependents are
+    skipped without duplicating/misattributing the upstream traceback."""
+
+    @ct.electron
+    def boom():
+        raise ValueError("only-here")
+
+    @ct.electron
+    def downstream(x):
+        return x
+
+    @ct.lattice
+    def flow():
+        return downstream(boom())
+
+    result = ct.dispatch_sync(flow)()
+    assert result.status is ct.Status.FAILED
+    assert list(result.node_errors) == [0]
+    # one traceback, not one per downstream node
+    assert result.error.count("ValueError: only-here") == 1
+
+
+def test_positional_electron_call_keeps_executor():
+    marker = object()
+    e = ct.electron(lambda: 1, executor=marker)
+    assert e.executor is marker
+
+
+def test_get_result_unknown_id_raises():
+    with pytest.raises(ValueError, match="unknown dispatch_id"):
+        ct.get_result("nope")
+
+
+def test_tpu_alias_registered():
+    from covalent_tpu_plugin import TPUExecutor
+
+    executor = ct.resolve_executor("local")
+    assert isinstance(executor, ct.LocalExecutor)
+    assert ct.resolve_executor(TPUExecutor(transport="local")).transport_kind == "local"
