@@ -65,8 +65,18 @@ def run_task(spec: dict) -> int:
     """Execute one staged task described by ``spec``.  Returns the exit code."""
     result_file = spec["result_file"]
 
-    for key, value in (spec.get("env") or {}).items():
+    env = spec.get("env") or {}
+    for key, value in env.items():
         os.environ[key] = str(value)
+    if "JAX_PLATFORMS" in env:
+        # Env alone can lose to a site-level PJRT plugin registration that
+        # pins another platform; jax.config wins if set before first use.
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", str(env["JAX_PLATFORMS"]))
+        except Exception:
+            pass
 
     distributed = spec.get("distributed")
     process_id = int(distributed["process_id"]) if distributed else 0

@@ -1,0 +1,31 @@
+"""Model zoo for the BASELINE configs.
+
+The reference ships no models (its ML coverage is one sklearn SVM inside a
+functional test, ``tests/functional_tests/svm_workflow.py``); these are the
+electron payloads the TPU north star names: the MNIST CNN for the
+data-parallel v5e-8 config and a GPT-style 125M LM for the multi-host
+pretrain config, both written mesh-first so the same code spans one chip to
+a pod.
+"""
+
+from .mlp import MLP, MnistCNN, synthetic_mnist
+from .transformer import TransformerConfig, TransformerLM, lm_125m_config
+from .train import (
+    cross_entropy_loss,
+    make_lm_train_step,
+    make_sharded_train_state,
+    make_train_step,
+)
+
+__all__ = [
+    "MLP",
+    "MnistCNN",
+    "synthetic_mnist",
+    "TransformerConfig",
+    "TransformerLM",
+    "lm_125m_config",
+    "cross_entropy_loss",
+    "make_sharded_train_state",
+    "make_train_step",
+    "make_lm_train_step",
+]

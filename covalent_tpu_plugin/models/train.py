@@ -1,0 +1,128 @@
+"""Sharded training: state construction and jitted train steps.
+
+The flax scale-up recipe, packaged: ``jax.eval_shape`` the state, read the
+logical axis names off the boxed params, translate them to NamedShardings
+through the rules, then jit init and step with explicit in/out shardings and
+donated state.  Everything under ``jit`` — no data-dependent Python control
+flow; XLA sees one static graph per (mesh, shapes) pair and inserts all
+collectives (gradient psum over data axes, all-gathers for fsdp, etc.).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from flax.training import train_state
+from jax.sharding import Mesh
+
+from ..parallel.sharding import DEFAULT_RULES, replicated
+
+
+class TrainState(train_state.TrainState):
+    """flax TrainState (params + optax state + step)."""
+
+
+def cross_entropy_loss(
+    logits: jax.Array, labels: jax.Array, mask: jax.Array | None = None
+) -> jax.Array:
+    """Mean softmax cross-entropy in float32."""
+    logits = logits.astype(jnp.float32)
+    losses = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    if mask is not None:
+        return (losses * mask).sum() / jnp.maximum(mask.sum(), 1)
+    return losses.mean()
+
+
+def make_sharded_train_state(
+    model: nn.Module,
+    tx: optax.GradientTransformation,
+    rng: jax.Array,
+    sample_input: Any,
+    mesh: Mesh,
+    rules=DEFAULT_RULES,
+) -> tuple[TrainState, Any]:
+    """Initialise a TrainState with every leaf placed per the logical rules.
+
+    Returns ``(state, state_shardings)``; the shardings pytree feeds the
+    train step's in/out shardings.  Parameters are materialised *directly
+    into their shards* (init under jit with out_shardings), so a model too
+    big for one host's memory still initialises.
+    """
+
+    def init_fn(rng):
+        variables = model.init(rng, sample_input)
+        return TrainState.create(
+            apply_fn=model.apply, params=variables["params"], tx=tx
+        )
+
+    abstract = jax.eval_shape(init_fn, rng)
+    logical_specs = nn.get_partition_spec(abstract)
+    with mesh:
+        shardings = nn.logical_to_mesh_sharding(logical_specs, mesh, list(rules))
+        state = jax.jit(init_fn, out_shardings=shardings)(rng)
+    return state, shardings
+
+
+def make_train_step(
+    loss_fn: Callable[[Any, Any, Any], jax.Array],
+    mesh: Mesh,
+    state_shardings: Any,
+    rules=DEFAULT_RULES,
+    donate_state: bool = True,
+) -> Callable[[TrainState, Any], tuple[TrainState, dict]]:
+    """Build the jitted sharded train step.
+
+    ``loss_fn(params, apply_fn, batch) -> scalar loss``.  The batch arrives
+    sharded over the data axes; gradients and metrics come out as the mesh
+    demands (XLA inserts the psums).  The state is donated — its buffers are
+    reused for the updated state, halving peak HBM.
+    """
+    def step(state: TrainState, batch: Any) -> tuple[TrainState, dict]:
+        with nn.logical_axis_rules(list(rules)):
+            def compute_loss(params):
+                return loss_fn(params, state.apply_fn, batch)
+
+            loss, grads = jax.value_and_grad(compute_loss)(state.params)
+            new_state = state.apply_gradients(grads=grads)
+            metrics = {
+                "loss": loss,
+                "grad_norm": optax.global_norm(grads),
+                "step": new_state.step,
+            }
+            return new_state, metrics
+
+    metrics_sharding = {
+        "loss": replicated(mesh),
+        "grad_norm": replicated(mesh),
+        "step": replicated(mesh),
+    }
+    return jax.jit(
+        step,
+        in_shardings=(state_shardings, None),
+        out_shardings=(state_shardings, metrics_sharding),
+        donate_argnums=(0,) if donate_state else (),
+    )
+
+
+def classifier_loss(params, apply_fn, batch):
+    logits = apply_fn({"params": params}, batch["image"])
+    return cross_entropy_loss(logits, batch["label"])
+
+
+def lm_loss(params, apply_fn, batch):
+    """Next-token loss over a {"tokens": (B, S)} batch."""
+    tokens = batch["tokens"]
+    logits = apply_fn({"params": params}, tokens[:, :-1])
+    return cross_entropy_loss(logits, tokens[:, 1:])
+
+
+def make_lm_train_step(mesh, state_shardings, rules=DEFAULT_RULES):
+    return make_train_step(lm_loss, mesh, state_shardings, rules)
+
+
+def make_classifier_train_step(mesh, state_shardings, rules=DEFAULT_RULES):
+    return make_train_step(classifier_loss, mesh, state_shardings, rules)

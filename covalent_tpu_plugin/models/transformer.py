@@ -1,0 +1,235 @@
+"""Decoder-only transformer LM, mesh-first (BASELINE config 5: 125M pretrain).
+
+Every parameter is annotated with *logical* axis names via
+``nn.with_partitioning``; the rules in
+:mod:`covalent_tpu_plugin.parallel.sharding` map them onto the physical
+mesh (heads/mlp/vocab -> ``tensor``, embed -> ``fsdp``, activations ->
+``batch``/``seq``), so the one module definition runs data-parallel on a
+single host or tensor+sequence-parallel across a pod with no code changes —
+XLA inserts the collectives.
+
+TPU-minded choices: bfloat16 activations (MXU-native), dimensions multiples
+of 128 (MXU tiling), RMSNorm + rotary embeddings (no learned position
+table), layers rolled up with ``nn.scan`` (one compiled block, weights
+stacked on a ``layers`` axis) and optionally rematerialised
+(``jax.checkpoint``) to trade FLOPs for HBM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import flash_attention, mha_reference, on_tpu
+from ..ops.ring_attention import sequence_parallel_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32768
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    max_seq: int = 1024
+    dtype: Any = jnp.bfloat16        # activations
+    param_dtype: Any = jnp.float32   # master weights
+    attention: str = "auto"          # auto | flash | reference | ring
+    remat: bool = False
+    scan_layers: bool = True
+    mesh: Any = None                 # required for attention="ring"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def lm_125m_config(**overrides) -> TransformerConfig:
+    """GPT-2-small-class preset (~125M params with a 32k vocab)."""
+    return TransformerConfig(**overrides)
+
+
+def _rotary(x: jax.Array, base: float = 10000.0) -> jax.Array:
+    """Rotary position embedding over (B, S, H, D) with D even."""
+    _, seq_len, _, head_dim = x.shape
+    half = head_dim // 2
+    freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = jnp.arange(seq_len, dtype=jnp.float32)[:, None] * freqs[None, :]
+    cos = jnp.cos(angles)[None, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[None, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+
+
+class RMSNorm(nn.Module):
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param(
+            "scale",
+            nn.with_partitioning(nn.initializers.ones_init(), ("embed",)),
+            (x.shape[-1],),
+            jnp.float32,
+        )
+        x32 = x.astype(jnp.float32)
+        norm = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + 1e-6)
+        return (norm * scale).astype(self.dtype)
+
+
+class Attention(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        dense = lambda name, features, axes: nn.DenseGeneral(  # noqa: E731
+            features=features,
+            axis=-1,
+            use_bias=False,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_partitioning(nn.initializers.normal(0.02), axes),
+            name=name,
+        )
+        q = dense("q_proj", (cfg.n_heads, cfg.head_dim), ("embed", "heads", "kv"))(x)
+        k = dense("k_proj", (cfg.n_heads, cfg.head_dim), ("embed", "heads", "kv"))(x)
+        v = dense("v_proj", (cfg.n_heads, cfg.head_dim), ("embed", "heads", "kv"))(x)
+        q = nn.with_logical_constraint(q, ("batch", "seq", "heads", "kv"))
+        k = nn.with_logical_constraint(k, ("batch", "seq", "heads", "kv"))
+        v = nn.with_logical_constraint(v, ("batch", "seq", "heads", "kv"))
+
+        q = _rotary(q)
+        k = _rotary(k)
+
+        # (B, S, H, D) -> (B, H, S, D) for the attention kernels
+        qh, kh, vh = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        impl = cfg.attention
+        if impl == "auto":
+            impl = "flash" if on_tpu() else "reference"
+        if impl == "ring":
+            if cfg.mesh is None:
+                raise ValueError("attention='ring' requires config.mesh")
+            out = sequence_parallel_attention(qh, kh, vh, cfg.mesh, causal=True)
+        elif impl == "flash":
+            out = flash_attention(qh, kh, vh, causal=True)
+        else:
+            out = mha_reference(qh, kh, vh, causal=True)
+        out = out.transpose(0, 2, 1, 3)
+
+        out = nn.DenseGeneral(
+            features=cfg.d_model,
+            axis=(-2, -1),
+            use_bias=False,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            # residual-output kernel: depth-scaled init (GPT-2 convention,
+            # matching MlpBlock's wo) keeps residual-stream variance flat
+            kernel_init=nn.with_partitioning(
+                nn.initializers.normal(0.02 / (2 * cfg.n_layers) ** 0.5),
+                ("heads", "kv", "embed"),
+            ),
+            name="out_proj",
+        )(out)
+        return nn.with_logical_constraint(out, ("batch", "seq", "embed"))
+
+
+class MlpBlock(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        h = nn.DenseGeneral(
+            features=cfg.d_ff,
+            use_bias=False,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_partitioning(nn.initializers.normal(0.02), ("embed", "mlp")),
+            name="wi",
+        )(x)
+        h = nn.with_logical_constraint(h, ("batch", "seq", "mlp"))
+        h = nn.gelu(h)
+        h = nn.DenseGeneral(
+            features=cfg.d_model,
+            use_bias=False,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_partitioning(
+                nn.initializers.normal(0.02 / (2 * cfg.n_layers) ** 0.5), ("mlp", "embed")
+            ),
+            name="wo",
+        )(h)
+        return nn.with_logical_constraint(h, ("batch", "seq", "embed"))
+
+
+class Block(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        x = x + Attention(self.config, name="attention")(
+            RMSNorm(self.config.dtype, name="ln_attn")(x)
+        )
+        x = x + MlpBlock(self.config, name="mlp")(
+            RMSNorm(self.config.dtype, name="ln_mlp")(x)
+        )
+        return nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+
+
+class TransformerLM(nn.Module):
+    """Causal LM: tokens (B, S) -> logits (B, S, vocab)."""
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.config
+        if tokens.shape[-1] > cfg.max_seq:
+            raise ValueError(
+                f"sequence length {tokens.shape[-1]} exceeds config.max_seq "
+                f"{cfg.max_seq}"
+            )
+        embedding = self.param(
+            "embedding",
+            nn.with_partitioning(nn.initializers.normal(0.02), ("vocab", "embed")),
+            (cfg.vocab_size, cfg.d_model),
+            cfg.param_dtype,
+        )
+        x = jnp.asarray(embedding, cfg.dtype)[tokens]
+        x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+
+        block_cls = Block
+        if cfg.remat:
+            block_cls = nn.remat(Block, prevent_cse=False)
+        if cfg.scan_layers:
+            x, _ = nn.scan(
+                lambda module, carry, _: (module(carry), None),
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=cfg.n_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(block_cls(cfg, name="layers"), x, None)
+        else:
+            for i in range(cfg.n_layers):
+                x = block_cls(cfg, name=f"layer_{i}")(x)
+
+        x = RMSNorm(cfg.dtype, name="ln_final")(x)
+        logits = nn.DenseGeneral(
+            features=cfg.vocab_size,
+            use_bias=False,
+            dtype=jnp.float32,  # final logits in f32 for a stable softmax
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_partitioning(nn.initializers.normal(0.02), ("embed", "vocab")),
+            name="lm_head",
+        )(x)
+        return nn.with_logical_constraint(logits, ("batch", "seq", "vocab"))
+
+    def parameter_count(self, params) -> int:
+        return sum(
+            leaf.size for leaf in jax.tree_util.tree_leaves(params)
+        )

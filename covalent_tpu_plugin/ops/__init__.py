@@ -1,0 +1,13 @@
+"""TPU compute ops: fused attention kernels and sequence-parallel attention.
+
+The reference contains no kernels or model code whatsoever (SURVEY §2 —
+100% Python control-plane).  These ops are the compute layer the TPU north
+star runs inside electrons: a Pallas flash-attention kernel for the MXU hot
+path and a ring-attention implementation for long-context sequence
+parallelism over the mesh's ``seq`` axis.
+"""
+
+from .attention import flash_attention, mha_reference
+from .ring_attention import ring_attention
+
+__all__ = ["flash_attention", "mha_reference", "ring_attention"]

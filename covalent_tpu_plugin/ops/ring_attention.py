@@ -1,0 +1,127 @@
+"""Ring attention: sequence/context parallelism over the mesh ``seq`` axis.
+
+Long-context scaling the TPU-native way: Q, K, V are sharded along the
+sequence dimension across devices; each device keeps its query shard
+resident while K/V shards rotate around the ring via ``lax.ppermute`` (one
+ICI hop per step).  Partial attention results merge with the same online
+softmax used by the flash kernel, so the full S×S score matrix never exists
+on any one chip and per-device memory is O(S/n · S/n) per step.
+
+Run inside ``shard_map`` over a mesh with a ``seq`` axis — see
+``sequence_parallel_attention`` for the wrapped entry point.  The loop is a
+``lax.scan`` (not fori) so reverse-mode autodiff works for training.
+
+The reference has no model/sequence scaling at all (SURVEY §5 "long-context
+— ABSENT"); this module is a new capability mandated by the TPU north star.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, q_offset, k_offset, scale, causal):
+    """Score one (local-q, rotating-k) block pair; return (m, l, o) partials.
+
+    Shapes: q (B,H,Sq,D), k/v (B,H,Sk,D).  All f32 math.
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if causal:
+        s_q, s_k = q.shape[2], k.shape[2]
+        qi = q_offset + lax.broadcasted_iota(jnp.int32, (s_q, s_k), 0)
+        ki = k_offset + lax.broadcasted_iota(jnp.int32, (s_q, s_k), 1)
+        mask = qi >= ki
+        s = jnp.where(mask, s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)  # (B,H,Sq,1)
+    p = jnp.exp(s - m)
+    if causal:
+        p = jnp.where(mask, p, 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return m, l, o
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "seq",
+    causal: bool = True,
+    scale: float | None = None,
+) -> jax.Array:
+    """Per-shard body: call under ``shard_map`` with seq-sharded (B,H,S/n,D).
+
+    Step ``t`` holds the K/V shard that originated on device
+    ``(my_index - t) mod n``; after scoring, the shard is passed to the next
+    device in the ring.
+    """
+    n = lax.axis_size(axis_name)
+    my_index = lax.axis_index(axis_name)
+    seq_local = q.shape[2]
+    head_dim = q.shape[3]
+    scale = head_dim**-0.5 if scale is None else scale
+    q_offset = my_index * seq_local
+    q32 = q.astype(jnp.float32)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, t):
+        m_prev, l_prev, acc_prev, k_cur, v_cur = carry
+        src = jnp.mod(my_index - t, n)
+        k_offset = src * seq_local
+        m_blk, l_blk, o_blk = _block_attend(
+            q32, k_cur, v_cur, q_offset, k_offset, scale, causal
+        )
+        m_new = jnp.maximum(m_prev, m_blk)
+        alpha_prev = jnp.exp(m_prev - m_new)
+        alpha_blk = jnp.exp(m_blk - m_new)
+        l_new = l_prev * alpha_prev + l_blk * alpha_blk
+        acc_new = acc_prev * alpha_prev + o_blk * alpha_blk
+        # Rotate K/V one hop around the ring (skipped result unused on the
+        # last step but keeps the scan body uniform; XLA overlaps the
+        # ppermute with the next step's einsum).
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return (m_new, l_new, acc_new, k_next, v_next), ()
+
+    shape = q32.shape[:3] + (1,)
+    m0 = jnp.full(shape, _NEG_INF, jnp.float32)
+    l0 = jnp.zeros(shape, jnp.float32)
+    acc0 = jnp.zeros(q32.shape, jnp.float32)
+    (m, l, acc, _, _), _ = lax.scan(
+        step, (m0, l0, acc0, k, v), jnp.arange(n)
+    )
+    return (acc / jnp.maximum(l, 1e-37)).astype(q.dtype)
+
+
+def sequence_parallel_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    causal: bool = True,
+    axis_name: str = "seq",
+    batch_axes: tuple[str, ...] = ("data", "fsdp"),
+    head_axis: str | None = "tensor",
+) -> jax.Array:
+    """Global entry: (B, H, S, D) arrays -> ring attention over ``mesh``.
+
+    Batch shards over the data axes, heads over tensor, sequence around the
+    ring — composing context parallelism with DP/TP in one shard_map.
+    """
+    spec = P(batch_axes, head_axis, axis_name, None)
+    ring = functools.partial(ring_attention, axis_name=axis_name, causal=causal)
+    return jax.shard_map(
+        ring,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

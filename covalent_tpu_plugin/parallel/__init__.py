@@ -1,0 +1,46 @@
+"""Parallelism layer: device meshes, logical shardings, collectives.
+
+The reference has **no** parallelism components (SURVEY §2.4 — exhaustively
+verified: no DP/TP/PP/SP/EP, no collectives; its only distributed dimension
+is task-level fan-out over SSH).  This subpackage is the TPU-native
+capability the north star adds: electrons scale *within* a task via
+``jax.sharding`` meshes + pjit/shard_map, with XLA emitting the ICI/DCN
+collectives — never hand-written NCCL-style calls.
+"""
+
+from .collectives import (
+    all_gather,
+    all_to_all,
+    psum,
+    reduce_scatter,
+    ring_permute,
+)
+from .distributed import coordinator_spec, process_info
+from .mesh import MeshPlan, auto_mesh, make_mesh
+from .sharding import (
+    DEFAULT_RULES,
+    batch_sharding,
+    logical_sharding,
+    param_shardings,
+    replicated,
+    shard_batch,
+)
+
+__all__ = [
+    "MeshPlan",
+    "auto_mesh",
+    "make_mesh",
+    "DEFAULT_RULES",
+    "logical_sharding",
+    "param_shardings",
+    "batch_sharding",
+    "shard_batch",
+    "replicated",
+    "psum",
+    "all_gather",
+    "all_to_all",
+    "reduce_scatter",
+    "ring_permute",
+    "process_info",
+    "coordinator_spec",
+]

@@ -1,0 +1,55 @@
+"""Collective wrappers for use inside ``shard_map`` regions.
+
+Thin, named-axis-explicit wrappers over the XLA collective primitives (the
+data plane the reference entirely lacks — its inter-node communication is
+SCP file copies, ``covalent_ssh_plugin/ssh.py:360-361,451``).  Centralising
+them keeps axis-name plumbing in one place and gives the simulated-mesh test
+tier a single surface to pin down semantics.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def psum(x, axis_name: str):
+    """Sum across the named mesh axis (rides ICI within a slice)."""
+    return lax.psum(x, axis_name)
+
+
+def all_gather(x, axis_name: str, *, axis: int = 0, tiled: bool = True):
+    """Gather shards along ``axis`` from every member of the mesh axis."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name: str, *, axis: int = 0):
+    """Sum-reduce then scatter shards along ``axis`` (ZeRO gradient path)."""
+    return lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+
+
+def all_to_all(x, axis_name: str, *, split_axis: int, concat_axis: int):
+    """Transpose shard ownership — the Ulysses-style sequence<->head swap."""
+    return lax.all_to_all(
+        x, axis_name, split_axis=split_axis, concat_axis=concat_axis, tiled=True
+    )
+
+
+def ring_permute(x, axis_name: str, *, shift: int = 1):
+    """Rotate shards around the mesh-axis ring (ring attention's K/V hop).
+
+    ``shift=+1`` sends to the next index; on a TPU torus neighbouring
+    logical ids are physical ICI neighbours, so each hop is one link.
+    """
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def axis_index(axis_name: str):
+    return lax.axis_index(axis_name)
+
+
+def axis_size(axis_name: str):
+    return lax.axis_size(axis_name)

@@ -1,0 +1,58 @@
+"""Multi-host data-plane bootstrap helpers.
+
+The harness performs the actual ``jax.distributed.initialize`` call from the
+task spec (``covalent_tpu_plugin/harness.py``); these helpers cover the two
+adjacent needs: electrons inspecting their place in the pod, and executors
+constructing the coordinator spec (SURVEY §2.4's "control plane arranges N
+processes with consistent coordinator_address/process_id so XLA can do the
+rest").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class ProcessInfo:
+    process_id: int
+    num_processes: int
+    local_device_count: int
+    global_device_count: int
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0
+
+
+def process_info() -> ProcessInfo:
+    """Where am I in the pod?  Callable from inside any electron."""
+    import jax
+
+    return ProcessInfo(
+        process_id=jax.process_index(),
+        num_processes=jax.process_count(),
+        local_device_count=jax.local_device_count(),
+        global_device_count=jax.device_count(),
+    )
+
+
+def coordinator_spec(
+    workers: list[str], port: int = 8476
+) -> list[dict]:
+    """Per-worker ``distributed`` spec blocks for the task spec files.
+
+    Worker 0's host is the rendezvous point; addresses may carry a
+    ``user@`` prefix on the control plane which is stripped for the data
+    plane.
+    """
+    host = workers[0].split("@", 1)[-1]
+    coordinator = f"{host}:{port}"
+    return [
+        {
+            "coordinator_address": coordinator,
+            "num_processes": len(workers),
+            "process_id": i,
+        }
+        for i in range(len(workers))
+    ]

@@ -1,0 +1,95 @@
+"""Device-mesh construction.
+
+Axis convention (order matters: outer axes map to DCN/slower links first,
+inner axes to ICI, per the standard TPU scaling recipe):
+
+* ``data``   — pure data parallelism (gradients psum'd)
+* ``fsdp``   — data parallelism with parameter sharding (weights gathered
+  just-in-time); batch is sharded over ``data × fsdp``
+* ``tensor`` — Megatron-style tensor parallelism inside layers
+* ``seq``    — sequence/context parallelism (ring attention)
+
+A dimension of 1 erases the axis's cost without changing program structure,
+so one train-step definition serves every topology from v5e-1 to multi-host
+pods.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+AXES = ("data", "fsdp", "tensor", "seq")
+
+
+@dataclass(frozen=True)
+class MeshPlan:
+    """A named factorisation of the device count over the standard axes."""
+
+    data: int = 1
+    fsdp: int = 1
+    tensor: int = 1
+    seq: int = 1
+
+    @property
+    def sizes(self) -> dict[str, int]:
+        return {"data": self.data, "fsdp": self.fsdp, "tensor": self.tensor, "seq": self.seq}
+
+    def total(self) -> int:
+        return self.data * self.fsdp * self.tensor * self.seq
+
+
+def make_mesh(plan: MeshPlan, devices=None):
+    """Build a ``jax.sharding.Mesh`` laid out per ``plan``.
+
+    Device order follows ``jax.devices()`` (XLA already orders a slice so
+    that adjacent logical ids are ICI neighbours); the *innermost* mesh axes
+    therefore get the tightest links — tensor/seq collectives ride ICI.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    if plan.total() > len(devices):
+        raise ValueError(
+            f"mesh plan {plan.sizes} needs {plan.total()} devices, got {len(devices)}"
+        )
+    array = np.array(devices[: plan.total()]).reshape(
+        plan.data, plan.fsdp, plan.tensor, plan.seq
+    )
+    return Mesh(array, AXES)
+
+
+def auto_mesh(
+    n_devices: int | None = None,
+    *,
+    tensor: int = 1,
+    seq: int = 1,
+    fsdp: int | None = None,
+    devices=None,
+):
+    """Pick a sensible plan for ``n_devices`` and build the mesh.
+
+    Model-parallel sizes (``tensor``, ``seq``) are explicit choices; the
+    remaining factor goes to ``data``, unless an explicit ``fsdp`` size
+    carves parameter-sharded data parallelism out of it.  Default —
+    everything on ``data`` — matches the MNIST data-parallel BASELINE
+    config.
+    """
+    import jax
+
+    devices = list(devices if devices is not None else jax.devices())
+    n = n_devices if n_devices is not None else len(devices)
+    devices = devices[:n]
+    if n % (tensor * seq) != 0:
+        raise ValueError(f"{n} devices not divisible by tensor*seq={tensor * seq}")
+    rest = n // (tensor * seq)
+    if fsdp is None:
+        data, fsdp_size = rest, 1
+    else:
+        if rest % fsdp != 0:
+            raise ValueError(f"residual {rest} not divisible by fsdp={fsdp}")
+        data, fsdp_size = rest // fsdp, fsdp
+    plan = MeshPlan(data=data, fsdp=fsdp_size, tensor=tensor, seq=seq)
+    return make_mesh(plan, devices)

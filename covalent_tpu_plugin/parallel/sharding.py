@@ -1,0 +1,108 @@
+"""Logical-axis sharding rules and helpers.
+
+Models annotate parameters with *logical* axis names (``"embed"``,
+``"heads"``, ...); these rules map them onto the physical mesh axes from
+:mod:`.mesh`.  XLA then inserts the all-gathers/psums/reduce-scatters — the
+framework never writes a collective for the forward/backward path (the
+scaling-book recipe: pick a mesh, annotate shardings, let XLA compile).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+#: logical axis -> mesh axis (or None = replicated).  t5x/Megatron-flavored:
+#: activation batch over the data axes, attention heads + MLP hidden +
+#: vocab over tensor, embed over fsdp (ZeRO-style parameter sharding),
+#: activation sequence over seq (context parallelism).
+DEFAULT_RULES: tuple[tuple[str, Any], ...] = (
+    ("batch", ("data", "fsdp")),
+    ("seq", "seq"),
+    ("embed", "fsdp"),
+    ("heads", "tensor"),
+    ("kv", None),
+    ("mlp", "tensor"),
+    ("vocab", "tensor"),
+    ("layers", None),
+)
+
+
+def _mesh_axes_for(logical_name: str | None, rules) -> Any:
+    if logical_name is None:
+        return None
+    for name, mesh_axes in rules:
+        if name == logical_name:
+            return mesh_axes
+    return None
+
+
+def logical_spec(logical_axes: tuple[str | None, ...], rules=DEFAULT_RULES) -> P:
+    """Translate a tuple of logical axis names into a PartitionSpec."""
+    return P(*(_mesh_axes_for(name, rules) for name in logical_axes))
+
+
+def logical_sharding(
+    mesh: Mesh, logical_axes: tuple[str | None, ...], rules=DEFAULT_RULES
+) -> NamedSharding:
+    return NamedSharding(mesh, logical_spec(logical_axes, rules))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, rules=DEFAULT_RULES) -> NamedSharding:
+    """Sharding for a leading batch dimension (data×fsdp)."""
+    return logical_sharding(mesh, ("batch",), rules)
+
+
+def shard_batch(batch: Any, mesh: Mesh, rules=DEFAULT_RULES) -> Any:
+    """Place a host batch pytree onto the mesh, sharded on dim 0.
+
+    Works for any leaf rank: dim 0 is the batch dim, the rest replicated.
+    """
+
+    def place(x):
+        x = jax.numpy.asarray(x)
+        if x.ndim == 0:  # scalars (step counters, loss weights) replicate
+            return jax.device_put(x, replicated(mesh))
+        spec = logical_spec(("batch",) + (None,) * (x.ndim - 1), rules)
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(place, batch)
+
+
+def param_shardings(params: Any, mesh: Mesh, rules=DEFAULT_RULES) -> Any:
+    """NamedShardings for a pytree of (possibly boxed) parameters.
+
+    Leaves carrying flax logical-axis metadata (``nn.Partitioned`` via
+    ``nn.with_partitioning``) shard per the rules; plain leaves replicate.
+    Accepts either real params or ``jax.eval_shape`` abstractions.
+    """
+    import flax.linen as nn
+
+    def to_sharding(leaf):
+        names = getattr(leaf, "names", None)
+        if names is not None:
+            return logical_sharding(mesh, tuple(names), rules)
+        return replicated(mesh)
+
+    return jax.tree_util.tree_map(
+        to_sharding,
+        params,
+        is_leaf=lambda x: isinstance(x, nn.Partitioned),
+    )
+
+
+def unbox(params: Any) -> Any:
+    """Strip flax Partitioned boxes, returning raw arrays."""
+    import flax.linen as nn
+
+    return jax.tree_util.tree_map(
+        lambda x: x.value if isinstance(x, nn.Partitioned) else x,
+        params,
+        is_leaf=lambda x: isinstance(x, nn.Partitioned),
+    )

@@ -144,6 +144,7 @@ class TPUExecutor(RemoteExecutor):
         strict_host_keys: bool | None = None,
         coordinator_port: int | None = None,
         task_timeout: float | None = None,
+        task_env: dict[str, str] | None = None,
         pool: TransportPool | None = None,
     ) -> None:
         def resolve(value, key):
@@ -179,6 +180,9 @@ class TPUExecutor(RemoteExecutor):
         self.strict_host_keys = bool(resolve(strict_host_keys, "strict_host_keys"))
         self.coordinator_port = int(resolve(coordinator_port, "coordinator_port"))
         self.task_timeout = float(resolve(task_timeout, "task_timeout"))
+        #: extra environment for the remote harness process (e.g.
+        #: LIBTPU_INIT_ARGS, JAX_PLATFORMS) — travels in the task spec.
+        self.task_env = dict(task_env or {})
 
         resolved_poll_freq = float(resolve(poll_freq, "poll_freq"))
         resolved_remote_cache = resolve(remote_cache, "remote_cache")
@@ -335,6 +339,8 @@ class TPUExecutor(RemoteExecutor):
                 "result_file": staged.remote_result_file,
                 "workdir": current_remote_workdir,
             }
+            if self.task_env:
+                spec["env"] = self.task_env
             if num_processes > 1:
                 spec["distributed"] = {
                     "coordinator_address": self._coordinator_address(),

@@ -11,12 +11,21 @@ initializes its backends, hence module level, before any test imports jax.
 import asyncio
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The test tier always runs on the virtual CPU mesh, even in sandboxes whose
+# sitecustomize force-registers a TPU platform: the env var alone can be
+# overridden by that registration, so pin the platform via jax.config too
+# (must happen before first backend use).
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 # Isolate the config system from any real user config file.
 os.environ.setdefault("COVALENT_TPU_CONFIG", "/tmp/covalent-tpu-test-config.toml")

@@ -1,0 +1,171 @@
+"""Model + sharded-train-step tests on the 8-device CPU mesh.
+
+Covers the BASELINE shapes: MNIST data-parallel training (config 4) and the
+transformer LM under real dp/fsdp/tp shardings (config 5's single-host
+analog).  Tiny dimensions keep the tier fast; the structure (mesh, rules,
+scan, remat) is exactly what runs at size on TPU.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from covalent_tpu_plugin.models import (
+    MLP,
+    MnistCNN,
+    TransformerConfig,
+    TransformerLM,
+    synthetic_mnist,
+)
+from covalent_tpu_plugin.models.train import (
+    classifier_loss,
+    cross_entropy_loss,
+    lm_loss,
+    make_sharded_train_state,
+    make_train_step,
+)
+from covalent_tpu_plugin.parallel import MeshPlan, make_mesh, shard_batch
+
+TINY_LM = TransformerConfig(
+    vocab_size=256,
+    d_model=64,
+    n_layers=2,
+    n_heads=4,
+    d_ff=128,
+    max_seq=32,
+    dtype=jnp.float32,
+    attention="reference",
+)
+
+
+def test_synthetic_mnist_shapes_and_determinism():
+    batch = synthetic_mnist(32, seed=7)
+    again = synthetic_mnist(32, seed=7)
+    assert batch["image"].shape == (32, 28, 28, 1)
+    assert batch["label"].shape == (32,)
+    np.testing.assert_array_equal(batch["image"], again["image"])
+
+
+def test_mlp_and_cnn_forward():
+    batch = synthetic_mnist(4)
+    for model in (MLP(), MnistCNN()):
+        params = model.init(jax.random.PRNGKey(0), batch["image"])
+        logits = model.apply(params, batch["image"])
+        assert logits.shape == (4, 10)
+
+
+def test_cross_entropy_masked():
+    logits = jnp.zeros((2, 3, 5))
+    labels = jnp.zeros((2, 3), jnp.int32)
+    mask = jnp.array([[1, 1, 0], [0, 0, 0]], jnp.float32)
+    loss = cross_entropy_loss(logits, labels, mask)
+    np.testing.assert_allclose(float(loss), np.log(5), rtol=1e-5)
+
+
+def test_mnist_data_parallel_training_loss_decreases():
+    mesh = make_mesh(MeshPlan(data=8))
+    model = MLP(features=(64,))
+    batch = shard_batch(synthetic_mnist(64, seed=1), mesh)
+    state, shardings = make_sharded_train_state(
+        model, optax.adam(1e-2), jax.random.PRNGKey(0), batch["image"], mesh
+    )
+    step = make_train_step(classifier_loss, mesh, shardings)
+    losses = []
+    for i in range(10):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] * 0.7, losses
+    assert int(state.step) == 10
+
+
+def test_lm_forward_shape_and_param_sharding():
+    mesh = make_mesh(MeshPlan(data=2, fsdp=2, tensor=2))
+    model = TransformerLM(TINY_LM)
+    tokens = shard_batch(np.zeros((8, 16), np.int32), mesh)
+    state, shardings = make_sharded_train_state(
+        model, optax.adamw(1e-3), jax.random.PRNGKey(0), tokens, mesh
+    )
+    # scanned layers: params stacked on the layers axis
+    attn_kernel = state.params["layers"]["attention"]["q_proj"]["kernel"]
+    assert attn_kernel.value.shape == (2, 64, 4, 16)  # (layers, embed, heads, kv)
+    # heads sharded over tensor, embed over fsdp (DEFAULT_RULES)
+    assert attn_kernel.value.sharding.spec == P(None, "fsdp", "tensor", None)
+    embedding = state.params["embedding"]
+    assert embedding.value.sharding.spec == P("tensor", "fsdp")
+
+    with mesh:
+        logits = model.apply({"params": state.params}, tokens)
+    assert logits.shape == (8, 16, 256)
+
+
+@pytest.mark.parametrize("remat", [False, True])
+def test_lm_train_step_dp_fsdp_tp(remat):
+    mesh = make_mesh(MeshPlan(data=2, fsdp=2, tensor=2))
+    cfg = TransformerConfig(
+        vocab_size=128,
+        d_model=32,
+        n_layers=2,
+        n_heads=2,
+        d_ff=64,
+        dtype=jnp.float32,
+        attention="reference",
+        remat=remat,
+    )
+    model = TransformerLM(cfg)
+    rng = np.random.default_rng(0)
+    batch = shard_batch(
+        {"tokens": rng.integers(0, 128, size=(8, 17)).astype(np.int32)}, mesh
+    )
+    state, shardings = make_sharded_train_state(
+        model, optax.adamw(1e-2), jax.random.PRNGKey(0), batch["tokens"][:, :-1], mesh
+    )
+    step = make_train_step(lm_loss, mesh, shardings)
+    losses = []
+    for _ in range(5):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+
+def test_lm_ring_attention_trains_on_seq_mesh():
+    """Context parallelism through the whole model: mesh with a seq axis,
+    attention='ring', one train step runs and matches the reference-attention
+    loss on the same init."""
+    mesh = make_mesh(MeshPlan(data=2, seq=4))
+    base = dict(
+        vocab_size=128, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+        dtype=jnp.float32, scan_layers=True,
+    )
+    rng = np.random.default_rng(1)
+    tokens = rng.integers(0, 128, size=(4, 17)).astype(np.int32)
+
+    losses = {}
+    for impl in ("reference", "ring"):
+        cfg = TransformerConfig(
+            **base, attention=impl, mesh=mesh if impl == "ring" else None
+        )
+        model = TransformerLM(cfg)
+        batch = shard_batch({"tokens": tokens}, mesh)
+        state, shardings = make_sharded_train_state(
+            model, optax.adamw(1e-2), jax.random.PRNGKey(0), batch["tokens"][:, :-1], mesh
+        )
+        step = make_train_step(lm_loss, mesh, shardings)
+        _, metrics = step(state, batch)
+        losses[impl] = float(metrics["loss"])
+    np.testing.assert_allclose(losses["ring"], losses["reference"], rtol=1e-4)
+
+
+def test_lm_unscanned_matches_structure():
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=16, n_layers=2, n_heads=2, d_ff=32,
+        dtype=jnp.float32, attention="reference", scan_layers=False,
+    )
+    model = TransformerLM(cfg)
+    tokens = jnp.zeros((2, 8), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), tokens)
+    assert "layer_0" in variables["params"] and "layer_1" in variables["params"]
+    assert model.apply(variables, tokens).shape == (2, 8, 64)

@@ -1,0 +1,137 @@
+"""Mesh construction, logical shardings, and collective semantics on the
+8-device CPU mesh (SURVEY §4.2c test tier)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from covalent_tpu_plugin.parallel import (
+    MeshPlan,
+    all_gather,
+    all_to_all,
+    auto_mesh,
+    batch_sharding,
+    make_mesh,
+    psum,
+    reduce_scatter,
+    ring_permute,
+    shard_batch,
+)
+from covalent_tpu_plugin.parallel.distributed import coordinator_spec
+from covalent_tpu_plugin.parallel.mesh import AXES
+
+
+def test_mesh_plan_and_axes():
+    mesh = make_mesh(MeshPlan(data=2, fsdp=2, tensor=2))
+    assert mesh.axis_names == AXES
+    assert mesh.shape == {"data": 2, "fsdp": 2, "tensor": 2, "seq": 1}
+
+
+def test_mesh_plan_wrong_device_count():
+    with pytest.raises(ValueError, match="needs 16 devices"):
+        make_mesh(MeshPlan(data=16))
+
+
+def test_auto_mesh_defaults_to_data_parallel():
+    mesh = auto_mesh()
+    assert mesh.shape["data"] == 8
+
+
+def test_auto_mesh_with_model_axes():
+    mesh = auto_mesh(tensor=2, seq=2)
+    assert mesh.shape == {"data": 2, "fsdp": 1, "tensor": 2, "seq": 2}
+    with pytest.raises(ValueError, match="not divisible"):
+        auto_mesh(tensor=3)
+
+
+def test_shard_batch_places_on_data_axes():
+    mesh = make_mesh(MeshPlan(data=4, fsdp=2))
+    batch = {"x": np.ones((16, 8), np.float32), "y": np.ones((16,), np.int32)}
+    placed = shard_batch(batch, mesh)
+    sharding = placed["x"].sharding
+    assert isinstance(sharding, NamedSharding)
+    assert sharding.spec == P(("data", "fsdp"), None)
+    # each device holds 16/8 = 2 rows
+    assert placed["x"].addressable_shards[0].data.shape == (2, 8)
+    assert batch_sharding(mesh).spec == P(("data", "fsdp"))
+
+
+def test_shard_batch_replicates_scalar_leaves():
+    mesh = make_mesh(MeshPlan(data=8))
+    placed = shard_batch({"x": np.ones((8, 4), np.float32), "step": np.float32(3.0)}, mesh)
+    assert placed["step"].sharding.spec == P()
+    assert float(placed["step"]) == 3.0
+
+
+def collective_run(mesh, fn, x, in_spec, out_spec, axis):
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec, check_vma=False
+    )(x)
+
+
+def test_psum_semantics():
+    mesh = make_mesh(MeshPlan(data=8))
+    x = jnp.arange(8.0)
+    total = collective_run(
+        mesh, lambda s: psum(s, "data"), x, P("data"), P("data"), "data"
+    )
+    np.testing.assert_allclose(np.asarray(total), np.full(8, 28.0))
+
+
+def test_all_gather_semantics():
+    mesh = make_mesh(MeshPlan(data=8))
+    x = jnp.arange(8.0)
+    gathered = collective_run(
+        mesh, lambda s: all_gather(s, "data"), x, P("data"), P(None), "data"
+    )
+    np.testing.assert_allclose(np.asarray(gathered), np.arange(8.0))
+
+
+def test_reduce_scatter_semantics():
+    mesh = make_mesh(MeshPlan(data=4))
+    # each shard holds the full row; reduce_scatter sums then splits
+    x = jnp.tile(jnp.arange(4.0), (4, 1))  # (4 shards, 4)
+    out = collective_run(
+        mesh,
+        lambda s: reduce_scatter(s[0], "data"),
+        x.reshape(4, 4),
+        P("data", None),
+        P("data"),
+        "data",
+    )
+    np.testing.assert_allclose(np.asarray(out), np.arange(4.0) * 4)
+
+
+def test_ring_permute_rotates():
+    mesh = make_mesh(MeshPlan(data=8))
+    x = jnp.arange(8.0)
+    rotated = collective_run(
+        mesh, lambda s: ring_permute(s, "data", shift=1), x, P("data"), P("data"), "data"
+    )
+    np.testing.assert_allclose(np.asarray(rotated), np.roll(np.arange(8.0), 1))
+
+
+def test_all_to_all_transposes_ownership():
+    mesh = make_mesh(MeshPlan(data=4))
+    x = jnp.arange(16.0).reshape(4, 4)  # device i owns row i
+    out = collective_run(
+        mesh,
+        lambda s: all_to_all(s, "data", split_axis=1, concat_axis=0),
+        x,
+        P("data", None),
+        P(None, "data"),
+        "data",
+    )
+    np.testing.assert_allclose(np.asarray(out), np.arange(16.0).reshape(4, 4).T.reshape(4, 4).T)
+
+
+def test_coordinator_spec():
+    specs = coordinator_spec(["alice@w0", "w1"], port=9999)
+    assert specs[0] == {
+        "coordinator_address": "w0:9999",
+        "num_processes": 2,
+        "process_id": 0,
+    }
+    assert specs[1]["process_id"] == 1
