@@ -11,7 +11,10 @@ a pod.
 from .mlp import MLP, MnistCNN, synthetic_mnist
 from .transformer import TransformerConfig, TransformerLM, lm_125m_config
 from .train import (
+    classifier_loss,
     cross_entropy_loss,
+    lm_loss,
+    make_classifier_train_step,
     make_lm_train_step,
     make_sharded_train_state,
     make_train_step,
@@ -25,7 +28,10 @@ __all__ = [
     "TransformerLM",
     "lm_125m_config",
     "cross_entropy_loss",
+    "classifier_loss",
+    "lm_loss",
     "make_sharded_train_state",
     "make_train_step",
     "make_lm_train_step",
+    "make_classifier_train_step",
 ]

@@ -22,7 +22,9 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..parallel.collectives import ring_permute
 
 _NEG_INF = -1e30
 
@@ -70,8 +72,6 @@ def ring_attention(
     q_offset = my_index * seq_local
     q32 = q.astype(jnp.float32)
 
-    perm = [(i, (i + 1) % n) for i in range(n)]
-
     def step(carry, t):
         m_prev, l_prev, acc_prev, k_cur, v_cur = carry
         src = jnp.mod(my_index - t, n)
@@ -87,8 +87,8 @@ def ring_attention(
         # Rotate K/V one hop around the ring (skipped result unused on the
         # last step but keeps the scan body uniform; XLA overlaps the
         # ppermute with the next step's einsum).
-        k_next = lax.ppermute(k_cur, axis_name, perm)
-        v_next = lax.ppermute(v_cur, axis_name, perm)
+        k_next = ring_permute(k_cur, axis_name, shift=1)
+        v_next = ring_permute(v_cur, axis_name, shift=1)
         return (m_new, l_new, acc_new, k_next, v_next), ()
 
     shape = q32.shape[:3] + (1,)

@@ -9,8 +9,6 @@ tier a single surface to pin down semantics.
 
 from __future__ import annotations
 
-import jax
-import jax.numpy as jnp
 from jax import lax
 
 

@@ -78,6 +78,7 @@ _EXECUTOR_PLUGIN_DEFAULTS = {
     "strict_host_keys": True,
     "coordinator_port": 8476,
     "task_timeout": 0.0,
+    "task_env": {},
 }
 
 
@@ -182,7 +183,7 @@ class TPUExecutor(RemoteExecutor):
         self.task_timeout = float(resolve(task_timeout, "task_timeout"))
         #: extra environment for the remote harness process (e.g.
         #: LIBTPU_INIT_ARGS, JAX_PLATFORMS) — travels in the task spec.
-        self.task_env = dict(task_env or {})
+        self.task_env = dict(resolve(task_env, "task_env") or {})
 
         resolved_poll_freq = float(resolve(poll_freq, "poll_freq"))
         resolved_remote_cache = resolve(remote_cache, "remote_cache")
