@@ -32,6 +32,30 @@ import os
 import sys
 
 
+def install_pip_deps(pip_deps: list) -> None:
+    """Install an electron's pip dependencies; raise RuntimeError on failure.
+
+    Shared contract between this worker harness and the in-process
+    LocalExecutor (reference ct.DepsPip, svm_workflow.py:6,19).  The
+    command is overridable via ``COVALENT_TPU_PIP_CMD`` for sandboxed test
+    environments.
+    """
+    import shlex
+    import subprocess
+
+    pip_cmd = shlex.split(
+        os.environ.get("COVALENT_TPU_PIP_CMD", "")
+    ) or [sys.executable, "-m", "pip", "install"]
+    proc = subprocess.run(
+        pip_cmd + list(pip_deps), capture_output=True, text=True
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"pip dependency install failed "
+            f"({' '.join(pip_deps)}): {proc.stderr.strip()}"
+        )
+
+
 def _fallback_result(result_file: str, error: BaseException) -> None:
     """Best-effort ``(None, error)`` write with stdlib pickle, mirroring the
     reference's cloudpickle-ImportError path (``exec.py:16-24``)."""
@@ -80,6 +104,21 @@ def run_task(spec: dict) -> int:
 
     distributed = spec.get("distributed")
     process_id = int(distributed["process_id"]) if distributed else 0
+
+    pip_deps = spec.get("pip_deps") or []
+    if pip_deps:
+        # Install BEFORE loading the function pickle — unpickling may import
+        # the dependency (reference ct.DepsPip, svm_workflow.py:6,19).  A
+        # non-zero process that fails here exits 1 *before* the distributed
+        # barrier; the dispatcher's poller watches every worker's liveness
+        # and fails the task fast instead of letting process 0 hang in
+        # jax.distributed.initialize.
+        try:
+            install_pip_deps(pip_deps)
+        except RuntimeError as pip_error:
+            if process_id == 0:
+                _fallback_result(result_file, pip_error)
+            return 1
 
     try:
         import cloudpickle as pickle

@@ -322,6 +322,7 @@ class TPUExecutor(RemoteExecutor):
         args: tuple,
         kwargs: dict,
         current_remote_workdir: str,
+        pip_deps: Sequence[str] = (),
     ) -> StagedTask:
         """Stage the function pickle + per-worker task specs locally.
 
@@ -342,6 +343,8 @@ class TPUExecutor(RemoteExecutor):
             }
             if self.task_env:
                 spec["env"] = self.task_env
+            if pip_deps:
+                spec["pip_deps"] = list(pip_deps)
             if num_processes > 1:
                 spec["distributed"] = {
                     "coordinator_address": self._coordinator_address(),
@@ -477,28 +480,89 @@ class TPUExecutor(RemoteExecutor):
                 f"status probe on {conn.address} failed: {result.stderr.strip()!r}"
             )
 
-    async def _poll_task(
-        self, conn: Transport, remote_result_file: str, pid: int | None = None
-    ) -> TaskStatus:
-        """Wait for the result with adaptive backoff.
+    async def _wait_while_running(
+        self,
+        probe: Callable,
+        timeout: float | None = None,
+    ) -> tuple[TaskStatus, int]:
+        """Adaptive-backoff wait shared by every poller.
 
-        Replaces the reference's fixed 15 s × 5-retry loop (ssh.py:408-432):
-        the interval starts at 50 ms and doubles up to ``poll_freq``, so
-        short electrons pay milliseconds of latency, not seconds, and there
-        is no artificial retry ceiling — a live process keeps being awaited
-        (bounded by ``task_timeout`` when set).
+        Calls ``probe() -> (status, blamed_worker)`` until it stops
+        reporting RUNNING.  Replaces the reference's fixed 15 s × 5-retry
+        loop (ssh.py:408-432): the interval starts at 50 ms and doubles up
+        to ``poll_freq``, so short electrons pay milliseconds of latency,
+        not seconds, and there is no artificial retry ceiling — a live
+        process keeps being awaited.  When ``timeout`` (default
+        ``task_timeout``; 0 disables) elapses, returns the last RUNNING
+        status and lets the caller decide what a timeout means.
         """
+        if timeout is None:
+            timeout = self.task_timeout
         interval = 0.05
         waited = 0.0
         while True:
-            status = await self.get_status(conn, remote_result_file, pid)
+            status, blamed = await probe()
             if status is not TaskStatus.RUNNING:
-                return status
-            if self.task_timeout and waited >= self.task_timeout:
-                return TaskStatus.DEAD
+                return status, blamed
+            if timeout and waited >= timeout:
+                return TaskStatus.RUNNING, blamed
             await asyncio.sleep(interval)
             waited += interval
             interval = min(interval * 2, float(self.poll_freq))
+
+    async def _poll_task(
+        self, conn: Transport, remote_result_file: str, pid: int | None = None
+    ) -> TaskStatus:
+        """Wait for one worker's result; a timeout counts as DEAD."""
+
+        async def probe() -> tuple[TaskStatus, int]:
+            return await self.get_status(conn, remote_result_file, pid), 0
+
+        status, _ = await self._wait_while_running(probe)
+        return TaskStatus.DEAD if status is TaskStatus.RUNNING else status
+
+    async def _poll_all(
+        self, conns: list[Transport], staged: StagedTask, pids: dict[str, int]
+    ) -> tuple[TaskStatus, int]:
+        """Wait for worker 0's result while watching every worker's liveness.
+
+        Returns ``(status, worker_index)`` where the index identifies which
+        worker to blame for a non-READY outcome.  A non-zero worker that
+        dies before the distributed barrier (e.g. a failed pip install)
+        would otherwise leave process 0 hung in
+        ``jax.distributed.initialize`` until its coordination timeout; this
+        poller turns that into a fast, correctly-attributed failure
+        (all-or-nothing semantics, SURVEY §5 failure detection).
+        """
+        addresses = self._worker_addresses()
+
+        async def probe() -> tuple[TaskStatus, int]:
+            statuses = await asyncio.gather(
+                self.get_status(
+                    conns[0], staged.remote_result_file, pids.get(addresses[0])
+                ),
+                *(
+                    # Workers 1..N-1 are "done" at their marker file — same
+                    # probe shape as worker 0's result file.
+                    self.get_status(
+                        conns[i],
+                        f"{staged.remote_result_file}.done.{i}",
+                        pids.get(addresses[i]),
+                    )
+                    for i in range(1, len(conns))
+                ),
+            )
+            if statuses[0] is not TaskStatus.RUNNING:
+                return statuses[0], 0
+            for i, status in enumerate(statuses[1:], start=1):
+                if status is TaskStatus.DEAD:
+                    return TaskStatus.DEAD, i
+            return TaskStatus.RUNNING, 0
+
+        status, blamed = await self._wait_while_running(probe)
+        return (
+            (TaskStatus.DEAD, 0) if status is TaskStatus.RUNNING else (status, blamed)
+        )
 
     async def query_result(
         self, conn: Transport, staged: StagedTask
@@ -619,7 +683,12 @@ class TPUExecutor(RemoteExecutor):
 
             with timer.stage("stage"):
                 staged = self._write_function_files(
-                    operation_id, function, args, kwargs, current_remote_workdir
+                    operation_id,
+                    function,
+                    args,
+                    kwargs,
+                    current_remote_workdir,
+                    pip_deps=task_metadata.get("pip_deps", ()),
                 )
             with timer.stage("upload"):
                 await asyncio.gather(
@@ -638,17 +707,15 @@ class TPUExecutor(RemoteExecutor):
             addresses = self._worker_addresses()
             try:
                 with timer.stage("execute"):
-                    status = await self._poll_task(
-                        conns[0], staged.remote_result_file, pids.get(addresses[0])
-                    )
+                    status, blamed = await self._poll_all(conns, staged, pids)
                 if status is not TaskStatus.READY:
-                    log_tail = await self._remote_log_tail(conns[0], staged)
+                    log_tail = await self._remote_log_tail(conns[blamed], staged)
                     await self.cancel(operation_id)
                     return self._on_dispatch_fail(
                         function,
                         args,
                         kwargs,
-                        f"remote task {operation_id} failed on {addresses[0]} "
+                        f"remote task {operation_id} failed on {addresses[blamed]} "
                         f"({status.value}); log tail:\n{log_tail}",
                     )
 
@@ -733,20 +800,18 @@ class TPUExecutor(RemoteExecutor):
         async def reap(process_id: int, conn: Transport, address: str) -> None:
             pid = pids.get(address)
             marker = f"{staged.remote_result_file}.done.{process_id}"
-            probe = (
-                f"if test -f {shlex.quote(marker)}; then echo READY; "
-                f"elif kill -0 {pid} 2>/dev/null; then echo RUNNING; "
-                "else echo DEAD; fi"
-            )
-            waited, interval = 0.0, 0.05
-            while waited < grace:
-                result = await conn.run(probe)
-                token = result.stdout.strip().splitlines()[-1] if result.stdout.strip() else ""
-                if token in ("READY", "DEAD"):
-                    return
-                await asyncio.sleep(interval)
-                waited += interval
-                interval = min(interval * 2, float(self.poll_freq))
+
+            async def probe() -> tuple[TaskStatus, int]:
+                try:
+                    return await self.get_status(conn, marker, pid), process_id
+                except TransportError:
+                    # Garbled probe output on a flaky channel: keep waiting
+                    # so the grace deadline (and the kill below) still fires.
+                    return TaskStatus.RUNNING, process_id
+
+            status, _ = await self._wait_while_running(probe, timeout=grace)
+            if status is not TaskStatus.RUNNING:
+                return
             app_log.warning(
                 "worker %s straggling %.1fs after result; killing pid %s",
                 address, grace, pid,

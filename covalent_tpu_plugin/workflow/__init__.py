@@ -25,6 +25,7 @@ module is simply not needed.
 """
 
 from .dag import Electron, Lattice, Node, electron, lattice
+from .deps import DepsCall, DepsPip
 from .executors import LocalExecutor, register_executor, resolve_executor
 from .runner import Result, Status, dispatch, get_result, dispatch_sync
 
@@ -34,6 +35,8 @@ __all__ = [
     "dispatch",
     "dispatch_sync",
     "get_result",
+    "DepsCall",
+    "DepsPip",
     "Electron",
     "Lattice",
     "Node",

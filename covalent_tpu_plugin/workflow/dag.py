@@ -13,7 +13,9 @@ from __future__ import annotations
 
 import threading
 from dataclasses import dataclass, field
-from typing import Any, Callable
+from typing import Any, Callable, Sequence
+
+from .deps import DepsCall, DepsPip, _as_calls
 
 
 class Node:
@@ -39,6 +41,9 @@ class NodeSpec:
     kwargs: dict
     executor: Any  # alias string or executor instance
     name: str
+    deps_pip: DepsPip | None = None
+    call_before: list[DepsCall] = field(default_factory=list)
+    call_after: list[DepsCall] = field(default_factory=list)
 
     def dependencies(self) -> set[int]:
         deps: set[int] = set()
@@ -74,22 +79,43 @@ def _active_graph() -> Graph | None:
 
 
 class Electron:
-    """A task function bound to an executor choice.
+    """A task function bound to an executor choice and its dependencies.
 
     Called inside a lattice trace it records a node; called directly it just
     runs (matching upstream Covalent's behaviour for bare electron calls).
+    Dependencies mirror upstream's electron kwargs seen in the reference's
+    ML workflow (``svm_workflow.py:16-19``): ``deps_pip`` plus
+    ``call_before``/``call_after`` hooks, all executed on the worker.
     """
 
-    def __init__(self, fn: Callable, executor: Any = "local"):
+    def __init__(
+        self,
+        fn: Callable,
+        executor: Any = "local",
+        deps_pip: DepsPip | Sequence[str] | None = None,
+        call_before: Sequence[Any] = (),
+        call_after: Sequence[Any] = (),
+    ):
         self.fn = fn
         self.executor = executor
+        if deps_pip is not None and not isinstance(deps_pip, DepsPip):
+            deps_pip = DepsPip(packages=deps_pip)
+        self.deps_pip = deps_pip
+        self.call_before = _as_calls(call_before)
+        self.call_after = _as_calls(call_after)
         self.__name__ = getattr(fn, "__name__", "electron")
         self.__doc__ = fn.__doc__
 
     def __call__(self, *args, **kwargs):
         graph = _active_graph()
         if graph is None:
-            return self.fn(*args, **kwargs)
+            for dep in self.call_before:
+                dep.apply()
+            try:
+                return self.fn(*args, **kwargs)
+            finally:
+                for dep in self.call_after:
+                    dep.apply()
         node_id = len(graph.nodes)
         graph.nodes.append(
             NodeSpec(
@@ -99,16 +125,36 @@ class Electron:
                 kwargs=kwargs,
                 executor=self.executor,
                 name=self.__name__,
+                deps_pip=self.deps_pip,
+                call_before=self.call_before,
+                call_after=self.call_after,
             )
         )
         return Node(node_id, self.__name__)
 
 
-def electron(fn: Callable | None = None, *, executor: Any = "local") -> Any:
-    """``@electron`` / ``@electron(executor="tpu")`` decorator."""
+def electron(
+    fn: Callable | None = None,
+    *,
+    executor: Any = "local",
+    deps_pip: DepsPip | Sequence[str] | None = None,
+    call_before: Sequence[Any] = (),
+    call_after: Sequence[Any] = (),
+) -> Any:
+    """``@electron`` / ``@electron(executor="tpu", deps_pip=...)`` decorator."""
+
+    def wrap(f: Callable) -> Electron:
+        return Electron(
+            f,
+            executor=executor,
+            deps_pip=deps_pip,
+            call_before=call_before,
+            call_after=call_after,
+        )
+
     if fn is not None:
-        return Electron(fn, executor=executor)
-    return lambda f: Electron(f, executor=executor)
+        return wrap(fn)
+    return wrap
 
 
 class Lattice:

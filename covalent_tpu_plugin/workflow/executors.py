@@ -11,7 +11,24 @@ unchanged (both spellings appear in the reference README, lines 46-60).
 from __future__ import annotations
 
 import asyncio
+import threading
 from typing import Any, Callable
+
+#: package-sets already installed into this process's environment, guarded
+#: by a thread lock — dispatches run on separate event-loop threads and pip
+#: does not guarantee concurrent installs into one site-packages are safe.
+_PIP_INSTALLED: set[tuple[str, ...]] = set()
+_PIP_LOCK = threading.Lock()
+
+
+def _install_pip_deps_once(pip_deps: tuple[str, ...]) -> None:
+    from ..harness import install_pip_deps
+
+    with _PIP_LOCK:
+        if pip_deps in _PIP_INSTALLED:
+            return
+        install_pip_deps(list(pip_deps))
+        _PIP_INSTALLED.add(pip_deps)
 
 
 class LocalExecutor:
@@ -28,6 +45,13 @@ class LocalExecutor:
     async def run(
         self, function: Callable, args: list, kwargs: dict, task_metadata: dict
     ) -> Any:
+        pip_deps = (task_metadata or {}).get("pip_deps")
+        if pip_deps:
+            # Same pre-task install contract as the remote harness (the
+            # dispatcher host is this electron's "worker"), but installed
+            # once per package-set per process — a mapped electron must not
+            # re-pay the subprocess on all N invocations.
+            await asyncio.to_thread(_install_pip_deps_once, tuple(pip_deps))
         return await asyncio.to_thread(function, *tuple(args or ()), **(kwargs or {}))
 
     async def close(self) -> None:

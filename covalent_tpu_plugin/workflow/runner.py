@@ -26,6 +26,7 @@ from typing import Any, Callable
 
 from ..utils.log import app_log
 from .dag import Graph, Lattice, Node
+from .deps import wrap_task
 from .executors import resolve_executor
 
 
@@ -106,7 +107,13 @@ async def _execute_graph(graph: Graph, result: Result) -> None:
         kwargs = _resolve_value(dict(spec.kwargs), result.node_outputs)
         executor = executor_for(spec.executor)
         task_metadata = {"dispatch_id": dispatch_id, "node_id": spec.node_id}
-        output = await executor.run(spec.fn, args, kwargs, task_metadata)
+        if spec.deps_pip and spec.deps_pip.packages:
+            # Installed by the worker harness *before* unpickling the task
+            # (the pickle may import the dependency), reference ct.DepsPip
+            # usage at svm_workflow.py:19.
+            task_metadata["pip_deps"] = list(spec.deps_pip.packages)
+        fn = wrap_task(spec.fn, spec.call_before, spec.call_after)
+        output = await executor.run(fn, args, kwargs, task_metadata)
         result.node_outputs[spec.node_id] = output
         return output
 

@@ -5,23 +5,14 @@ the local transport (BASELINE config 1's shape: hostname electron over the
 loopback control plane, SURVEY §4.2b)."""
 
 import socket
-import sys
 
 import pytest
 
 import covalent_tpu_plugin.workflow as ct
-from covalent_tpu_plugin import TPUExecutor
+
+from ..helpers import make_local_executor as make_tpu_executor
 
 pytestmark = pytest.mark.functional_tests
-
-
-def make_tpu_executor(tmp_path, **kwargs):
-    kwargs.setdefault("transport", "local")
-    kwargs.setdefault("cache_dir", str(tmp_path / "cache"))
-    kwargs.setdefault("remote_cache", str(tmp_path / "remote"))
-    kwargs.setdefault("python_path", sys.executable)
-    kwargs.setdefault("poll_freq", 0.2)
-    return TPUExecutor(**kwargs)
 
 
 def test_basic_workflow_success(tmp_path):

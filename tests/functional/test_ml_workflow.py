@@ -1,0 +1,59 @@
+"""Functional tier: realistic ML lattice with mixed executors + pip deps.
+
+Mirrors the reference's ``tests/functional_tests/svm_workflow.py`` — data
+loading and scoring on the default (local) executor, training on the remote
+executor with a ``DepsPip`` attached (``svm_workflow.py:11-29``) — but the
+classifier is a numpy ridge regression (no sklearn in this image) and the
+pip install is a requirement already satisfied in the environment, so the
+test exercises the install path without touching the network.
+"""
+
+import numpy as np
+import pytest
+
+import covalent_tpu_plugin.workflow as ct
+
+from ..helpers import make_local_executor
+
+pytestmark = pytest.mark.functional_tests
+
+
+def test_ml_workflow_mixed_executors(tmp_path):
+    executor = make_local_executor(tmp_path)
+
+    @ct.electron  # local, like svm_workflow.py:11 load_data
+    def load_data(n=200, seed=0):
+        rng = np.random.default_rng(seed)
+        x = rng.standard_normal((n, 8))
+        w_true = rng.standard_normal(8)
+        y = (x @ w_true > 0).astype(np.float64)
+        split = int(0.8 * n)
+        return x[:split], y[:split], x[split:], y[split:]
+
+    @ct.electron(
+        executor=executor,
+        # Already satisfied in the image -> install path runs, no network.
+        deps_pip=ct.DepsPip(packages=["numpy"]),
+    )  # remote, like svm_workflow.py:16-22 train_svm
+    def train_model(data, reg=1e-3):
+        import numpy as np
+
+        x, y, _, _ = data
+        w = np.linalg.solve(x.T @ x + reg * np.eye(x.shape[1]), x.T @ (2 * y - 1))
+        return w
+
+    @ct.electron  # local, like svm_workflow.py:25-29 score_svm
+    def score_model(data, w):
+        _, _, x_test, y_test = data
+        pred = (x_test @ w > 0).astype(np.float64)
+        return float((pred == y_test).mean())
+
+    @ct.lattice  # svm_workflow.py:32-40 run_experiment
+    def run_experiment(n=200):
+        data = load_data(n)
+        w = train_model(data)
+        return score_model(data, w)
+
+    result = ct.dispatch_sync(run_experiment)(200)
+    assert result.status is ct.Status.COMPLETED, result.error
+    assert result.result > 0.8  # linearly separable data -> high accuracy

@@ -8,9 +8,23 @@ tests can assert the control-plane conversation.
 
 from __future__ import annotations
 
+import sys
+
 import cloudpickle
 
 from covalent_tpu_plugin.transport.base import CommandResult, Transport
+
+
+def make_local_executor(tmp_path, **kwargs):
+    """A TPUExecutor over the local transport, staged under tmp_path."""
+    from covalent_tpu_plugin import TPUExecutor
+
+    kwargs.setdefault("transport", "local")
+    kwargs.setdefault("cache_dir", str(tmp_path / "cache"))
+    kwargs.setdefault("remote_cache", str(tmp_path / "remote"))
+    kwargs.setdefault("python_path", sys.executable)
+    kwargs.setdefault("poll_freq", 0.2)
+    return TPUExecutor(**kwargs)
 
 
 class FakeTransport(Transport):

@@ -1,0 +1,202 @@
+"""Per-electron dependency tests: DepsPip, call_before/call_after hooks.
+
+Reference capability: upstream Covalent's ``ct.DepsPip`` attached to an
+electron (``tests/functional_tests/svm_workflow.py:6,19``).  The install
+command is redirected through ``COVALENT_TPU_PIP_CMD`` so no test touches
+the network or mutates the environment.
+"""
+
+import json
+import shlex
+import sys
+
+import pytest
+
+import covalent_tpu_plugin.workflow as ct
+from covalent_tpu_plugin.harness import run_task
+from covalent_tpu_plugin.utils.serialize import dump_task
+from covalent_tpu_plugin.workflow.deps import wrap_task
+
+from .helpers import make_local_executor
+
+
+# -------------------------------------------------------------------- #
+# DepsPip construction                                                 #
+# -------------------------------------------------------------------- #
+
+
+def test_deps_pip_from_list_and_string():
+    assert ct.DepsPip(packages=["numpy==1.23.2", "scikit-learn"]).packages == [
+        "numpy==1.23.2",
+        "scikit-learn",
+    ]
+    assert ct.DepsPip(packages="einops").packages == ["einops"]
+    assert ct.DepsPip().packages == []
+
+
+def test_deps_pip_from_requirements_file(tmp_path):
+    reqs = tmp_path / "requirements.txt"
+    reqs.write_text("# comment\nnumpy==1.23.2\n\nscikit-learn==1.1.2\n")
+    deps = ct.DepsPip(reqs_path=str(reqs))
+    assert deps.packages == ["numpy==1.23.2", "scikit-learn==1.1.2"]
+
+
+# -------------------------------------------------------------------- #
+# Call hooks                                                           #
+# -------------------------------------------------------------------- #
+
+
+def test_call_hooks_run_in_order_for_bare_electron_call():
+    events = []
+
+    @ct.electron(
+        call_before=[lambda: events.append("before")],
+        call_after=[lambda: events.append("after")],
+    )
+    def task(x):
+        events.append("body")
+        return x + 1
+
+    assert task(1) == 2
+    assert events == ["before", "body", "after"]
+
+
+def test_call_after_runs_even_when_body_raises():
+    events = []
+
+    fn = wrap_task(
+        lambda: (_ for _ in ()).throw(ValueError("boom")),
+        call_before=[ct.DepsCall(events.append, ("before",))],
+        call_after=[ct.DepsCall(events.append, ("after",))],
+    )
+    with pytest.raises(ValueError):
+        fn()
+    assert events == ["before", "after"]
+
+
+def test_hooked_task_survives_pickle_roundtrip(tmp_path):
+    """The wrapper must serialise by value — workers lack this package."""
+    import cloudpickle
+
+    marker = tmp_path / "hook_ran"
+    fn = wrap_task(
+        lambda x: x * 2,
+        call_before=[ct.DepsCall(lambda p: open(p, "w").close(), (str(marker),))],
+        call_after=[],
+    )
+    restored = cloudpickle.loads(cloudpickle.dumps(fn))
+    assert restored(21) == 42
+    assert marker.exists()
+
+
+# -------------------------------------------------------------------- #
+# Harness pip install path                                             #
+# -------------------------------------------------------------------- #
+
+
+def _recorder_cmd(record_file) -> str:
+    """A fake pip: records its arguments as JSON and exits 0."""
+    return (
+        f"{shlex.quote(sys.executable)} -c "
+        + shlex.quote(
+            "import json,sys; json.dump(sys.argv[1:], open("
+            + repr(str(record_file))
+            + ", 'w'))"
+        )
+    )
+
+
+def test_harness_installs_pip_deps_before_unpickle(tmp_path, monkeypatch):
+    record = tmp_path / "pip_args.json"
+    monkeypatch.setenv("COVALENT_TPU_PIP_CMD", _recorder_cmd(record))
+
+    function_file = tmp_path / "function.pkl"
+    result_file = tmp_path / "result.pkl"
+    dump_task(lambda: "ok", (), {}, str(function_file))
+
+    rc = run_task(
+        {
+            "function_file": str(function_file),
+            "result_file": str(result_file),
+            "pip_deps": ["scikit-learn==1.1.2", "numpy"],
+        }
+    )
+    assert rc == 0
+    assert json.loads(record.read_text()) == ["scikit-learn==1.1.2", "numpy"]
+    import pickle
+
+    result, exception = pickle.load(open(result_file, "rb"))
+    assert exception is None and result == "ok"
+
+
+def test_harness_reports_pip_failure_as_task_error(tmp_path, monkeypatch):
+    monkeypatch.setenv(
+        "COVALENT_TPU_PIP_CMD",
+        f"{shlex.quote(sys.executable)} -c "
+        + shlex.quote("import sys; print('no index', file=sys.stderr); sys.exit(1)"),
+    )
+    function_file = tmp_path / "function.pkl"
+    result_file = tmp_path / "result.pkl"
+    dump_task(lambda: "ok", (), {}, str(function_file))
+
+    rc = run_task(
+        {
+            "function_file": str(function_file),
+            "result_file": str(result_file),
+            "pip_deps": ["definitely-not-a-package"],
+        }
+    )
+    assert rc == 1
+    import pickle
+
+    result, exception = pickle.load(open(result_file, "rb"))
+    assert result is None
+    assert "pip dependency install failed" in str(exception)
+
+
+# -------------------------------------------------------------------- #
+# End-to-end through the engine                                        #
+# -------------------------------------------------------------------- #
+
+
+def test_lattice_with_deps_and_hooks_through_tpu_executor(tmp_path, monkeypatch):
+    record = tmp_path / "pip_args.json"
+    monkeypatch.setenv("COVALENT_TPU_PIP_CMD", _recorder_cmd(record))
+    marker = tmp_path / "before_marker"
+
+    executor = make_local_executor(tmp_path)
+
+    @ct.electron(
+        executor=executor,
+        deps_pip=ct.DepsPip(packages=["cloudpickle"]),
+        call_before=[ct.DepsCall(lambda p: open(p, "w").close(), (str(marker),))],
+    )
+    def remote_task(x):
+        return x * 10
+
+    @ct.lattice
+    def flow(x):
+        return remote_task(x)
+
+    result = ct.dispatch_sync(flow)(4)
+    assert result.status is ct.Status.COMPLETED, result.error
+    assert result.result == 40
+    assert json.loads(record.read_text()) == ["cloudpickle"]
+    assert marker.exists()  # hook ran on the worker (same fs: local transport)
+
+
+def test_local_executor_honours_pip_deps(tmp_path, monkeypatch):
+    record = tmp_path / "pip_args.json"
+    monkeypatch.setenv("COVALENT_TPU_PIP_CMD", _recorder_cmd(record))
+
+    @ct.electron(deps_pip=["einops"])  # bare list accepted like upstream
+    def task():
+        return "done"
+
+    @ct.lattice
+    def flow():
+        return task()
+
+    result = ct.dispatch_sync(flow)()
+    assert result.status is ct.Status.COMPLETED, result.error
+    assert json.loads(record.read_text()) == ["einops"]

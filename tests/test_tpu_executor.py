@@ -190,9 +190,9 @@ def test_unique_workdir_layout(tmp_path, run_async):
     captured = {}
     original = ex._write_function_files
 
-    def spy(op_id, fn, args, kwargs, workdir):
+    def spy(op_id, fn, args, kwargs, workdir, **kw):
         captured["workdir"] = workdir
-        return original(op_id, fn, args, kwargs, workdir)
+        return original(op_id, fn, args, kwargs, workdir, **kw)
 
     ex._write_function_files = spy
     run_async(ex.run(lambda: "ok", [], {}, METADATA))
@@ -261,6 +261,30 @@ def test_poll_task_timeout(tmp_path, run_async):
     fake = FakeTransport({"if test -f": CommandResult(0, "RUNNING\n", "")})
     ex = make_executor(tmp_path, task_timeout=0.15, poll_freq=0.05)
     assert run_async(ex._poll_task(fake, "/r.pkl", 1)) is TaskStatus.DEAD
+
+
+def test_poll_all_blames_dead_nonzero_worker(tmp_path, run_async):
+    """A worker that dies before the barrier (e.g. failed pip install) must
+    fail the task fast, not leave process 0 hung in jax.distributed."""
+    w0 = FakeTransport({"if test -f": CommandResult(0, "RUNNING\n", "")}, address="w0")
+    w1 = FakeTransport({"if test -f": CommandResult(0, "DEAD\n", "")}, address="w1")
+    ex = make_executor(tmp_path, workers=["w0", "w1"])
+    staged = ex._write_function_files("op", lambda: 1, (), {}, "/wd")
+    status, blamed = run_async(ex._poll_all([w0, w1], staged, {"w0": 1, "w1": 2}))
+    assert status is TaskStatus.DEAD
+    assert blamed == 1
+    # worker 1 was probed at its done-marker, not the result file
+    assert any(".done.1" in c for c in w1.commands)
+
+
+def test_poll_all_ready_from_worker_zero(tmp_path, run_async):
+    w0 = FakeTransport({"if test -f": CommandResult(0, "READY\n", "")}, address="w0")
+    w1 = FakeTransport({"if test -f": CommandResult(0, "RUNNING\n", "")}, address="w1")
+    ex = make_executor(tmp_path, workers=["w0", "w1"])
+    staged = ex._write_function_files("op", lambda: 1, (), {}, "/wd")
+    status, blamed = run_async(ex._poll_all([w0, w1], staged, {"w0": 1, "w1": 2}))
+    assert status is TaskStatus.READY
+    assert blamed == 0
 
 
 # --------------------------------------------------------------------- #
