@@ -510,13 +510,38 @@ class TPUExecutor(RemoteExecutor):
             waited += interval
             interval = min(interval * 2, float(self.poll_freq))
 
+    def _tolerant_status(self, max_consecutive: int = 3) -> Callable:
+        """Wrap ``get_status`` with bounded tolerance for garbled probes.
+
+        A single corrupted status line on a flaky control channel must not
+        abort a long-running task (the probe repeats anyway); only
+        ``max_consecutive`` failures in a row — a genuinely broken channel —
+        re-raise the ``TransportError``.  Per-key state so each worker's
+        channel is judged independently.
+        """
+        failures: dict[Any, int] = {}
+
+        async def probe_once(key, conn, path, pid) -> TaskStatus:
+            try:
+                status = await self.get_status(conn, path, pid)
+            except TransportError:
+                failures[key] = failures.get(key, 0) + 1
+                if failures[key] >= max_consecutive:
+                    raise
+                return TaskStatus.RUNNING
+            failures[key] = 0
+            return status
+
+        return probe_once
+
     async def _poll_task(
         self, conn: Transport, remote_result_file: str, pid: int | None = None
     ) -> TaskStatus:
         """Wait for one worker's result; a timeout counts as DEAD."""
+        tolerant = self._tolerant_status()
 
         async def probe() -> tuple[TaskStatus, int]:
-            return await self.get_status(conn, remote_result_file, pid), 0
+            return await tolerant(0, conn, remote_result_file, pid), 0
 
         status, _ = await self._wait_while_running(probe)
         return TaskStatus.DEAD if status is TaskStatus.RUNNING else status
@@ -535,16 +560,18 @@ class TPUExecutor(RemoteExecutor):
         (all-or-nothing semantics, SURVEY §5 failure detection).
         """
         addresses = self._worker_addresses()
+        tolerant = self._tolerant_status()
 
         async def probe() -> tuple[TaskStatus, int]:
             statuses = await asyncio.gather(
-                self.get_status(
-                    conns[0], staged.remote_result_file, pids.get(addresses[0])
+                tolerant(
+                    0, conns[0], staged.remote_result_file, pids.get(addresses[0])
                 ),
                 *(
                     # Workers 1..N-1 are "done" at their marker file — same
                     # probe shape as worker 0's result file.
-                    self.get_status(
+                    tolerant(
+                        i,
                         conns[i],
                         f"{staged.remote_result_file}.done.{i}",
                         pids.get(addresses[i]),

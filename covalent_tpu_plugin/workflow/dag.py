@@ -15,7 +15,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable, Sequence
 
-from .deps import DepsCall, DepsPip, _as_calls
+from .deps import DepsCall, DepsPip, _as_calls, wrap_task
 
 
 class Node:
@@ -109,13 +109,9 @@ class Electron:
     def __call__(self, *args, **kwargs):
         graph = _active_graph()
         if graph is None:
-            for dep in self.call_before:
-                dep.apply()
-            try:
-                return self.fn(*args, **kwargs)
-            finally:
-                for dep in self.call_after:
-                    dep.apply()
+            return wrap_task(self.fn, self.call_before, self.call_after)(
+                *args, **kwargs
+            )
         node_id = len(graph.nodes)
         graph.nodes.append(
             NodeSpec(

@@ -4,9 +4,13 @@ Mirrors the reference's ``tests/functional_tests/svm_workflow.py`` — data
 loading and scoring on the default (local) executor, training on the remote
 executor with a ``DepsPip`` attached (``svm_workflow.py:11-29``) — but the
 classifier is a numpy ridge regression (no sklearn in this image) and the
-pip install is a requirement already satisfied in the environment, so the
-test exercises the install path without touching the network.
+pip install is redirected through ``COVALENT_TPU_PIP_CMD`` (the contract
+stated in ``tests/test_deps.py``), so the install path runs end-to-end
+without touching the network or a possibly PEP 668-managed interpreter.
 """
+
+import shlex
+import sys
 
 import numpy as np
 import pytest
@@ -18,7 +22,19 @@ from ..helpers import make_local_executor
 pytestmark = pytest.mark.functional_tests
 
 
-def test_ml_workflow_mixed_executors(tmp_path):
+def test_ml_workflow_mixed_executors(tmp_path, monkeypatch):
+    # Fake pip: record the requested packages and exit 0 (numpy is already
+    # satisfied in the image; a real `pip install` would fail on PEP 668
+    # externally-managed interpreters even for satisfied requirements).
+    record = tmp_path / "pip_args.json"
+    monkeypatch.setenv(
+        "COVALENT_TPU_PIP_CMD",
+        f"{shlex.quote(sys.executable)} -c "
+        + shlex.quote(
+            "import json,sys; json.dump(sys.argv[1:], open("
+            + repr(str(record)) + ", 'w'))"
+        ),
+    )
     executor = make_local_executor(tmp_path)
 
     @ct.electron  # local, like svm_workflow.py:11 load_data
@@ -32,7 +48,6 @@ def test_ml_workflow_mixed_executors(tmp_path):
 
     @ct.electron(
         executor=executor,
-        # Already satisfied in the image -> install path runs, no network.
         deps_pip=ct.DepsPip(packages=["numpy"]),
     )  # remote, like svm_workflow.py:16-22 train_svm
     def train_model(data, reg=1e-3):
@@ -57,3 +72,5 @@ def test_ml_workflow_mixed_executors(tmp_path):
     result = ct.dispatch_sync(run_experiment)(200)
     assert result.status is ct.Status.COMPLETED, result.error
     assert result.result > 0.8  # linearly separable data -> high accuracy
+    assert record.exists()  # the DepsPip install path actually ran
+    assert "numpy" in record.read_text()

@@ -277,6 +277,58 @@ def test_poll_all_blames_dead_nonzero_worker(tmp_path, run_async):
     assert any(".done.1" in c for c in w1.commands)
 
 
+def test_poll_task_tolerates_transient_garbled_probe(tmp_path, run_async):
+    """One corrupted status line on a flaky channel must not abort the task;
+    the probe repeats and succeeds on the next round-trip."""
+    countdown = {"n": 2}
+
+    def probe(command):
+        countdown["n"] -= 1
+        if countdown["n"] >= 1:
+            return CommandResult(1, "garbage\n", "channel hiccup")
+        return CommandResult(0, "READY\n", "")
+
+    fake = FakeTransport({"if test -f": probe})
+    ex = make_executor(tmp_path, poll_freq=0.05)
+    assert run_async(ex._poll_task(fake, "/r.pkl", 1)) is TaskStatus.READY
+
+
+def test_poll_task_raises_after_consecutive_garbled_probes(tmp_path, run_async):
+    """A persistently broken channel still surfaces as TransportError."""
+    from covalent_tpu_plugin.transport import TransportError
+
+    fake = FakeTransport({"if test -f": CommandResult(1, "garbage\n", "broken")})
+    ex = make_executor(tmp_path, poll_freq=0.05)
+    with pytest.raises(TransportError):
+        run_async(ex._poll_task(fake, "/r.pkl", 1))
+
+
+def test_poll_all_tolerates_flaky_nonzero_worker_probe(tmp_path, run_async):
+    """A single garbled probe on worker 1's channel must not abort a healthy
+    multi-worker task (same tolerance the straggler-reap path has)."""
+    hiccup = {"n": 1}
+
+    def w1_probe(command):
+        if hiccup["n"] > 0:
+            hiccup["n"] -= 1
+            return CommandResult(1, "garbage\n", "channel hiccup")
+        return CommandResult(0, "RUNNING\n", "")
+
+    ready = {"n": 3}
+
+    def w0_probe(command):
+        ready["n"] -= 1
+        return CommandResult(0, "READY\n" if ready["n"] <= 0 else "RUNNING\n", "")
+
+    w0 = FakeTransport({"if test -f": w0_probe}, address="w0")
+    w1 = FakeTransport({"if test -f": w1_probe}, address="w1")
+    ex = make_executor(tmp_path, workers=["w0", "w1"], poll_freq=0.05)
+    staged = ex._write_function_files("op", lambda: 1, (), {}, "/wd")
+    status, blamed = run_async(ex._poll_all([w0, w1], staged, {"w0": 1, "w1": 2}))
+    assert status is TaskStatus.READY
+    assert blamed == 0
+
+
 def test_poll_all_ready_from_worker_zero(tmp_path, run_async):
     w0 = FakeTransport({"if test -f": CommandResult(0, "READY\n", "")}, address="w0")
     w1 = FakeTransport({"if test -f": CommandResult(0, "RUNNING\n", "")}, address="w1")
