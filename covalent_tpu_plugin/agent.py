@@ -1,0 +1,267 @@
+"""Client for the resident worker agent (``native/agent.cc``).
+
+The reference's submit/status protocol costs one SSH round-trip per probe
+(``covalent_ssh_plugin/ssh.py:383`` submit, ``ssh.py:402-406`` status,
+``ssh.py:408-432`` poll loop).  The agent collapses all of that into one
+persistent channel per worker: the executor writes a ``run`` command and the
+agent *pushes* ``started``/``exit`` events the moment they happen — no poll
+traffic, and task-completion latency bounded by the channel RTT instead of
+the poll interval.
+
+Deployment is self-contained: the single C++ source ships inside this
+package, is uploaded to the worker's cache dir, and is compiled there by the
+system compiler (cached by content hash, so compilation happens once per
+worker per agent version).  Workers without a C++ toolchain simply raise
+:class:`AgentError` and the executor falls back to the stateless
+``nohup`` + poll protocol — the agent is an accelerator, never a
+requirement.  Agent-launched tasks run in their own sessions, so even if the
+agent or its channel dies mid-task, the fallback poller can resume
+supervision using the PID from the ``started`` event.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import shlex
+import uuid
+from functools import lru_cache
+from pathlib import Path
+
+from .transport.base import Transport, TransportError
+from .utils.log import app_log
+
+AGENT_SOURCE = Path(__file__).parent / "native" / "agent.cc"
+
+
+class AgentError(TransportError):
+    """Agent unavailable or its channel failed; callers fall back to polling."""
+
+
+@lru_cache(maxsize=1)
+def agent_source_hash() -> str:
+    """Content hash naming the remote binary, so stale agents never run."""
+    return hashlib.sha256(AGENT_SOURCE.read_bytes()).hexdigest()[:12]
+
+
+async def ensure_agent_binary(conn: Transport, remote_cache: str) -> str:
+    """Upload + compile the agent on the worker (idempotent, hash-cached).
+
+    One round-trip when the binary already exists; upload + one compile
+    round-trip the first time.  Raises :class:`AgentError` when the worker
+    has no C++ compiler.
+    """
+    binary = f"{remote_cache}/agent_{agent_source_hash()}"
+    q_binary = shlex.quote(binary)
+    # mkdir rides the probe: this may run concurrently with (or before) the
+    # executor preflight that normally creates the cache dir.
+    probe = await conn.run(
+        f"mkdir -p {shlex.quote(remote_cache)}; "
+        f"test -x {q_binary} && echo HAVE || echo MISSING"
+    )
+    if "HAVE" in probe.stdout:
+        return binary
+
+    source = f"{binary}.cc"
+    await conn.put(str(AGENT_SOURCE), source)
+    # Unique tmp name + atomic mv so concurrent electrons can race safely.
+    tmp = shlex.quote(f"{binary}.tmp.{uuid.uuid4().hex[:8]}")
+    build = await conn.run(
+        "CXX=$(command -v g++ || command -v c++ || command -v clang++) "
+        "&& [ -n \"$CXX\" ] "
+        f"&& $CXX -O2 -std=c++17 -o {tmp} {shlex.quote(source)} "
+        f"&& mv {tmp} {q_binary}",
+        timeout=120.0,
+    )
+    if build.exit_status != 0:
+        raise AgentError(
+            f"no agent on {conn.address}: compile failed or no C++ compiler "
+            f"({build.stderr.strip()[:200]})"
+        )
+    return binary
+
+
+class AgentClient:
+    """One agent channel to one worker, demultiplexing pushed events.
+
+    A background reader drains the channel and files events by task id;
+    any number of concurrent tasks can await their own ``started``/``exit``
+    notifications.
+    """
+
+    def __init__(self, process, address: str):
+        self._process = process
+        self.address = address
+        self._started: dict[str, int] = {}
+        self._exits: dict[str, tuple[int, int]] = {}
+        self._errors: dict[str, str] = {}
+        self._pongs = 0
+        self._dead: BaseException | None = None
+        self._cond = asyncio.Condition()
+        self._reader = asyncio.create_task(self._read_loop())
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @classmethod
+    async def start(
+        cls, conn: Transport, binary: str, timeout: float = 15.0
+    ) -> "AgentClient":
+        try:
+            process = await conn.start_process(
+                shlex.quote(binary), describe=f"agent@{conn.address}"
+            )
+        except TransportError as err:
+            raise AgentError(f"cannot start agent on {conn.address}: {err}") from err
+        client = cls(process, conn.address)
+        try:
+            # A ping round-trip both consumes the ready banner and proves the
+            # channel is live before any task is entrusted to it.
+            await client.ping(timeout)
+        except AgentError:
+            await client.close()
+            raise
+        return client
+
+    @property
+    def alive(self) -> bool:
+        return self._dead is None and not self._reader.done()
+
+    async def close(self) -> None:
+        try:
+            if self._dead is None:
+                await self._process.write_line('{"cmd":"shutdown"}')
+        except TransportError:
+            pass
+        self._reader.cancel()
+        try:
+            await self._reader
+        except (asyncio.CancelledError, Exception):
+            pass
+        await self._process.close()
+
+    # -- event plumbing ------------------------------------------------------
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                line = await self._process.read_line()
+                try:
+                    event = json.loads(line)
+                except ValueError:
+                    continue  # stray non-protocol output; ignore
+                async with self._cond:
+                    kind = event.get("event")
+                    task_id = event.get("id", "")
+                    if kind == "started":
+                        self._started[task_id] = int(event["pid"])
+                    elif kind == "exit":
+                        self._exits[task_id] = (
+                            int(event.get("code", -1)),
+                            int(event.get("signal", 0)),
+                        )
+                    elif kind == "pong":
+                        self._pongs += 1
+                    elif kind == "error":
+                        self._errors[task_id] = str(event.get("message", "?"))
+                        app_log.warning(
+                            "agent@%s error: %s", self.address, event.get("message")
+                        )
+                    self._cond.notify_all()
+        except asyncio.CancelledError:
+            raise
+        except BaseException as err:  # noqa: BLE001 - ANY reader death must
+            # wake waiters: an unnotified exception here would leave
+            # wait_exit() blocked forever (e.g. asyncssh.ConnectionLost is
+            # neither TransportError nor OSError).
+            async with self._cond:
+                self._dead = err
+                self._cond.notify_all()
+
+    async def _wait(self, predicate, timeout: float | None):
+        """Await ``predicate(self)`` truthy, raising AgentError on channel death."""
+
+        async def waiter():
+            async with self._cond:
+                while True:
+                    if self._dead is not None:
+                        raise AgentError(
+                            f"agent@{self.address} channel died: {self._dead}"
+                        )
+                    value = predicate(self)
+                    if value:
+                        return value
+                    await self._cond.wait()
+
+        try:
+            return await asyncio.wait_for(waiter(), timeout)
+        except asyncio.TimeoutError:
+            raise AgentError(f"agent@{self.address}: no event within {timeout}s")
+
+    # -- commands ------------------------------------------------------------
+
+    async def ping(self, timeout: float = 15.0) -> None:
+        before = self._pongs
+        await self._send({"cmd": "ping"})
+        await self._wait(lambda c: c._pongs > before, timeout)
+
+    async def run_task(
+        self,
+        task_id: str,
+        argv: list[str],
+        cwd: str = "",
+        env: dict[str, str] | None = None,
+        log: str = "",
+        timeout: float = 30.0,
+    ) -> int:
+        """Launch a task; returns the remote PID from the ``started`` event."""
+        command: dict = {"cmd": "run", "id": task_id, "argv": list(argv)}
+        if cwd:
+            command["cwd"] = cwd
+        if env:
+            command["env"] = {str(k): str(v) for k, v in env.items()}
+        if log:
+            command["log"] = log
+        sent = False
+        try:
+            await self._send(command)
+            sent = True
+
+            def ready(c: "AgentClient"):
+                if task_id in c._errors:
+                    raise AgentError(
+                        f"agent@{c.address} rejected {task_id}: "
+                        f"{c._errors.pop(task_id)}"
+                    )
+                return c._started.get(task_id)
+
+            # Pop on success: a resident client serves many electrons;
+            # per-task entries must not accumulate for the channel's lifetime.
+            pid = await self._wait(ready, timeout)
+            self._started.pop(task_id, None)
+            return pid
+        except AgentError as err:
+            # Once the run command left for the worker, the harness may
+            # already be alive there even though we never saw `started` —
+            # the caller must NOT relaunch (double harness), only abort.
+            err.maybe_started = sent  # type: ignore[attr-defined]
+            raise
+
+    async def wait_exit(
+        self, task_id: str, timeout: float | None = None
+    ) -> tuple[int, int]:
+        """Block until the pushed exit event: ``(exit_code, term_signal)``."""
+        event = await self._wait(lambda c: c._exits.get(task_id), timeout)
+        self._exits.pop(task_id, None)
+        return event
+
+    async def kill(self, task_id: str, sig: int = 15) -> None:
+        await self._send({"cmd": "kill", "id": task_id, "sig": sig})
+
+    async def _send(self, command: dict) -> None:
+        if self._dead is not None:
+            raise AgentError(f"agent@{self.address} channel died: {self._dead}")
+        try:
+            await self._process.write_line(json.dumps(command))
+        except TransportError as err:
+            raise AgentError(f"agent@{self.address}: send failed: {err}") from err

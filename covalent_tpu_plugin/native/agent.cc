@@ -1,0 +1,484 @@
+// Resident worker agent: event-driven task lifecycle over one channel.
+//
+// The stateless-files protocol (reference: covalent_ssh_plugin/ssh.py:363-432,
+// one `conn.run` to submit plus a poll loop of `test -f` round-trips) costs a
+// control-plane round-trip per status probe.  This agent replaces that with a
+// single resident process per worker speaking newline-delimited JSON on
+// stdin/stdout: the executor writes one `run` command and *completion is
+// pushed* as an `exit` event the instant SIGCHLD fires — zero poll traffic,
+// sub-millisecond task turnaround on the control plane.
+//
+// Protocol (one JSON object per line):
+//   -> {"cmd":"ping"}
+//   <- {"event":"pong"}
+//   -> {"cmd":"run","id":"<op>","argv":["python3","harness.py","spec.json"],
+//       "cwd":"/path","env":{"K":"V"},"log":"/path/log.txt"}
+//   <- {"event":"started","id":"<op>","pid":1234}
+//   <- {"event":"exit","id":"<op>","code":0,"signal":0}        (pushed)
+//   -> {"cmd":"kill","id":"<op>","sig":15}
+//   <- {"event":"killed","id":"<op>"}   (exit event still follows from reaper)
+//   -> {"cmd":"shutdown"}
+//   <- {"event":"bye"}
+//   <- {"event":"error","message":"..."}  (malformed input, unknown id, ...)
+//
+// Children run in their own sessions (setsid + exec), so they survive an
+// agent/channel drop exactly like the fallback path's `nohup` launch — the
+// executor can always resume supervision by pid-file polling.  stdout is
+// line-buffered JSON only; child output goes to the per-task log file, same
+// contract as the polling path.
+//
+// Single file, C++17, no dependencies beyond POSIX; built on the worker by
+// the executor's preflight (g++ -O2 -std=c++17 -o agent agent.cc).
+
+#include <cerrno>
+#include <cctype>
+#include <csignal>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fcntl.h>
+#include <map>
+#include <poll.h>
+#include <string>
+#include <sys/wait.h>
+#include <unistd.h>
+#include <vector>
+
+// ---------------------------------------------------------------------------
+// Minimal JSON: just the subset this protocol uses (obj/arr/string/int/bool).
+// ---------------------------------------------------------------------------
+
+struct Json {
+  enum Type { Null, Bool, Int, Str, Arr, Obj } type = Null;
+  bool b = false;
+  long long i = 0;
+  std::string s;
+  std::vector<Json> arr;
+  std::vector<std::pair<std::string, Json>> obj;
+
+  const Json* get(const std::string& key) const {
+    if (type != Obj) return nullptr;
+    for (const auto& kv : obj)
+      if (kv.first == key) return &kv.second;
+    return nullptr;
+  }
+};
+
+struct JsonParser {
+  const char* p;
+  const char* end;
+  bool ok = true;
+
+  explicit JsonParser(const std::string& text)
+      : p(text.data()), end(text.data() + text.size()) {}
+
+  void skip_ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\r' || *p == '\n')) p++;
+  }
+  bool fail() { ok = false; return false; }
+
+  bool parse_value(Json& out) {
+    skip_ws();
+    if (p >= end) return fail();
+    switch (*p) {
+      case '{': return parse_obj(out);
+      case '[': return parse_arr(out);
+      case '"': out.type = Json::Str; return parse_string(out.s);
+      case 't':
+        if (end - p >= 4 && !strncmp(p, "true", 4)) {
+          out.type = Json::Bool; out.b = true; p += 4; return true;
+        }
+        return fail();
+      case 'f':
+        if (end - p >= 5 && !strncmp(p, "false", 5)) {
+          out.type = Json::Bool; out.b = false; p += 5; return true;
+        }
+        return fail();
+      case 'n':
+        if (end - p >= 4 && !strncmp(p, "null", 4)) {
+          out.type = Json::Null; p += 4; return true;
+        }
+        return fail();
+      default: return parse_int(out);
+    }
+  }
+
+  bool parse_int(Json& out) {
+    char* num_end = nullptr;
+    errno = 0;
+    long long v = strtoll(p, &num_end, 10);
+    if (num_end == p || errno == ERANGE) return fail();
+    // Skip a fractional/exponent tail (we only ever need integers).
+    const char* q = num_end;
+    while (q < end && (*q == '.' || *q == 'e' || *q == 'E' || *q == '+' ||
+                       *q == '-' || isdigit((unsigned char)*q)))
+      q++;
+    out.type = Json::Int;
+    out.i = v;
+    p = q;
+    return true;
+  }
+
+  bool parse_hex4(unsigned& out) {
+    if (end - p < 4) return fail();
+    out = 0;
+    for (int k = 0; k < 4; k++) {
+      char c = p[k];
+      out <<= 4;
+      if (c >= '0' && c <= '9') out |= (unsigned)(c - '0');
+      else if (c >= 'a' && c <= 'f') out |= (unsigned)(c - 'a' + 10);
+      else if (c >= 'A' && c <= 'F') out |= (unsigned)(c - 'A' + 10);
+      else return fail();
+    }
+    p += 4;
+    return true;
+  }
+
+  void append_utf8(std::string& s, unsigned cp) {
+    if (cp < 0x80) {
+      s += (char)cp;
+    } else if (cp < 0x800) {
+      s += (char)(0xC0 | (cp >> 6));
+      s += (char)(0x80 | (cp & 0x3F));
+    } else if (cp < 0x10000) {
+      s += (char)(0xE0 | (cp >> 12));
+      s += (char)(0x80 | ((cp >> 6) & 0x3F));
+      s += (char)(0x80 | (cp & 0x3F));
+    } else {
+      s += (char)(0xF0 | (cp >> 18));
+      s += (char)(0x80 | ((cp >> 12) & 0x3F));
+      s += (char)(0x80 | ((cp >> 6) & 0x3F));
+      s += (char)(0x80 | (cp & 0x3F));
+    }
+  }
+
+  bool parse_string(std::string& out) {
+    if (*p != '"') return fail();
+    p++;
+    out.clear();
+    while (p < end && *p != '"') {
+      if (*p == '\\') {
+        p++;
+        if (p >= end) return fail();
+        switch (*p) {
+          case '"': out += '"'; p++; break;
+          case '\\': out += '\\'; p++; break;
+          case '/': out += '/'; p++; break;
+          case 'b': out += '\b'; p++; break;
+          case 'f': out += '\f'; p++; break;
+          case 'n': out += '\n'; p++; break;
+          case 'r': out += '\r'; p++; break;
+          case 't': out += '\t'; p++; break;
+          case 'u': {
+            p++;
+            unsigned cp;
+            if (!parse_hex4(cp)) return false;
+            if (cp >= 0xD800 && cp <= 0xDBFF && end - p >= 6 && p[0] == '\\' &&
+                p[1] == 'u') {
+              p += 2;
+              unsigned lo;
+              if (!parse_hex4(lo)) return false;
+              cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+            }
+            append_utf8(out, cp);
+            break;
+          }
+          default: return fail();
+        }
+      } else {
+        out += *p++;
+      }
+    }
+    if (p >= end) return fail();
+    p++;  // closing quote
+    return true;
+  }
+
+  bool parse_arr(Json& out) {
+    out.type = Json::Arr;
+    p++;  // '['
+    skip_ws();
+    if (p < end && *p == ']') { p++; return true; }
+    while (true) {
+      Json elem;
+      if (!parse_value(elem)) return false;
+      out.arr.push_back(std::move(elem));
+      skip_ws();
+      if (p >= end) return fail();
+      if (*p == ',') { p++; continue; }
+      if (*p == ']') { p++; return true; }
+      return fail();
+    }
+  }
+
+  bool parse_obj(Json& out) {
+    out.type = Json::Obj;
+    p++;  // '{'
+    skip_ws();
+    if (p < end && *p == '}') { p++; return true; }
+    while (true) {
+      skip_ws();
+      std::string key;
+      if (p >= end || *p != '"' || !parse_string(key)) return fail();
+      skip_ws();
+      if (p >= end || *p != ':') return fail();
+      p++;
+      Json val;
+      if (!parse_value(val)) return false;
+      out.obj.emplace_back(std::move(key), std::move(val));
+      skip_ws();
+      if (p >= end) return fail();
+      if (*p == ',') { p++; continue; }
+      if (*p == '}') { p++; return true; }
+      return fail();
+    }
+  }
+};
+
+static bool parse_json(const std::string& line, Json& out) {
+  JsonParser parser(line);
+  if (!parser.parse_value(out)) return false;
+  parser.skip_ws();
+  return parser.ok && parser.p == parser.end;
+}
+
+static std::string json_escape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size() + 8);
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof buf, "\\u%04x", c);
+          out += buf;
+        } else {
+          out += (char)c;
+        }
+    }
+  }
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// Event emission: stdout is protocol-only, one JSON object per line.
+// ---------------------------------------------------------------------------
+
+static void emit(const std::string& line) {
+  fputs(line.c_str(), stdout);
+  fputc('\n', stdout);
+  fflush(stdout);
+}
+
+static void emit_error(const std::string& message, const std::string& id = "") {
+  std::string line = "{\"event\":\"error\",\"message\":\"" + json_escape(message) + "\"";
+  if (!id.empty()) line += ",\"id\":\"" + json_escape(id) + "\"";
+  emit(line + "}");
+}
+
+// ---------------------------------------------------------------------------
+// Child management.
+// ---------------------------------------------------------------------------
+
+static int g_sigchld_pipe[2] = {-1, -1};
+
+static void on_sigchld(int) {
+  // Self-pipe trick: make SIGCHLD poll()-able without signalfd.
+  ssize_t ignored = write(g_sigchld_pipe[1], "x", 1);
+  (void)ignored;
+}
+
+struct Task {
+  pid_t pid;
+  std::string id;
+};
+
+static std::map<pid_t, Task> g_tasks;
+
+static void spawn(const Json& cmd) {
+  const Json* id_field = cmd.get("id");
+  const Json* argv_field = cmd.get("argv");
+  if (!id_field || id_field->type != Json::Str || !argv_field ||
+      argv_field->type != Json::Arr || argv_field->arr.empty()) {
+    emit_error("run requires string id and non-empty argv array");
+    return;
+  }
+  const std::string& id = id_field->s;
+  const Json* cwd = cmd.get("cwd");
+  const Json* env = cmd.get("env");
+  const Json* log = cmd.get("log");
+
+  pid_t pid = fork();
+  if (pid < 0) {
+    emit_error(std::string("fork failed: ") + strerror(errno), id);
+    return;
+  }
+  if (pid == 0) {
+    // Child: own session so it survives an agent/channel drop, exactly like
+    // the polling path's nohup+setsid launch.
+    setsid();
+    if (cwd && cwd->type == Json::Str && !cwd->s.empty()) {
+      if (chdir(cwd->s.c_str()) != 0) _exit(127);
+    }
+    if (env && env->type == Json::Obj) {
+      for (const auto& kv : env->obj)
+        if (kv.second.type == Json::Str)
+          setenv(kv.first.c_str(), kv.second.s.c_str(), 1);
+    }
+    int log_fd = -1;
+    if (log && log->type == Json::Str && !log->s.empty()) {
+      log_fd = open(log->s.c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
+    }
+    if (log_fd < 0) log_fd = open("/dev/null", O_WRONLY);
+    int devnull = open("/dev/null", O_RDONLY);
+    if (devnull >= 0) dup2(devnull, 0);
+    if (log_fd >= 0) {
+      dup2(log_fd, 1);
+      dup2(log_fd, 2);
+    }
+    for (int fd = 3; fd < 256; fd++) close(fd);
+
+    std::vector<char*> argv;
+    argv.reserve(argv_field->arr.size() + 1);
+    for (const auto& a : argv_field->arr)
+      if (a.type == Json::Str) argv.push_back(const_cast<char*>(a.s.c_str()));
+    argv.push_back(nullptr);
+    execvp(argv[0], argv.data());
+    _exit(127);
+  }
+  g_tasks[pid] = Task{pid, id};
+  emit("{\"event\":\"started\",\"id\":\"" + json_escape(id) +
+       "\",\"pid\":" + std::to_string((long long)pid) + "}");
+}
+
+static void kill_task(const Json& cmd) {
+  const Json* id_field = cmd.get("id");
+  if (!id_field || id_field->type != Json::Str) {
+    emit_error("kill requires string id");
+    return;
+  }
+  const Json* sig_field = cmd.get("sig");
+  int sig = (sig_field && sig_field->type == Json::Int) ? (int)sig_field->i : SIGTERM;
+  for (const auto& kv : g_tasks) {
+    if (kv.second.id == id_field->s) {
+      // Negative pid: the whole session/process group the child leads.
+      kill(-kv.second.pid, sig);
+      kill(kv.second.pid, sig);
+      emit("{\"event\":\"killed\",\"id\":\"" + json_escape(id_field->s) + "\"}");
+      return;
+    }
+  }
+  emit_error("unknown task id", id_field->s);
+}
+
+static void reap_children() {
+  while (true) {
+    int status = 0;
+    pid_t pid = waitpid(-1, &status, WNOHANG);
+    if (pid <= 0) break;
+    auto it = g_tasks.find(pid);
+    if (it == g_tasks.end()) continue;
+    int code = WIFEXITED(status) ? WEXITSTATUS(status) : -1;
+    int sig = WIFSIGNALED(status) ? WTERMSIG(status) : 0;
+    emit("{\"event\":\"exit\",\"id\":\"" + json_escape(it->second.id) +
+         "\",\"code\":" + std::to_string(code) +
+         ",\"signal\":" + std::to_string(sig) + "}");
+    g_tasks.erase(it);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Main loop: poll stdin + the SIGCHLD self-pipe.
+// ---------------------------------------------------------------------------
+
+static void handle_line(const std::string& line, bool& running) {
+  if (line.empty()) return;
+  Json cmd;
+  if (!parse_json(line, cmd) || cmd.type != Json::Obj) {
+    emit_error("malformed command line");
+    return;
+  }
+  const Json* cmd_field = cmd.get("cmd");
+  if (!cmd_field || cmd_field->type != Json::Str) {
+    emit_error("missing cmd field");
+    return;
+  }
+  const std::string& name = cmd_field->s;
+  if (name == "ping") emit("{\"event\":\"pong\"}");
+  else if (name == "run") spawn(cmd);
+  else if (name == "kill") kill_task(cmd);
+  else if (name == "shutdown") { emit("{\"event\":\"bye\"}"); running = false; }
+  else emit_error("unknown cmd: " + name);
+}
+
+int main() {
+  if (pipe(g_sigchld_pipe) != 0) return 1;
+  fcntl(g_sigchld_pipe[0], F_SETFL, O_NONBLOCK);
+  fcntl(g_sigchld_pipe[1], F_SETFL, O_NONBLOCK);
+
+  struct sigaction sa;
+  memset(&sa, 0, sizeof sa);
+  sa.sa_handler = on_sigchld;
+  sa.sa_flags = SA_RESTART | SA_NOCLDSTOP;
+  sigaction(SIGCHLD, &sa, nullptr);
+  signal(SIGPIPE, SIG_IGN);
+
+  emit("{\"event\":\"ready\",\"pid\":" + std::to_string((long long)getpid()) + "}");
+
+  std::string buffer;
+  bool running = true;
+  bool stdin_open = true;
+  char chunk[4096];
+
+  // Keep serving until shutdown — or, after stdin closes, until every child
+  // is reaped so no exit event is lost on a clean drain.
+  while (running && (stdin_open || !g_tasks.empty())) {
+    struct pollfd fds[2];
+    nfds_t nfds = 0;
+    if (stdin_open) {
+      fds[nfds].fd = 0;
+      fds[nfds].events = POLLIN;
+      nfds++;
+    }
+    fds[nfds].fd = g_sigchld_pipe[0];
+    fds[nfds].events = POLLIN;
+    nfds++;
+
+    int rc = poll(fds, nfds, -1);
+    if (rc < 0) {
+      if (errno == EINTR) { reap_children(); continue; }
+      break;
+    }
+
+    for (nfds_t k = 0; k < nfds; k++) {
+      if (!(fds[k].revents & (POLLIN | POLLHUP | POLLERR))) continue;
+      if (fds[k].fd == g_sigchld_pipe[0]) {
+        char drain[64];
+        while (read(g_sigchld_pipe[0], drain, sizeof drain) > 0) {}
+        reap_children();
+      } else {
+        ssize_t n = read(0, chunk, sizeof chunk);
+        if (n <= 0) {
+          // Channel dropped: children keep running in their own sessions;
+          // the executor resumes supervision via the pid-file polling path.
+          stdin_open = false;
+          continue;
+        }
+        buffer.append(chunk, (size_t)n);
+        size_t pos;
+        while ((pos = buffer.find('\n')) != std::string::npos) {
+          std::string line = buffer.substr(0, pos);
+          buffer.erase(0, pos + 1);
+          handle_line(line, running);
+        }
+      }
+    }
+  }
+  return 0;
+}

@@ -39,6 +39,7 @@ from pathlib import Path
 from typing import Any, Callable, Sequence
 
 from . import harness as _harness_module
+from .agent import AgentClient, AgentError, ensure_agent_binary
 from .executor_base import RemoteExecutor
 from .transport import (
     LocalTransport,
@@ -79,6 +80,7 @@ _EXECUTOR_PLUGIN_DEFAULTS = {
     "coordinator_port": 8476,
     "task_timeout": 0.0,
     "task_env": {},
+    "use_agent": True,
 }
 
 
@@ -146,6 +148,7 @@ class TPUExecutor(RemoteExecutor):
         coordinator_port: int | None = None,
         task_timeout: float | None = None,
         task_env: dict[str, str] | None = None,
+        use_agent: bool | None = None,
         pool: TransportPool | None = None,
     ) -> None:
         def resolve(value, key):
@@ -184,6 +187,11 @@ class TPUExecutor(RemoteExecutor):
         #: extra environment for the remote harness process (e.g.
         #: LIBTPU_INIT_ARGS, JAX_PLATFORMS) — travels in the task spec.
         self.task_env = dict(resolve(task_env, "task_env") or {})
+        #: prefer the resident worker agent (native/agent.cc): push-based
+        #: completion over one channel instead of status-probe round-trips.
+        #: Auto-degrades per worker to the nohup+poll protocol when the
+        #: worker can't build or run the agent.
+        self.use_agent = bool(resolve(use_agent, "use_agent"))
 
         resolved_poll_freq = float(resolve(poll_freq, "poll_freq"))
         resolved_remote_cache = resolve(remote_cache, "remote_cache")
@@ -200,6 +208,14 @@ class TPUExecutor(RemoteExecutor):
         self._preflighted: set[int] = set()
         #: operation_id -> {worker address -> pid}; backs cancel().
         self._active: dict[str, dict[str, int]] = {}
+        #: worker address -> AgentClient | None (None = worker can't run the
+        #: agent; don't retry the compile every electron).
+        self._agents: dict[str, Any] = {}
+        #: operation_id -> per-worker AgentClient used at launch (None slots
+        #: mean that worker went through the nohup fallback).
+        self._op_agents: dict[str, list] = {}
+        #: per-address locks making agent creation single-flight.
+        self._agent_locks: dict[str, asyncio.Lock] = {}
         self.last_timings: dict[str, float] = {}
 
     # ------------------------------------------------------------------ #
@@ -279,6 +295,9 @@ class TPUExecutor(RemoteExecutor):
         next electron redials instead of reusing a dead channel."""
         for address in self._worker_addresses():
             await self._pool.discard(self._pool_key(address))
+            client = self._agents.pop(address, None)
+            if client is not None:
+                await client.close()
         self._preflighted.clear()
 
     async def _connect_all(self) -> list[Transport]:
@@ -452,6 +471,124 @@ class TPUExecutor(RemoteExecutor):
             raise TransportError(
                 f"submit on {conn.address} returned no PID: {result.stdout!r}"
             ) from err
+
+    # ------------------------------------------------------------------ #
+    # Resident agent fast path (native/agent.cc)                         #
+    # ------------------------------------------------------------------ #
+
+    async def _agent_for(self, conn: Transport) -> AgentClient | None:
+        """A live agent channel for this worker, or None if unavailable.
+
+        First use per worker uploads + compiles the agent (content-hash
+        cached in ``remote_cache``); a worker that can't build or run it is
+        remembered as agent-less so no electron pays the probe again.
+        """
+        if not self.use_agent:
+            return None
+        # Single-flight per address: concurrent electrons must not each
+        # compile/start an agent and orphan the loser's process.
+        lock = self._agent_locks.setdefault(conn.address, asyncio.Lock())
+        async with lock:
+            if conn.address in self._agents:
+                client = self._agents[conn.address]
+                if client is None or client.alive:
+                    return client
+                await client.close()  # stale channel; rebuild below
+            try:
+                binary = await ensure_agent_binary(conn, self.remote_cache)
+                client = await AgentClient.start(conn, binary)
+            except (AgentError, TransportError) as err:
+                app_log.info(
+                    "worker %s: no resident agent (%s); using nohup+poll protocol",
+                    conn.address, err,
+                )
+                self._agents[conn.address] = None
+                return None
+            self._agents[conn.address] = client
+            return client
+
+    async def _submit_via_agent(
+        self, client: AgentClient, staged: StagedTask, process_id: int
+    ) -> int:
+        """Launch one worker's harness through its agent; returns the PID.
+
+        The command line is identical to :meth:`submit_task`'s — same
+        harness, same spec file, same log — only the launch/notification
+        mechanism differs, so every downstream probe (pid liveness, result
+        file, cancel-by-pid) works unchanged if the agent channel later dies.
+        """
+        return await client.run_task(
+            staged.operation_id,
+            ["/bin/sh", "-c", self._task_command(staged, process_id)],
+            log=staged.remote_log_file,
+        )
+
+    async def _await_all_agent(
+        self,
+        clients: list[AgentClient],
+        conns: list[Transport],
+        staged: StagedTask,
+        pids: dict[str, int],
+    ) -> tuple[TaskStatus, int]:
+        """Event-driven analog of :meth:`_poll_all`: block on pushed exit
+        events instead of status round-trips.
+
+        Worker 0's exit resolves the task (one ``test -f`` round-trip then
+        confirms the result file, preserving the polling path's READY
+        definition); a non-zero worker exiting unsuccessfully first fails
+        fast with correct blame.  Any agent-channel death downgrades to
+        :meth:`_poll_all` — the tasks themselves are unaffected.
+        """
+        op = staged.operation_id
+        timeout = self.task_timeout or None
+
+        async def exit_of(i: int) -> tuple[int, int, int]:
+            code, sig = await clients[i].wait_exit(op)
+            return i, code, sig
+
+        waiters = [asyncio.ensure_future(exit_of(i)) for i in range(len(clients))]
+        try:
+            pending = set(waiters)
+            deadline = (
+                asyncio.get_running_loop().time() + timeout if timeout else None
+            )
+            while pending:
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - asyncio.get_running_loop().time()
+                    if remaining <= 0:
+                        return TaskStatus.DEAD, 0  # timeout ≙ _poll_task's DEAD
+                done, pending = await asyncio.wait(
+                    pending, timeout=remaining, return_when=asyncio.FIRST_COMPLETED
+                )
+                if not done:
+                    return TaskStatus.DEAD, 0
+                # Worker 0 first: its successful completion outranks another
+                # worker's post-barrier teardown failure, matching
+                # _poll_all's statuses[0]-first precedence.
+                for task in sorted(done, key=lambda t: t is not waiters[0]):
+                    try:
+                        i, code, _sig = task.result()
+                    except AgentError:
+                        # Channel died, task lives on: resume by polling.
+                        return await self._poll_all(conns, staged, pids)
+                    if i == 0:
+                        # Completion truth stays "result file exists", exactly
+                        # like the polling path (reference: ssh.py:402-406).
+                        status = await self.get_status(
+                            conns[0], staged.remote_result_file, None
+                        )
+                        return (
+                            TaskStatus.READY
+                            if status is TaskStatus.READY
+                            else TaskStatus.DEAD
+                        ), 0
+                    if code != 0:
+                        return TaskStatus.DEAD, i
+            return TaskStatus.DEAD, 0
+        finally:
+            for task in waiters:
+                task.cancel()
 
     async def get_status(
         self, conn: Transport, remote_result_file: str, pid: int | None = None
@@ -659,7 +796,11 @@ class TPUExecutor(RemoteExecutor):
         )
 
     async def close(self) -> None:
-        """Release pooled transports (call once per executor lifetime)."""
+        """Release agent channels + pooled transports (once per executor)."""
+        for client in self._agents.values():
+            if client is not None:
+                await client.close()
+        self._agents.clear()
         if self._owns_pool:
             await self._pool.close_all()
 
@@ -702,7 +843,13 @@ class TPUExecutor(RemoteExecutor):
                 with timer.stage("connect"):
                     conns = await self._connect_all()
                 with timer.stage("preflight"):
-                    await asyncio.gather(*(self._preflight(c) for c in conns))
+                    # Agent warm-up (upload + compile on first use) rides the
+                    # same gather as the env checks: independent round-trips,
+                    # so the first electron hides the one-time compile cost.
+                    await asyncio.gather(
+                        *(self._preflight(c) for c in conns),
+                        *(self._agent_for(c) for c in conns),
+                    )
             except (TransportError, OSError, ValueError) as err:
                 return self._on_dispatch_fail(
                     function, args, kwargs, f"could not reach TPU workers: {err}"
@@ -734,7 +881,15 @@ class TPUExecutor(RemoteExecutor):
             addresses = self._worker_addresses()
             try:
                 with timer.stage("execute"):
-                    status, blamed = await self._poll_all(conns, staged, pids)
+                    agents = self._op_agents.get(operation_id, [])
+                    if agents and all(c is not None and c.alive for c in agents):
+                        # Every worker launched through its agent: completion
+                        # is pushed, no status round-trips.
+                        status, blamed = await self._await_all_agent(
+                            agents, conns, staged, pids
+                        )
+                    else:
+                        status, blamed = await self._poll_all(conns, staged, pids)
                 if status is not TaskStatus.READY:
                     log_tail = await self._remote_log_tail(conns[blamed], staged)
                     await self.cancel(operation_id)
@@ -774,6 +929,7 @@ class TPUExecutor(RemoteExecutor):
         finally:
             self.last_timings = timer.summary()
             self._active.pop(operation_id, None)
+            self._op_agents.pop(operation_id, None)
             # Pooled transports stay open for the next electron; close()
             # tears them down.  Non-pooled (error) states are handled by
             # the pool itself.
@@ -789,8 +945,39 @@ class TPUExecutor(RemoteExecutor):
         through the same pool key that opened the connection.
         """
         addresses = self._worker_addresses()
+        launched_via: list[AgentClient | None] = [None] * len(conns)
+
+        async def launch_one(i: int, conn: Transport) -> int:
+            client = await self._agent_for(conn)
+            if client is not None:
+                try:
+                    pid = await self._submit_via_agent(client, staged, i)
+                    launched_via[i] = client
+                    return pid
+                except AgentError as err:
+                    if getattr(err, "maybe_started", False):
+                        # The run command reached (or may have reached) the
+                        # worker before the channel failed: the harness could
+                        # already be alive.  Relaunching would double-run the
+                        # task; kill any orphan by its unique spec path and
+                        # abort this worker's launch instead.
+                        await conn.run(
+                            "pkill -f "
+                            + shlex.quote(staged.remote_spec_file(i))
+                            + " 2>/dev/null || true"
+                        )
+                        raise TransportError(
+                            f"agent submit on {conn.address} failed after the "
+                            f"run command was sent: {err}"
+                        ) from err
+                    app_log.warning(
+                        "agent submit on %s failed (%s); nohup fallback",
+                        conn.address, err,
+                    )
+            return await self.submit_task(conn, staged, i)
+
         results = await asyncio.gather(
-            *(self.submit_task(c, staged, i) for i, c in enumerate(conns)),
+            *(launch_one(i, c) for i, c in enumerate(conns)),
             return_exceptions=True,
         )
         pids: dict[str, int] = {}
@@ -801,6 +988,7 @@ class TPUExecutor(RemoteExecutor):
             else:
                 pids[address] = res
         self._active[staged.operation_id] = pids
+        self._op_agents[staged.operation_id] = launched_via
         if errors:
             await self.cancel(staged.operation_id)
             raise TransportError(

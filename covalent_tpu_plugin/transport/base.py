@@ -56,6 +56,17 @@ class Transport(ABC):
     async def close(self) -> None:
         """Release the channel (idempotent)."""
 
+    async def start_process(self, command: str, describe: str = ""):
+        """Start a long-lived remote process with piped stdin/stdout.
+
+        Returns a :class:`~.process.TransportProcess`.  Optional: backends
+        that cannot hold a persistent channel raise, and callers fall back
+        to the one-shot ``run()`` protocol.
+        """
+        raise TransportError(
+            f"{type(self).__name__} does not support persistent processes"
+        )
+
     async def __aenter__(self) -> "Transport":
         return self
 

@@ -142,6 +142,20 @@ class SSHTransport(Transport):
 
     # -- Transport interface -------------------------------------------------
 
+    async def start_process(self, command: str, describe: str = ""):
+        """Persistent remote process: asyncssh session or an ssh-binary pipe."""
+        if self._closed:
+            raise TransportError("transport is closed")
+        describe = describe or f"{self.address}:{command.split()[0]}"
+        if self._use_asyncssh:  # pragma: no cover - needs asyncssh
+            from .process import TransportProcess
+
+            proc = await self._conn.create_process(command, encoding=None)
+            return TransportProcess(proc.stdout, proc.stdin, proc, describe)
+        from .process import start_local_process
+
+        return await start_local_process(self._ssh_base() + [command], describe)
+
     async def run(self, command: str, timeout: float | None = None) -> CommandResult:
         if self._closed:
             raise TransportError("transport is closed")

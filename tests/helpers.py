@@ -24,6 +24,7 @@ def make_local_executor(tmp_path, **kwargs):
     kwargs.setdefault("remote_cache", str(tmp_path / "remote"))
     kwargs.setdefault("python_path", sys.executable)
     kwargs.setdefault("poll_freq", 0.2)
+    kwargs.setdefault("use_agent", False)  # dedicated agent tests opt in
     return TPUExecutor(**kwargs)
 
 

@@ -1,0 +1,168 @@
+"""Resident worker agent: compile the real C++ binary and drive it.
+
+This is the native analog of the reference's transport tests — but where
+the reference mocks its connection (`ssh_test.py:120-132`), the agent tests
+exercise the genuine artifact: `native/agent.cc` is compiled by the same
+`ensure_agent_binary` path the executor uses, then spoken to over a real
+local process channel.
+"""
+
+import asyncio
+import shutil
+
+import pytest
+
+from covalent_tpu_plugin.agent import (
+    AgentClient,
+    AgentError,
+    agent_source_hash,
+    ensure_agent_binary,
+)
+from covalent_tpu_plugin.transport import LocalTransport
+
+pytestmark = pytest.mark.skipif(
+    all(shutil.which(cc) is None for cc in ("g++", "c++", "clang++")),
+    reason="no C++ compiler",
+)
+
+
+@pytest.fixture(scope="module")
+def agent_binary(tmp_path_factory):
+    """Compile once per test session (content-hash cached like production)."""
+    cache = tmp_path_factory.mktemp("agent-cache")
+
+    async def build():
+        conn = LocalTransport()
+        return await ensure_agent_binary(conn, str(cache))
+
+    return asyncio.run(build())
+
+
+def test_ensure_agent_is_idempotent(agent_binary, run_async):
+    async def second():
+        conn = LocalTransport()
+        return await ensure_agent_binary(conn, agent_binary.rsplit("/", 1)[0])
+
+    assert run_async(second()) == agent_binary
+    assert agent_source_hash() in agent_binary
+
+
+def test_agent_runs_task_and_pushes_exit(agent_binary, tmp_path, run_async):
+    async def flow():
+        conn = LocalTransport()
+        client = await AgentClient.start(conn, agent_binary)
+        out = tmp_path / "out.txt"
+        pid = await client.run_task(
+            "t1",
+            ["/bin/sh", "-c", f"echo from-agent > {out}; exit 7"],
+            log=str(tmp_path / "t1.log"),
+        )
+        assert pid > 0
+        code, signal = await client.wait_exit("t1", timeout=10.0)
+        await client.close()
+        return out.read_text().strip(), code, signal
+
+    text, code, signal = run_async(flow())
+    assert text == "from-agent"
+    assert code == 7
+    assert signal == 0
+
+
+def test_agent_multiplexes_concurrent_tasks(agent_binary, run_async):
+    async def flow():
+        conn = LocalTransport()
+        client = await AgentClient.start(conn, agent_binary)
+        # Launch out of order; the slower task must not block the faster one.
+        await client.run_task("slow", ["/bin/sh", "-c", "sleep 0.5; exit 1"])
+        await client.run_task("fast", ["/bin/sh", "-c", "exit 0"])
+        fast = await client.wait_exit("fast", timeout=10.0)
+        slow = await client.wait_exit("slow", timeout=10.0)
+        await client.close()
+        return fast, slow
+
+    fast, slow = run_async(flow())
+    assert fast == (0, 0)
+    assert slow == (1, 0)
+
+
+def test_agent_applies_cwd_and_env(agent_binary, tmp_path, run_async):
+    async def flow():
+        conn = LocalTransport()
+        client = await AgentClient.start(conn, agent_binary)
+        out = tmp_path / "envdump"
+        await client.run_task(
+            "t-env",
+            ["/bin/sh", "-c", f"pwd > {out}; echo $AGENT_TEST_VAR >> {out}"],
+            cwd=str(tmp_path),
+            env={"AGENT_TEST_VAR": "tpu-native"},
+        )
+        await client.wait_exit("t-env", timeout=10.0)
+        await client.close()
+        return out.read_text().splitlines()
+
+    lines = run_async(flow())
+    assert lines[0] == str(tmp_path)
+    assert lines[1] == "tpu-native"
+
+
+def test_agent_kill_terminates_task(agent_binary, run_async):
+    async def flow():
+        conn = LocalTransport()
+        client = await AgentClient.start(conn, agent_binary)
+        await client.run_task("victim", ["/bin/sh", "-c", "exec sleep 30"])
+        await client.kill("victim")
+        code, signal = await client.wait_exit("victim", timeout=10.0)
+        await client.close()
+        return code, signal
+
+    code, signal = run_async(flow())
+    assert signal == 15 or code != 0
+
+
+def test_agent_survivor_task_outlives_agent(agent_binary, tmp_path, run_async):
+    """Children run in their own sessions: agent death must not kill them
+    (the executor falls back to pid polling, like a dropped nohup channel)."""
+
+    async def flow():
+        conn = LocalTransport()
+        client = await AgentClient.start(conn, agent_binary)
+        marker = tmp_path / "survived"
+        pid = await client.run_task(
+            "orphan", ["/bin/sh", "-c", f"sleep 0.6; echo yes > {marker}"]
+        )
+        await client.close(    )  # shutdown before the task finishes
+        for _ in range(60):
+            if marker.exists():
+                break
+            await asyncio.sleep(0.1)
+        return marker.exists(), pid
+
+    survived, pid = run_async(flow())
+    assert survived
+    assert pid > 0
+
+
+def test_agent_rejects_malformed_run(agent_binary, run_async):
+    async def flow():
+        conn = LocalTransport()
+        client = await AgentClient.start(conn, agent_binary)
+        with pytest.raises(AgentError):
+            await client.run_task("bad", [], timeout=5.0)
+        await client.close()
+
+    run_async(flow())
+
+
+def test_agent_channel_death_surfaces_as_error(agent_binary, run_async):
+    async def flow():
+        conn = LocalTransport()
+        client = await AgentClient.start(conn, agent_binary)
+        await client.run_task("t", ["/bin/sh", "-c", "sleep 5"])
+        # Kill the agent process out from under the client.
+        client._process._proc.kill()
+        with pytest.raises(AgentError):
+            await client.wait_exit("t", timeout=5.0)
+        assert not client.alive
+        await client.close()
+
+    run_async(flow())

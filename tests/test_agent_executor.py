@@ -1,0 +1,141 @@
+"""Executor ↔ resident-agent integration over the real local transport.
+
+The agent tier of the dispatch path: a TPUExecutor with ``use_agent=True``
+must compile the agent once, launch the harness through it, receive the
+pushed exit event (no status-probe polling), and fall back cleanly when the
+agent can't be built.
+"""
+
+import asyncio
+import shutil
+import sys
+
+import pytest
+
+from covalent_tpu_plugin import TPUExecutor
+
+pytestmark = pytest.mark.skipif(
+    all(shutil.which(cc) is None for cc in ("g++", "c++", "clang++")),
+    reason="no C++ compiler",
+)
+
+METADATA = {"dispatch_id": "dA", "node_id": 0}
+
+
+@pytest.fixture(scope="module")
+def shared_cache(tmp_path_factory):
+    """One remote cache for the module so the agent compiles exactly once."""
+    return tmp_path_factory.mktemp("agent-exec")
+
+
+def make_agent_executor(shared_cache, **kwargs):
+    kwargs.setdefault("transport", "local")
+    kwargs.setdefault("cache_dir", str(shared_cache / "cache"))
+    kwargs.setdefault("remote_cache", str(shared_cache / "remote"))
+    kwargs.setdefault("python_path", sys.executable)
+    kwargs.setdefault("poll_freq", 0.2)
+    kwargs.setdefault("use_agent", True)
+    return TPUExecutor(**kwargs)
+
+
+def test_agent_run_returns_result_without_status_polling(shared_cache, run_async):
+    async def flow():
+        ex = make_agent_executor(shared_cache)
+        result = await ex.run(lambda a, b: a * b, [6, 7], {}, METADATA)
+        agent = ex._agents.get("localhost")
+        timings = ex.last_timings
+        await ex.close()
+        return result, agent, timings
+
+    result, agent, timings = run_async(flow())
+    assert result == 42
+    assert agent is not None  # the agent path was actually taken
+    assert "execute" in timings
+
+
+def test_agent_reused_across_electrons_and_exceptions_reraise(shared_cache, run_async):
+    async def flow():
+        ex = make_agent_executor(shared_cache)
+        assert await ex.run(lambda: "one", [], {}, METADATA) == "one"
+        first_agent = ex._agents.get("localhost")
+
+        def boom():
+            raise KeyError("agent-boom")
+
+        try:
+            await ex.run(boom, [], {}, {"dispatch_id": "dB", "node_id": 1})
+            raised = False
+        except KeyError as err:
+            raised = "agent-boom" in str(err)
+        second_agent = ex._agents.get("localhost")
+        await ex.close()
+        return raised, first_agent is second_agent
+
+    raised, same_agent = run_async(flow())
+    assert raised
+    assert same_agent  # one resident agent serves many electrons
+
+
+def test_agent_unavailable_falls_back_to_polling(tmp_path, run_async):
+    """A worker where the compile fails must degrade to nohup+poll, once."""
+
+    async def flow():
+        ex = TPUExecutor(
+            transport="local",
+            cache_dir=str(tmp_path / "cache"),
+            remote_cache=str(tmp_path / "remote"),
+            python_path=sys.executable,
+            poll_freq=0.2,
+            use_agent=True,
+        )
+        # Force the compile to fail: make ensure_agent_binary see no compiler.
+        from covalent_tpu_plugin import tpu as tpu_mod
+
+        async def no_agent(conn, remote_cache):
+            raise tpu_mod.AgentError("scripted: no compiler")
+
+        orig = tpu_mod.ensure_agent_binary
+        tpu_mod.ensure_agent_binary = no_agent
+        try:
+            result = await ex.run(lambda: "polled", [], {}, METADATA)
+        finally:
+            tpu_mod.ensure_agent_binary = orig
+        cached = ex._agents.get("localhost", "missing")
+        await ex.close()
+        return result, cached
+
+    result, cached = run_async(flow())
+    assert result == "polled"
+    assert cached is None  # failure remembered; no per-electron re-probe
+
+
+def test_agent_cancel_kills_running_task(shared_cache, run_async):
+    async def flow():
+        ex = make_agent_executor(shared_cache, task_timeout=30.0)
+
+        def sleeper():
+            import time
+
+            time.sleep(30)
+            return "never"
+
+        run_task = asyncio.ensure_future(
+            ex.run(sleeper, [], {}, {"dispatch_id": "dC", "node_id": 2})
+        )
+        # Wait until the task is registered as active, then cancel it.
+        for _ in range(100):
+            if ex._active.get("dC_2"):
+                break
+            await asyncio.sleep(0.1)
+        await ex.cancel("dC_2")
+        try:
+            await asyncio.wait_for(run_task, 30.0)
+            outcome = "returned"
+        except Exception:
+            outcome = "raised"
+        await ex.close()
+        return outcome
+
+    # A cancelled task must terminate promptly (either surfaced failure or
+    # fallback result) rather than sleeping out the full 30 s.
+    assert run_async(flow()) == "raised"

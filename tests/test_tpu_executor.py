@@ -28,6 +28,7 @@ def make_executor(tmp_path, fake: FakeTransport | None = None, **kwargs):
     kwargs.setdefault("cache_dir", str(tmp_path / "cache"))
     kwargs.setdefault("remote_cache", str(tmp_path / "remote"))
     kwargs.setdefault("poll_freq", 0.05)
+    kwargs.setdefault("use_agent", False)  # dedicated agent tests opt in
     ex = TPUExecutor(**kwargs)
     if fake is not None:
 
