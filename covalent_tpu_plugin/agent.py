@@ -163,7 +163,8 @@ class AgentClient:
                     elif kind == "pong":
                         self._pongs += 1
                     elif kind == "error":
-                        self._errors[task_id] = str(event.get("message", "?"))
+                        if task_id:  # id-less errors are log-only, not stored
+                            self._errors[task_id] = str(event.get("message", "?"))
                         app_log.warning(
                             "agent@%s error: %s", self.address, event.get("message")
                         )
@@ -229,10 +230,14 @@ class AgentClient:
 
             def ready(c: "AgentClient"):
                 if task_id in c._errors:
-                    raise AgentError(
+                    rejection = AgentError(
                         f"agent@{c.address} rejected {task_id}: "
                         f"{c._errors.pop(task_id)}"
                     )
+                    # A definitive rejection means the task never forked:
+                    # relaunching through the fallback path is safe.
+                    rejection.rejected = True  # type: ignore[attr-defined]
+                    raise rejection
                 return c._started.get(task_id)
 
             # Pop on success: a resident client serves many electrons;
@@ -244,7 +249,10 @@ class AgentClient:
             # Once the run command left for the worker, the harness may
             # already be alive there even though we never saw `started` —
             # the caller must NOT relaunch (double harness), only abort.
-            err.maybe_started = sent  # type: ignore[attr-defined]
+            # Exception: an explicit error event proves it never started.
+            err.maybe_started = sent and not getattr(  # type: ignore[attr-defined]
+                err, "rejected", False
+            )
             raise
 
     async def wait_exit(
@@ -254,6 +262,18 @@ class AgentClient:
         event = await self._wait(lambda c: c._exits.get(task_id), timeout)
         self._exits.pop(task_id, None)
         return event
+
+    def forget(self, task_id: str) -> None:
+        """Drop any retained state for a finished/abandoned task.
+
+        Called by the executor when an operation leaves its books — e.g. a
+        straggler worker's exit event that no waiter consumed (its waiter
+        was cancelled once worker 0 resolved the task) must not accumulate
+        for the channel's lifetime.
+        """
+        self._started.pop(task_id, None)
+        self._exits.pop(task_id, None)
+        self._errors.pop(task_id, None)
 
     async def kill(self, task_id: str, sig: int = 15) -> None:
         await self._send({"cmd": "kill", "id": task_id, "sig": sig})

@@ -92,6 +92,13 @@ def run_task(spec: dict) -> int:
     env = spec.get("env") or {}
     for key, value in env.items():
         os.environ[key] = str(value)
+    if "PYTHONPATH" in env:
+        # The interpreter already started; os.environ alone no longer affects
+        # import resolution.  Mirror the entries into sys.path so task_env
+        # PYTHONPATH means what users expect.
+        for entry in reversed(str(env["PYTHONPATH"]).split(os.pathsep)):
+            if entry and entry not in sys.path:
+                sys.path.insert(0, entry)
     if "JAX_PLATFORMS" in env:
         # Env alone can lose to a site-level PJRT plugin registration that
         # pins another platform; jax.config wins if set before first use.
@@ -141,6 +148,20 @@ def run_task(spec: dict) -> int:
     with open(spec["function_file"], "rb") as f:
         fn, args, kwargs = pickle.load(f)
 
+    # Optional device-level tracing (SURVEY §5: the reference captures no
+    # timings at all; this surfaces the XLA/TPU view of the electron).  The
+    # trace lands in the task workdir/cache so the dispatcher can scp it.
+    profile_dir = spec.get("profile_dir")
+    profiling = False
+    if profile_dir:
+        try:
+            import jax
+
+            jax.profiler.start_trace(profile_dir)
+            profiling = True
+        except Exception as profile_error:  # pragma: no cover - best effort
+            print(f"profiler unavailable: {profile_error}", file=sys.stderr)
+
     workdir = spec.get("workdir")
     current_dir = os.getcwd()
     result, exception = None, None
@@ -154,6 +175,13 @@ def run_task(spec: dict) -> int:
         exception = task_error
     finally:
         os.chdir(current_dir)
+        if profiling:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception:  # pragma: no cover
+                pass
 
     # Replicated outputs: one writer suffices (process 0); the others emit a
     # done-marker the control plane can watch for all-workers-finished.

@@ -81,6 +81,7 @@ _EXECUTOR_PLUGIN_DEFAULTS = {
     "task_timeout": 0.0,
     "task_env": {},
     "use_agent": True,
+    "profile_dir": "",
 }
 
 
@@ -149,6 +150,7 @@ class TPUExecutor(RemoteExecutor):
         task_timeout: float | None = None,
         task_env: dict[str, str] | None = None,
         use_agent: bool | None = None,
+        profile_dir: str | None = None,
         pool: TransportPool | None = None,
     ) -> None:
         def resolve(value, key):
@@ -187,6 +189,9 @@ class TPUExecutor(RemoteExecutor):
         #: extra environment for the remote harness process (e.g.
         #: LIBTPU_INIT_ARGS, JAX_PLATFORMS) — travels in the task spec.
         self.task_env = dict(resolve(task_env, "task_env") or {})
+        #: remote dir for jax.profiler traces; empty disables (SURVEY §5 —
+        #: the reference has no tracing subsystem at all).
+        self.profile_dir = str(resolve(profile_dir, "profile_dir") or "")
         #: prefer the resident worker agent (native/agent.cc): push-based
         #: completion over one channel instead of status-probe round-trips.
         #: Auto-degrades per worker to the nohup+poll protocol when the
@@ -362,6 +367,9 @@ class TPUExecutor(RemoteExecutor):
             }
             if self.task_env:
                 spec["env"] = self.task_env
+            if self.profile_dir:
+                # Per-task subdir so concurrent electrons' traces don't mix.
+                spec["profile_dir"] = f"{self.profile_dir}/{operation_id}"
             if pip_deps:
                 spec["pip_deps"] = list(pip_deps)
             if num_processes > 1:
@@ -584,6 +592,16 @@ class TPUExecutor(RemoteExecutor):
                             else TaskStatus.DEAD
                         ), 0
                     if code != 0:
+                        # Before blaming worker i, check whether worker 0
+                        # already delivered (its exit event may just be in a
+                        # later batch): a written result outranks a post-
+                        # barrier teardown failure, matching _poll_all's
+                        # statuses[0]-first precedence.
+                        status = await self.get_status(
+                            conns[0], staged.remote_result_file, None
+                        )
+                        if status is TaskStatus.READY:
+                            return TaskStatus.READY, 0
                         return TaskStatus.DEAD, i
             return TaskStatus.DEAD, 0
         finally:
@@ -929,7 +947,11 @@ class TPUExecutor(RemoteExecutor):
         finally:
             self.last_timings = timer.summary()
             self._active.pop(operation_id, None)
-            self._op_agents.pop(operation_id, None)
+            # Release per-task state retained by resident agent channels
+            # (e.g. straggler exit events whose waiters were cancelled).
+            for client in self._op_agents.pop(operation_id, []) or []:
+                if client is not None:
+                    client.forget(operation_id)
             # Pooled transports stay open for the next electron; close()
             # tears them down.  Non-pooled (error) states are handled by
             # the pool itself.

@@ -6,12 +6,22 @@ serialization at ``ssh.py:28``).  Here they are a proper subpackage so the
 transport, executor, and harness layers share one implementation.
 """
 
+from .checkpoint import (
+    checkpoint_dir,
+    latest_step,
+    restore_checkpoint,
+    save_checkpoint,
+)
 from .config import get_config, set_config, update_config
 from .log import app_log
 from .serialize import dump_task, load_result
 from .timing import StageTimer
 
 __all__ = [
+    "checkpoint_dir",
+    "latest_step",
+    "restore_checkpoint",
+    "save_checkpoint",
     "get_config",
     "set_config",
     "update_config",

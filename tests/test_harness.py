@@ -129,3 +129,39 @@ def test_harness_subprocess_roundtrip(tmp_path):
     assert proc.returncode == 0, proc.stderr
     result, exception = pickle.loads(result_file.read_bytes())
     assert result == 70 and exception is None
+
+
+def test_run_task_pythonpath_env_reaches_sys_path(tmp_path):
+    """task_env PYTHONPATH must affect imports inside the electron, not just
+    child processes (the interpreter is already running when env applies)."""
+    pkg = tmp_path / "extra_pkg"
+    pkg.mkdir()
+    (pkg / "task_env_probe_mod.py").write_text("VALUE = 'found-me'\n")
+
+    def electron():
+        import task_env_probe_mod
+
+        return task_env_probe_mod.VALUE
+
+    spec, result_file = _stage(tmp_path, electron, env={"PYTHONPATH": str(pkg)})
+    assert harness.run_task(spec) == 0
+    result, exception = load_result(result_file)
+    assert exception is None
+    assert result == "found-me"
+
+
+def test_run_task_writes_profiler_trace(tmp_path):
+    """profile_dir in the spec turns on jax.profiler around the electron."""
+
+    def electron():
+        import jax.numpy as jnp
+
+        return float(jnp.ones((8, 8)).sum())
+
+    profile_dir = tmp_path / "traces"
+    spec, result_file = _stage(tmp_path, electron, profile_dir=str(profile_dir))
+    assert harness.run_task(spec) == 0
+    result, exception = load_result(result_file)
+    assert exception is None and result == 64.0
+    # jax writes plugins/profile/<ts>/*.xplane.pb under the trace dir
+    assert any(profile_dir.rglob("*.xplane.pb"))

@@ -442,3 +442,21 @@ def test_launch_all_is_all_or_nothing(tmp_path, run_async):
 
     run_async(flow())
     assert any("kill" in c and "111" in c for c in good.commands)
+
+
+def test_profile_dir_lands_in_spec_per_operation(tmp_path):
+    ex = make_executor(tmp_path, profile_dir="/traces")
+    staged = ex._write_function_files("opX", lambda: 1, (), {}, "/wd")
+    import json
+
+    spec = json.load(open(staged.local_spec_files[0]))
+    assert spec["profile_dir"] == "/traces/opX"  # per-task subdir
+
+
+def test_profile_dir_absent_by_default(tmp_path):
+    ex = make_executor(tmp_path)
+    staged = ex._write_function_files("opY", lambda: 1, (), {}, "/wd")
+    import json
+
+    spec = json.load(open(staged.local_spec_files[0]))
+    assert "profile_dir" not in spec
