@@ -116,6 +116,7 @@ async def main() -> dict:
         remote_cache=f"{workdir}/remote",
         python_path=sys.executable,
         poll_freq=0.2,
+        pool_preload="cloudpickle",
         task_env={
             "PYTHONPATH": repo_root + os.pathsep + os.environ.get("PYTHONPATH", "")
         },

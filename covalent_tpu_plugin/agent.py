@@ -34,6 +34,11 @@ from .utils.log import app_log
 
 AGENT_SOURCE = Path(__file__).parent / "native" / "agent.cc"
 
+#: Remote filename of the staged harness module.  Shared by the per-task
+#: stager (StagedTask.remote_harness_file) and the pool server so the
+#: resident interpreter always serves the same file task specs point at.
+HARNESS_BASENAME = "covalent_tpu_harness.py"
+
 
 class AgentError(TransportError):
     """Agent unavailable or its channel failed; callers fall back to polling."""
@@ -82,6 +87,54 @@ async def ensure_agent_binary(conn: Transport, remote_cache: str) -> str:
     return binary
 
 
+async def start_pool_server(
+    conn: Transport,
+    remote_cache: str,
+    python_path: str,
+    conda_env: str = "",
+    preload: str = "cloudpickle",
+    timeout: float = 90.0,
+) -> "AgentClient":
+    """Start the harness forkserver (``harness.py --serve``) on a worker.
+
+    The resident interpreter preloads ``preload`` modules once; each task
+    then costs a fork instead of interpreter startup + imports.  The
+    generous timeout covers a cold jax import on the worker.  Speaks the
+    same protocol as the native agent, so the returned client is a drop-in
+    (``mode == "pool"``).
+    """
+    from . import harness as harness_module
+
+    remote_harness = f"{remote_cache}/{HARNESS_BASENAME}"
+    try:
+        await conn.run(f"mkdir -p {shlex.quote(remote_cache)}")
+        await conn.put(harness_module.__file__, remote_harness)
+    except TransportError as err:
+        raise AgentError(f"cannot stage pool server on {conn.address}: {err}") from err
+
+    command = (
+        f"env COVALENT_TPU_POOL_PRELOAD={shlex.quote(preload)} "
+        f"{python_path} {shlex.quote(remote_harness)} --serve"
+    )
+    if conda_env:
+        command = (
+            f'eval "$(conda shell.bash hook)" && conda activate '
+            f"{shlex.quote(conda_env)} && {command}"
+        )
+    try:
+        process = await conn.start_process(command, describe=f"pool@{conn.address}")
+    except TransportError as err:
+        raise AgentError(f"cannot start pool server on {conn.address}: {err}") from err
+    client = AgentClient(process, conn.address)
+    client.mode = "pool"
+    try:
+        await client.ping(timeout)
+    except AgentError:
+        await client.close()
+        raise
+    return client
+
+
 class AgentClient:
     """One agent channel to one worker, demultiplexing pushed events.
 
@@ -89,6 +142,9 @@ class AgentClient:
     any number of concurrent tasks can await their own ``started``/``exit``
     notifications.
     """
+
+    #: "native" (C++ agent, argv exec) or "pool" (harness forkserver, spec).
+    mode: str = "native"
 
     def __init__(self, process, address: str):
         self._process = process
@@ -209,14 +265,24 @@ class AgentClient:
     async def run_task(
         self,
         task_id: str,
-        argv: list[str],
+        argv: list[str] | None = None,
         cwd: str = "",
         env: dict[str, str] | None = None,
         log: str = "",
         timeout: float = 30.0,
+        spec: str = "",
     ) -> int:
-        """Launch a task; returns the remote PID from the ``started`` event."""
-        command: dict = {"cmd": "run", "id": task_id, "argv": list(argv)}
+        """Launch a task; returns the remote PID from the ``started`` event.
+
+        ``argv`` targets the native C++ agent (it execs the command);
+        ``spec`` targets the harness pool server (it forks and runs the spec
+        in the pre-warmed interpreter).  Exactly one must be given.
+        """
+        command: dict = {"cmd": "run", "id": task_id}
+        if spec:
+            command["spec"] = spec
+        else:
+            command["argv"] = list(argv or [])
         if cwd:
             command["cwd"] = cwd
         if env:

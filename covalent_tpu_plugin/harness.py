@@ -198,9 +198,190 @@ def run_task(spec: dict) -> int:
     return 0
 
 
+# --------------------------------------------------------------------------
+# Pool (forkserver) mode: `python harness.py --serve`
+#
+# One resident interpreter per worker, heavy imports preloaded ONCE, then a
+# fork per task — the per-electron cost collapses from interpreter startup +
+# imports (seconds) to a fork (milliseconds).  Speaks the same newline-JSON
+# protocol as the native C++ agent (native/agent.cc) so the dispatcher
+# drives both through one client, with `spec` instead of `argv`:
+#
+#   -> {"cmd":"run","id":"op","spec":"/path/spec.json","log":"/path/log"}
+#   <- {"event":"started","id":"op","pid":123}
+#   <- {"event":"exit","id":"op","code":0,"signal":0}
+#
+# Fork-safety: the parent preloads modules (cloudpickle, jax, ...) but never
+# initializes an XLA backend or runs a computation — backend init happens in
+# each child, which is the documented-safe pattern (import before fork, use
+# after).  Children setsid into their own sessions, so they survive a pool/
+# channel death exactly like the other launch paths, and the dispatcher can
+# fall back to pid polling.
+# --------------------------------------------------------------------------
+
+
+def _emit(obj: dict) -> None:
+    sys.stdout.write(json.dumps(obj) + "\n")
+    sys.stdout.flush()
+
+
+def _spawn_task(command: dict, children: dict) -> None:
+    task_id = command.get("id")
+    spec_path = command.get("spec")
+    if not task_id or not spec_path:
+        _emit({"event": "error", "id": task_id or "",
+               "message": "run requires id and spec"})
+        return
+    sys.stdout.flush()
+    pid = os.fork()
+    if pid == 0:
+        rc = 1
+        try:
+            import signal as _signal
+
+            _signal.set_wakeup_fd(-1)
+            _signal.signal(_signal.SIGCHLD, _signal.SIG_DFL)
+            os.setsid()
+            log_fd = os.open(
+                command.get("log") or os.devnull,
+                os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                0o644,
+            )
+            devnull = os.open(os.devnull, os.O_RDONLY)
+            os.dup2(devnull, 0)
+            os.dup2(log_fd, 1)
+            os.dup2(log_fd, 2)
+            with open(spec_path) as f:
+                spec = json.load(f)
+            rc = run_task(spec)
+        except BaseException:  # noqa: BLE001 - child must never return
+            import traceback
+
+            traceback.print_exc()
+        finally:
+            os._exit(rc)
+    children[pid] = task_id
+    _emit({"event": "started", "id": task_id, "pid": pid})
+
+
+def _reap(children: dict) -> None:
+    while True:
+        try:
+            pid, status = os.waitpid(-1, os.WNOHANG)
+        except ChildProcessError:
+            return
+        if pid <= 0:
+            return
+        task_id = children.pop(pid, None)
+        if task_id is None:
+            continue
+        code = os.waitstatus_to_exitcode(status)
+        _emit({
+            "event": "exit",
+            "id": task_id,
+            "code": code if code >= 0 else -1,
+            "signal": -code if code < 0 else 0,
+        })
+
+
+def serve() -> int:
+    """Forkserver main loop: poll stdin commands + a SIGCHLD wakeup pipe."""
+    import selectors
+    import signal
+
+    for mod in filter(None, os.environ.get(
+        "COVALENT_TPU_POOL_PRELOAD", "cloudpickle"
+    ).split(",")):
+        try:
+            __import__(mod.strip())
+        except Exception as preload_error:  # noqa: BLE001 - children retry
+            print(f"preload {mod} failed: {preload_error}", file=sys.stderr)
+
+    rpipe, wpipe = os.pipe()
+    os.set_blocking(rpipe, False)
+    os.set_blocking(wpipe, False)
+    signal.set_wakeup_fd(wpipe)
+    signal.signal(signal.SIGCHLD, lambda *_: None)
+    signal.signal(signal.SIGPIPE, signal.SIG_IGN)
+
+    sel = selectors.DefaultSelector()
+    sel.register(0, selectors.EVENT_READ, "stdin")
+    sel.register(rpipe, selectors.EVENT_READ, "sigchld")
+
+    children: dict = {}
+    buffer = ""
+    running = True
+    stdin_open = True
+    _emit({"event": "ready", "pid": os.getpid(), "mode": "pool"})
+
+    while running and (stdin_open or children):
+        for key, _ in sel.select():
+            if key.data == "sigchld":
+                try:
+                    while os.read(rpipe, 512):
+                        pass
+                except BlockingIOError:
+                    pass
+                _reap(children)
+                continue
+            data = os.read(0, 65536)
+            if not data:
+                # Channel dropped: children keep running in their own
+                # sessions; serve until they are all reaped, then exit.
+                stdin_open = False
+                sel.unregister(0)
+                continue
+            buffer += data.decode(errors="replace")
+            while "\n" in buffer:
+                line, buffer = buffer.split("\n", 1)
+                if not line.strip():
+                    continue
+                try:
+                    command = json.loads(line)
+                except ValueError:
+                    _emit({"event": "error", "message": "malformed command"})
+                    continue
+                name = command.get("cmd")
+                if name == "ping":
+                    _emit({"event": "pong"})
+                elif name == "run":
+                    _spawn_task(command, children)
+                elif name == "kill":
+                    target = command.get("id")
+                    sig = int(command.get("sig", 15))
+                    for pid, task_id in list(children.items()):
+                        if task_id == target:
+                            # Group AND direct pid: a kill racing the child's
+                            # setsid() would otherwise no-op (same guard as
+                            # native/agent.cc kill_task).
+                            try:
+                                os.killpg(pid, sig)
+                            except ProcessLookupError:
+                                pass
+                            try:
+                                os.kill(pid, sig)
+                            except ProcessLookupError:
+                                pass
+                            _emit({"event": "killed", "id": target})
+                            break
+                    else:
+                        _emit({"event": "error", "id": target or "",
+                               "message": "unknown task id"})
+                elif name == "shutdown":
+                    _emit({"event": "bye"})
+                    running = False
+                else:
+                    _emit({"event": "error",
+                           "message": f"unknown cmd: {name}"})
+        _reap(children)  # belt-and-braces against missed wakeups
+    return 0
+
+
 def main(argv: list[str]) -> int:
+    if len(argv) == 2 and argv[1] == "--serve":
+        return serve()
     if len(argv) != 2:
-        print("usage: harness.py <task_spec.json>", file=sys.stderr)
+        print("usage: harness.py <task_spec.json> | --serve", file=sys.stderr)
         return 2
     with open(argv[1]) as f:
         spec = json.load(f)

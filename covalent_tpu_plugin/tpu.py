@@ -39,7 +39,13 @@ from pathlib import Path
 from typing import Any, Callable, Sequence
 
 from . import harness as _harness_module
-from .agent import AgentClient, AgentError, ensure_agent_binary
+from .agent import (
+    HARNESS_BASENAME,
+    AgentClient,
+    AgentError,
+    ensure_agent_binary,
+    start_pool_server,
+)
 from .executor_base import RemoteExecutor
 from .transport import (
     LocalTransport,
@@ -81,6 +87,10 @@ _EXECUTOR_PLUGIN_DEFAULTS = {
     "task_timeout": 0.0,
     "task_env": {},
     "use_agent": True,
+    # NOT jax by default: forking a parent that already imported jax (PJRT
+    # plugins register at import) measurably slows TPU backend init in the
+    # children; interpreter+sitecustomize startup is the big win anyway.
+    "pool_preload": "cloudpickle",
     "profile_dir": "",
 }
 
@@ -107,7 +117,7 @@ class StagedTask:
         self.local_spec_files: list[str] = []
         self.remote_cache = remote_cache
         self.remote_function_file = f"{remote_cache}/function_{operation_id}.pkl"
-        self.remote_harness_file = f"{remote_cache}/covalent_tpu_harness.py"
+        self.remote_harness_file = f"{remote_cache}/{HARNESS_BASENAME}"
         self.remote_result_file = f"{remote_cache}/result_{operation_id}.pkl"
         self.remote_log_file = f"{remote_cache}/log_{operation_id}.txt"
         self.remote_pid_file = f"{remote_cache}/pid_{operation_id}"
@@ -149,7 +159,8 @@ class TPUExecutor(RemoteExecutor):
         coordinator_port: int | None = None,
         task_timeout: float | None = None,
         task_env: dict[str, str] | None = None,
-        use_agent: bool | None = None,
+        use_agent: bool | str | None = None,
+        pool_preload: str | None = None,
         profile_dir: str | None = None,
         pool: TransportPool | None = None,
     ) -> None:
@@ -192,11 +203,21 @@ class TPUExecutor(RemoteExecutor):
         #: remote dir for jax.profiler traces; empty disables (SURVEY §5 —
         #: the reference has no tracing subsystem at all).
         self.profile_dir = str(resolve(profile_dir, "profile_dir") or "")
-        #: prefer the resident worker agent (native/agent.cc): push-based
-        #: completion over one channel instead of status-probe round-trips.
-        #: Auto-degrades per worker to the nohup+poll protocol when the
-        #: worker can't build or run the agent.
-        self.use_agent = bool(resolve(use_agent, "use_agent"))
+        #: resident worker runtime: push-based completion over one channel
+        #: instead of status-probe round-trips.  True/"auto" prefers the
+        #: harness forkserver pool (pre-warmed imports, fork per task) and
+        #: falls back to the native C++ agent, then to nohup+poll; "pool" or
+        #: "native" pins one; False disables both.
+        self.use_agent = resolve(use_agent, "use_agent")
+        if self.use_agent not in (True, False, "auto", "pool", "native", "off"):
+            raise ValueError(
+                f"use_agent must be True/False/'auto'/'pool'/'native'/'off', "
+                f"got {self.use_agent!r}"
+            )
+        if self.use_agent == "off":
+            self.use_agent = False
+        #: comma-separated modules the pool server imports once at start.
+        self.pool_preload = str(resolve(pool_preload, "pool_preload"))
 
         resolved_poll_freq = float(resolve(poll_freq, "poll_freq"))
         resolved_remote_cache = resolve(remote_cache, "remote_cache")
@@ -493,6 +514,11 @@ class TPUExecutor(RemoteExecutor):
         """
         if not self.use_agent:
             return None
+        modes = (
+            ["pool", "native"]
+            if self.use_agent in (True, "auto")
+            else [str(self.use_agent)]
+        )
         # Single-flight per address: concurrent electrons must not each
         # compile/start an agent and orphan the loser's process.
         lock = self._agent_locks.setdefault(conn.address, asyncio.Lock())
@@ -502,29 +528,50 @@ class TPUExecutor(RemoteExecutor):
                 if client is None or client.alive:
                     return client
                 await client.close()  # stale channel; rebuild below
-            try:
-                binary = await ensure_agent_binary(conn, self.remote_cache)
-                client = await AgentClient.start(conn, binary)
-            except (AgentError, TransportError) as err:
-                app_log.info(
-                    "worker %s: no resident agent (%s); using nohup+poll protocol",
-                    conn.address, err,
-                )
-                self._agents[conn.address] = None
-                return None
-            self._agents[conn.address] = client
-            return client
+            for mode in modes:
+                try:
+                    if mode == "pool":
+                        client = await start_pool_server(
+                            conn,
+                            self.remote_cache,
+                            self.python_path,
+                            conda_env=self.conda_env,
+                            preload=self.pool_preload,
+                        )
+                    else:
+                        binary = await ensure_agent_binary(conn, self.remote_cache)
+                        client = await AgentClient.start(conn, binary)
+                except (AgentError, TransportError) as err:
+                    app_log.info(
+                        "worker %s: no %s runtime (%s)", conn.address, mode, err
+                    )
+                    continue
+                self._agents[conn.address] = client
+                return client
+            app_log.info(
+                "worker %s: no resident runtime; using nohup+poll protocol",
+                conn.address,
+            )
+            self._agents[conn.address] = None
+            return None
 
     async def _submit_via_agent(
         self, client: AgentClient, staged: StagedTask, process_id: int
     ) -> int:
-        """Launch one worker's harness through its agent; returns the PID.
+        """Launch one worker's harness through its resident runtime.
 
-        The command line is identical to :meth:`submit_task`'s — same
-        harness, same spec file, same log — only the launch/notification
-        mechanism differs, so every downstream probe (pid liveness, result
-        file, cancel-by-pid) works unchanged if the agent channel later dies.
+        Pool mode forks the pre-warmed interpreter directly on the spec;
+        native mode execs the same command line :meth:`submit_task` would.
+        Either way the task artifacts (spec, log, result, PID semantics) are
+        identical, so every downstream probe (pid liveness, result file,
+        cancel-by-pid) works unchanged if the channel later dies.
         """
+        if client.mode == "pool":
+            return await client.run_task(
+                staged.operation_id,
+                spec=staged.remote_spec_file(process_id),
+                log=staged.remote_log_file,
+            )
         return await client.run_task(
             staged.operation_id,
             ["/bin/sh", "-c", self._task_command(staged, process_id)],

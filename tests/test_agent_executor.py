@@ -88,18 +88,21 @@ def test_agent_unavailable_falls_back_to_polling(tmp_path, run_async):
             poll_freq=0.2,
             use_agent=True,
         )
-        # Force the compile to fail: make ensure_agent_binary see no compiler.
+        # Force both resident runtimes to fail: no pool, no compiler.
         from covalent_tpu_plugin import tpu as tpu_mod
 
-        async def no_agent(conn, remote_cache):
-            raise tpu_mod.AgentError("scripted: no compiler")
+        async def no_agent(*args, **kwargs):
+            raise tpu_mod.AgentError("scripted: unavailable")
 
-        orig = tpu_mod.ensure_agent_binary
+        orig_binary = tpu_mod.ensure_agent_binary
+        orig_pool = tpu_mod.start_pool_server
         tpu_mod.ensure_agent_binary = no_agent
+        tpu_mod.start_pool_server = no_agent
         try:
             result = await ex.run(lambda: "polled", [], {}, METADATA)
         finally:
-            tpu_mod.ensure_agent_binary = orig
+            tpu_mod.ensure_agent_binary = orig_binary
+            tpu_mod.start_pool_server = orig_pool
         cached = ex._agents.get("localhost", "missing")
         await ex.close()
         return result, cached

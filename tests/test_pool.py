@@ -1,0 +1,169 @@
+"""Harness forkserver pool (`harness.py --serve`).
+
+Drives the real pool protocol end-to-end: a resident interpreter that
+preloads modules once and forks per task, speaking the native agent's JSON
+protocol.  Verifies the fork path executes specs correctly, pushes exit
+events, and that the executor's auto mode picks the pool and reuses it.
+"""
+
+import asyncio
+import json
+import sys
+
+import pytest
+
+from covalent_tpu_plugin import harness
+from covalent_tpu_plugin.agent import AgentClient, start_pool_server
+from covalent_tpu_plugin.transport import LocalTransport
+from covalent_tpu_plugin.utils.serialize import dump_task, load_result
+
+from .helpers import make_local_executor
+
+METADATA = {"dispatch_id": "dP", "node_id": 0}
+
+
+def _stage_spec(tmp_path, fn, args=(), name="t"):
+    function_file = tmp_path / f"fn_{name}.pkl"
+    result_file = tmp_path / f"res_{name}.pkl"
+    dump_task(fn, args, {}, function_file)
+    spec = {
+        "function_file": str(function_file),
+        "result_file": str(result_file),
+        "workdir": str(tmp_path / "wd"),
+    }
+    spec_file = tmp_path / f"spec_{name}.json"
+    spec_file.write_text(json.dumps(spec))
+    return str(spec_file), result_file
+
+
+def test_pool_server_runs_spec_and_pushes_exit(tmp_path, run_async):
+    async def flow():
+        conn = LocalTransport()
+        client = await start_pool_server(
+            conn, str(tmp_path / "remote"), sys.executable, preload="cloudpickle"
+        )
+        assert client.mode == "pool"
+        spec_file, result_file = _stage_spec(tmp_path, lambda a: a + 1, (41,))
+        pid = await client.run_task(
+            "t1", spec=spec_file, log=str(tmp_path / "t1.log"), timeout=30.0
+        )
+        code, signal = await client.wait_exit("t1", timeout=30.0)
+        await client.close()
+        return pid, code, signal, load_result(result_file)
+
+    pid, code, signal, (result, exception) = run_async(flow())
+    assert pid > 0 and code == 0 and signal == 0
+    assert result == 42 and exception is None
+
+
+def test_pool_forks_are_concurrent_and_isolated(tmp_path, run_async):
+    """Two tasks forked from one server run simultaneously and don't share
+    mutable state (each fork gets its own copy-on-write interpreter)."""
+
+    def slow_electron(marker_path, delay):
+        import os
+        import time
+
+        time.sleep(delay)
+        return os.getpid()
+
+    async def flow():
+        conn = LocalTransport()
+        client = await start_pool_server(
+            conn, str(tmp_path / "remote"), sys.executable, preload="cloudpickle"
+        )
+        spec_a, res_a = _stage_spec(tmp_path, slow_electron, ("a", 0.6), "a")
+        spec_b, res_b = _stage_spec(tmp_path, slow_electron, ("b", 0.6), "b")
+        import time
+
+        t0 = time.perf_counter()
+        await client.run_task("a", spec=spec_a, timeout=30.0)
+        await client.run_task("b", spec=spec_b, timeout=30.0)
+        await asyncio.gather(
+            client.wait_exit("a", timeout=30.0), client.wait_exit("b", timeout=30.0)
+        )
+        elapsed = time.perf_counter() - t0
+        await client.close()
+        return elapsed, load_result(res_a)[0], load_result(res_b)[0]
+
+    elapsed, pid_a, pid_b = run_async(flow())
+    assert pid_a != pid_b  # separate forked processes
+    # The property is OVERLAP, not absolute speed: two 0.6 s sleeps run
+    # serially take >= 1.2 s; leave generous slack for loaded CI.
+    assert elapsed < 1.1
+
+
+def test_pool_transports_electron_exception(tmp_path, run_async):
+    def boom():
+        raise ValueError("pool-boom")
+
+    async def flow():
+        conn = LocalTransport()
+        client = await start_pool_server(
+            conn, str(tmp_path / "remote"), sys.executable, preload="cloudpickle"
+        )
+        spec_file, result_file = _stage_spec(tmp_path, boom)
+        await client.run_task("t", spec=spec_file, timeout=30.0)
+        code, _ = await client.wait_exit("t", timeout=30.0)
+        await client.close()
+        return code, load_result(result_file)
+
+    code, (result, exception) = run_async(flow())
+    assert code == 0  # harness succeeded; the error travels in the pickle
+    assert isinstance(exception, ValueError) and "pool-boom" in str(exception)
+
+
+def test_pool_kill_terminates_fork(tmp_path, run_async):
+    def sleeper():
+        import time
+
+        time.sleep(30)
+
+    async def flow():
+        conn = LocalTransport()
+        client = await start_pool_server(
+            conn, str(tmp_path / "remote"), sys.executable, preload="cloudpickle"
+        )
+        spec_file, _ = _stage_spec(tmp_path, sleeper)
+        await client.run_task("victim", spec=spec_file, timeout=30.0)
+        await client.kill("victim")
+        code, signal = await client.wait_exit("victim", timeout=30.0)
+        await client.close()
+        return code, signal
+
+    code, signal = run_async(flow())
+    assert signal == 15 or code != 0
+
+
+def test_executor_auto_mode_prefers_pool_and_reuses_it(tmp_path, run_async):
+    async def flow():
+        ex = make_local_executor(tmp_path, use_agent=True, pool_preload="cloudpickle")
+        first = await ex.run(lambda: 1, [], {}, METADATA)
+        client = ex._agents.get("localhost")
+        second = await ex.run(lambda: 2, [], {}, {"dispatch_id": "dP", "node_id": 1})
+        same = ex._agents.get("localhost") is client
+        await ex.close()
+        return first, second, client.mode if client else None, same
+
+    first, second, mode, same = run_async(flow())
+    assert (first, second) == (1, 2)
+    assert mode == "pool"
+    assert same
+
+
+def test_executor_pinned_native_mode_still_works(tmp_path, run_async):
+    import shutil
+
+    if all(shutil.which(cc) is None for cc in ("g++", "c++", "clang++")):
+        pytest.skip("no C++ compiler")
+
+    async def flow():
+        ex = make_local_executor(tmp_path, use_agent="native")
+        result = await ex.run(lambda: "native", [], {}, METADATA)
+        mode = ex._agents["localhost"].mode
+        await ex.close()
+        return result, mode
+
+    result, mode = run_async(flow())
+    assert result == "native"
+    assert mode == "native"
