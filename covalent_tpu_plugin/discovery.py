@@ -1,0 +1,102 @@
+"""TPU pod worker discovery (SURVEY §5 config: ``tpu_name``/``zone``/``project``).
+
+The reference takes exactly one ``hostname`` (``covalent_ssh_plugin/
+ssh.py:77``); a TPU pod slice is N workers whose addresses live in GCP
+metadata.  Given a TPU name, this module resolves every worker's control-
+plane address with ``gcloud compute tpus tpu-vm describe`` so users write
+
+    TPUExecutor(tpu_name="my-v5e-16", zone="us-west4-a", project="p")
+
+instead of enumerating worker IPs by hand.  The gcloud invocation is
+overridable via ``COVALENT_TPU_GCLOUD_CMD`` (tests substitute a recorder;
+air-gapped deployments can point at a wrapper).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shlex
+import subprocess
+
+from .transport.base import TransportError
+
+
+class DiscoveryError(TransportError):
+    """gcloud missing/failed or returned no usable worker endpoints.
+
+    A :class:`TransportError` so the executor's could-not-reach-workers
+    routing (local fallback / RuntimeError policy) applies uniformly.
+    """
+
+
+def discover_tpu_endpoints(
+    tpu_name: str,
+    zone: str = "",
+    project: str = "",
+    timeout: float = 60.0,
+) -> list[tuple[str, str]]:
+    """``(external_ip, internal_ip)`` per worker, in worker index order.
+
+    Worker order matters: worker 0 hosts the ``jax.distributed``
+    coordinator, and GCP's ``networkEndpoints`` list is already in worker
+    index order.  Callers pick per plane: the SSH control plane usually
+    needs the external IP (dispatcher outside the VPC), while the
+    coordinator address must be the *internal* IP — default GCP firewalls
+    only allow arbitrary ports within the VPC, so workers dialing worker
+    0's external IP would hang in ``jax.distributed.initialize``.
+    """
+    base = shlex.split(os.environ.get("COVALENT_TPU_GCLOUD_CMD", "")) or ["gcloud"]
+    argv = base + ["compute", "tpus", "tpu-vm", "describe", tpu_name, "--format=json"]
+    if zone:
+        argv += [f"--zone={zone}"]
+    if project:
+        argv += [f"--project={project}"]
+    try:
+        proc = subprocess.run(argv, capture_output=True, text=True, timeout=timeout)
+    except FileNotFoundError as err:
+        raise DiscoveryError(
+            f"cannot discover workers for {tpu_name!r}: {base[0]} not found "
+            "(install the Google Cloud SDK or set `workers` explicitly)"
+        ) from err
+    except subprocess.TimeoutExpired as err:
+        raise DiscoveryError(f"{base[0]} describe timed out for {tpu_name!r}") from err
+    if proc.returncode != 0:
+        raise DiscoveryError(
+            f"{base[0]} describe failed for {tpu_name!r}: {proc.stderr.strip()}"
+        )
+    try:
+        description = json.loads(proc.stdout)
+    except ValueError as err:
+        raise DiscoveryError(
+            f"unparseable describe output for {tpu_name!r}"
+        ) from err
+
+    endpoints: list[tuple[str, str]] = []
+    for endpoint in description.get("networkEndpoints") or []:
+        external = (endpoint.get("accessConfig") or {}).get("externalIp", "")
+        internal = endpoint.get("ipAddress", "")
+        if external or internal:
+            endpoints.append((external, internal))
+    if not endpoints:
+        raise DiscoveryError(
+            f"TPU {tpu_name!r} reports no network endpoints "
+            f"(state: {description.get('state', 'unknown')})"
+        )
+    return endpoints
+
+
+def discover_tpu_workers(
+    tpu_name: str,
+    zone: str = "",
+    project: str = "",
+    prefer_external: bool = True,
+    timeout: float = 60.0,
+) -> list[str]:
+    """Flat address list for one plane; see :func:`discover_tpu_endpoints`."""
+    return [
+        (ext or int_) if prefer_external else (int_ or ext)
+        for ext, int_ in discover_tpu_endpoints(
+            tpu_name, zone=zone, project=project, timeout=timeout
+        )
+    ]

@@ -89,6 +89,14 @@ def run_task(spec: dict) -> int:
     """Execute one staged task described by ``spec``.  Returns the exit code."""
     result_file = spec["result_file"]
 
+    pid_file = spec.get("pid_file")
+    if pid_file:
+        # First thing, before any failure mode: the dispatcher's orphan
+        # cleanup kills by this pid when a launch channel dies mid-submit
+        # (a pool fork keeps the server's cmdline, so pkill can't find it).
+        with open(pid_file, "w") as f:
+            f.write(str(os.getpid()))
+
     env = spec.get("env") or {}
     for key, value in env.items():
         os.environ[key] = str(value)

@@ -69,6 +69,10 @@ _EXECUTOR_PLUGIN_DEFAULTS = {
     "username": "",
     "hostname": "",
     "workers": [],
+    "tpu_name": "",
+    "zone": "",
+    "project": "",
+    "use_internal_ips": False,
     "ssh_key_file": os.path.join("~", ".ssh", "id_rsa"),
     "transport": "ssh",
     "cache_dir": os.path.join("~", ".cache", "covalent-tpu"),
@@ -141,6 +145,10 @@ class TPUExecutor(RemoteExecutor):
         username: str | None = None,
         hostname: str | None = None,
         workers: Sequence[str] | None = None,
+        tpu_name: str | None = None,
+        zone: str | None = None,
+        project: str | None = None,
+        use_internal_ips: bool | None = None,
         ssh_key_file: str | None = None,
         transport: str | None = None,
         cache_dir: str | None = None,
@@ -173,6 +181,13 @@ class TPUExecutor(RemoteExecutor):
         self.username = resolve(username, "username")
         self.hostname = resolve(hostname, "hostname")
         self.workers = list(resolve(workers, "workers") or [])
+        self.tpu_name = resolve(tpu_name, "tpu_name")
+        self.zone = resolve(zone, "zone")
+        self.project = resolve(project, "project")
+        #: dial workers on VPC-internal IPs (dispatcher inside the project).
+        self.use_internal_ips = bool(resolve(use_internal_ips, "use_internal_ips"))
+        #: discovery cache: [(external_ip, internal_ip)] per worker.
+        self._discovered_endpoints: list[tuple[str, str]] | None = None
         self.transport_kind = resolve(transport, "transport")
         self.ssh_key_file = str(
             Path(resolve(ssh_key_file, "ssh_key_file")).expanduser().resolve()
@@ -256,17 +271,57 @@ class TPUExecutor(RemoteExecutor):
         needs no address at all.
         """
         if self.workers:
+            if len(set(self.workers)) != len(self.workers):
+                # PIDs/pool keys are keyed by address; duplicates would alias.
+                raise ValueError(f"duplicate worker addresses: {self.workers}")
             return list(self.workers)
+        if self.tpu_name:
+            endpoints = self._discover_endpoints()
+            if self.use_internal_ips:
+                return [internal or external for external, internal in endpoints]
+            return [external or internal for external, internal in endpoints]
         if self.hostname:
             return [self.hostname]
         if self.transport_kind == "local":
             return ["localhost"]
-        raise ValueError("TPUExecutor needs `hostname` or `workers` (or transport='local')")
+        raise ValueError(
+            "TPUExecutor needs `tpu_name`, `hostname`, or `workers` "
+            "(or transport='local')"
+        )
 
     def _num_processes(self) -> int:
         return len(self._worker_addresses())
 
+    def _discover_endpoints(self) -> list[tuple[str, str]]:
+        """Cached ``(external, internal)`` endpoints for ``tpu_name``."""
+        if self._discovered_endpoints is None:
+            from .discovery import discover_tpu_endpoints
+
+            self._discovered_endpoints = discover_tpu_endpoints(
+                self.tpu_name, zone=self.zone, project=self.project
+            )
+            app_log.info(
+                "TPU %s: discovered %d worker(s)",
+                self.tpu_name, len(self._discovered_endpoints),
+            )
+        return self._discovered_endpoints
+
+    async def _ensure_workers(self) -> None:
+        """Warm the discovery cache off the event loop (gcloud can be slow)."""
+        if self.tpu_name and self._discovered_endpoints is None:
+            await asyncio.to_thread(self._discover_endpoints)
+
     def _coordinator_address(self) -> str:
+        if self.transport_kind == "local":
+            # Local-transport "workers" are processes on this machine; their
+            # labels are bookkeeping names, not resolvable hosts.
+            return f"127.0.0.1:{self.coordinator_port}"
+        if self.tpu_name:
+            # Data plane stays on the VPC: workers dial worker 0's INTERNAL
+            # IP — default GCP firewalls block arbitrary ports on external
+            # IPs, which would hang every jax.distributed.initialize.
+            external, internal = self._discover_endpoints()[0]
+            return f"{internal or external}:{self.coordinator_port}"
         host = self._worker_addresses()[0]
         host = host.split("@", 1)[-1]  # strip user@ for the data plane
         return f"{host}:{self.coordinator_port}"
@@ -328,6 +383,7 @@ class TPUExecutor(RemoteExecutor):
 
     async def _connect_all(self) -> list[Transport]:
         """Open channels to every worker concurrently (all-or-nothing)."""
+        await self._ensure_workers()  # blocking gcloud discovery off-loop
         addresses = self._worker_addresses()
         results = await asyncio.gather(
             *(self._client_connect(a) for a in addresses), return_exceptions=True
@@ -385,6 +441,7 @@ class TPUExecutor(RemoteExecutor):
                 "function_file": staged.remote_function_file,
                 "result_file": staged.remote_result_file,
                 "workdir": current_remote_workdir,
+                "pid_file": f"{staged.remote_pid_file}.{process_id}",
             }
             if self.task_env:
                 spec["env"] = self.task_env
@@ -844,6 +901,7 @@ class TPUExecutor(RemoteExecutor):
                 staged.remote_function_file,
                 staged.remote_spec_file(process_id),
                 staged.remote_log_file,
+                f"{staged.remote_pid_file}.{process_id}",
             ]
             if process_id == 0:
                 files.append(staged.remote_result_file)
@@ -1028,10 +1086,17 @@ class TPUExecutor(RemoteExecutor):
                         # The run command reached (or may have reached) the
                         # worker before the channel failed: the harness could
                         # already be alive.  Relaunching would double-run the
-                        # task; kill any orphan by its unique spec path and
-                        # abort this worker's launch instead.
+                        # task; kill any orphan and abort this worker's
+                        # launch instead.  Two handles cover both runtimes:
+                        # the pid file the harness writes at startup (pool
+                        # forks keep the server's cmdline, so pkill alone
+                        # can't find them) and the spec path in the native
+                        # agent's exec'd command line.
+                        pid_file = f"{staged.remote_pid_file}.{i}"
                         await conn.run(
-                            "pkill -f "
+                            f"[ -f {shlex.quote(pid_file)} ] && "
+                            f"kill -TERM $(cat {shlex.quote(pid_file)}) "
+                            "2>/dev/null; pkill -f "
                             + shlex.quote(staged.remote_spec_file(i))
                             + " 2>/dev/null || true"
                         )

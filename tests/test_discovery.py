@@ -1,0 +1,125 @@
+"""TPU pod worker discovery via gcloud metadata (tpu_name/zone/project).
+
+The gcloud binary is substituted through ``COVALENT_TPU_GCLOUD_CMD`` (the
+same override pattern as the pip/test contract), so these tests exercise
+the real subprocess + JSON parsing path without the Cloud SDK.
+"""
+
+import json
+import shlex
+import sys
+
+import pytest
+
+from covalent_tpu_plugin.discovery import DiscoveryError, discover_tpu_workers
+
+DESCRIBE = {
+    "name": "projects/p/locations/us-west4-a/nodes/my-tpu",
+    "state": "READY",
+    "networkEndpoints": [
+        {"ipAddress": "10.0.0.2", "accessConfig": {"externalIp": "34.1.1.1"}},
+        {"ipAddress": "10.0.0.3", "accessConfig": {"externalIp": "34.1.1.2"}},
+    ],
+}
+
+
+def _fake_gcloud(tmp_path, monkeypatch, payload, record_to=None, exit_code=0):
+    out = tmp_path / "payload.json"
+    out.write_text(payload if isinstance(payload, str) else json.dumps(payload))
+    record = record_to or (tmp_path / "argv.json")
+    monkeypatch.setenv(
+        "COVALENT_TPU_GCLOUD_CMD",
+        f"{shlex.quote(sys.executable)} -c "
+        + shlex.quote(
+            "import json,sys; json.dump(sys.argv[1:], open("
+            + repr(str(record)) + ", 'w'));"
+            + "sys.stdout.write(open(" + repr(str(out)) + ").read());"
+            + f"sys.exit({exit_code})"
+        ),
+    )
+    return record
+
+
+def test_discovers_workers_in_order(tmp_path, monkeypatch):
+    record = _fake_gcloud(tmp_path, monkeypatch, DESCRIBE)
+    workers = discover_tpu_workers("my-tpu", zone="us-west4-a", project="p")
+    assert workers == ["34.1.1.1", "34.1.1.2"]
+    argv = json.loads(record.read_text())
+    assert argv[:5] == ["compute", "tpus", "tpu-vm", "describe", "my-tpu"]
+    assert "--zone=us-west4-a" in argv and "--project=p" in argv
+
+
+def test_prefers_internal_when_asked(tmp_path, monkeypatch):
+    _fake_gcloud(tmp_path, monkeypatch, DESCRIBE)
+    workers = discover_tpu_workers("my-tpu", prefer_external=False)
+    assert workers == ["10.0.0.2", "10.0.0.3"]
+
+
+def test_gcloud_failure_raises_discovery_error(tmp_path, monkeypatch):
+    _fake_gcloud(tmp_path, monkeypatch, DESCRIBE, exit_code=1)
+    with pytest.raises(DiscoveryError, match="describe failed"):
+        discover_tpu_workers("my-tpu")
+
+
+def test_no_endpoints_raises(tmp_path, monkeypatch):
+    _fake_gcloud(
+        tmp_path, monkeypatch, {"state": "CREATING", "networkEndpoints": []}
+    )
+    with pytest.raises(DiscoveryError, match="CREATING"):
+        discover_tpu_workers("my-tpu")
+
+
+def test_executor_uses_discovery_and_caches_it(tmp_path, monkeypatch):
+    from covalent_tpu_plugin import TPUExecutor
+
+    _fake_gcloud(tmp_path, monkeypatch, DESCRIBE)
+    key = tmp_path / "key"
+    key.write_text("")
+    ex = TPUExecutor(
+        transport="ssh",
+        tpu_name="my-tpu",
+        zone="us-west4-a",
+        project="p",
+        ssh_key_file=str(key),
+        cache_dir=str(tmp_path / "cache"),
+        use_agent=False,
+    )
+    assert ex._worker_addresses() == ["34.1.1.1", "34.1.1.2"]
+    assert ex._num_processes() == 2
+    # Control plane dials external IPs; the coordinator must be INTERNAL
+    # (VPC-reachable), or workers hang in jax.distributed.initialize.
+    assert ex._coordinator_address() == f"10.0.0.2:{ex.coordinator_port}"
+    # Second call must hit the cache, not re-invoke gcloud.
+    monkeypatch.setenv("COVALENT_TPU_GCLOUD_CMD", "/nonexistent-gcloud")
+    assert ex._worker_addresses() == ["34.1.1.1", "34.1.1.2"]
+
+
+def test_executor_internal_ip_mode(tmp_path, monkeypatch):
+    from covalent_tpu_plugin import TPUExecutor
+
+    _fake_gcloud(tmp_path, monkeypatch, DESCRIBE)
+    key = tmp_path / "key"
+    key.write_text("")
+    ex = TPUExecutor(
+        transport="ssh",
+        tpu_name="my-tpu",
+        use_internal_ips=True,
+        ssh_key_file=str(key),
+        cache_dir=str(tmp_path / "cache"),
+        use_agent=False,
+    )
+    assert ex._worker_addresses() == ["10.0.0.2", "10.0.0.3"]
+
+
+def test_explicit_workers_override_discovery(tmp_path, monkeypatch):
+    from covalent_tpu_plugin import TPUExecutor
+
+    monkeypatch.setenv("COVALENT_TPU_GCLOUD_CMD", "/nonexistent-gcloud")
+    ex = TPUExecutor(
+        transport="local",
+        tpu_name="my-tpu",
+        workers=["w0", "w1"],
+        cache_dir=str(tmp_path / "cache"),
+        use_agent=False,
+    )
+    assert ex._worker_addresses() == ["w0", "w1"]  # gcloud never consulted

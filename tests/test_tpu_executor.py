@@ -120,15 +120,28 @@ def test_worker_addresses_require_topology(tmp_path):
 
 
 def test_worker_addresses_explicit_workers_win(tmp_path):
-    ex = make_executor(tmp_path, hostname="solo", workers=["w0", "w1"])
+    ex = make_executor(tmp_path, transport="ssh", hostname="solo", workers=["w0", "w1"])
     assert ex._worker_addresses() == ["w0", "w1"]
     assert ex._num_processes() == 2
     assert ex._coordinator_address() == f"w0:{ex.coordinator_port}"
 
 
 def test_coordinator_address_strips_username(tmp_path):
-    ex = make_executor(tmp_path, workers=["alice@w0", "alice@w1"], coordinator_port=9000)
+    ex = make_executor(
+        tmp_path, transport="ssh", workers=["alice@w0", "alice@w1"], coordinator_port=9000
+    )
     assert ex._coordinator_address() == "w0:9000"
+
+
+def test_coordinator_address_local_transport_is_loopback(tmp_path):
+    ex = make_executor(tmp_path, workers=["w0", "w1"], coordinator_port=9000)
+    assert ex._coordinator_address() == "127.0.0.1:9000"
+
+
+def test_duplicate_worker_addresses_rejected(tmp_path):
+    ex = make_executor(tmp_path, workers=["w0", "w0"])
+    with pytest.raises(ValueError, match="duplicate"):
+        ex._worker_addresses()
 
 
 # --------------------------------------------------------------------- #
@@ -176,7 +189,7 @@ def test_file_writes_multi_worker_specs(tmp_path):
     for process_id, path in enumerate(staged.local_spec_files):
         spec = json.load(open(path))
         assert spec["distributed"] == {
-            "coordinator_address": "w0:8111",
+            "coordinator_address": "127.0.0.1:8111",  # local transport -> loopback
             "num_processes": 3,
             "process_id": process_id,
         }
