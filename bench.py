@@ -40,6 +40,35 @@ def trivial_electron(i: int) -> int:
     return i * i
 
 
+def matmul_electron(n: int, iters: int) -> dict:
+    """BASELINE config 2: n×n bf16 einsum on the accelerator, TFLOP/s."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones((n, n), jnp.bfloat16)
+    y = jnp.ones((n, n), jnp.bfloat16)
+
+    @jax.jit
+    def mm(a, b):
+        return jnp.einsum("ij,jk->ik", a, b)
+
+    jax.device_get(mm(x, y)[0, 0])  # compile + warm
+    t0 = time.perf_counter()
+    out = x
+    for _ in range(iters):
+        out = mm(out, y)
+    # device_get, not block_until_ready: proxy/tunnel backends can make the
+    # latter a no-op, and a fetched scalar can't lie about completion.
+    jax.device_get(out[0, 0])
+    elapsed = time.perf_counter() - t0
+    return {
+        "tflops": (2 * n**3 * iters) / elapsed / 1e12,
+        "backend": jax.devices()[0].platform,
+    }
+
+
 def mnist_train_electron(steps: int, batch_size: int) -> dict:
     """Train the Flax MLP on synthetic MNIST; returns loss curve + rate.
 
@@ -153,6 +182,11 @@ async def main() -> dict:
     )
     fanout_wall = time.perf_counter() - fanout_start
 
+    # BASELINE config 2: single-electron 4k×4k einsum on the chip.
+    matmul_stats = await executor.run(
+        matmul_electron, [4096, 64], {}, {"dispatch_id": "mm", "node_id": 0}
+    )
+
     wall_start = time.perf_counter()
     train_stats = await executor.run(
         mnist_train_electron,
@@ -177,6 +211,7 @@ async def main() -> dict:
         "fanout8_wall_s": round(fanout_wall, 3),
         "fanout8_per_electron_s": round(fanout_wall / 8, 4),
         "fanout8_speedup_vs_serial": round(8 * single_wall / fanout_wall, 2),
+        "matmul4k_tflops": round(matmul_stats["tflops"], 2),
         "train_backend": train_stats["backend"],
     }
 

@@ -94,8 +94,11 @@ def run_task(spec: dict) -> int:
         # First thing, before any failure mode: the dispatcher's orphan
         # cleanup kills by this pid when a launch channel dies mid-submit
         # (a pool fork keeps the server's cmdline, so pkill can't find it).
-        with open(pid_file, "w") as f:
+        # Atomic write: a reader must never observe an empty pid file.
+        tmp_pid = f"{pid_file}.tmp.{os.getpid()}"
+        with open(tmp_pid, "w") as f:
             f.write(str(os.getpid()))
+        os.replace(tmp_pid, pid_file)
 
     env = spec.get("env") or {}
     for key, value in env.items():

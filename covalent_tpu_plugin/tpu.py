@@ -249,6 +249,9 @@ class TPUExecutor(RemoteExecutor):
         self._preflighted: set[int] = set()
         #: operation_id -> {worker address -> pid}; backs cancel().
         self._active: dict[str, dict[str, int]] = {}
+        #: operations killed by cancel(): their DEAD status must surface as
+        #: cancellation, never as a failure that re-runs the body locally.
+        self._cancelled_ops: set[str] = set()
         #: worker address -> AgentClient | None (None = worker can't run the
         #: agent; don't retry the compile every electron).
         self._agents: dict[str, Any] = {}
@@ -380,6 +383,10 @@ class TPUExecutor(RemoteExecutor):
             if client is not None:
                 await client.close()
         self._preflighted.clear()
+        # A mid-run control-plane failure may mean the TPU itself was
+        # preempted/recreated with new IPs: re-discover on the next electron
+        # instead of dialing stale addresses forever.
+        self._discovered_endpoints = None
 
     async def _connect_all(self) -> list[Transport]:
         """Open channels to every worker concurrently (all-or-nothing)."""
@@ -409,6 +416,21 @@ class TPUExecutor(RemoteExecutor):
                 "dispatcher host (CPU)", message
             )
             return fn(*args, **kwargs)
+        app_log.error(message)
+        raise RuntimeError(message)
+
+    async def _on_dispatch_fail_async(
+        self, fn: Callable, args: tuple, kwargs: dict, message: str
+    ) -> Any:
+        """Async wrapper: the fallback body runs on a worker thread so a
+        long CPU electron cannot stall the (shared) dispatcher event loop —
+        every concurrent dispatch and agent channel lives there."""
+        if self.run_local_on_dispatch_fail:
+            app_log.warning(
+                "TPU dispatch failed (%s); running electron locally on the "
+                "dispatcher host (CPU)", message
+            )
+            return await asyncio.to_thread(fn, *args, **kwargs)
         app_log.error(message)
         raise RuntimeError(message)
 
@@ -874,6 +896,10 @@ class TPUExecutor(RemoteExecutor):
             else dict(self._active)
         )
         for op_id, pids in targets.items():
+            # Flag FIRST: the moment a kill lands, the op's poller can see
+            # DEAD and must classify it as cancelled, not failed (a failure
+            # with run_local_on_dispatch_fail would re-run the body).
+            self._cancelled_ops.add(op_id)
             for address, pid in pids.items():
                 try:
                     conn = await self._client_connect(address)
@@ -918,6 +944,50 @@ class TPUExecutor(RemoteExecutor):
             return_exceptions=True,
         )
 
+    def _guard_event_loop(self) -> None:
+        """Reset loop-bound state when the executor moves between loops.
+
+        Pooled transports, agent channels, and their locks/conditions are
+        bound to the event loop that created them.  A library user driving
+        the executor from successive ``asyncio.run`` calls would otherwise
+        hit dead-loop errors on the second run; the workflow layer avoids
+        this by using one shared dispatcher loop, so this guard is the
+        safety net for direct API use.
+        """
+        loop = asyncio.get_running_loop()
+        bound = getattr(self, "_bound_loop", None)
+        if bound is None:
+            self._bound_loop = loop
+            return
+        if bound is loop:
+            return
+        app_log.warning(
+            "TPUExecutor reused on a new event loop; abandoning pooled "
+            "transports and resident agent channels from the previous loop"
+        )
+        if not bound.is_closed():
+            # Best-effort teardown on the loop that owns the resources.
+            # A caller-shared pool (_owns_pool False) is NOT closed: other
+            # executors may be mid-electron on the old loop; we only drop
+            # our reference to it.
+            old_agents = dict(self._agents)
+            old_pool = self._pool if self._owns_pool else None
+
+            async def teardown() -> None:
+                for client in old_agents.values():
+                    if client is not None:
+                        await client.close()
+                if old_pool is not None:
+                    await old_pool.close_all()
+
+            asyncio.run_coroutine_threadsafe(teardown(), bound)
+        self._pool = TransportPool()
+        self._owns_pool = True
+        self._agents = {}
+        self._agent_locks = {}
+        self._preflighted.clear()
+        self._bound_loop = loop
+
     async def close(self) -> None:
         """Release agent channels + pooled transports (once per executor)."""
         for client in self._agents.values():
@@ -955,6 +1025,8 @@ class TPUExecutor(RemoteExecutor):
                 self.remote_workdir, dispatch_id, f"node_{node_id}"
             )
 
+        self._guard_event_loop()
+
         timer = StageTimer()
         staged: StagedTask | None = None
         conns: list[Transport] = []
@@ -974,7 +1046,7 @@ class TPUExecutor(RemoteExecutor):
                         *(self._agent_for(c) for c in conns),
                     )
             except (TransportError, OSError, ValueError) as err:
-                return self._on_dispatch_fail(
+                return await self._on_dispatch_fail_async(
                     function, args, kwargs, f"could not reach TPU workers: {err}"
                 )
 
@@ -996,8 +1068,12 @@ class TPUExecutor(RemoteExecutor):
                 with timer.stage("submit"):
                     pids = await self._launch_all(conns, staged)
             except TransportError as err:
+                if operation_id in self._cancelled_ops:
+                    raise asyncio.CancelledError(
+                        f"task {operation_id} cancelled during launch"
+                    ) from err
                 # Nonzero-submit routing mirrors ssh.py:553-557.
-                return self._on_dispatch_fail(
+                return await self._on_dispatch_fail_async(
                     function, args, kwargs, f"task launch failed: {err}"
                 )
 
@@ -1014,9 +1090,15 @@ class TPUExecutor(RemoteExecutor):
                     else:
                         status, blamed = await self._poll_all(conns, staged, pids)
                 if status is not TaskStatus.READY:
+                    if operation_id in self._cancelled_ops:
+                        # cancel() killed the harness: surface cancellation,
+                        # never the local-fallback re-run of the body.
+                        raise asyncio.CancelledError(
+                            f"task {operation_id} cancelled"
+                        )
                     log_tail = await self._remote_log_tail(conns[blamed], staged)
                     await self.cancel(operation_id)
-                    return self._on_dispatch_fail(
+                    return await self._on_dispatch_fail_async(
                         function,
                         args,
                         kwargs,
@@ -1052,6 +1134,7 @@ class TPUExecutor(RemoteExecutor):
         finally:
             self.last_timings = timer.summary()
             self._active.pop(operation_id, None)
+            self._cancelled_ops.discard(operation_id)
             # Release per-task state retained by resident agent channels
             # (e.g. straggler exit events whose waiters were cancelled).
             for client in self._op_agents.pop(operation_id, []) or []:
@@ -1091,15 +1174,26 @@ class TPUExecutor(RemoteExecutor):
                         # the pid file the harness writes at startup (pool
                         # forks keep the server's cmdline, so pkill alone
                         # can't find them) and the spec path in the native
-                        # agent's exec'd command line.
-                        pid_file = f"{staged.remote_pid_file}.{i}"
-                        await conn.run(
-                            f"[ -f {shlex.quote(pid_file)} ] && "
-                            f"kill -TERM $(cat {shlex.quote(pid_file)}) "
-                            "2>/dev/null; pkill -f "
+                        # agent's exec'd command line.  The pid file is
+                        # written moments after fork, so retry over a short
+                        # grace window rather than racing it once.
+                        pid_file = shlex.quote(f"{staged.remote_pid_file}.{i}")
+                        # -s (non-empty) + the harness's atomic pid write
+                        # mean a readable pid IS complete; echo only on a
+                        # kill that had a real target so the retry loop
+                        # can't declare victory on an empty race window.
+                        reap = (
+                            f"if [ -s {pid_file} ]; then "
+                            f"kill -TERM $(cat {pid_file}) 2>/dev/null; "
+                            "echo KILLED; fi; pkill -f "
                             + shlex.quote(staged.remote_spec_file(i))
-                            + " 2>/dev/null || true"
+                            + " 2>/dev/null && echo PKILLED || true"
                         )
+                        for _attempt in range(4):
+                            reaped = await conn.run(reap)
+                            if "KILLED" in reaped.stdout:  # matches PKILLED too
+                                break
+                            await asyncio.sleep(0.5)
                         raise TransportError(
                             f"agent submit on {conn.address} failed after the "
                             f"run command was sent: {err}"
@@ -1125,6 +1219,9 @@ class TPUExecutor(RemoteExecutor):
         self._op_agents[staged.operation_id] = launched_via
         if errors:
             await self.cancel(staged.operation_id)
+            # This is the all-or-nothing launch ABORT, not a user cancel:
+            # the failure must still route to the fallback policy.
+            self._cancelled_ops.discard(staged.operation_id)
             raise TransportError(
                 f"launch failed on {len(errors)}/{len(conns)} workers: {errors[0]}"
             ) from errors[0]

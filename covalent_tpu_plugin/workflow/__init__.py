@@ -25,9 +25,9 @@ module is simply not needed.
 """
 
 from .dag import Electron, Lattice, Node, electron, lattice
-from .deps import DepsCall, DepsPip
+from .deps import DepsBash, DepsCall, DepsPip
 from .executors import LocalExecutor, register_executor, resolve_executor
-from .runner import Result, Status, dispatch, get_result, dispatch_sync
+from .runner import Result, Status, cancel, dispatch, get_result, dispatch_sync
 
 __all__ = [
     "electron",
@@ -35,6 +35,8 @@ __all__ = [
     "dispatch",
     "dispatch_sync",
     "get_result",
+    "cancel",
+    "DepsBash",
     "DepsCall",
     "DepsPip",
     "Electron",

@@ -15,7 +15,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable, Sequence
 
-from .deps import DepsCall, DepsPip, _as_calls, wrap_task
+from .deps import DepsBash, DepsCall, DepsPip, _as_calls, wrap_task
 
 
 class Node:
@@ -93,6 +93,7 @@ class Electron:
         fn: Callable,
         executor: Any = "local",
         deps_pip: DepsPip | Sequence[str] | None = None,
+        deps_bash: Any = None,
         call_before: Sequence[Any] = (),
         call_after: Sequence[Any] = (),
     ):
@@ -101,6 +102,10 @@ class Electron:
         if deps_pip is not None and not isinstance(deps_pip, DepsPip):
             deps_pip = DepsPip(packages=deps_pip)
         self.deps_pip = deps_pip
+        if deps_bash is not None and not isinstance(deps_bash, DepsBash):
+            deps_bash = DepsBash(deps_bash)
+        # Bash deps are just call_before hooks that run shell commands.
+        call_before = ([deps_bash] if deps_bash else []) + list(call_before)
         self.call_before = _as_calls(call_before)
         self.call_after = _as_calls(call_after)
         self.__name__ = getattr(fn, "__name__", "electron")
@@ -134,6 +139,7 @@ def electron(
     *,
     executor: Any = "local",
     deps_pip: DepsPip | Sequence[str] | None = None,
+    deps_bash: Any = None,
     call_before: Sequence[Any] = (),
     call_after: Sequence[Any] = (),
 ) -> Any:
@@ -144,6 +150,7 @@ def electron(
             f,
             executor=executor,
             deps_pip=deps_pip,
+            deps_bash=deps_bash,
             call_before=call_before,
             call_after=call_after,
         )

@@ -70,6 +70,37 @@ class DepsCall:
         return self.fn(*self.args, **self.kwargs)
 
 
+class DepsBash(DepsCall):
+    """Shell commands run on the worker before the electron body.
+
+    Upstream Covalent's ``ct.DepsBash(["apt list", ...])`` surface: each
+    command runs under the worker's shell in the task's working directory;
+    a non-zero exit fails the electron with the command's stderr.
+    """
+
+    def __init__(self, commands: str | Sequence[str] = ()):
+        if isinstance(commands, str):
+            commands = [commands] if commands else []
+        self.commands: list[str] = list(commands)
+        super().__init__(self._run_commands)
+
+    def _run_commands(self) -> None:
+        import subprocess
+
+        for command in self.commands:
+            proc = subprocess.run(
+                command, shell=True, capture_output=True, text=True
+            )
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"DepsBash command failed ({command!r}, "
+                    f"exit {proc.returncode}): {proc.stderr.strip()}"
+                )
+
+    def __repr__(self) -> str:
+        return f"DepsBash({self.commands!r})"
+
+
 def _as_calls(hooks: Iterable[Any]) -> list[DepsCall]:
     out: list[DepsCall] = []
     for hook in hooks or ():

@@ -51,6 +51,12 @@ class Result:
     node_outputs: dict[int, Any] = field(default_factory=dict)
     node_errors: dict[int, str] = field(default_factory=dict)
     _done: threading.Event = field(default_factory=threading.Event, repr=False)
+    # Cancellation plumbing: the dispatch's loop + per-node tasks/executors,
+    # populated by _execute_graph (cancel() reaches in from another thread).
+    _loop: Any = field(default=None, repr=False)
+    _tasks: dict = field(default_factory=dict, repr=False)
+    _node_executors: dict = field(default_factory=dict, repr=False)
+    _cancelled: bool = field(default=False, repr=False)
 
     def wait(self, timeout: float | None = None) -> bool:
         return self._done.wait(timeout)
@@ -106,6 +112,7 @@ async def _execute_graph(graph: Graph, result: Result) -> None:
         args = _resolve_value(list(spec.args), result.node_outputs)
         kwargs = _resolve_value(dict(spec.kwargs), result.node_outputs)
         executor = executor_for(spec.executor)
+        result._node_executors[spec.node_id] = executor
         task_metadata = {"dispatch_id": dispatch_id, "node_id": spec.node_id}
         if spec.deps_pip and spec.deps_pip.packages:
             # Installed by the worker harness *before* unpickling the task
@@ -119,8 +126,16 @@ async def _execute_graph(graph: Graph, result: Result) -> None:
 
     try:
         loop = asyncio.get_running_loop()
+        result._loop = loop
+        if result._cancelled:
+            # Cancelled before the loop even started (ct.cancel immediately
+            # after ct.dispatch): never launch any electron.
+            result.status = Status.CANCELLED
+            result.error = "dispatch cancelled"
+            return
         for spec in graph.nodes:
             futures[spec.node_id] = loop.create_task(run_node(spec))
+        result._tasks = dict(futures)
         node_results = await asyncio.gather(*futures.values(), return_exceptions=True)
 
         failed = False
@@ -132,7 +147,10 @@ async def _execute_graph(graph: Graph, result: Result) -> None:
                 result.node_errors[spec.node_id] = "".join(
                     traceback.format_exception(node_result)
                 )
-        if failed:
+        if result._cancelled:
+            result.status = Status.CANCELLED
+            result.error = result.error or "dispatch cancelled"
+        elif failed:
             result.status = Status.FAILED
             result.error = "\n".join(result.node_errors.values())
         else:
@@ -153,25 +171,43 @@ async def _execute_graph(graph: Graph, result: Result) -> None:
         result._done.set()
 
 
-def dispatch(lattice: Lattice) -> Callable[..., str]:
-    """``dispatch(lattice)(*args, **kwargs) -> dispatch_id`` (non-blocking).
+_LOOP_LOCK = threading.Lock()
+_LOOP: Any = None
 
-    Runs the DAG on a dedicated event-loop thread — the standalone stand-in
-    for the Covalent server process (``tests.yml:80``).
+
+def _dispatcher_loop() -> asyncio.AbstractEventLoop:
+    """The ONE long-lived event loop all dispatches share.
+
+    A per-dispatch loop (the obvious design) breaks persistent executors: a
+    ``TPUExecutor``'s pooled transports and resident agent channels are
+    bound to the loop that created them, so the second lattice through the
+    same executor would find them on a dead loop.  One shared loop is also
+    what lets connection pooling and pre-flight caching amortise across
+    *dispatches*, not just across electrons of one lattice — the standalone
+    stand-in for the Covalent server process (``tests.yml:80``).
     """
+    global _LOOP
+    with _LOOP_LOCK:
+        if _LOOP is None or _LOOP.is_closed():
+            loop = asyncio.new_event_loop()
+            threading.Thread(
+                target=loop.run_forever, name="covalent-tpu-dispatcher", daemon=True
+            ).start()
+            _LOOP = loop
+        return _LOOP
+
+
+def dispatch(lattice: Lattice) -> Callable[..., str]:
+    """``dispatch(lattice)(*args, **kwargs) -> dispatch_id`` (non-blocking)."""
 
     def submit(*args, **kwargs) -> str:
         dispatch_id = str(uuid.uuid4())
         graph = lattice.build_graph(*args, **kwargs)
         result = Result(dispatch_id=dispatch_id, status=Status.RUNNING)
         _RESULTS[dispatch_id] = result
-
-        def runner() -> None:
-            asyncio.run(_execute_graph(graph, result))
-
-        threading.Thread(
-            target=runner, name=f"dispatch-{dispatch_id[:8]}", daemon=True
-        ).start()
+        asyncio.run_coroutine_threadsafe(
+            _execute_graph(graph, result), _dispatcher_loop()
+        )
         return dispatch_id
 
     return submit
@@ -184,6 +220,64 @@ def dispatch_sync(lattice: Lattice) -> Callable[..., Result]:
         return get_result(dispatch(lattice)(*args, **kwargs), wait=True)
 
     return submit
+
+
+def cancel(dispatch_id: str, timeout: float = 30.0) -> Result:
+    """Cancel a running dispatch: kill remote tasks, mark CANCELLED.
+
+    Upstream Covalent exposes ``ct.cancel(dispatch_id)``; the reference
+    executor couldn't honor it (``cancel`` stub, ssh.py:460-464) — ours
+    can: each running node's executor kills its remote process group, then
+    the node task is cancelled on the dispatch loop.
+
+    Scope: executors with a ``cancel`` method (TPUExecutor) have their
+    worker-side processes killed.  An in-process LocalExecutor electron
+    cannot be interrupted mid-body (a Python thread is not killable); its
+    output is discarded and the dispatch still reports CANCELLED promptly.
+    """
+    import time as _time
+
+    result = get_result(dispatch_id)
+    if result.status is not Status.RUNNING:
+        return result
+    result._cancelled = True  # _execute_graph honors this even pre-loop
+
+    # The dispatch thread may not have entered its event loop yet
+    # (cancel immediately after dispatch); give it a moment.
+    deadline = _time.monotonic() + min(timeout, 5.0)
+    while result._loop is None and not result._done.is_set():
+        if _time.monotonic() > deadline:
+            break
+        _time.sleep(0.01)
+    loop = result._loop
+    if loop is None or result._done.is_set():
+        result.wait(timeout)
+        return result
+
+    async def do_cancel() -> None:
+        for node_id, task in result._tasks.items():
+            if task.done():
+                continue
+            executor = result._node_executors.get(node_id)
+            canceller = getattr(executor, "cancel", None)
+            if canceller is not None:
+                try:
+                    await canceller(f"{dispatch_id}_{node_id}")
+                except Exception as err:  # noqa: BLE001 - best-effort kill
+                    app_log.warning(
+                        "cancel %s node %s: %s", dispatch_id, node_id, err
+                    )
+            task.cancel()
+
+    try:
+        future = asyncio.run_coroutine_threadsafe(do_cancel(), loop)
+        future.result(timeout)
+    except RuntimeError:
+        pass  # loop closed between the check and the call: dispatch finished
+    except TimeoutError:
+        app_log.warning("cancel %s: remote kill timed out", dispatch_id)
+    result.wait(timeout)
+    return result
 
 
 def get_result(

@@ -129,3 +129,60 @@ def test_electron_fanout_shares_connection_pool(tmp_path):
     assert result.status is ct.Status.COMPLETED, result.error
     assert result.result == [0, 1, 4, 9, 16]
     assert len(executor._pool) == 1  # one pooled channel, five electrons
+
+
+def test_cancel_kills_remote_electron(tmp_path):
+    """ct.cancel must TERM the worker-side harness process, not just abandon
+    it — the capability the reference stubs (ssh.py:460-464)."""
+    import time
+
+    from ..helpers import make_local_executor
+
+    executor = make_local_executor(tmp_path, task_timeout=60.0)
+    started = tmp_path / "started"
+    finished = tmp_path / "finished"
+
+    @ct.electron(executor=executor)
+    def slow(started_path, finished_path):
+        import os
+        import pathlib
+        import time as _time
+
+        pathlib.Path(started_path).write_text(str(os.getpid()))
+        _time.sleep(45)
+        pathlib.Path(finished_path).write_text("y")
+        return "done"
+
+    @ct.lattice
+    def flow():
+        return slow(str(started), str(finished))
+
+    dispatch_id = ct.dispatch(flow)()
+    deadline = time.time() + 30
+    while not started.exists() and time.time() < deadline:
+        time.sleep(0.05)
+    assert started.exists(), "electron never started"
+
+    harness_pid = int(started.read_text())
+
+    t0 = time.perf_counter()
+    result = ct.cancel(dispatch_id)
+    assert result.status is ct.Status.CANCELLED
+    assert time.perf_counter() - t0 < 15
+    # The worker-side harness process must actually be DEAD (a regression
+    # that merely abandons it would otherwise pass unobserved while the
+    # process sleeps out its 45 s).
+    import os
+    import signal as _signal
+
+    deadline = time.time() + 10
+    alive = True
+    while time.time() < deadline:
+        try:
+            os.kill(harness_pid, 0)
+        except ProcessLookupError:
+            alive = False
+            break
+        time.sleep(0.1)
+    assert not alive, f"harness pid {harness_pid} still running after cancel"
+    assert not finished.exists()

@@ -168,3 +168,26 @@ def test_executor_pinned_native_mode_still_works(tmp_path, run_async):
     result, mode = run_async(flow())
     assert result == "native"
     assert mode == "native"
+
+
+def test_executor_reused_across_separate_dispatches(tmp_path):
+    """A persistent TPUExecutor must serve MULTIPLE dispatches: pooled
+    transports and resident agents are loop-bound, so this regression-tests
+    the shared dispatcher loop (a per-dispatch loop left the second lattice
+    talking to channels on a dead loop)."""
+    import covalent_tpu_plugin.workflow as ct
+
+    ex = make_local_executor(tmp_path, use_agent=True, pool_preload="cloudpickle")
+
+    @ct.electron(executor=ex)
+    def double(n):
+        return n * 2
+
+    @ct.lattice
+    def flow(n):
+        return double(n)
+
+    first = ct.dispatch_sync(flow)(4)
+    second = ct.dispatch_sync(flow)(5)  # same executor, new dispatch
+    assert first.status is ct.Status.COMPLETED and first.result == 8
+    assert second.status is ct.Status.COMPLETED and second.result == 10

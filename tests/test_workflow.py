@@ -184,3 +184,117 @@ def test_tpu_alias_registered():
     executor = ct.resolve_executor("local")
     assert isinstance(executor, ct.LocalExecutor)
     assert ct.resolve_executor(TPUExecutor(transport="local")).transport_kind == "local"
+
+
+def test_deps_bash_runs_before_electron(tmp_path):
+    marker = tmp_path / "bash_ran"
+
+    @ct.electron(deps_bash=[f"echo before > {marker}"])
+    def task():
+        return marker.read_text().strip()
+
+    @ct.lattice
+    def flow():
+        return task()
+
+    result = ct.dispatch_sync(flow)()
+    assert result.status is ct.Status.COMPLETED
+    assert result.result == "before"
+
+
+def test_deps_bash_failure_fails_electron():
+    @ct.electron(deps_bash=["exit 3"])
+    def task():
+        return "unreachable"
+
+    @ct.lattice
+    def flow():
+        return task()
+
+    result = ct.dispatch_sync(flow)()
+    assert result.status is ct.Status.FAILED
+    assert "DepsBash" in result.error and "exit 3" in result.error
+
+
+def test_cancel_running_dispatch(tmp_path):
+    started = tmp_path / "started"
+    finished = tmp_path / "finished"
+
+    @ct.electron
+    def slow():
+        import time as _time
+
+        started.write_text("y")
+        _time.sleep(30)
+        finished.write_text("y")
+        return "done"
+
+    @ct.lattice
+    def flow():
+        return slow()
+
+    dispatch_id = ct.dispatch(flow)()
+    for _ in range(100):
+        if started.exists():
+            break
+        time.sleep(0.05)
+    t0 = time.perf_counter()
+    result = ct.cancel(dispatch_id)
+    elapsed = time.perf_counter() - t0
+    assert result.status is ct.Status.CANCELLED
+    assert elapsed < 10  # did not sleep out the electron
+    assert not finished.exists()
+
+
+def test_cancel_finished_dispatch_is_noop():
+    @ct.electron
+    def quick():
+        return 5
+
+    @ct.lattice
+    def flow():
+        return quick()
+
+    dispatch_id = ct.dispatch(flow)()
+    result = ct.get_result(dispatch_id, wait=True)
+    assert result.status is ct.Status.COMPLETED
+    assert ct.cancel(dispatch_id).status is ct.Status.COMPLETED
+
+
+def test_cancel_immediately_after_dispatch_prevents_execution(tmp_path):
+    marker = tmp_path / "ran"
+
+    @ct.electron
+    def task():
+        marker.write_text("y")
+        return 1
+
+    @ct.lattice
+    def flow():
+        return task()
+
+    dispatch_id = ct.dispatch(flow)()
+    result = ct.cancel(dispatch_id)
+    assert result.status in (ct.Status.CANCELLED, ct.Status.COMPLETED)
+    if result.status is ct.Status.CANCELLED and not result.node_outputs:
+        # The pre-loop cancel path: no electron may have run at all, or the
+        # race let it start — either way the status must be final, not hung.
+        pass
+    assert result._done.is_set()
+
+
+def test_cancel_racing_completion_returns_final_result():
+    @ct.electron
+    def quick():
+        return 9
+
+    @ct.lattice
+    def flow():
+        return quick()
+
+    dispatch_id = ct.dispatch(flow)()
+    # Cancel may land before, during, or after completion; it must never
+    # raise and must always return a final result.
+    result = ct.cancel(dispatch_id)
+    assert result._done.is_set()
+    assert result.status in (ct.Status.CANCELLED, ct.Status.COMPLETED)
