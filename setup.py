@@ -24,6 +24,12 @@ setup(
     description="Covalent executor plugin dispatching electrons to Cloud TPU "
     "VMs and pod slices (JAX/XLA-native).",
     packages=find_packages(include=["covalent_tpu_plugin", "covalent_tpu_plugin.*"]),
+    package_data={
+        # The resident worker agent ships as C++ SOURCE and is compiled on
+        # each worker by the executor's preflight (content-hash cached).
+        "covalent_tpu_plugin": ["native/agent.cc"],
+    },
+    include_package_data=True,
     python_requires=">=3.11",  # tomllib is stdlib from 3.11
     install_requires=[
         "cloudpickle>=2.0",
