@@ -140,10 +140,22 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
 
 
 def _fit_block(requested: int, seq_len: int) -> int:
-    """Largest power-of-two shrink of ``requested`` that divides seq_len."""
+    """Largest power-of-two shrink of ``requested`` that divides seq_len.
+
+    Floors at 128 (or the whole sequence when shorter): a length with a
+    large odd factor (4098 = 2·3·683) would otherwise silently degrade to
+    2-wide tiles — pathologically slow or rejected by Mosaic — where the
+    pre-adaptive behavior was a clear "pad the sequence" error.
+    """
     block = min(requested, seq_len)
     while block > 1 and seq_len % block:
         block //= 2
+    floor = min(128, seq_len)
+    if block < floor:
+        raise ValueError(
+            f"seq_len {seq_len} has no usable tile size (>= {floor}); "
+            "pad the sequence to a multiple of 128"
+        )
     return block
 
 

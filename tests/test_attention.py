@@ -76,6 +76,11 @@ def test_default_blocks_adapt_to_odd_seq_lengths():
     q = jax.random.normal(jax.random.PRNGKey(0), (1, 2, 768, 32), jnp.float32)
     out = flash_attention(q, q, q, causal=True)
     ref = mha_reference(q, q, q, causal=True)
-    assert jnp.allclose(out, ref, atol=2e-2)
+    assert jnp.allclose(out, ref, atol=2e-5)
     with pytest.raises(ValueError, match="divisible"):
         flash_attention(q, q, q, causal=True, block_q=512)
+    # Lengths with large odd factors must fail loudly, not degrade to
+    # 2-wide tiles (4098 = 2*3*683).
+    q_bad = jax.random.normal(jax.random.PRNGKey(1), (1, 2, 4098, 32), jnp.float32)
+    with pytest.raises(ValueError, match="pad the sequence"):
+        flash_attention(q_bad, q_bad, q_bad, causal=True)
