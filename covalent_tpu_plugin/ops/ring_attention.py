@@ -29,20 +29,19 @@ from ..parallel.collectives import ring_permute
 _NEG_INF = -1e30
 
 
-def _block_attend(q, k, v, q_offset, k_offset, scale, causal):
+def _block_attend(q, k, v, q_idx, k_idx, scale, causal):
     """Score one (local-q, rotating-k) block pair; return (m, l, o) partials.
 
-    Shapes: q (B,H,Sq,D), k/v (B,H,Sk,D).  Matmul inputs stay in the input
-    dtype (bf16 on TPU — the MXU's native path; casting to f32 first costs
-    3-4x, same lesson as the flash kernel) with f32 accumulation; the
-    softmax statistics are f32 throughout.
+    Shapes: q (B,H,Sq,D), k/v (B,H,Sk,D); ``q_idx``/``k_idx`` are the
+    GLOBAL sequence positions of each local row ((Sq,)/(Sk,) int32) — index
+    vectors rather than offsets so non-contiguous (zigzag-striped) layouts
+    mask correctly.  Matmul inputs stay in the input dtype (bf16 on TPU —
+    the MXU's native path; casting to f32 first costs 3-4x, same lesson as
+    the flash kernel) with f32 accumulation; softmax statistics are f32.
     """
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
     if causal:
-        s_q, s_k = q.shape[2], k.shape[2]
-        qi = q_offset + lax.broadcasted_iota(jnp.int32, (s_q, s_k), 0)
-        ki = k_offset + lax.broadcasted_iota(jnp.int32, (s_q, s_k), 1)
-        mask = qi >= ki
+        mask = q_idx[:, None] >= k_idx[None, :]
         s = jnp.where(mask, s, _NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)  # (B,H,Sq,1)
     p = jnp.exp(s - m)
@@ -63,37 +62,52 @@ def ring_attention(
     axis_name: str = "seq",
     causal: bool = True,
     scale: float | None = None,
+    zigzag: bool = False,
 ) -> jax.Array:
     """Per-shard body: call under ``shard_map`` with seq-sharded (B,H,S/n,D).
 
     Step ``t`` holds the K/V shard that originated on device
     ``(my_index - t) mod n``; after scoring, the shard is passed to the next
-    device in the ring.
+    device in the ring.  With ``zigzag=True`` the local shard is assumed to
+    be the striped layout produced by :func:`stripe_sequence` (device i owns
+    stripes i and 2n-1-i), which load-balances causal masking across the
+    ring — without it, early-ring devices idle while late ones attend.
     """
     n = lax.axis_size(axis_name)
     my_index = lax.axis_index(axis_name)
     seq_local = q.shape[2]
     head_dim = q.shape[3]
     scale = head_dim**-0.5 if scale is None else scale
-    q_offset = my_index * seq_local
+
+    def shard_indices(shard: jax.Array) -> jax.Array:
+        """Global positions of shard ``shard``'s local rows."""
+        if zigzag:
+            # Device i holds stripes i and 2n-1-i (each seq_local//2 long):
+            # the mirror pairing balances causal work across the ring.
+            stripe = seq_local // 2
+            low = shard * stripe + jnp.arange(stripe, dtype=jnp.int32)
+            high = (2 * n - 1 - shard) * stripe + jnp.arange(stripe, dtype=jnp.int32)
+            return jnp.concatenate([low, high])
+        return shard * seq_local + jnp.arange(seq_local, dtype=jnp.int32)
+
+    q_idx = shard_indices(my_index)
 
     def step(carry, t):
         m_prev, l_prev, acc_prev, k_cur, v_cur = carry
         src = jnp.mod(my_index - t, n)
-        k_offset = src * seq_local
+        k_idx = shard_indices(src)
 
         def attend(_):
-            return _block_attend(
-                q, k_cur, v_cur, q_offset, k_offset, scale, causal
-            )
+            return _block_attend(q, k_cur, v_cur, q_idx, k_idx, scale, causal)
 
-        if causal:
+        if causal and not zigzag:
             # A strictly-future K/V shard is fully masked: skip its matmuls.
             # The ring is lockstep (every step ends at a ppermute), so this
             # saves FLOPs/energy on the skipping devices, not wall-clock —
-            # latency stays bound by the device still attending.  Balanced
-            # wall-clock would need striped/zigzag sequence sharding; the
-            # zero partials merge as a no-op (exp(-inf - m) == 0).
+            # latency stays bound by the device still attending.  Zigzag
+            # striping is the wall-clock fix: every (q-shard, k-shard) pair
+            # then carries ~equal causal work, so no step has an idle
+            # device (and no pair is fully masked, so no skip applies).
             def skip(_):
                 stat_shape = q.shape[:3] + (1,)
                 return (
@@ -102,7 +116,7 @@ def ring_attention(
                     jnp.zeros(q.shape, jnp.float32),
                 )
 
-            needed = k_offset <= q_offset + seq_local - 1
+            needed = jnp.min(k_idx) <= jnp.max(q_idx)
             m_blk, l_blk, o_blk = lax.cond(needed, attend, skip, None)
         else:
             m_blk, l_blk, o_blk = attend(None)
@@ -128,6 +142,39 @@ def ring_attention(
     return (acc / jnp.maximum(l, 1e-37)).astype(q.dtype)
 
 
+def _stripe_permutation(seq_len: int, n: int) -> jax.Array:
+    """Index vector mapping natural order -> zigzag-striped order.
+
+    The sequence splits into 2n stripes; device i's contiguous shard under
+    ``P(..., axis_name, ...)`` becomes [stripe i ; stripe 2n-1-i], pairing
+    a cheap (early) stripe with an expensive (late) one on every device.
+    """
+    import numpy as np
+
+    if seq_len % (2 * n):
+        raise ValueError(
+            f"zigzag striping needs seq_len divisible by 2*n ({2 * n}); "
+            f"got {seq_len} — pad the sequence or pass zigzag=False"
+        )
+    stripe = seq_len // (2 * n)
+    order = []
+    for device in range(n):
+        order.extend(range(device * stripe, (device + 1) * stripe))
+        order.extend(range((2 * n - 1 - device) * stripe, (2 * n - device) * stripe))
+    return jnp.asarray(np.asarray(order, dtype=np.int32))
+
+
+def stripe_sequence(x: jax.Array, n: int, axis: int = 2) -> jax.Array:
+    """Permute ``axis`` into the zigzag layout for an ``n``-device ring."""
+    return jnp.take(x, _stripe_permutation(x.shape[axis], n), axis=axis)
+
+
+def unstripe_sequence(x: jax.Array, n: int, axis: int = 2) -> jax.Array:
+    """Inverse of :func:`stripe_sequence`."""
+    perm = _stripe_permutation(x.shape[axis], n)
+    return jnp.take(x, jnp.argsort(perm), axis=axis)
+
+
 def sequence_parallel_attention(
     q: jax.Array,
     k: jax.Array,
@@ -137,18 +184,37 @@ def sequence_parallel_attention(
     axis_name: str = "seq",
     batch_axes: tuple[str, ...] = ("data", "fsdp"),
     head_axis: str | None = "tensor",
+    zigzag: bool | None = None,
 ) -> jax.Array:
     """Global entry: (B, H, S, D) arrays -> ring attention over ``mesh``.
 
     Batch shards over the data axes, heads over tensor, sequence around the
     ring — composing context parallelism with DP/TP in one shard_map.
+
+    ``zigzag`` (default: on for causal) permutes the sequence into the
+    striped layout before sharding and back after, so causal work balances
+    across the ring instead of serialising on the last device; XLA lowers
+    the permutes to collective data movement alongside the resharding it
+    already performs for ``P(..., seq, ...)``.
     """
+    n = mesh.shape[axis_name]
+    if zigzag is None:
+        zigzag = causal and n > 1 and q.shape[2] % (2 * n) == 0
+    if zigzag:
+        q = stripe_sequence(q, n)
+        k = stripe_sequence(k, n)
+        v = stripe_sequence(v, n)
     spec = P(batch_axes, head_axis, axis_name, None)
-    ring = functools.partial(ring_attention, axis_name=axis_name, causal=causal)
-    return jax.shard_map(
+    ring = functools.partial(
+        ring_attention, axis_name=axis_name, causal=causal, zigzag=zigzag
+    )
+    out = jax.shard_map(
         ring,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
         check_vma=False,
     )(q, k, v)
+    if zigzag:
+        out = unstripe_sequence(out, n)
+    return out

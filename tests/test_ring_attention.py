@@ -68,3 +68,41 @@ def test_ring_single_device_degenerates():
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(mha_reference(q, k, v)), atol=1e-5, rtol=1e-5
     )
+
+
+def test_stripe_roundtrip_and_layout():
+    from covalent_tpu_plugin.ops.ring_attention import (
+        stripe_sequence,
+        unstripe_sequence,
+    )
+
+    x = jnp.arange(16.0).reshape(1, 1, 16, 1)
+    striped = stripe_sequence(x, n=4)
+    # Device 0's shard = stripes 0 and 7: positions 0,1 and 14,15.
+    assert striped[0, 0, :4, 0].tolist() == [0.0, 1.0, 14.0, 15.0]
+    roundtrip = unstripe_sequence(striped, n=4)
+    assert jnp.array_equal(roundtrip, x)
+
+
+def test_zigzag_and_contiguous_agree(seq_mesh):
+    q, k, v = (
+        jax.random.normal(jax.random.PRNGKey(i), (2, 2, 64, 16))
+        for i in range(3)
+    )
+    zz = sequence_parallel_attention(q, k, v, seq_mesh, causal=True, zigzag=True)
+    contiguous = sequence_parallel_attention(
+        q, k, v, seq_mesh, causal=True, zigzag=False
+    )
+    ref = mha_reference(q, k, v, causal=True)
+    assert jnp.allclose(zz, ref, atol=2e-5)
+    assert jnp.allclose(contiguous, ref, atol=2e-5)
+    assert jnp.allclose(zz, contiguous, atol=2e-5)
+
+
+def test_zigzag_rejects_indivisible_seq(seq_mesh):
+    q, k, v = (
+        jax.random.normal(jax.random.PRNGKey(i), (1, 2, 24, 16))
+        for i in range(3)
+    )
+    with pytest.raises(ValueError, match="divisible by 2"):
+        sequence_parallel_attention(q, k, v, seq_mesh, causal=True, zigzag=True)
