@@ -110,13 +110,23 @@ def run_task(spec: dict) -> int:
         for entry in reversed(str(env["PYTHONPATH"]).split(os.pathsep)):
             if entry and entry not in sys.path:
                 sys.path.insert(0, entry)
+    # Env alone can lose to a site-level PJRT plugin registration that
+    # re-pins the platform after interpreter start; jax.config wins if set
+    # before first backend use.  Pin from the spec env always (explicit user
+    # intent, worth the jax import), and from the inherited process env only
+    # when a sitecustomize already imported jax — then the pin is free and
+    # protects every subprocess on hosts whose site hook overrides the env.
     if "JAX_PLATFORMS" in env:
-        # Env alone can lose to a site-level PJRT plugin registration that
-        # pins another platform; jax.config wins if set before first use.
+        platforms = env["JAX_PLATFORMS"]  # explicit, even "" = auto-select
+    elif "jax" in sys.modules:
+        platforms = os.environ.get("JAX_PLATFORMS")
+    else:
+        platforms = None
+    if platforms is not None:
         try:
             import jax
 
-            jax.config.update("jax_platforms", str(env["JAX_PLATFORMS"]))
+            jax.config.update("jax_platforms", str(platforms))
         except Exception:
             pass
 

@@ -15,6 +15,18 @@ import cloudpickle
 from covalent_tpu_plugin.transport.base import CommandResult, Transport
 
 
+def pin_cpu_task_env(kwargs: dict) -> dict:
+    """Merge ``JAX_PLATFORMS=cpu`` under a kwargs dict's ``task_env``.
+
+    Harness subprocesses must run on CPU in tests: a sandbox sitecustomize
+    can re-pin the platform to an experimental PJRT plugin whose backend
+    init hangs, and only the harness's jax.config pin (driven by spec env)
+    reliably overrides it.  Caller-provided task_env keys win.
+    """
+    kwargs["task_env"] = {"JAX_PLATFORMS": "cpu", **kwargs.get("task_env", {})}
+    return kwargs
+
+
 def make_local_executor(tmp_path, **kwargs):
     """A TPUExecutor over the local transport, staged under tmp_path."""
     from covalent_tpu_plugin import TPUExecutor
@@ -25,7 +37,7 @@ def make_local_executor(tmp_path, **kwargs):
     kwargs.setdefault("python_path", sys.executable)
     kwargs.setdefault("poll_freq", 0.2)
     kwargs.setdefault("use_agent", False)  # dedicated agent tests opt in
-    return TPUExecutor(**kwargs)
+    return TPUExecutor(**pin_cpu_task_env(kwargs))
 
 
 class FakeTransport(Transport):

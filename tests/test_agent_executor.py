@@ -14,6 +14,8 @@ import pytest
 
 from covalent_tpu_plugin import TPUExecutor
 
+from .helpers import pin_cpu_task_env
+
 pytestmark = pytest.mark.skipif(
     all(shutil.which(cc) is None for cc in ("g++", "c++", "clang++")),
     reason="no C++ compiler",
@@ -35,7 +37,7 @@ def make_agent_executor(shared_cache, **kwargs):
     kwargs.setdefault("python_path", sys.executable)
     kwargs.setdefault("poll_freq", 0.2)
     kwargs.setdefault("use_agent", True)
-    return TPUExecutor(**kwargs)
+    return TPUExecutor(**pin_cpu_task_env(kwargs))
 
 
 def test_agent_run_returns_result_without_status_polling(shared_cache, run_async):

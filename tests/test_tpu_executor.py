@@ -18,7 +18,7 @@ from covalent_tpu_plugin.tpu import (
 from covalent_tpu_plugin.transport import TransportError
 from covalent_tpu_plugin.transport.base import CommandResult
 
-from .helpers import FakeTransport, scripted_ok_responses
+from .helpers import FakeTransport, pin_cpu_task_env, scripted_ok_responses
 
 
 def make_executor(tmp_path, fake: FakeTransport | None = None, **kwargs):
@@ -29,7 +29,7 @@ def make_executor(tmp_path, fake: FakeTransport | None = None, **kwargs):
     kwargs.setdefault("remote_cache", str(tmp_path / "remote"))
     kwargs.setdefault("poll_freq", 0.05)
     kwargs.setdefault("use_agent", False)  # dedicated agent tests opt in
-    ex = TPUExecutor(**kwargs)
+    ex = TPUExecutor(**pin_cpu_task_env(kwargs))
     if fake is not None:
 
         async def fake_connect(address):
