@@ -10,9 +10,14 @@ Kernel layout (per pallas_guide.md):
   grid = (batch, heads, S // BQ); each program owns one query tile and
   fori-loops over key tiles, carrying (running max, running sum, output
   accumulator) in f32.  Causal masking prunes the loop bound so the kernel
-  does ~half the work of the dense path.  The backward pass recomputes
-  through the reference path (flash-style recompute; a dedicated Pallas
-  backward kernel is a later optimisation).
+  does ~half the work of the dense path.
+
+The backward pass is likewise Pallas (FlashAttention-2 style): the forward
+saves only the per-row log-sum-exp (B, H, S, 1) — not the S×S probabilities
+— and two backward kernels recompute each probability tile from (q, k, lse)
+on the fly: one accumulates dk/dv sweeping query tiles, one accumulates dq
+sweeping key tiles.  Backward HBM stays O(S·D), the same as forward, where
+the dense path's backward would materialise O(S²) probabilities.
 
 On non-TPU backends the same kernel runs in interpreter mode, which is what
 the CPU test tier exercises.
@@ -67,8 +72,8 @@ def mha_reference(
     return out.astype(q.dtype)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-                  causal: bool, scale: float):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
+                  *, causal: bool, scale: float):
     """One (query tile, key tile) grid cell.
 
     The key-tile index is the *innermost* grid dimension, so for a fixed
@@ -137,6 +142,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         o_ref[0, 0, :, :] = (
             acc_ref[:] / jnp.maximum(l_ref[:], 1e-37)
         ).astype(o_ref.dtype)
+        # Per-row log-sum-exp — the only softmax statistic the backward
+        # kernels need to recompute any probability tile.  Kept in the
+        # (BQ, 1) sublane layout the scratch already uses.
+        lse_ref[0, 0, :, :] = m_ref[:] + jnp.log(jnp.maximum(l_ref[:], 1e-37))
 
 
 def _fit_block(requested: int, seq_len: int) -> int:
@@ -190,14 +199,18 @@ def _flash_forward(
     kv_spec = pl.BlockSpec(
         (1, 1, block_k, head_dim), lambda b, h, i, j: (b, h, j, 0)
     )
+    lse_spec = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0))
     kernel = functools.partial(_flash_kernel, causal=causal, scale=scale)
     flops_factor = 0.5 if causal else 1.0
-    return pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[qo_spec, kv_spec, kv_spec],
-        out_specs=qo_spec,
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_specs=[qo_spec, lse_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((batch, heads, seq_len, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),        # running max
             pltpu.VMEM((block_q, 1), jnp.float32),        # running sum
@@ -210,21 +223,221 @@ def _flash_forward(
             transcendentals=int(batch * heads * seq_len * seq_len * flops_factor),
         ),
     )(q, k, v)
+    return out, lse
+
+
+# Backward tiles: square-ish blocks keep the four recompute matmuls per
+# cell MXU-shaped while halving the VMEM of the f32 score tiles vs 512x1024.
+_DEFAULT_BWD_BLOCK = 512
+
+
+def _flash_bwd_dkdv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc, *, causal: bool, scale: float
+):
+    """One (key tile, query tile) cell of the dk/dv sweep.
+
+    Query tiles are the innermost grid dimension: for a fixed key tile the
+    accumulators persist in VMEM scratch across the query sweep, and the
+    probability tile is recomputed from (q, k, lse) — never read from HBM.
+    """
+    block_q = q_ref.shape[2]
+    block_k = k_ref.shape[2]
+    qt = pl.program_id(3)
+    num_q_tiles = pl.num_programs(3)
+    k_offset = pl.program_id(2) * block_k
+    q_offset = qt * block_q
+
+    @pl.when(qt == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    # Under causal masking a query tile strictly above the key tile's first
+    # row contributes nothing to this key tile's gradients.
+    needed = (not causal) or (q_offset + block_q - 1 >= k_offset)
+
+    @pl.when(needed)
+    def _tile():
+        q = q_ref[0, 0, :, :]
+        k_tile = k_ref[0, 0, :, :]
+        v_tile = v_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :]
+        lse = lse_ref[0, 0, :, :]      # (BQ, 1) f32
+        delta = delta_ref[0, 0, :, :]  # (BQ, 1) f32
+
+        s = jax.lax.dot_general(
+            q, k_tile,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (BQ, BK) f32
+        p = jnp.exp(s - lse)  # exactly the forward's normalised probabilities
+        if causal:
+            qi = q_offset + jax.lax.broadcasted_iota(jnp.int32, p.shape, 0)
+            ki = k_offset + jax.lax.broadcasted_iota(jnp.int32, p.shape, 1)
+            p = jnp.where(qi >= ki, p, 0.0)
+
+        # dV += P^T dO ; dP = dO V^T ; dS = P*(dP - delta)*scale ; dK += dS^T Q
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v_tile,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(qt == num_q_tiles - 1)
+    def _finalise():
+        dk_ref[0, 0, :, :] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0, :, :] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
+    *, causal: bool, scale: float
+):
+    """One (query tile, key tile) cell of the dq sweep (key tiles innermost)."""
+    block_q = q_ref.shape[2]
+    block_k = k_ref.shape[2]
+    kt = pl.program_id(3)
+    num_k_tiles = pl.num_programs(3)
+    q_offset = pl.program_id(2) * block_q
+    k_offset = kt * block_k
+
+    @pl.when(kt == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    needed = (not causal) or (k_offset <= q_offset + block_q - 1)
+
+    @pl.when(needed)
+    def _tile():
+        q = q_ref[0, 0, :, :]
+        k_tile = k_ref[0, 0, :, :]
+        v_tile = v_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :]
+        lse = lse_ref[0, 0, :, :]
+        delta = delta_ref[0, 0, :, :]
+
+        s = jax.lax.dot_general(
+            q, k_tile,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        p = jnp.exp(s - lse)
+        if causal:
+            qi = q_offset + jax.lax.broadcasted_iota(jnp.int32, p.shape, 0)
+            ki = k_offset + jax.lax.broadcasted_iota(jnp.int32, p.shape, 1)
+            p = jnp.where(qi >= ki, p, 0.0)
+
+        dp = jax.lax.dot_general(
+            do, v_tile,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k_tile.dtype), k_tile,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(kt == num_k_tiles - 1)
+    def _finalise():
+        dq_ref[0, 0, :, :] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, causal: bool, interpret: bool):
+    """FlashAttention-2 backward: two Pallas sweeps, O(S·D) HBM."""
+    batch, heads, seq_len, head_dim = q.shape
+    scale = head_dim**-0.5
+    block_q = _fit_block(_DEFAULT_BWD_BLOCK, seq_len)
+    block_k = _fit_block(_DEFAULT_BWD_BLOCK, seq_len)
+
+    # delta_i = rowsum(dO_i * O_i) — a cheap elementwise reduce XLA fuses;
+    # kept (B, H, S, 1) to match the kernels' sublane layout.
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True
+    )
+
+    qo_spec_q = pl.BlockSpec(
+        (1, 1, block_q, head_dim), lambda b, h, i, j: (b, h, j, 0)
+    )
+    kv_spec_k = pl.BlockSpec(
+        (1, 1, block_k, head_dim), lambda b, h, i, j: (b, h, i, 0)
+    )
+    stat_spec_q = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, j, 0))
+    flops_factor = 0.5 if causal else 1.0
+    cost = pl.CostEstimate(
+        flops=int(10 * batch * heads * seq_len * seq_len * head_dim * flops_factor),
+        bytes_accessed=int(8 * batch * heads * seq_len * head_dim * q.dtype.itemsize),
+        transcendentals=int(batch * heads * seq_len * seq_len * flops_factor),
+    )
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkdv_kernel, causal=causal, scale=scale),
+        grid=(batch, heads, seq_len // block_k, seq_len // block_q),
+        in_specs=[qo_spec_q, kv_spec_k, kv_spec_k, qo_spec_q, stat_spec_q,
+                  stat_spec_q],
+        out_specs=[kv_spec_k, kv_spec_k],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, head_dim), jnp.float32),  # dk accumulator
+            pltpu.VMEM((block_k, head_dim), jnp.float32),  # dv accumulator
+        ],
+        interpret=interpret,
+        cost_estimate=cost,
+    )(q, k, v, g, lse, delta)
+
+    qo_spec_i = pl.BlockSpec(
+        (1, 1, block_q, head_dim), lambda b, h, i, j: (b, h, i, 0)
+    )
+    kv_spec_j = pl.BlockSpec(
+        (1, 1, block_k, head_dim), lambda b, h, i, j: (b, h, j, 0)
+    )
+    stat_spec_i = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0))
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, causal=causal, scale=scale),
+        grid=(batch, heads, seq_len // block_q, seq_len // block_k),
+        in_specs=[qo_spec_i, kv_spec_j, kv_spec_j, qo_spec_i, stat_spec_i,
+                  stat_spec_i],
+        out_specs=qo_spec_i,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, head_dim), jnp.float32),  # dq accumulator
+        ],
+        interpret=interpret,
+        cost_estimate=cost,
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, causal, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    out, _ = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
-    q, k, v = residuals
-    _, vjp = jax.vjp(lambda q_, k_, v_: mha_reference(q_, k_, v_, causal), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = residuals
+    return _flash_backward(q, k, v, out, lse, g, causal, interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
