@@ -104,6 +104,7 @@ class TaskStatus(str, Enum):
 
     READY = "READY"          # result file exists
     RUNNING = "RUNNING"      # process alive, no result yet
+    STARTING = "STARTING"    # no result, no pid file yet (launch window)
     DEAD = "DEAD"            # process gone and no result -> failure
 
 
@@ -735,21 +736,37 @@ class TPUExecutor(RemoteExecutor):
                 task.cancel()
 
     async def get_status(
-        self, conn: Transport, remote_result_file: str, pid: int | None = None
+        self,
+        conn: Transport,
+        remote_result_file: str,
+        pid: int | None = None,
+        pid_file: str | None = None,
     ) -> TaskStatus:
         """Combined result-exists + process-alive probe, one round-trip.
 
         Fixes the reference's brittle ``ls``-output string compare
         (ssh.py:402-406) with ``test -f`` exit status, and detects a crashed
-        harness instead of polling forever.
+        harness instead of polling forever.  When the dispatcher lost the
+        pid (e.g. an agent channel died mid-launch), the pid file the
+        harness writes at startup is the liveness source instead; a missing
+        pid file reports STARTING, which the poller tolerates only for a
+        bounded grace window.
         """
+        if pid is not None:
+            liveness = f"elif kill -0 {pid} 2>/dev/null; then echo RUNNING; "
+        elif pid_file is not None:
+            quoted = shlex.quote(pid_file)
+            liveness = (
+                f"elif test -s {quoted}; then "
+                f"if kill -0 \"$(cat {quoted})\" 2>/dev/null; "
+                "then echo RUNNING; else echo DEAD; fi; "
+                "elif true; then echo STARTING; "
+            )
+        else:
+            liveness = "elif true; then echo RUNNING; "
         probe = (
             f"if test -f {shlex.quote(remote_result_file)}; then echo READY; "
-            + (
-                f"elif kill -0 {pid} 2>/dev/null; then echo RUNNING; "
-                if pid is not None
-                else "elif true; then echo RUNNING; "
-            )
+            + liveness
             + "else echo DEAD; fi"
         )
         result = await conn.run(probe)
@@ -761,6 +778,15 @@ class TPUExecutor(RemoteExecutor):
                 f"status probe on {conn.address} failed: {result.stderr.strip()!r}"
             )
 
+    #: How long a task may stay STARTING (no result, no pid file) before it
+    #: is declared DEAD: covers the launch window between the run command
+    #: landing and the harness's first act of writing its pid file.
+    STARTING_GRACE_S = 30.0
+
+    #: With no task_timeout set, log a still-running reminder this often so
+    #: a silently-stuck electron is at least visible on billed TPU time.
+    WATCHDOG_LOG_INTERVAL_S = 600.0
+
     async def _wait_while_running(
         self,
         probe: Callable,
@@ -769,24 +795,48 @@ class TPUExecutor(RemoteExecutor):
         """Adaptive-backoff wait shared by every poller.
 
         Calls ``probe() -> (status, blamed_worker)`` until it stops
-        reporting RUNNING.  Replaces the reference's fixed 15 s × 5-retry
-        loop (ssh.py:408-432): the interval starts at 50 ms and doubles up
-        to ``poll_freq``, so short electrons pay milliseconds of latency,
-        not seconds, and there is no artificial retry ceiling — a live
-        process keeps being awaited.  When ``timeout`` (default
+        reporting RUNNING/STARTING.  Replaces the reference's fixed
+        15 s × 5-retry loop (ssh.py:408-432): the interval starts at 50 ms
+        and doubles up to ``poll_freq``, so short electrons pay milliseconds
+        of latency, not seconds, and there is no artificial retry ceiling —
+        a live process keeps being awaited.  When ``timeout`` (default
         ``task_timeout``; 0 disables) elapses, returns the last RUNNING
-        status and lets the caller decide what a timeout means.
+        status and lets the caller decide what a timeout means.  STARTING —
+        liveness unknowable because the pid file hasn't appeared — is
+        tolerated only for ``STARTING_GRACE_S`` and then becomes DEAD, so a
+        harness that died before its first write cannot be polled forever.
         """
         if timeout is None:
             timeout = self.task_timeout
         interval = 0.05
         waited = 0.0
+        starting_for = 0.0
+        last_watchdog = 0.0
         while True:
             status, blamed = await probe()
-            if status is not TaskStatus.RUNNING:
+            if status not in (TaskStatus.RUNNING, TaskStatus.STARTING):
                 return status, blamed
+            if status is TaskStatus.STARTING:
+                if starting_for >= self.STARTING_GRACE_S:
+                    app_log.error(
+                        "task has no result and no pid file after %.0fs; "
+                        "declaring worker %d dead", starting_for, blamed,
+                    )
+                    return TaskStatus.DEAD, blamed
+                starting_for += interval
+            else:
+                starting_for = 0.0
             if timeout and waited >= timeout:
                 return TaskStatus.RUNNING, blamed
+            if (
+                not timeout
+                and waited - last_watchdog >= self.WATCHDOG_LOG_INTERVAL_S
+            ):
+                last_watchdog = waited
+                app_log.warning(
+                    "task still running after %.0fs with no task_timeout set",
+                    waited,
+                )
             await asyncio.sleep(interval)
             waited += interval
             interval = min(interval * 2, float(self.poll_freq))
@@ -802,9 +852,9 @@ class TPUExecutor(RemoteExecutor):
         """
         failures: dict[Any, int] = {}
 
-        async def probe_once(key, conn, path, pid) -> TaskStatus:
+        async def probe_once(key, conn, path, pid, pid_file=None) -> TaskStatus:
             try:
-                status = await self.get_status(conn, path, pid)
+                status = await self.get_status(conn, path, pid, pid_file)
             except TransportError:
                 failures[key] = failures.get(key, 0) + 1
                 if failures[key] >= max_consecutive:
@@ -816,13 +866,17 @@ class TPUExecutor(RemoteExecutor):
         return probe_once
 
     async def _poll_task(
-        self, conn: Transport, remote_result_file: str, pid: int | None = None
+        self,
+        conn: Transport,
+        remote_result_file: str,
+        pid: int | None = None,
+        pid_file: str | None = None,
     ) -> TaskStatus:
         """Wait for one worker's result; a timeout counts as DEAD."""
         tolerant = self._tolerant_status()
 
         async def probe() -> tuple[TaskStatus, int]:
-            return await tolerant(0, conn, remote_result_file, pid), 0
+            return await tolerant(0, conn, remote_result_file, pid, pid_file), 0
 
         status, _ = await self._wait_while_running(probe)
         return TaskStatus.DEAD if status is TaskStatus.RUNNING else status
@@ -846,7 +900,11 @@ class TPUExecutor(RemoteExecutor):
         async def probe() -> tuple[TaskStatus, int]:
             statuses = await asyncio.gather(
                 tolerant(
-                    0, conns[0], staged.remote_result_file, pids.get(addresses[0])
+                    0,
+                    conns[0],
+                    staged.remote_result_file,
+                    pids.get(addresses[0]),
+                    f"{staged.remote_pid_file}.0",
                 ),
                 *(
                     # Workers 1..N-1 are "done" at their marker file — same
@@ -856,15 +914,22 @@ class TPUExecutor(RemoteExecutor):
                         conns[i],
                         f"{staged.remote_result_file}.done.{i}",
                         pids.get(addresses[i]),
+                        f"{staged.remote_pid_file}.{i}",
                     )
                     for i in range(1, len(conns))
                 ),
             )
-            if statuses[0] is not TaskStatus.RUNNING:
+            if statuses[0] not in (TaskStatus.RUNNING, TaskStatus.STARTING):
                 return statuses[0], 0
             for i, status in enumerate(statuses[1:], start=1):
                 if status is TaskStatus.DEAD:
                     return TaskStatus.DEAD, i
+            # Any worker still in its launch window keeps the whole task in
+            # STARTING so the bounded grace (not an infinite RUNNING poll)
+            # governs a harness that died before writing its pid file.
+            for i, status in enumerate(statuses):
+                if status is TaskStatus.STARTING:
+                    return TaskStatus.STARTING, i
             return TaskStatus.RUNNING, 0
 
         status, blamed = await self._wait_while_running(probe)
@@ -965,7 +1030,7 @@ class TPUExecutor(RemoteExecutor):
             "TPUExecutor reused on a new event loop; abandoning pooled "
             "transports and resident agent channels from the previous loop"
         )
-        if not bound.is_closed():
+        if not bound.is_closed() and bound.is_running():
             # Best-effort teardown on the loop that owns the resources.
             # A caller-shared pool (_owns_pool False) is NOT closed: other
             # executors may be mid-electron on the old loop; we only drop
@@ -980,7 +1045,20 @@ class TPUExecutor(RemoteExecutor):
                 if old_pool is not None:
                     await old_pool.close_all()
 
-            asyncio.run_coroutine_threadsafe(teardown(), bound)
+            future = asyncio.run_coroutine_threadsafe(teardown(), bound)
+            future.add_done_callback(
+                lambda f: f.exception()
+                and app_log.warning("old-loop teardown failed: %s", f.exception())
+            )
+        elif not bound.is_closed():
+            # Stopped-but-open loop: scheduling a coroutine on it would
+            # never run (and warn about never-awaited coroutines); the
+            # remote pool-server/agent processes are abandoned instead, and
+            # their own channel-loss handling reaps them.
+            app_log.warning(
+                "previous event loop is stopped; abandoning its pooled "
+                "transports and agent channels without teardown"
+            )
         self._pool = TransportPool()
         self._owns_pool = True
         self._agents = {}
@@ -1182,11 +1260,17 @@ class TPUExecutor(RemoteExecutor):
                         # mean a readable pid IS complete; echo only on a
                         # kill that had a real target so the retry loop
                         # can't declare victory on an empty race window.
+                        # The pkill pattern brackets its first character
+                        # ([s]pec-style) so the reaping shell — whose own
+                        # command line contains the spec path — can never
+                        # match and TERM itself.
+                        spec_path = staged.remote_spec_file(i)
+                        pkill_pattern = f"[{spec_path[0]}]{spec_path[1:]}"
                         reap = (
                             f"if [ -s {pid_file} ]; then "
                             f"kill -TERM $(cat {pid_file}) 2>/dev/null; "
                             "echo KILLED; fi; pkill -f "
-                            + shlex.quote(staged.remote_spec_file(i))
+                            + shlex.quote(pkill_pattern)
                             + " 2>/dev/null && echo PKILLED || true"
                         )
                         for _attempt in range(4):
@@ -1246,10 +1330,14 @@ class TPUExecutor(RemoteExecutor):
         async def reap(process_id: int, conn: Transport, address: str) -> None:
             pid = pids.get(address)
             marker = f"{staged.remote_result_file}.done.{process_id}"
+            pid_file = f"{staged.remote_pid_file}.{process_id}"
 
             async def probe() -> tuple[TaskStatus, int]:
                 try:
-                    return await self.get_status(conn, marker, pid), process_id
+                    return (
+                        await self.get_status(conn, marker, pid, pid_file),
+                        process_id,
+                    )
                 except TransportError:
                     # Garbled probe output on a flaky channel: keep waiting
                     # so the grace deadline (and the kill below) still fires.
@@ -1262,7 +1350,14 @@ class TPUExecutor(RemoteExecutor):
                 "worker %s straggling %.1fs after result; killing pid %s",
                 address, grace, pid,
             )
-            await conn.run(f"kill -TERM {pid} 2>/dev/null || true")
+            if pid is not None:
+                await conn.run(f"kill -TERM {pid} 2>/dev/null || true")
+            else:
+                quoted = shlex.quote(pid_file)
+                await conn.run(
+                    f"test -s {quoted} && "
+                    f"kill -TERM \"$(cat {quoted})\" 2>/dev/null || true"
+                )
 
         await asyncio.gather(
             *(

@@ -21,6 +21,7 @@ from __future__ import annotations
 import os
 import pickle
 import re
+import sys
 import uuid
 from pathlib import Path
 from typing import Any
@@ -66,13 +67,49 @@ def _to_host(tree: Any) -> Any:
         return tree
 
 
+def _process_index() -> int:
+    """jax.process_index() when the data plane is up, else 0 (single host).
+
+    Avoids importing jax (seconds) in tasks that never touched it.
+    """
+    if "jax" not in sys.modules:
+        return 0
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:  # pragma: no cover - uninitialised backends
+        return 0
+
+
 def save_checkpoint(
     tree: Any, step: int, base: str | os.PathLike | None = None
 ) -> Path:
-    """Persist ``tree`` for ``step``; returns the checkpoint path."""
+    """Persist ``tree`` for ``step``; returns the checkpoint path.
+
+    Assumes a *replicated* tree in multi-process electrons: process 0 is the
+    single writer (matching the harness's result-write contract); other
+    processes return immediately.  Per-process state should go to
+    per-process ``base`` paths instead.
+    """
     root = checkpoint_dir(base)
     target = root / f"step_{step}"
     ocp = _orbax()
+    # A step saved by one stack (orbax = directory, fallback = file) must
+    # not be silently clobbered-or-crashed by the other: availability can
+    # differ between save and restore environments.
+    if ocp is not None and target.is_file():
+        raise RuntimeError(
+            f"{target} holds a pickle-format checkpoint but orbax is active; "
+            "delete it or restore with the stack that wrote it"
+        )
+    if ocp is None and target.is_dir():
+        raise RuntimeError(
+            f"{target} holds an orbax (directory) checkpoint but orbax is "
+            "unavailable; install orbax or delete the old step"
+        )
+    if _process_index() != 0:
+        return target
     if ocp is not None:
         checkpointer = ocp.PyTreeCheckpointer()
         checkpointer.save(target.resolve(), _to_host(tree), force=True)
@@ -122,5 +159,10 @@ def restore_checkpoint(
         if template is not None:
             return checkpointer.restore(target.resolve(), item=template)
         return checkpointer.restore(target.resolve())
+    if target.is_dir():
+        raise RuntimeError(
+            f"{target} is an orbax (directory) checkpoint but orbax is "
+            "unavailable in this environment; install orbax to restore it"
+        )
     with open(target, "rb") as f:
         return pickle.load(f)

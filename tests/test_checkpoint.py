@@ -60,6 +60,30 @@ def test_checkpoint_dir_honors_cwd_workdir_contract(tmp_path, monkeypatch):
     assert checkpoint_dir() == tmp_path / "checkpoints"
 
 
+def test_format_mismatch_raises_descriptive_error(tmp_path, monkeypatch):
+    """Orbax availability can differ between save and restore environments;
+    a dir-vs-file mismatch must be a clear error, not IsADirectoryError
+    (ADVICE r1)."""
+    from covalent_tpu_plugin.utils import checkpoint as ckpt_mod
+
+    # Simulate an orbax-written step (directory), then an orbax-less stack.
+    (tmp_path / "step_1").mkdir()
+    monkeypatch.setattr(ckpt_mod, "_ORBAX", False)
+    with pytest.raises(RuntimeError, match="orbax"):
+        restore_checkpoint(step=1, base=tmp_path)
+    with pytest.raises(RuntimeError, match="orbax"):
+        save_checkpoint({"x": 1}, step=1, base=tmp_path)
+
+
+def test_nonzero_process_skips_write(tmp_path, monkeypatch):
+    """Replicated electrons: process 0 is the single writer."""
+    from covalent_tpu_plugin.utils import checkpoint as ckpt_mod
+
+    monkeypatch.setattr(ckpt_mod, "_process_index", lambda: 1)
+    target = save_checkpoint({"x": 1}, step=3, base=tmp_path)
+    assert not target.exists()
+
+
 def test_resume_across_electron_dispatches(tmp_path, run_async):
     """End-to-end: electron 1 checkpoints, electron 2 (same unique workdir)
     resumes — the framework-level resume story."""
