@@ -8,23 +8,43 @@ capability the north star adds: electrons scale *within* a task via
 collectives — never hand-written NCCL-style calls.
 """
 
-from .collectives import (
-    all_gather,
-    all_to_all,
-    psum,
-    reduce_scatter,
-    ring_permute,
-)
-from .distributed import coordinator_spec, process_info
-from .mesh import MeshPlan, auto_mesh, make_mesh
-from .sharding import (
-    DEFAULT_RULES,
-    batch_sharding,
-    logical_sharding,
-    param_shardings,
-    replicated,
-    shard_batch,
-)
+# Lazy (PEP 562) re-exports: mesh/sharding/collectives import jax at module
+# level (seconds), which the dispatcher control plane — which imports this
+# package only for `coordinator_spec` — must not pay.
+import importlib
+
+_EXPORTS = {
+    "psum": ".collectives",
+    "all_gather": ".collectives",
+    "all_to_all": ".collectives",
+    "reduce_scatter": ".collectives",
+    "ring_permute": ".collectives",
+    "coordinator_spec": ".distributed",
+    "process_info": ".distributed",
+    "MeshPlan": ".mesh",
+    "auto_mesh": ".mesh",
+    "make_mesh": ".mesh",
+    "DEFAULT_RULES": ".sharding",
+    "batch_sharding": ".sharding",
+    "logical_sharding": ".sharding",
+    "param_shardings": ".sharding",
+    "replicated": ".sharding",
+    "shard_batch": ".sharding",
+}
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        module = importlib.import_module(_EXPORTS[name], __name__)
+        value = getattr(module, name)
+        globals()[name] = value  # cache: subsequent lookups skip __getattr__
+        return value
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))
+
 
 __all__ = [
     "MeshPlan",

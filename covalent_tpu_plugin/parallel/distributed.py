@@ -38,21 +38,32 @@ def process_info() -> ProcessInfo:
 
 
 def coordinator_spec(
-    workers: list[str], port: int = 8476
+    workers: list[str] | None = None,
+    port: int = 8476,
+    *,
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
 ) -> list[dict]:
     """Per-worker ``distributed`` spec blocks for the task spec files.
 
-    Worker 0's host is the rendezvous point; addresses may carry a
-    ``user@`` prefix on the control plane which is stripped for the data
-    plane.
+    By default worker 0's host is the rendezvous point; addresses may carry
+    a ``user@`` prefix on the control plane which is stripped for the data
+    plane.  The executor passes an explicit ``coordinator_address`` instead
+    when the rendezvous host differs from the dial address (TPU pods dial
+    internal IPs; the local transport rendezvouses on 127.0.0.1).
     """
-    host = workers[0].split("@", 1)[-1]
-    coordinator = f"{host}:{port}"
+    if coordinator_address is None:
+        if not workers:
+            raise ValueError("coordinator_spec needs workers or coordinator_address")
+        host = workers[0].split("@", 1)[-1]
+        coordinator_address = f"{host}:{port}"
+    if num_processes is None:
+        num_processes = len(workers or [])
     return [
         {
-            "coordinator_address": coordinator,
-            "num_processes": len(workers),
+            "coordinator_address": coordinator_address,
+            "num_processes": num_processes,
             "process_id": i,
         }
-        for i in range(len(workers))
+        for i in range(num_processes)
     ]

@@ -47,6 +47,7 @@ from .agent import (
     start_pool_server,
 )
 from .executor_base import RemoteExecutor
+from .parallel.distributed import coordinator_spec
 from .transport import (
     LocalTransport,
     SSHTransport,
@@ -459,6 +460,14 @@ class TPUExecutor(RemoteExecutor):
         dump_task(fn, args, kwargs, staged.function_file)
 
         num_processes = self._num_processes()
+        dist_blocks = (
+            coordinator_spec(
+                coordinator_address=self._coordinator_address(),
+                num_processes=num_processes,
+            )
+            if num_processes > 1
+            else None
+        )
         for process_id in range(num_processes):
             spec: dict[str, Any] = {
                 "function_file": staged.remote_function_file,
@@ -473,12 +482,8 @@ class TPUExecutor(RemoteExecutor):
                 spec["profile_dir"] = f"{self.profile_dir}/{operation_id}"
             if pip_deps:
                 spec["pip_deps"] = list(pip_deps)
-            if num_processes > 1:
-                spec["distributed"] = {
-                    "coordinator_address": self._coordinator_address(),
-                    "num_processes": num_processes,
-                    "process_id": process_id,
-                }
+            if dist_blocks is not None:
+                spec["distributed"] = dist_blocks[process_id]
             local_spec = str(
                 Path(self.cache_dir) / f"spec_{operation_id}_{process_id}.json"
             )
@@ -1046,10 +1051,14 @@ class TPUExecutor(RemoteExecutor):
                     await old_pool.close_all()
 
             future = asyncio.run_coroutine_threadsafe(teardown(), bound)
-            future.add_done_callback(
-                lambda f: f.exception()
-                and app_log.warning("old-loop teardown failed: %s", f.exception())
-            )
+
+            def _log_teardown(f) -> None:
+                if f.cancelled():
+                    app_log.warning("old-loop teardown was cancelled")
+                elif f.exception() is not None:
+                    app_log.warning("old-loop teardown failed: %s", f.exception())
+
+            future.add_done_callback(_log_teardown)
         elif not bound.is_closed():
             # Stopped-but-open loop: scheduling a coroutine on it would
             # never run (and warn about never-awaited coroutines); the

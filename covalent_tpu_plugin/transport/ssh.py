@@ -77,7 +77,7 @@ class SSHTransport(Transport):
     # -- handshake -----------------------------------------------------------
 
     async def _open(self) -> None:
-        if self._use_asyncssh:  # pragma: no cover - needs asyncssh
+        if self._use_asyncssh:
             kwargs = dict(
                 username=self.username or None,
                 client_keys=[self.ssh_key_file] if self.ssh_key_file else None,
@@ -147,7 +147,7 @@ class SSHTransport(Transport):
         if self._closed:
             raise TransportError("transport is closed")
         describe = describe or f"{self.address}:{command.split()[0]}"
-        if self._use_asyncssh:  # pragma: no cover - needs asyncssh
+        if self._use_asyncssh:
             from .process import TransportProcess
 
             proc = await self._conn.create_process(command, encoding=None)
@@ -159,7 +159,7 @@ class SSHTransport(Transport):
     async def run(self, command: str, timeout: float | None = None) -> CommandResult:
         if self._closed:
             raise TransportError("transport is closed")
-        if self._use_asyncssh:  # pragma: no cover
+        if self._use_asyncssh:
             proc = await asyncio.wait_for(self._conn.run(command), timeout)
             return CommandResult(
                 exit_status=proc.exit_status if proc.exit_status is not None else -1,
@@ -169,7 +169,7 @@ class SSHTransport(Transport):
         return await self._exec_openssh(command, timeout)
 
     async def put(self, local_path: str, remote_path: str) -> None:
-        if self._use_asyncssh:  # pragma: no cover
+        if self._use_asyncssh:
             await asyncssh.scp(local_path, (self._conn, remote_path))
             return
         result = await self._exec_argv(
@@ -180,7 +180,7 @@ class SSHTransport(Transport):
             raise TransportError(f"scp upload failed: {result.stderr.strip()}")
 
     async def get(self, remote_path: str, local_path: str) -> None:
-        if self._use_asyncssh:  # pragma: no cover
+        if self._use_asyncssh:
             await asyncssh.scp((self._conn, remote_path), local_path)
             return
         result = await self._exec_argv(
@@ -194,7 +194,7 @@ class SSHTransport(Transport):
         if self._closed:
             return
         self._closed = True
-        if self._use_asyncssh and self._conn is not None:  # pragma: no cover
+        if self._use_asyncssh and self._conn is not None:
             self._conn.close()
             await self._conn.wait_closed()
 

@@ -83,14 +83,19 @@ def _process_index() -> int:
 
 
 def save_checkpoint(
-    tree: Any, step: int, base: str | os.PathLike | None = None
+    tree: Any,
+    step: int,
+    base: str | os.PathLike | None = None,
+    *,
+    per_process: bool = False,
 ) -> Path:
     """Persist ``tree`` for ``step``; returns the checkpoint path.
 
     Assumes a *replicated* tree in multi-process electrons: process 0 is the
     single writer (matching the harness's result-write contract); other
-    processes return immediately.  Per-process state should go to
-    per-process ``base`` paths instead.
+    processes return immediately.  For genuinely per-process state pass
+    ``per_process=True`` with a per-process ``base`` path — every process
+    then writes its own checkpoint.
     """
     root = checkpoint_dir(base)
     target = root / f"step_{step}"
@@ -108,7 +113,7 @@ def save_checkpoint(
             f"{target} holds an orbax (directory) checkpoint but orbax is "
             "unavailable; install orbax or delete the old step"
         )
-    if _process_index() != 0:
+    if not per_process and _process_index() != 0:
         return target
     if ocp is not None:
         checkpointer = ocp.PyTreeCheckpointer()

@@ -82,6 +82,10 @@ def test_nonzero_process_skips_write(tmp_path, monkeypatch):
     monkeypatch.setattr(ckpt_mod, "_process_index", lambda: 1)
     target = save_checkpoint({"x": 1}, step=3, base=tmp_path)
     assert not target.exists()
+    # The documented escape hatch: per-process state writes from any rank.
+    target = save_checkpoint({"x": 1}, step=3, base=tmp_path / "proc1",
+                             per_process=True)
+    assert target.exists()
 
 
 def test_resume_across_electron_dispatches(tmp_path, run_async):

