@@ -17,7 +17,7 @@ from __future__ import annotations
 from abc import abstractmethod
 from typing import Any, Callable
 
-try:  # pragma: no cover - exercised only when covalent is installed
+try:  # covered by the stub-covalent interop tier when importable
     from covalent.executor.executor_plugins.remote_executor import (
         RemoteExecutor as _CovalentRemoteExecutor,
     )

@@ -1,0 +1,160 @@
+"""Covalent-present interop tier (VERDICT r1 next-round #7).
+
+The reference CI's gate is importing the plugin through a live Covalent
+server's loader (``/root/reference/.github/workflows/tests.yml:80-84``).
+Covalent cannot be installed in this sandbox, so a stub ``covalent``
+package — the same pattern as the stub-asyncssh transport tier — stands in:
+the modules are reloaded with the stub importable, which flips the
+covalent-present branches of ``executor_base`` (real ``RemoteExecutor``
+template) and ``utils.config`` (delegating ``get_config``/``set_config``),
+and one electron runs end-to-end with ``TPUExecutor`` subclassing the
+*Covalent* template class.
+"""
+
+from __future__ import annotations
+
+import importlib
+import sys
+import types
+
+import pytest
+
+
+class _FakeRemoteExecutor:
+    """Covalent's async RemoteExecutor template, shape-compatible
+    (covalent.executor.executor_plugins.remote_executor)."""
+
+    def __init__(self, poll_freq=15, remote_cache="", credentials_file=""):
+        self.poll_freq = poll_freq
+        self.remote_cache = remote_cache
+        self.credentials_file = credentials_file
+        self.template_init_ran = True
+
+
+@pytest.fixture()
+def covalent_stub(monkeypatch):
+    """Install a fake `covalent` package and reload the interop modules."""
+    store: dict[str, object] = {"executors.tpu.remote_workdir": "from-covalent-config"}
+
+    root = types.ModuleType("covalent")
+    root.__path__ = []  # mark as package
+    executor_pkg = types.ModuleType("covalent.executor")
+    executor_pkg.__path__ = []
+    plugins_pkg = types.ModuleType("covalent.executor.executor_plugins")
+    plugins_pkg.__path__ = []
+    remote_mod = types.ModuleType(
+        "covalent.executor.executor_plugins.remote_executor"
+    )
+    remote_mod.RemoteExecutor = _FakeRemoteExecutor
+    shared = types.ModuleType("covalent._shared_files")
+    shared.__path__ = []
+    config_mod = types.ModuleType("covalent._shared_files.config")
+
+    def get_config(key):
+        if key not in store:
+            raise KeyError(key)
+        return store[key]
+
+    def set_config(mapping):
+        store.update(mapping)
+
+    config_mod.get_config = get_config
+    config_mod.set_config = set_config
+    config_mod.store = store
+
+    modules = {
+        "covalent": root,
+        "covalent.executor": executor_pkg,
+        "covalent.executor.executor_plugins": plugins_pkg,
+        "covalent.executor.executor_plugins.remote_executor": remote_mod,
+        "covalent._shared_files": shared,
+        "covalent._shared_files.config": config_mod,
+    }
+    for name, module in modules.items():
+        monkeypatch.setitem(sys.modules, name, module)
+
+    import covalent_tpu_plugin.executor_base as eb
+    import covalent_tpu_plugin.utils.config as cfg
+
+    importlib.reload(eb)
+    importlib.reload(cfg)
+    try:
+        yield types.SimpleNamespace(store=store, eb=eb, cfg=cfg)
+    finally:
+        for name in modules:
+            sys.modules.pop(name, None)
+        importlib.reload(eb)
+        importlib.reload(cfg)
+        assert not eb.HAVE_COVALENT  # sandbox ground state restored
+
+
+def test_executor_base_uses_covalent_template(covalent_stub):
+    assert covalent_stub.eb.HAVE_COVALENT
+    assert covalent_stub.eb.RemoteExecutor is _FakeRemoteExecutor
+
+
+def test_config_delegates_to_covalent(covalent_stub):
+    cfg = covalent_stub.cfg
+    assert cfg._HAVE_COVALENT
+    assert cfg.get_config("executors.tpu.remote_workdir") == "from-covalent-config"
+    assert cfg.get_config("executors.tpu.missing", "fallback") == "fallback"
+    cfg.set_config("executors.tpu.poll_freq", 0.25)
+    assert covalent_stub.store["executors.tpu.poll_freq"] == 0.25
+    cfg.update_config({"new_key": "v"}, section="executors.tpu")
+    assert covalent_stub.store["executors.tpu.new_key"] == "v"
+
+
+def test_electron_end_to_end_on_covalent_template(covalent_stub, tmp_path,
+                                                  run_async):
+    """TPUExecutor subclassing Covalent's own RemoteExecutor runs a full
+    electron — what a live dispatcher would drive."""
+    import covalent_tpu_plugin.tpu as tpu_mod
+
+    importlib.reload(tpu_mod)
+    try:
+        assert issubclass(tpu_mod.TPUExecutor, _FakeRemoteExecutor)
+        ex = tpu_mod.TPUExecutor(
+            transport="local",
+            cache_dir=str(tmp_path / "cache"),
+            remote_cache=str(tmp_path / "remote"),
+            python_path=sys.executable,
+            poll_freq=0.1,
+            use_agent=False,
+            task_env={"JAX_PLATFORMS": "cpu"},
+        )
+        assert ex.template_init_ran  # Covalent template __init__ really ran
+        # Config chain: unset ctor arg -> covalent's get_config wins.
+        assert ex.remote_workdir == "from-covalent-config"
+
+        async def flow():
+            result = await ex.run(
+                lambda a, b: a * b, [6, 7], {},
+                {"dispatch_id": "cov", "node_id": 0},
+            )
+            await ex.close()
+            return result
+
+        assert run_async(flow()) == 42
+    finally:
+        importlib.reload(tpu_mod)
+        importlib.reload(importlib.import_module("covalent_tpu_plugin"))
+
+
+def test_entry_point_declared_for_covalent_loader():
+    """setup.py must register the plugin in Covalent's entry-point group
+    (reference setup.py:36, 74-76)."""
+    import re
+    from pathlib import Path
+
+    setup_src = Path(__file__).resolve().parents[1].joinpath("setup.py").read_text()
+    assert "covalent.executor.executor_plugins" in setup_src
+    assert re.search(r"tpu\s*=\s*covalent_tpu_plugin\.tpu", setup_src)
+
+
+def test_plugin_identity_globals():
+    """The loader keys on EXECUTOR_PLUGIN_NAME + defaults dict (ssh.py:34-50)."""
+    import covalent_tpu_plugin.tpu as tpu_mod
+
+    assert tpu_mod.EXECUTOR_PLUGIN_NAME == "TPUExecutor"
+    assert isinstance(tpu_mod._EXECUTOR_PLUGIN_DEFAULTS, dict)
+    assert "remote_workdir" in tpu_mod._EXECUTOR_PLUGIN_DEFAULTS
