@@ -14,6 +14,7 @@ and one electron runs end-to-end with ``TPUExecutor`` subclassing the
 from __future__ import annotations
 
 import importlib
+import os
 import sys
 import types
 
@@ -104,40 +105,105 @@ def test_config_delegates_to_covalent(covalent_stub):
     assert covalent_stub.store["executors.tpu.new_key"] == "v"
 
 
-def test_electron_end_to_end_on_covalent_template(covalent_stub, tmp_path,
-                                                  run_async):
+_E2E_SCRIPT = r"""
+import asyncio, sys, types
+
+store = {"executors.tpu.remote_workdir": "from-covalent-config"}
+
+
+class FakeRemoteExecutor:
+    def __init__(self, poll_freq=15, remote_cache="", credentials_file=""):
+        self.poll_freq = poll_freq
+        self.remote_cache = remote_cache
+        self.credentials_file = credentials_file
+        self.template_init_ran = True
+
+
+def fake_module(name, **attrs):
+    module = types.ModuleType(name)
+    module.__path__ = []
+    for key, value in attrs.items():
+        setattr(module, key, value)
+    sys.modules[name] = module
+    return module
+
+
+def get_config(key):
+    return store[key]
+
+
+def set_config(mapping):
+    store.update(mapping)
+
+
+fake_module("covalent")
+fake_module("covalent.executor")
+fake_module("covalent.executor.executor_plugins")
+fake_module(
+    "covalent.executor.executor_plugins.remote_executor",
+    RemoteExecutor=FakeRemoteExecutor,
+)
+fake_module("covalent._shared_files")
+fake_module(
+    "covalent._shared_files.config", get_config=get_config, set_config=set_config
+)
+
+# Imported AFTER the stub is in place: the covalent-present branches load.
+from covalent_tpu_plugin import TPUExecutor  # noqa: E402
+
+assert issubclass(TPUExecutor, FakeRemoteExecutor), TPUExecutor.__mro__
+# Plugin-loader contract: defaults were merged into covalent's config.
+assert store["executors.tpu.poll_freq"] == 0.5, store
+
+tmp = sys.argv[1]
+ex = TPUExecutor(
+    transport="local",
+    cache_dir=f"{tmp}/cache",
+    remote_cache=f"{tmp}/remote",
+    python_path=sys.executable,
+    poll_freq=0.1,
+    use_agent=False,
+    task_env={"JAX_PLATFORMS": "cpu"},
+)
+assert ex.template_init_ran  # Covalent template __init__ really ran
+# Config chain: unset ctor arg -> covalent's get_config wins.
+assert ex.remote_workdir == "from-covalent-config", ex.remote_workdir
+
+
+async def flow():
+    result = await ex.run(
+        lambda a, b: a * b, [6, 7], {}, {"dispatch_id": "cov", "node_id": 0}
+    )
+    await ex.close()
+    return result
+
+
+assert asyncio.run(flow()) == 42
+print("INTEROP-E2E-OK")
+"""
+
+
+def test_electron_end_to_end_on_covalent_template(tmp_path):
     """TPUExecutor subclassing Covalent's own RemoteExecutor runs a full
-    electron — what a live dispatcher would drive."""
-    import covalent_tpu_plugin.tpu as tpu_mod
+    electron — what a live dispatcher would drive.  Runs in a subprocess:
+    installing the stub before first import flips every covalent-present
+    branch without reloading modules under an in-flight test session."""
+    import pathlib
+    import subprocess
 
-    importlib.reload(tpu_mod)
-    try:
-        assert issubclass(tpu_mod.TPUExecutor, _FakeRemoteExecutor)
-        ex = tpu_mod.TPUExecutor(
-            transport="local",
-            cache_dir=str(tmp_path / "cache"),
-            remote_cache=str(tmp_path / "remote"),
-            python_path=sys.executable,
-            poll_freq=0.1,
-            use_agent=False,
-            task_env={"JAX_PLATFORMS": "cpu"},
-        )
-        assert ex.template_init_ran  # Covalent template __init__ really ran
-        # Config chain: unset ctor arg -> covalent's get_config wins.
-        assert ex.remote_workdir == "from-covalent-config"
-
-        async def flow():
-            result = await ex.run(
-                lambda a, b: a * b, [6, 7], {},
-                {"dispatch_id": "cov", "node_id": 0},
-            )
-            await ex.close()
-            return result
-
-        assert run_async(flow()) == 42
-    finally:
-        importlib.reload(tpu_mod)
-        importlib.reload(importlib.import_module("covalent_tpu_plugin"))
+    repo_root = str(pathlib.Path(__file__).resolve().parents[1])
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("COVALENT_TPU_CONFIG", str(tmp_path / "unused.toml"))
+    proc = subprocess.run(
+        [sys.executable, "-c", _E2E_SCRIPT, str(tmp_path)],
+        capture_output=True,
+        text=True,
+        timeout=240,
+        env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "INTEROP-E2E-OK" in proc.stdout
 
 
 def test_entry_point_declared_for_covalent_loader():
