@@ -113,8 +113,39 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
     def mfu(tflops):
         return round(tflops / peak_tflops, 4) if peak_tflops else None
 
-    def adaptive_iters(once_s: float, target_s: float, cap: int) -> int:
-        return max(1, min(cap, int(target_s / max(once_s, 1e-6))))
+    def unit_seconds(dispatch, fetch, target_s: float, cap: int) -> float:
+        """Seconds per dispatched unit, by two-batch delta timing.
+
+        The tunneled/proxied device this bench runs against adds a large
+        constant per-fetch round-trip (~65 ms measured) that would
+        masquerade as low FLOP throughput.  Timing a 1-unit batch and a
+        k-unit batch and dividing by (k - 1) cancels that constant:
+        dispatches are async (they only enqueue), the device queue
+        serialises them, and ``fetch`` forces a drain.
+        """
+        dispatch()
+        fetch()  # compiled + warm
+        t0 = time.monotonic()
+        dispatch()
+        fetch()
+        once = time.monotonic() - t0  # includes the round-trip constant
+        k = max(2, min(cap, int(target_s / max(once, 1e-6)) + 1))
+        deltas = []
+        for _ in range(2):  # best-of-2: the round-trip constant jitters
+            t0 = time.monotonic()
+            dispatch()
+            fetch()
+            e1 = time.monotonic() - t0
+            t0 = time.monotonic()
+            for _ in range(k):
+                dispatch()
+            fetch()
+            ek = time.monotonic() - t0
+            if ek > e1:  # jitter can invert tiny deltas; discard, don't clamp
+                deltas.append((ek - e1) / (k - 1))
+        # Both trials jitter-inverted: the single-batch time (round-trip
+        # included) is the honest upper bound, never a fabricated rate.
+        return min(deltas) if deltas else once
 
     # Non-TPU backends (the CPU validation tier) get scaled-down shapes so
     # every subphase still executes end to end within the budget.
@@ -123,45 +154,43 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
     # -- matmul TFLOP/s + MFU (BASELINE config 2) --------------------------
     try:
         n = 1024 if small else 4096
+        chain_len = 16
         inv_n = 1.0 / n
         x = jnp.ones((n, n), jnp.bfloat16)
         y = jnp.ones((n, n), jnp.bfloat16)
 
         @jax.jit
-        def mm(a, b):
+        def mm_chain(a, b):
             # Rescale by 1/n so the chained all-ones product stays exactly 1
             # (a raw chain overflows bf16 to inf after ~10 iterations) —
             # the fetched scalar doubles as a correctness check.
-            return jnp.einsum("ij,jk->ik", a, b) * inv_n
+            return jax.lax.fori_loop(
+                0,
+                chain_len,
+                lambda _, acc: jnp.einsum("ij,jk->ik", acc, b) * inv_n,
+                a,
+            )
 
-        jax.device_get(mm(x, y)[0, 0])  # compile + warm
-        t0 = time.monotonic()
-        jax.device_get(mm(x, y)[0, 0])
-        once = time.monotonic() - t0
-        iters = adaptive_iters(once, 8.0, 64)
+        holder = {}
 
-        # The chain lives INSIDE jit (lax.fori_loop): one dispatch for all
-        # iterations, so a tunneled/proxied device's per-call latency can't
-        # masquerade as low FLOP throughput.
-        @jax.jit
-        def mm_chain(a, b):
-            return jax.lax.fori_loop(0, iters, lambda _, acc: mm(acc, b), a)
+        def dispatch():
+            holder["out"] = mm_chain(x, y)
 
-        jax.device_get(mm_chain(x, y)[0, 0])  # compile + warm
-        t0 = time.monotonic()
-        # device_get, not block_until_ready: proxy/tunnel backends can make
-        # the latter a no-op, and a fetched scalar can't lie.
-        final = float(jax.device_get(mm_chain(x, y)[0, 0]))
-        elapsed = time.monotonic() - t0
-        tflops = (2 * n**3 * iters) / elapsed / 1e12
+        def fetch():
+            # device_get, not block_until_ready: proxy/tunnel backends can
+            # make the latter a no-op, and a fetched scalar can't lie.
+            holder["check"] = float(jax.device_get(holder["out"][0, 0]))
+
+        unit = unit_seconds(dispatch, fetch, target_s=6.0, cap=40)
+        tflops = (2 * n**3 * chain_len) / unit / 1e12
         report(
             "matmul",
             n=n,
-            iters=iters,
+            chain_len=chain_len,
             tflops=round(tflops, 2),
             mfu=mfu(tflops),
             peak_tflops=peak_tflops,
-            check=final,  # must be 1.0
+            check=holder["check"],  # must be 1.0
         )
     except Exception as error:  # noqa: BLE001
         report("matmul", error=repr(error))
@@ -198,28 +227,19 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
                 loss, grads = jax.value_and_grad(loss_fn)(state.params)
                 return state.apply_gradients(grads=grads), loss
 
-            state, loss = step(state, batch)  # compile + warm
-            jax.device_get(loss)
+            holder = {"state": state}
 
-            # Scan the whole epoch inside one jit: a tunneled device's
-            # per-dispatch RTT otherwise dominates a ~ms train step.
-            @jax.jit
-            def train(state, batch):
-                def body(state, _):
-                    new_state, loss = step(state, batch)
-                    return new_state, loss
-                return jax.lax.scan(body, state, None, length=steps)
+            def dispatch():
+                holder["state"], holder["loss"] = step(holder["state"], batch)
 
-            state, losses = train(state, batch)  # compile
-            jax.device_get(losses[-1])
-            t0 = time.monotonic()
-            state, losses = train(state, batch)
-            final_loss = float(jax.device_get(losses[-1]))
-            elapsed = time.monotonic() - t0
+            def fetch():
+                holder["final"] = float(jax.device_get(holder["loss"]))
+
+            unit = unit_seconds(dispatch, fetch, target_s=4.0, cap=steps)
             report(
                 "mnist",
-                steps_per_s=round(steps / elapsed, 2),
-                final_loss=round(final_loss, 4),
+                steps_per_s=round(1.0 / unit, 2),
+                final_loss=round(holder["final"], 4),
             )
         except Exception as error:  # noqa: BLE001
             report("mnist", error=repr(error))
@@ -239,17 +259,17 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
             k = jax.random.normal(jax.random.PRNGKey(1), (b, h, s, d), jnp.bfloat16)
             v = jax.random.normal(jax.random.PRNGKey(2), (b, h, s, d), jnp.bfloat16)
 
-            def bench_fwd(fn, cap=8):
+            def bench_fwd(fn, cap=24):
                 f = jax.jit(fn)
-                jax.device_get(f(q, k, v)[0, 0, 0, 0])  # compile + warm
-                t0 = time.monotonic()
-                jax.device_get(f(q, k, v)[0, 0, 0, 0])
-                iters = adaptive_iters(time.monotonic() - t0, 4.0, cap)
-                t0 = time.monotonic()
-                for _ in range(iters):
-                    out = f(q, k, v)
-                jax.device_get(out[0, 0, 0, 0])
-                return (time.monotonic() - t0) / iters
+                holder = {}
+
+                def dispatch():
+                    holder["out"] = f(q, k, v)
+
+                def fetch():
+                    jax.device_get(holder["out"][0, 0, 0, 0])
+
+                return unit_seconds(dispatch, fetch, target_s=3.0, cap=cap)
 
             ref_s = bench_fwd(lambda q, k, v: mha_reference(q, k, v, causal=True))
             flash_s = bench_fwd(lambda q, k, v: flash_attention(q, k, v, causal=True))
@@ -273,27 +293,27 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
                 mha_reference,
             )
 
-            b, h, s, d = (1, 4, 512, 64) if small else (2, 8, 2048, 64)
+            b, h, s, d = (1, 4, 512, 64) if small else (2, 16, 4096, 64)
             q = jax.random.normal(jax.random.PRNGKey(0), (b, h, s, d), jnp.bfloat16)
             k = jax.random.normal(jax.random.PRNGKey(1), (b, h, s, d), jnp.bfloat16)
             v = jax.random.normal(jax.random.PRNGKey(2), (b, h, s, d), jnp.bfloat16)
 
-            def bench_bwd(fn, cap=4):
+            def bench_bwd(fn, cap=12):
                 grad_fn = jax.jit(
                     jax.grad(
                         lambda q, k, v: fn(q, k, v).astype(jnp.float32).sum(),
                         argnums=(0, 1, 2),
                     )
                 )
-                jax.device_get(grad_fn(q, k, v)[0][0, 0, 0, 0])  # compile
-                t0 = time.monotonic()
-                jax.device_get(grad_fn(q, k, v)[0][0, 0, 0, 0])
-                iters = adaptive_iters(time.monotonic() - t0, 3.0, cap)
-                t0 = time.monotonic()
-                for _ in range(iters):
-                    grads = grad_fn(q, k, v)
-                jax.device_get(grads[0][0, 0, 0, 0])
-                return (time.monotonic() - t0) / iters
+                holder = {}
+
+                def dispatch():
+                    holder["grads"] = grad_fn(q, k, v)
+
+                def fetch():
+                    jax.device_get(holder["grads"][0][0, 0, 0, 0])
+
+                return unit_seconds(dispatch, fetch, target_s=3.0, cap=cap)
 
             ref_s = bench_bwd(lambda q, k, v: mha_reference(q, k, v, causal=True))
             flash_s = bench_bwd(lambda q, k, v: flash_attention(q, k, v, causal=True))
@@ -351,27 +371,16 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
                 )(state.params)
                 return state.apply_gradients(grads=grads), loss
 
-            state, loss = step(state, tokens)  # compile
-            jax.device_get(loss)
-            t0 = time.monotonic()
-            state, loss = step(state, tokens)
-            jax.device_get(loss)
-            iters = adaptive_iters(time.monotonic() - t0, 5.0, 8)
+            holder = {"state": state}
 
-            @jax.jit
-            def train(state, tokens):
-                def body(state, _):
-                    new_state, loss = step(state, tokens)
-                    return new_state, loss
-                return jax.lax.scan(body, state, None, length=iters)
+            def dispatch():
+                holder["state"], holder["loss"] = step(holder["state"], tokens)
 
-            state, losses = train(state, tokens)  # compile
-            jax.device_get(losses[-1])
-            t0 = time.monotonic()
-            state, losses = train(state, tokens)
-            final_loss = float(jax.device_get(losses[-1]))
-            elapsed = time.monotonic() - t0
-            step_s = elapsed / iters
+            def fetch():
+                holder["final"] = float(jax.device_get(holder["loss"]))
+
+            step_s = unit_seconds(dispatch, fetch, target_s=5.0, cap=10)
+            final_loss = holder["final"]
             # 6ND for fwd+bwd (+ remat recompute ~ +1 fwd -> 8ND ceiling;
             # report the standard 6ND so MFU is comparable across frameworks)
             lm_tflops = 6 * n_params * bsz * seq / step_s / 1e12
@@ -556,7 +565,7 @@ async def main() -> None:
         "mnist_final_loss": sub("mnist", "final_loss"),
         "flash_fwd_4k_speedup": sub("flash_fwd", "speedup"),
         "flash_fwd_4k_ms": sub("flash_fwd", "flash_ms"),
-        "flash_bwd_2k_speedup": sub("flash_bwd", "speedup"),
+        "flash_bwd_4k_speedup": sub("flash_bwd", "speedup"),
         "lm125m_step_ms": sub("lm_step", "step_ms"),
         "lm125m_tokens_per_s": sub("lm_step", "tokens_per_s"),
         "lm125m_mfu": sub("lm_step", "mfu"),

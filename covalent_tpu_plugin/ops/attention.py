@@ -226,9 +226,10 @@ def _flash_forward(
     return out, lse
 
 
-# Backward tiles: square-ish blocks keep the four recompute matmuls per
-# cell MXU-shaped while halving the VMEM of the f32 score tiles vs 512x1024.
-_DEFAULT_BWD_BLOCK = 512
+# Backward tile edge (v5e sweep, 2026-07): 1024 beat 512/256 at every
+# (S, head_dim) probed — S=2048/4096/8192, d=64/128; see benchmarks/.
+# _fit_block halves it to divide shorter or odd sequences.
+_DEFAULT_BWD_BLOCK = 1024
 
 
 def _flash_bwd_dkdv_kernel(

@@ -1,0 +1,68 @@
+"""Shared fake `covalent` package for the interop tier.
+
+One definition serves both consumers in ``test_covalent_interop.py``: the
+in-process fixture (branch tests on reloaded modules) and the subprocess
+end-to-end script (stub installed before first import) — so the stubbed
+RemoteExecutor/config contract cannot silently diverge between tiers.
+"""
+
+from __future__ import annotations
+
+import sys
+import types
+
+
+class FakeRemoteExecutor:
+    """Covalent's async RemoteExecutor template, shape-compatible
+    (covalent.executor.executor_plugins.remote_executor)."""
+
+    def __init__(self, poll_freq=15, remote_cache="", credentials_file=""):
+        self.poll_freq = poll_freq
+        self.remote_cache = remote_cache
+        self.credentials_file = credentials_file
+        self.template_init_ran = True
+
+
+def build_modules(store: dict) -> dict[str, types.ModuleType]:
+    """Fake covalent module tree backed by ``store`` for config state."""
+
+    def get_config(key):
+        if key not in store:
+            raise KeyError(key)
+        return store[key]
+
+    def set_config(mapping):
+        store.update(mapping)
+
+    def package(name, **attrs):
+        module = types.ModuleType(name)
+        module.__path__ = []  # mark as package
+        for key, value in attrs.items():
+            setattr(module, key, value)
+        return module
+
+    return {
+        "covalent": package("covalent"),
+        "covalent.executor": package("covalent.executor"),
+        "covalent.executor.executor_plugins": package(
+            "covalent.executor.executor_plugins"
+        ),
+        "covalent.executor.executor_plugins.remote_executor": package(
+            "covalent.executor.executor_plugins.remote_executor",
+            RemoteExecutor=FakeRemoteExecutor,
+        ),
+        "covalent._shared_files": package("covalent._shared_files"),
+        "covalent._shared_files.config": package(
+            "covalent._shared_files.config",
+            get_config=get_config,
+            set_config=set_config,
+            store=store,
+        ),
+    }
+
+
+def install(store: dict) -> dict[str, types.ModuleType]:
+    """Install the stub into sys.modules (subprocess usage)."""
+    modules = build_modules(store)
+    sys.modules.update(modules)
+    return modules

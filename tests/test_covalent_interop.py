@@ -2,12 +2,12 @@
 
 The reference CI's gate is importing the plugin through a live Covalent
 server's loader (``/root/reference/.github/workflows/tests.yml:80-84``).
-Covalent cannot be installed in this sandbox, so a stub ``covalent``
-package — the same pattern as the stub-asyncssh transport tier — stands in:
-the modules are reloaded with the stub importable, which flips the
-covalent-present branches of ``executor_base`` (real ``RemoteExecutor``
-template) and ``utils.config`` (delegating ``get_config``/``set_config``),
-and one electron runs end-to-end with ``TPUExecutor`` subclassing the
+Covalent cannot be installed in this sandbox, so a shared stub ``covalent``
+package (``tests/covalent_stub.py`` — the same pattern as the stub-asyncssh
+transport tier) stands in, consumed two ways: an in-process fixture that
+reloads ``executor_base``/``utils.config`` with their covalent-present
+branches live, and a subprocess that installs the stub before first import
+and runs one electron end-to-end with ``TPUExecutor`` subclassing the
 *Covalent* template class.
 """
 
@@ -20,58 +20,14 @@ import types
 
 import pytest
 
-
-class _FakeRemoteExecutor:
-    """Covalent's async RemoteExecutor template, shape-compatible
-    (covalent.executor.executor_plugins.remote_executor)."""
-
-    def __init__(self, poll_freq=15, remote_cache="", credentials_file=""):
-        self.poll_freq = poll_freq
-        self.remote_cache = remote_cache
-        self.credentials_file = credentials_file
-        self.template_init_ran = True
+from .covalent_stub import FakeRemoteExecutor, build_modules
 
 
 @pytest.fixture()
 def covalent_stub(monkeypatch):
-    """Install a fake `covalent` package and reload the interop modules."""
+    """Install the fake `covalent` package and reload the interop modules."""
     store: dict[str, object] = {"executors.tpu.remote_workdir": "from-covalent-config"}
-
-    root = types.ModuleType("covalent")
-    root.__path__ = []  # mark as package
-    executor_pkg = types.ModuleType("covalent.executor")
-    executor_pkg.__path__ = []
-    plugins_pkg = types.ModuleType("covalent.executor.executor_plugins")
-    plugins_pkg.__path__ = []
-    remote_mod = types.ModuleType(
-        "covalent.executor.executor_plugins.remote_executor"
-    )
-    remote_mod.RemoteExecutor = _FakeRemoteExecutor
-    shared = types.ModuleType("covalent._shared_files")
-    shared.__path__ = []
-    config_mod = types.ModuleType("covalent._shared_files.config")
-
-    def get_config(key):
-        if key not in store:
-            raise KeyError(key)
-        return store[key]
-
-    def set_config(mapping):
-        store.update(mapping)
-
-    config_mod.get_config = get_config
-    config_mod.set_config = set_config
-    config_mod.store = store
-
-    modules = {
-        "covalent": root,
-        "covalent.executor": executor_pkg,
-        "covalent.executor.executor_plugins": plugins_pkg,
-        "covalent.executor.executor_plugins.remote_executor": remote_mod,
-        "covalent._shared_files": shared,
-        "covalent._shared_files.config": config_mod,
-    }
-    for name, module in modules.items():
+    for name, module in build_modules(store).items():
         monkeypatch.setitem(sys.modules, name, module)
 
     import covalent_tpu_plugin.executor_base as eb
@@ -82,7 +38,7 @@ def covalent_stub(monkeypatch):
     try:
         yield types.SimpleNamespace(store=store, eb=eb, cfg=cfg)
     finally:
-        for name in modules:
+        for name in build_modules({}):
             sys.modules.pop(name, None)
         importlib.reload(eb)
         importlib.reload(cfg)
@@ -91,7 +47,7 @@ def covalent_stub(monkeypatch):
 
 def test_executor_base_uses_covalent_template(covalent_stub):
     assert covalent_stub.eb.HAVE_COVALENT
-    assert covalent_stub.eb.RemoteExecutor is _FakeRemoteExecutor
+    assert covalent_stub.eb.RemoteExecutor is FakeRemoteExecutor
 
 
 def test_config_delegates_to_covalent(covalent_stub):
@@ -106,47 +62,12 @@ def test_config_delegates_to_covalent(covalent_stub):
 
 
 _E2E_SCRIPT = r"""
-import asyncio, sys, types
+import asyncio, sys
+
+from tests.covalent_stub import FakeRemoteExecutor, install
 
 store = {"executors.tpu.remote_workdir": "from-covalent-config"}
-
-
-class FakeRemoteExecutor:
-    def __init__(self, poll_freq=15, remote_cache="", credentials_file=""):
-        self.poll_freq = poll_freq
-        self.remote_cache = remote_cache
-        self.credentials_file = credentials_file
-        self.template_init_ran = True
-
-
-def fake_module(name, **attrs):
-    module = types.ModuleType(name)
-    module.__path__ = []
-    for key, value in attrs.items():
-        setattr(module, key, value)
-    sys.modules[name] = module
-    return module
-
-
-def get_config(key):
-    return store[key]
-
-
-def set_config(mapping):
-    store.update(mapping)
-
-
-fake_module("covalent")
-fake_module("covalent.executor")
-fake_module("covalent.executor.executor_plugins")
-fake_module(
-    "covalent.executor.executor_plugins.remote_executor",
-    RemoteExecutor=FakeRemoteExecutor,
-)
-fake_module("covalent._shared_files")
-fake_module(
-    "covalent._shared_files.config", get_config=get_config, set_config=set_config
-)
+install(store)
 
 # Imported AFTER the stub is in place: the covalent-present branches load.
 from covalent_tpu_plugin import TPUExecutor  # noqa: E402
