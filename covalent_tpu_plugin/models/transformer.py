@@ -34,12 +34,21 @@ class TransformerConfig:
     d_model: int = 768
     n_layers: int = 12
     n_heads: int = 12
+    #: kv heads for grouped-query attention; None = n_heads (plain MHA).
+    n_kv_heads: int | None = None
     d_ff: int = 3072
     max_seq: int = 1024
     dtype: Any = jnp.bfloat16        # activations
     param_dtype: Any = jnp.float32   # master weights
+    #: lm_head matmul dtype.  f32 is the conservative default; bf16 runs the
+    #: head on the MXU's fast path (the loss re-casts to f32 for softmax).
+    logits_dtype: Any = jnp.float32
     attention: str = "auto"          # auto | flash | reference | ring
     remat: bool = False
+    #: "full" recomputes everything in backward; "dots" saves matmul outputs
+    #: (jax dots_with_no_batch_dims_saveable) — ~half the recompute FLOPs for
+    #: a modest activation-memory increase.
+    remat_policy: str = "full"
     scan_layers: bool = True
     mesh: Any = None                 # required for attention="ring"
 
@@ -96,12 +105,21 @@ class Attention(nn.Module):
             kernel_init=nn.with_partitioning(nn.initializers.normal(0.02), axes),
             name=name,
         )
+        kv_heads = cfg.n_kv_heads or cfg.n_heads
+        if cfg.n_heads % kv_heads:
+            raise ValueError(
+                f"n_heads {cfg.n_heads} must be divisible by n_kv_heads {kv_heads}"
+            )
+        # GQA kv projections take the "kv_heads" logical axis (replicated
+        # across tensor shards by DEFAULT_RULES) — the small kv head count
+        # generally doesn't divide the tensor axis the way "heads" must.
+        kv_axis = "heads" if kv_heads == cfg.n_heads else "kv_heads"
         q = dense("q_proj", (cfg.n_heads, cfg.head_dim), ("embed", "heads", "kv"))(x)
-        k = dense("k_proj", (cfg.n_heads, cfg.head_dim), ("embed", "heads", "kv"))(x)
-        v = dense("v_proj", (cfg.n_heads, cfg.head_dim), ("embed", "heads", "kv"))(x)
+        k = dense("k_proj", (kv_heads, cfg.head_dim), ("embed", kv_axis, "kv"))(x)
+        v = dense("v_proj", (kv_heads, cfg.head_dim), ("embed", kv_axis, "kv"))(x)
         q = nn.with_logical_constraint(q, ("batch", "seq", "heads", "kv"))
-        k = nn.with_logical_constraint(k, ("batch", "seq", "heads", "kv"))
-        v = nn.with_logical_constraint(v, ("batch", "seq", "heads", "kv"))
+        k = nn.with_logical_constraint(k, ("batch", "seq", kv_axis, "kv"))
+        v = nn.with_logical_constraint(v, ("batch", "seq", kv_axis, "kv"))
 
         q = _rotary(q)
         k = _rotary(k)
@@ -114,6 +132,12 @@ class Attention(nn.Module):
         if impl == "ring":
             if cfg.mesh is None:
                 raise ValueError("attention='ring' requires config.mesh")
+            if kv_heads != cfg.n_heads:
+                # Ring shards over sequence, not heads: materialising the
+                # group repeat is cheap relative to the ring's kv transfers.
+                group = cfg.n_heads // kv_heads
+                kh = jnp.repeat(kh, group, axis=1)
+                vh = jnp.repeat(vh, group, axis=1)
             out = sequence_parallel_attention(qh, kh, vh, cfg.mesh, causal=True)
         elif impl == "flash":
             out = flash_attention(qh, kh, vh, causal=True)
@@ -205,7 +229,14 @@ class TransformerLM(nn.Module):
 
         block_cls = Block
         if cfg.remat:
-            block_cls = nn.remat(Block, prevent_cse=False)
+            policy = None
+            if cfg.remat_policy == "dots":
+                policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            elif cfg.remat_policy != "full":
+                raise ValueError(
+                    f"remat_policy must be 'full' or 'dots', got {cfg.remat_policy!r}"
+                )
+            block_cls = nn.remat(Block, prevent_cse=False, policy=policy)
         if cfg.scan_layers:
             x, _ = nn.scan(
                 lambda module, carry, _: (module(carry), None),
@@ -222,7 +253,7 @@ class TransformerLM(nn.Module):
         logits = nn.DenseGeneral(
             features=cfg.vocab_size,
             use_bias=False,
-            dtype=jnp.float32,  # final logits in f32 for a stable softmax
+            dtype=cfg.logits_dtype,  # f32 default; bf16 for the MXU fast path
             param_dtype=cfg.param_dtype,
             kernel_init=nn.with_partitioning(nn.initializers.normal(0.02), ("embed", "vocab")),
             name="lm_head",

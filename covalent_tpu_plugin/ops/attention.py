@@ -39,6 +39,16 @@ _DEFAULT_BLOCK_Q = 512
 _DEFAULT_BLOCK_K = 1024
 
 
+def _gqa_group(q: jax.Array, k: jax.Array) -> int:
+    """Query-heads-per-kv-head ratio; validates the GQA head contract."""
+    h_q, h_kv = q.shape[1], k.shape[1]
+    if h_kv == 0 or h_q % h_kv:
+        raise ValueError(
+            f"GQA needs query heads ({h_q}) divisible by kv heads ({h_kv})"
+        )
+    return h_q // h_kv
+
+
 def on_tpu() -> bool:
     try:
         device = jax.devices()[0]
@@ -54,7 +64,16 @@ def mha_reference(
     causal: bool = True,
     scale: float | None = None,
 ) -> jax.Array:
-    """Dense multi-head attention oracle.  Shapes: (B, H, S, D)."""
+    """Dense multi-head attention oracle.  Shapes: (B, H, S, D).
+
+    Grouped-query attention: ``k``/``v`` may carry fewer heads than ``q``
+    (``H_q % H_kv == 0``); each kv head serves a contiguous group of query
+    heads, matching the flash kernel's convention.
+    """
+    if k.shape[1] != q.shape[1]:
+        group = _gqa_group(q, k)
+        k = jnp.repeat(k, group, axis=1)
+        v = jnp.repeat(v, group, axis=1)
     d = q.shape[-1]
     scale = d**-0.5 if scale is None else scale
     scores = jnp.einsum(
@@ -192,12 +211,14 @@ def _flash_forward(
             f"({block_q}, {block_k}); pad the sequence"
         )
 
+    group = _gqa_group(q, k)
     grid = (batch, heads, seq_len // block_q, seq_len // block_k)
     qo_spec = pl.BlockSpec(
         (1, 1, block_q, head_dim), lambda b, h, i, j: (b, h, i, 0)
     )
+    # GQA: each query head reads its group's shared kv head (h // group).
     kv_spec = pl.BlockSpec(
-        (1, 1, block_k, head_dim), lambda b, h, i, j: (b, h, j, 0)
+        (1, 1, block_k, head_dim), lambda b, h, i, j: (b, h // group, j, 0)
     )
     lse_spec = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0))
     kernel = functools.partial(_flash_kernel, causal=causal, scale=scale)
@@ -236,20 +257,25 @@ def _flash_bwd_dkdv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dk_acc, dv_acc, *, causal: bool, scale: float
 ):
-    """One (key tile, query tile) cell of the dk/dv sweep.
+    """One (kv head, key tile, group member, query tile) cell of the dk/dv
+    sweep, grid (B, H_kv, KT, G, QT).
 
-    Query tiles are the innermost grid dimension: for a fixed key tile the
-    accumulators persist in VMEM scratch across the query sweep, and the
+    The two innermost grid dimensions — query-head-group member and query
+    tile — share one (kv head, key tile) output block, so the accumulators
+    persist in VMEM scratch across the whole sweep and dk/dv sum over the
+    query heads a GQA kv head serves (G = 1 degenerates to plain MHA).  The
     probability tile is recomputed from (q, k, lse) — never read from HBM.
     """
     block_q = q_ref.shape[2]
     block_k = k_ref.shape[2]
-    qt = pl.program_id(3)
-    num_q_tiles = pl.num_programs(3)
+    gi = pl.program_id(3)
+    qt = pl.program_id(4)
+    num_q_tiles = pl.num_programs(4)
+    last_group = pl.num_programs(3) - 1
     k_offset = pl.program_id(2) * block_k
     q_offset = qt * block_q
 
-    @pl.when(qt == 0)
+    @pl.when(jnp.logical_and(gi == 0, qt == 0))
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -296,7 +322,7 @@ def _flash_bwd_dkdv_kernel(
             preferred_element_type=jnp.float32,
         )
 
-    @pl.when(qt == num_q_tiles - 1)
+    @pl.when(jnp.logical_and(gi == last_group, qt == num_q_tiles - 1))
     def _finalise():
         dk_ref[0, 0, :, :] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0, 0, :, :] = dv_acc[:].astype(dv_ref.dtype)
@@ -360,6 +386,8 @@ def _flash_bwd_dq_kernel(
 def _flash_backward(q, k, v, out, lse, g, causal: bool, interpret: bool):
     """FlashAttention-2 backward: two Pallas sweeps, O(S·D) HBM."""
     batch, heads, seq_len, head_dim = q.shape
+    kv_heads = k.shape[1]
+    group = _gqa_group(q, k)
     scale = head_dim**-0.5
     block_q = _fit_block(_DEFAULT_BWD_BLOCK, seq_len)
     block_k = _fit_block(_DEFAULT_BWD_BLOCK, seq_len)
@@ -370,13 +398,6 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, interpret: bool):
         g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True
     )
 
-    qo_spec_q = pl.BlockSpec(
-        (1, 1, block_q, head_dim), lambda b, h, i, j: (b, h, j, 0)
-    )
-    kv_spec_k = pl.BlockSpec(
-        (1, 1, block_k, head_dim), lambda b, h, i, j: (b, h, i, 0)
-    )
-    stat_spec_q = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, j, 0))
     flops_factor = 0.5 if causal else 1.0
     cost = pl.CostEstimate(
         flops=int(10 * batch * heads * seq_len * seq_len * head_dim * flops_factor),
@@ -384,9 +405,22 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, interpret: bool):
         transcendentals=int(batch * heads * seq_len * seq_len * flops_factor),
     )
 
+    # dk/dv sweep — grid (B, H_kv, KT, G, QT): group member + query tile are
+    # innermost so one (kv head, key tile) output block accumulates across
+    # every query head in its group (see kernel docstring).
+    qo_spec_q = pl.BlockSpec(
+        (1, 1, block_q, head_dim),
+        lambda b, h, i, gi, j: (b, h * group + gi, j, 0),
+    )
+    kv_spec_k = pl.BlockSpec(
+        (1, 1, block_k, head_dim), lambda b, h, i, gi, j: (b, h, i, 0)
+    )
+    stat_spec_q = pl.BlockSpec(
+        (1, 1, block_q, 1), lambda b, h, i, gi, j: (b, h * group + gi, j, 0)
+    )
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkdv_kernel, causal=causal, scale=scale),
-        grid=(batch, heads, seq_len // block_k, seq_len // block_q),
+        grid=(batch, kv_heads, seq_len // block_k, group, seq_len // block_q),
         in_specs=[qo_spec_q, kv_spec_k, kv_spec_k, qo_spec_q, stat_spec_q,
                   stat_spec_q],
         out_specs=[kv_spec_k, kv_spec_k],
@@ -406,7 +440,7 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, interpret: bool):
         (1, 1, block_q, head_dim), lambda b, h, i, j: (b, h, i, 0)
     )
     kv_spec_j = pl.BlockSpec(
-        (1, 1, block_k, head_dim), lambda b, h, i, j: (b, h, j, 0)
+        (1, 1, block_k, head_dim), lambda b, h, i, j: (b, h // group, j, 0)
     )
     stat_spec_i = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0))
     dq = pl.pallas_call(
@@ -456,12 +490,18 @@ def flash_attention(
 ) -> jax.Array:
     """Flash attention over (B, H, S, D) inputs.
 
+    Grouped-query attention: ``k``/``v`` may have fewer heads than ``q``
+    (``H_q % H_kv == 0``); kv head ``i`` serves query heads
+    ``[i*G, (i+1)*G)``.  Gradients flow to the true kv shapes (dk/dv sum
+    over each group) — no materialised ``repeat``.
+
     ``interpret=None`` auto-selects: compiled Mosaic kernel on TPU,
     interpreter elsewhere (the CPU-mesh test tier).  Default (None) blocks
-    are the MXU-sweep winners on v5e — 512×1024, ≈3.9x over the fused XLA
-    path at S=4096 and ≈70x at S=8192 where the dense S² path spills —
-    auto-shrunk by halving to divide any sequence length; explicitly passed
-    blocks must divide the sequence exactly.
+    are the MXU-sweep winners on v5e (fwd 512×1024: 16.9× over the fused
+    XLA path at S=4096; bwd 1024²: 5.6× at S=4096, 15.7× at S=8192 — see
+    benchmarks/ATTENTION_SWEEP.md), auto-shrunk by halving to divide any
+    sequence length; explicitly passed blocks must divide the sequence
+    exactly.
     """
     if interpret is None:
         interpret = not on_tpu()

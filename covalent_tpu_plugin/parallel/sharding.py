@@ -23,6 +23,10 @@ DEFAULT_RULES: tuple[tuple[str, Any], ...] = (
     ("seq", "seq"),
     ("embed", "fsdp"),
     ("heads", "tensor"),
+    # GQA kv projections: replicated across tensor shards — n_kv_heads is
+    # typically smaller than the tensor axis (and kv weights are tiny), so
+    # sharding them like "heads" would demand impossible divisibility.
+    ("kv_heads", None),
     ("kv", None),
     ("mlp", "tensor"),
     ("vocab", "tensor"),

@@ -100,6 +100,40 @@ def test_flash_backward_no_dense_scores():
     assert not dense, f"backward materialises dense S x S values: {dense}"
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_gqa_matches_repeated_kv(causal):
+    """GQA: 8 query heads over 2 kv heads == dense attention with kv heads
+    explicitly repeated; gradients land on the true kv shapes."""
+    key = jax.random.PRNGKey(8)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (2, 8, 256, 32))
+    k = jax.random.normal(kk, (2, 2, 256, 32))
+    v = jax.random.normal(kv, (2, 2, 256, 32))
+
+    out = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
+    ref = mha_reference(q, k, v, causal=causal)  # repeats kv internally
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, causal=causal) * 0.01).sum()
+
+    def loss_ref(q, k, v):
+        return (mha_reference(q, k, v, causal=causal) * 0.01).sum()
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    assert g_flash[1].shape == k.shape  # true kv shape, not repeated
+    assert g_flash[2].shape == v.shape
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(a, b, atol=2e-5, rtol=2e-4)
+
+
+def test_flash_gqa_rejects_indivisible_heads():
+    q, k, v = random_qkv(jax.random.PRNGKey(9), (1, 6, 128, 32))
+    with pytest.raises(ValueError, match="divisible"):
+        flash_attention(q, k[:, :4], v[:, :4])
+
+
 def test_flash_rejects_indivisible_seq():
     q, k, v = random_qkv(jax.random.PRNGKey(3), (1, 1, 100, 32))
     with pytest.raises(ValueError, match="divisible"):

@@ -27,7 +27,8 @@ from .covalent_stub import FakeRemoteExecutor, build_modules
 def covalent_stub(monkeypatch):
     """Install the fake `covalent` package and reload the interop modules."""
     store: dict[str, object] = {"executors.tpu.remote_workdir": "from-covalent-config"}
-    for name, module in build_modules(store).items():
+    modules = build_modules(store)
+    for name, module in modules.items():
         monkeypatch.setitem(sys.modules, name, module)
 
     import covalent_tpu_plugin.executor_base as eb
@@ -38,7 +39,7 @@ def covalent_stub(monkeypatch):
     try:
         yield types.SimpleNamespace(store=store, eb=eb, cfg=cfg)
     finally:
-        for name in build_modules({}):
+        for name in modules:
             sys.modules.pop(name, None)
         importlib.reload(eb)
         importlib.reload(cfg)
