@@ -24,7 +24,12 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from ..ops.attention import flash_attention, mha_reference, on_tpu
+from ..ops.attention import (
+    flash_attention,
+    flash_attention_sharded,
+    mha_reference,
+    on_tpu,
+)
 from ..ops.ring_attention import sequence_parallel_attention
 
 
@@ -50,7 +55,9 @@ class TransformerConfig:
     #: a modest activation-memory increase.
     remat_policy: str = "full"
     scan_layers: bool = True
-    mesh: Any = None                 # required for attention="ring"
+    #: device mesh: required for attention="ring"; with attention="flash"
+    #: it switches the kernel to the shard_map (collective-free) path.
+    mesh: Any = None
 
     @property
     def head_dim(self) -> int:
@@ -140,7 +147,13 @@ class Attention(nn.Module):
                 vh = jnp.repeat(vh, group, axis=1)
             out = sequence_parallel_attention(qh, kh, vh, cfg.mesh, causal=True)
         elif impl == "flash":
-            out = flash_attention(qh, kh, vh, causal=True)
+            if cfg.mesh is not None:
+                # Bare pallas_call is opaque to sharding propagation — under
+                # a sharded jit it would all-gather Q/K/V to every device;
+                # the shard_map wrapper keeps each (batch, head) block local.
+                out = flash_attention_sharded(qh, kh, vh, cfg.mesh, causal=True)
+            else:
+                out = flash_attention(qh, kh, vh, causal=True)
         else:
             out = mha_reference(qh, kh, vh, causal=True)
         out = out.transpose(0, 2, 1, 3)

@@ -506,3 +506,74 @@ def flash_attention(
     if interpret is None:
         interpret = not on_tpu()
     return _flash(q, k, v, causal, block_q, block_k, interpret)
+
+
+def flash_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh,
+    causal: bool = True,
+    *,
+    batch_axes=("data", "fsdp"),
+    head_axis: str = "tensor",
+    **kwargs,
+) -> jax.Array:
+    """Flash attention as a shard_map over (batch, heads) mesh axes.
+
+    A ``pallas_call`` is opaque to XLA's sharding propagation: under a
+    sharded ``jit`` the bare kernel forces all-gathers of Q/K/V to every
+    device (measured 27 gathers in the compiled HLO of one call on a
+    2×4 mesh).  Attention is embarrassingly parallel over batch and query
+    heads, so this wrapper runs the kernel on each shard's local block
+    instead — zero collectives in the forward pass.
+
+    GQA under tensor parallelism: when the head axis divides ``H_kv`` the
+    kv tensors shard right along with q (contiguous groups keep the
+    q↔kv correspondence); when the head axis is *larger* than ``H_kv``
+    (``tp % H_kv == 0``) kv arrives replicated and each shard slices the
+    single kv head its query slab attends to.  The shard_map transpose
+    rule psums the sliced-kv cotangents automatically in the backward.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    batch_axes = tuple(a for a in batch_axes if a in mesh.shape)
+    if head_axis not in mesh.shape:
+        # No head axis on this mesh (e.g. a hand-built data-only Mesh):
+        # shard over batch only, heads stay whole on every shard.
+        head_axis = None
+    tp = mesh.shape[head_axis] if head_axis else 1
+    h_q, h_kv = q.shape[1], k.shape[1]
+    group = _gqa_group(q, k)
+    if h_q % tp:
+        raise ValueError(f"query heads {h_q} not divisible by {head_axis}={tp}")
+    local_q_heads = h_q // tp
+
+    q_spec = P(batch_axes, head_axis, None, None)
+    if h_kv % tp == 0:
+        kv_spec = P(batch_axes, head_axis, None, None)
+        slice_kv = False
+    elif tp % h_kv == 0:
+        # More shards than kv heads: replicate kv, slice per shard.
+        kv_spec = P(batch_axes, None, None, None)
+        slice_kv = True
+    else:
+        raise ValueError(
+            f"kv heads {h_kv} and {head_axis} axis {tp} must divide one way"
+        )
+
+    def local_fn(q_l, k_l, v_l):
+        if slice_kv:
+            shard = jax.lax.axis_index(head_axis)
+            kv_head = (shard * local_q_heads) // group
+            k_l = jax.lax.dynamic_slice_in_dim(k_l, kv_head, 1, axis=1)
+            v_l = jax.lax.dynamic_slice_in_dim(v_l, kv_head, 1, axis=1)
+        return flash_attention(q_l, k_l, v_l, causal=causal, **kwargs)
+
+    return jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(q_spec, kv_spec, kv_spec),
+        out_specs=q_spec,
+        check_vma=False,  # pallas_call defeats the replication checker
+    )(q, k, v)
