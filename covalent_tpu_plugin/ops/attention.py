@@ -91,7 +91,8 @@ def mha_reference(
     return out.astype(q.dtype)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
+def _flash_kernel(q_ref, k_ref, v_ref, qpos_ref, kpos_ref, o_ref, lse_ref,
+                  m_ref, l_ref, acc_ref,
                   *, causal: bool, scale: float):
     """One (query tile, key tile) grid cell.
 
@@ -102,12 +103,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
     are ever resident, which is what lets sequence length scale far past
     VMEM.  Pallas double-buffers the K/V tile DMAs across grid steps.
     """
-    block_q = q_ref.shape[2]
-    block_k = k_ref.shape[2]
     kt = pl.program_id(3)
     num_k_tiles = pl.num_programs(3)
-    q_offset = pl.program_id(2) * block_q
-    k_offset = kt * block_k
 
     @pl.when(kt == 0)
     def _init():
@@ -115,9 +112,15 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # Strictly-above-diagonal key tiles contribute nothing under causal
-    # masking: skip their compute entirely (~2x fewer MXU ops).
-    needed = (not causal) or (k_offset <= q_offset + block_q - 1)
+    # A tile whose every key position is in the future of every query
+    # position contributes nothing under causal masking: skip its MXU work.
+    # The bound check reads the POSITION tiles, so it is exact for the
+    # default contiguous layout (reproducing the classic above-diagonal
+    # skip, ~2x fewer ops) and conservative-but-correct for arbitrary
+    # ring/striped position vectors.
+    needed = True if not causal else (
+        jnp.min(kpos_ref[:, :]) <= jnp.max(qpos_ref[:, :])
+    )
 
     @pl.when(needed)
     def _tile():
@@ -135,9 +138,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         ) * scale  # (BQ, BK) f32
 
         if causal:
-            qi = q_offset + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            ki = k_offset + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            mask = qi >= ki
+            # Masking reads GLOBAL positions — (BQ,1) against (1,BK) —
+            # so striped/rotated layouts (ring attention) mask correctly;
+            # contiguous arange positions reproduce the classic diagonal.
+            mask = qpos_ref[:, :] >= kpos_ref[:, :]
             s = jnp.where(mask, s, _NEG_INF)
 
         m_prev = m_ref[:]
@@ -187,11 +191,24 @@ def _fit_block(requested: int, seq_len: int) -> int:
     return block
 
 
+def _positions_2d(q_positions, k_positions, seq_len_q: int, seq_len_k: int):
+    """Normalise optional (S,) position vectors to the kernels' layouts:
+    query positions (S,1) — sublanes; key positions (1,S) — lanes."""
+    if q_positions is None:
+        q_positions = jnp.arange(seq_len_q, dtype=jnp.int32)
+    if k_positions is None:
+        k_positions = jnp.arange(seq_len_k, dtype=jnp.int32)
+    qpos = jnp.asarray(q_positions, jnp.int32).reshape(seq_len_q, 1)
+    kpos = jnp.asarray(k_positions, jnp.int32).reshape(1, seq_len_k)
+    return qpos, kpos
+
+
 def _flash_forward(
-    q, k, v, causal: bool, block_q: int | None, block_k: int | None,
-    interpret: bool
-) -> jax.Array:
+    q, k, v, q_positions, k_positions, causal: bool,
+    block_q: int | None, block_k: int | None, interpret: bool
+):
     batch, heads, seq_len, head_dim = q.shape
+    seq_len_k = k.shape[2]
     scale = head_dim**-0.5
     # Default (None) blocks adapt to the sequence: the tuned sweep winners
     # shrink by halving until they divide seq_len, so any even-ish length
@@ -202,17 +219,18 @@ def _flash_forward(
     else:
         block_q = min(block_q, seq_len)
     if block_k is None:
-        block_k = _fit_block(_DEFAULT_BLOCK_K, seq_len)
+        block_k = _fit_block(_DEFAULT_BLOCK_K, seq_len_k)
     else:
-        block_k = min(block_k, seq_len)
-    if seq_len % block_q or seq_len % block_k:
+        block_k = min(block_k, seq_len_k)
+    if seq_len % block_q or seq_len_k % block_k:
         raise ValueError(
-            f"seq_len {seq_len} must be divisible by block sizes "
-            f"({block_q}, {block_k}); pad the sequence"
+            f"seq lengths ({seq_len}, {seq_len_k}) must be divisible by "
+            f"block sizes ({block_q}, {block_k}); pad the sequence"
         )
 
     group = _gqa_group(q, k)
-    grid = (batch, heads, seq_len // block_q, seq_len // block_k)
+    qpos, kpos = _positions_2d(q_positions, k_positions, seq_len, seq_len_k)
+    grid = (batch, heads, seq_len // block_q, seq_len_k // block_k)
     qo_spec = pl.BlockSpec(
         (1, 1, block_q, head_dim), lambda b, h, i, j: (b, h, i, 0)
     )
@@ -220,13 +238,15 @@ def _flash_forward(
     kv_spec = pl.BlockSpec(
         (1, 1, block_k, head_dim), lambda b, h, i, j: (b, h // group, j, 0)
     )
+    qpos_spec = pl.BlockSpec((block_q, 1), lambda b, h, i, j: (i, 0))
+    kpos_spec = pl.BlockSpec((1, block_k), lambda b, h, i, j: (0, j))
     lse_spec = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0))
     kernel = functools.partial(_flash_kernel, causal=causal, scale=scale)
     flops_factor = 0.5 if causal else 1.0
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[qo_spec, kv_spec, kv_spec],
+        in_specs=[qo_spec, kv_spec, kv_spec, qpos_spec, kpos_spec],
         out_specs=[qo_spec, lse_spec],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
@@ -239,11 +259,11 @@ def _flash_forward(
         ],
         interpret=interpret,
         cost_estimate=pl.CostEstimate(
-            flops=int(4 * batch * heads * seq_len * seq_len * head_dim * flops_factor),
+            flops=int(4 * batch * heads * seq_len * seq_len_k * head_dim * flops_factor),
             bytes_accessed=int(4 * batch * heads * seq_len * head_dim * q.dtype.itemsize),
-            transcendentals=int(batch * heads * seq_len * seq_len * flops_factor),
+            transcendentals=int(batch * heads * seq_len * seq_len_k * flops_factor),
         ),
-    )(q, k, v)
+    )(q, k, v, qpos, kpos)
     return out, lse
 
 
@@ -254,8 +274,9 @@ _DEFAULT_BWD_BLOCK = 1024
 
 
 def _flash_bwd_dkdv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_acc, dv_acc, *, causal: bool, scale: float
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qpos_ref, kpos_ref,
+    dk_ref, dv_ref, dk_acc, dv_acc,
+    *, causal: bool, scale: float
 ):
     """One (kv head, key tile, group member, query tile) cell of the dk/dv
     sweep, grid (B, H_kv, KT, G, QT).
@@ -266,23 +287,22 @@ def _flash_bwd_dkdv_kernel(
     query heads a GQA kv head serves (G = 1 degenerates to plain MHA).  The
     probability tile is recomputed from (q, k, lse) — never read from HBM.
     """
-    block_q = q_ref.shape[2]
-    block_k = k_ref.shape[2]
     gi = pl.program_id(3)
     qt = pl.program_id(4)
     num_q_tiles = pl.num_programs(4)
     last_group = pl.num_programs(3) - 1
-    k_offset = pl.program_id(2) * block_k
-    q_offset = qt * block_q
 
     @pl.when(jnp.logical_and(gi == 0, qt == 0))
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    # Under causal masking a query tile strictly above the key tile's first
-    # row contributes nothing to this key tile's gradients.
-    needed = (not causal) or (q_offset + block_q - 1 >= k_offset)
+    # A query tile entirely in the past of this key tile contributes no
+    # gradient under causal masking; the position-tile bound check is exact
+    # for contiguous layouts and conservative for striped ones.
+    needed = True if not causal else (
+        jnp.max(qpos_ref[:, :]) >= jnp.min(kpos_ref[:, :])
+    )
 
     @pl.when(needed)
     def _tile():
@@ -300,9 +320,7 @@ def _flash_bwd_dkdv_kernel(
         ) * scale  # (BQ, BK) f32
         p = jnp.exp(s - lse)  # exactly the forward's normalised probabilities
         if causal:
-            qi = q_offset + jax.lax.broadcasted_iota(jnp.int32, p.shape, 0)
-            ki = k_offset + jax.lax.broadcasted_iota(jnp.int32, p.shape, 1)
-            p = jnp.where(qi >= ki, p, 0.0)
+            p = jnp.where(qpos_ref[:, :] >= kpos_ref[:, :], p, 0.0)
 
         # dV += P^T dO ; dP = dO V^T ; dS = P*(dP - delta)*scale ; dK += dS^T Q
         dv_acc[:] += jax.lax.dot_general(
@@ -329,22 +347,21 @@ def _flash_bwd_dkdv_kernel(
 
 
 def _flash_bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qpos_ref, kpos_ref,
+    dq_ref, dq_acc,
     *, causal: bool, scale: float
 ):
     """One (query tile, key tile) cell of the dq sweep (key tiles innermost)."""
-    block_q = q_ref.shape[2]
-    block_k = k_ref.shape[2]
     kt = pl.program_id(3)
     num_k_tiles = pl.num_programs(3)
-    q_offset = pl.program_id(2) * block_q
-    k_offset = kt * block_k
 
     @pl.when(kt == 0)
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    needed = (not causal) or (k_offset <= q_offset + block_q - 1)
+    needed = True if not causal else (
+        jnp.min(kpos_ref[:, :]) <= jnp.max(qpos_ref[:, :])
+    )
 
     @pl.when(needed)
     def _tile():
@@ -362,9 +379,7 @@ def _flash_bwd_dq_kernel(
         ) * scale
         p = jnp.exp(s - lse)
         if causal:
-            qi = q_offset + jax.lax.broadcasted_iota(jnp.int32, p.shape, 0)
-            ki = k_offset + jax.lax.broadcasted_iota(jnp.int32, p.shape, 1)
-            p = jnp.where(qi >= ki, p, 0.0)
+            p = jnp.where(qpos_ref[:, :] >= kpos_ref[:, :], p, 0.0)
 
         dp = jax.lax.dot_general(
             do, v_tile,
@@ -383,26 +398,34 @@ def _flash_bwd_dq_kernel(
         dq_ref[0, 0, :, :] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _flash_backward(q, k, v, out, lse, g, causal: bool, interpret: bool):
+def _flash_backward(
+    q, k, v, out, lse, g, q_positions, k_positions, causal: bool,
+    interpret: bool, delta=None
+):
     """FlashAttention-2 backward: two Pallas sweeps, O(S·D) HBM."""
     batch, heads, seq_len, head_dim = q.shape
     kv_heads = k.shape[1]
+    seq_len_k = k.shape[2]
     group = _gqa_group(q, k)
     scale = head_dim**-0.5
     block_q = _fit_block(_DEFAULT_BWD_BLOCK, seq_len)
-    block_k = _fit_block(_DEFAULT_BWD_BLOCK, seq_len)
+    block_k = _fit_block(_DEFAULT_BWD_BLOCK, seq_len_k)
+    qpos, kpos = _positions_2d(q_positions, k_positions, seq_len, seq_len_k)
 
     # delta_i = rowsum(dO_i * O_i) — a cheap elementwise reduce XLA fuses;
-    # kept (B, H, S, 1) to match the kernels' sublane layout.
-    delta = jnp.sum(
-        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True
-    )
+    # kept (B, H, S, 1) to match the kernels' sublane layout.  Ring callers
+    # precompute it once per training step (it is loop-invariant there).
+    if delta is None:
+        delta = jnp.sum(
+            g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1,
+            keepdims=True,
+        )
 
     flops_factor = 0.5 if causal else 1.0
     cost = pl.CostEstimate(
-        flops=int(10 * batch * heads * seq_len * seq_len * head_dim * flops_factor),
+        flops=int(10 * batch * heads * seq_len * seq_len_k * head_dim * flops_factor),
         bytes_accessed=int(8 * batch * heads * seq_len * head_dim * q.dtype.itemsize),
-        transcendentals=int(batch * heads * seq_len * seq_len * flops_factor),
+        transcendentals=int(batch * heads * seq_len * seq_len_k * flops_factor),
     )
 
     # dk/dv sweep — grid (B, H_kv, KT, G, QT): group member + query tile are
@@ -418,11 +441,13 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, interpret: bool):
     stat_spec_q = pl.BlockSpec(
         (1, 1, block_q, 1), lambda b, h, i, gi, j: (b, h * group + gi, j, 0)
     )
+    qpos_spec_q = pl.BlockSpec((block_q, 1), lambda b, h, i, gi, j: (j, 0))
+    kpos_spec_k = pl.BlockSpec((1, block_k), lambda b, h, i, gi, j: (0, i))
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkdv_kernel, causal=causal, scale=scale),
-        grid=(batch, kv_heads, seq_len // block_k, group, seq_len // block_q),
+        grid=(batch, kv_heads, seq_len_k // block_k, group, seq_len // block_q),
         in_specs=[qo_spec_q, kv_spec_k, kv_spec_k, qo_spec_q, stat_spec_q,
-                  stat_spec_q],
+                  stat_spec_q, qpos_spec_q, kpos_spec_k],
         out_specs=[kv_spec_k, kv_spec_k],
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, k.dtype),
@@ -434,7 +459,7 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, interpret: bool):
         ],
         interpret=interpret,
         cost_estimate=cost,
-    )(q, k, v, g, lse, delta)
+    )(q, k, v, g, lse, delta, qpos, kpos)
 
     qo_spec_i = pl.BlockSpec(
         (1, 1, block_q, head_dim), lambda b, h, i, j: (b, h, i, 0)
@@ -443,11 +468,13 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, interpret: bool):
         (1, 1, block_k, head_dim), lambda b, h, i, j: (b, h // group, j, 0)
     )
     stat_spec_i = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0))
+    qpos_spec_i = pl.BlockSpec((block_q, 1), lambda b, h, i, j: (i, 0))
+    kpos_spec_j = pl.BlockSpec((1, block_k), lambda b, h, i, j: (0, j))
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, causal=causal, scale=scale),
-        grid=(batch, heads, seq_len // block_q, seq_len // block_k),
+        grid=(batch, heads, seq_len // block_q, seq_len_k // block_k),
         in_specs=[qo_spec_i, kv_spec_j, kv_spec_j, qo_spec_i, stat_spec_i,
-                  stat_spec_i],
+                  stat_spec_i, qpos_spec_i, kpos_spec_j],
         out_specs=qo_spec_i,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[
@@ -455,24 +482,40 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, interpret: bool):
         ],
         interpret=interpret,
         cost_estimate=cost,
-    )(q, k, v, g, lse, delta)
+    )(q, k, v, g, lse, delta, qpos, kpos)
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, block_q, block_k, interpret):
-    out, _ = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+def _pos_zero(positions):
+    """float0 cotangent for an (integer) position argument, or None."""
+    if positions is None:
+        return None
+    return jnp.zeros(jnp.shape(positions), dtype=jax.dtypes.float0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash(q, k, v, q_positions, k_positions, causal, block_q, block_k,
+           interpret):
+    out, _ = _flash_forward(
+        q, k, v, q_positions, k_positions, causal, block_q, block_k, interpret
+    )
     return out
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v, out, lse)
+def _flash_fwd(q, k, v, q_positions, k_positions, causal, block_q, block_k,
+               interpret):
+    out, lse = _flash_forward(
+        q, k, v, q_positions, k_positions, causal, block_q, block_k, interpret
+    )
+    return out, (q, k, v, out, lse, q_positions, k_positions)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
-    q, k, v, out, lse = residuals
-    return _flash_backward(q, k, v, out, lse, g, causal, interpret)
+    q, k, v, out, lse, q_positions, k_positions = residuals
+    dq, dk, dv = _flash_backward(
+        q, k, v, out, lse, g, q_positions, k_positions, causal, interpret
+    )
+    return dq, dk, dv, _pos_zero(q_positions), _pos_zero(k_positions)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -484,6 +527,8 @@ def flash_attention(
     v: jax.Array,
     causal: bool = True,
     *,
+    q_positions: jax.Array | None = None,
+    k_positions: jax.Array | None = None,
     block_q: int | None = None,
     block_k: int | None = None,
     interpret: bool | None = None,
@@ -495,6 +540,14 @@ def flash_attention(
     ``[i*G, (i+1)*G)``.  Gradients flow to the true kv shapes (dk/dv sum
     over each group) — no materialised ``repeat``.
 
+    ``q_positions``/``k_positions`` ((S,) int32) override the causal mask's
+    notion of position: row ``i`` attends column ``j`` iff
+    ``q_positions[i] >= k_positions[j]``.  This is what lets ring attention
+    run striped (zigzag) sequence layouts through the same kernels; the
+    static above-diagonal tile skip applies only to the default contiguous
+    positions.  ``k`` may also have a different sequence length than ``q``
+    (ring K/V shards).
+
     ``interpret=None`` auto-selects: compiled Mosaic kernel on TPU,
     interpreter elsewhere (the CPU-mesh test tier).  Default (None) blocks
     are the MXU-sweep winners on v5e (fwd 512×1024: 16.9× over the fused
@@ -505,7 +558,9 @@ def flash_attention(
     """
     if interpret is None:
         interpret = not on_tpu()
-    return _flash(q, k, v, causal, block_q, block_k, interpret)
+    return _flash(
+        q, k, v, q_positions, k_positions, causal, block_q, block_k, interpret
+    )
 
 
 def flash_attention_sharded(

@@ -25,8 +25,23 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..parallel.collectives import ring_permute
+from .attention import _flash_backward, _flash_forward, on_tpu
 
 _NEG_INF = -1e30
+
+
+def _shard_indices(
+    shard: jax.Array, n: int, seq_local: int, zigzag: bool
+) -> jax.Array:
+    """Global positions of ``shard``'s local rows ((seq_local,) int32)."""
+    if zigzag:
+        # Device i holds stripes i and 2n-1-i (each seq_local//2 long):
+        # the mirror pairing balances causal work across the ring.
+        stripe = seq_local // 2
+        low = shard * stripe + jnp.arange(stripe, dtype=jnp.int32)
+        high = (2 * n - 1 - shard) * stripe + jnp.arange(stripe, dtype=jnp.int32)
+        return jnp.concatenate([low, high])
+    return shard * seq_local + jnp.arange(seq_local, dtype=jnp.int32)
 
 
 def _block_attend(q, k, v, q_idx, k_idx, scale, causal):
@@ -80,15 +95,7 @@ def ring_attention(
     scale = head_dim**-0.5 if scale is None else scale
 
     def shard_indices(shard: jax.Array) -> jax.Array:
-        """Global positions of shard ``shard``'s local rows."""
-        if zigzag:
-            # Device i holds stripes i and 2n-1-i (each seq_local//2 long):
-            # the mirror pairing balances causal work across the ring.
-            stripe = seq_local // 2
-            low = shard * stripe + jnp.arange(stripe, dtype=jnp.int32)
-            high = (2 * n - 1 - shard) * stripe + jnp.arange(stripe, dtype=jnp.int32)
-            return jnp.concatenate([low, high])
-        return shard * seq_local + jnp.arange(seq_local, dtype=jnp.int32)
+        return _shard_indices(shard, n, seq_local, zigzag)
 
     q_idx = shard_indices(my_index)
 
@@ -142,6 +149,167 @@ def ring_attention(
     return (acc / jnp.maximum(l, 1e-37)).astype(q.dtype)
 
 
+# --------------------------------------------------------------------- #
+# Ring flash: the Pallas kernels do each (q-shard, k-shard) block pair
+# --------------------------------------------------------------------- #
+#
+# The einsum ring above materialises an (S/n x S/n) f32 score block per
+# step — fine at moderate lengths, but the per-device memory still grows
+# quadratically in the local shard.  The flash ring keeps the kernels'
+# O(S/n * D) footprint: the forward merges per-block flash outputs with a
+# log-sum-exp running merge, and the backward makes a second ring pass
+# calling the FlashAttention-2 kernels per block with the GLOBAL softmax
+# statistics (lse, delta) — dk/dv partials rotate around the ring with
+# their k/v shards.  Position vectors (attention.py) make the causal mask
+# correct for striped/rotated layouts where block offsets mean nothing.
+
+
+def _ring_flash_fwd_pass(q, k, v, axis_name, causal, zigzag, interpret):
+    n = lax.axis_size(axis_name)
+    my_index = lax.axis_index(axis_name)
+    seq_local = q.shape[2]
+    q_idx = _shard_indices(my_index, n, seq_local, zigzag)
+    stat_shape = q.shape[:3] + (1,)
+
+    def step(carry, t):
+        o_run, lse_run, k_cur, v_cur = carry
+        src = jnp.mod(my_index - t, n)
+        k_idx = _shard_indices(src, n, seq_local, zigzag)
+
+        def attend(_):
+            return _flash_forward(
+                q, k_cur, v_cur, q_idx, k_idx, causal, None, None, interpret
+            )
+
+        if causal and not zigzag:
+            # A strictly-future K/V shard is fully masked: skip its kernels
+            # (the lockstep ring still waits on the ppermute either way).
+            def skip(_):
+                return (
+                    jnp.zeros(q.shape, q.dtype),
+                    jnp.full(stat_shape, _NEG_INF, jnp.float32),
+                )
+
+            needed = jnp.min(k_idx) <= jnp.max(q_idx)
+            o_blk, lse_blk = lax.cond(needed, attend, skip, None)
+        else:
+            o_blk, lse_blk = attend(None)
+
+        # Merge the normalised block output into the running output:
+        # out = sum_blk exp(lse_blk - lse_global) * o_blk.  All statistics
+        # are finite (_NEG_INF, not -inf), so no NaN guards are needed.
+        lse_new = jnp.logaddexp(lse_run, lse_blk)
+        w_run = jnp.exp(lse_run - lse_new)
+        w_blk = jnp.exp(lse_blk - lse_new)
+        o_new = o_run * w_run + o_blk.astype(jnp.float32) * w_blk
+        k_next = ring_permute(k_cur, axis_name, shift=1)
+        v_next = ring_permute(v_cur, axis_name, shift=1)
+        return (o_new, lse_new, k_next, v_next), ()
+
+    o0 = jnp.zeros(q.shape, jnp.float32)
+    lse0 = jnp.full(stat_shape, _NEG_INF, jnp.float32)
+    (o, lse, _, _), _ = lax.scan(step, (o0, lse0, k, v), jnp.arange(n))
+    return o.astype(q.dtype), lse
+
+
+def _ring_flash_bwd_pass(q, k, v, out, lse, g, axis_name, causal, zigzag,
+                         interpret):
+    n = lax.axis_size(axis_name)
+    my_index = lax.axis_index(axis_name)
+    seq_local = q.shape[2]
+    q_idx = _shard_indices(my_index, n, seq_local, zigzag)
+    # delta = rowsum(dO * O) is loop-invariant: compute once, not per hop.
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True
+    )
+
+    def step(carry, t):
+        dq_acc, k_cur, v_cur, dk_cur, dv_cur = carry
+        src = jnp.mod(my_index - t, n)
+        k_idx = _shard_indices(src, n, seq_local, zigzag)
+
+        def attend(_):
+            return _flash_backward(
+                q, k_cur, v_cur, out, lse, g, q_idx, k_idx, causal, interpret,
+                delta=delta,
+            )
+
+        if causal and not zigzag:
+            def skip(_):
+                return (
+                    jnp.zeros(q.shape, q.dtype),
+                    jnp.zeros(k.shape, k.dtype),
+                    jnp.zeros(v.shape, v.dtype),
+                )
+
+            needed = jnp.min(k_idx) <= jnp.max(q_idx)
+            dq_blk, dk_blk, dv_blk = lax.cond(needed, attend, skip, None)
+        else:
+            dq_blk, dk_blk, dv_blk = attend(None)
+
+        dq_acc = dq_acc + dq_blk.astype(jnp.float32)
+        dk_cur = dk_cur + dk_blk.astype(jnp.float32)
+        dv_cur = dv_cur + dv_blk.astype(jnp.float32)
+        # dk/dv partials ride the ring WITH their k/v shards; after n
+        # rotations each shard (and its accumulated gradient) is home.
+        k_next = ring_permute(k_cur, axis_name, shift=1)
+        v_next = ring_permute(v_cur, axis_name, shift=1)
+        dk_next = ring_permute(dk_cur, axis_name, shift=1)
+        dv_next = ring_permute(dv_cur, axis_name, shift=1)
+        return (dq_acc, k_next, v_next, dk_next, dv_next), ()
+
+    dq0 = jnp.zeros(q.shape, jnp.float32)
+    dk0 = jnp.zeros(k.shape, jnp.float32)
+    dv0 = jnp.zeros(v.shape, jnp.float32)
+    (dq, _, _, dk, dv), _ = lax.scan(
+        step, (dq0, k, v, dk0, dv0), jnp.arange(n)
+    )
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_flash(q, k, v, axis_name, causal, zigzag, interpret):
+    out, _ = _ring_flash_fwd_pass(q, k, v, axis_name, causal, zigzag, interpret)
+    return out
+
+
+def _ring_flash_vjp_fwd(q, k, v, axis_name, causal, zigzag, interpret):
+    out, lse = _ring_flash_fwd_pass(q, k, v, axis_name, causal, zigzag, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_vjp_bwd(axis_name, causal, zigzag, interpret, residuals, g):
+    q, k, v, out, lse = residuals
+    return _ring_flash_bwd_pass(
+        q, k, v, out, lse, g, axis_name, causal, zigzag, interpret
+    )
+
+
+_ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
+
+
+def ring_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "seq",
+    causal: bool = True,
+    zigzag: bool = False,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Per-shard ring attention through the Pallas flash kernels.
+
+    Same contract as :func:`ring_attention` (call under ``shard_map`` with
+    seq-sharded (B, H, S/n, D)), but each (q-shard, k-shard) pair runs the
+    flash kernel instead of a dense einsum, so per-device memory stays
+    O(S/n · D) at any length, forward AND backward (a second ring pass
+    recomputes per-block gradients from the global softmax statistics).
+    """
+    if interpret is None:
+        interpret = not on_tpu()
+    return _ring_flash(q, k, v, axis_name, causal, zigzag, interpret)
+
+
 def _stripe_permutation(seq_len: int, n: int) -> jax.Array:
     """Index vector mapping natural order -> zigzag-striped order.
 
@@ -185,6 +353,7 @@ def sequence_parallel_attention(
     batch_axes: tuple[str, ...] = ("data", "fsdp"),
     head_axis: str | None = "tensor",
     zigzag: bool | None = None,
+    impl: str | None = None,
 ) -> jax.Array:
     """Global entry: (B, H, S, D) arrays -> ring attention over ``mesh``.
 
@@ -196,17 +365,26 @@ def sequence_parallel_attention(
     across the ring instead of serialising on the last device; XLA lowers
     the permutes to collective data movement alongside the resharding it
     already performs for ``P(..., seq, ...)``.
+
+    ``impl``: ``"flash"`` runs each block pair through the Pallas kernels
+    (O(S/n·D) per-device memory, fwd and bwd), ``"einsum"`` uses the fused
+    dense block path; default auto-selects flash on TPU.
     """
     n = mesh.shape[axis_name]
     if zigzag is None:
         zigzag = causal and n > 1 and q.shape[2] % (2 * n) == 0
+    if impl is None:
+        impl = "flash" if on_tpu() else "einsum"
+    if impl not in ("flash", "einsum"):
+        raise ValueError(f"impl must be 'flash' or 'einsum', got {impl!r}")
     if zigzag:
         q = stripe_sequence(q, n)
         k = stripe_sequence(k, n)
         v = stripe_sequence(v, n)
     spec = P(batch_axes, head_axis, axis_name, None)
+    body = ring_flash_attention if impl == "flash" else ring_attention
     ring = functools.partial(
-        ring_attention, axis_name=axis_name, causal=causal, zigzag=zigzag
+        body, axis_name=axis_name, causal=causal, zigzag=zigzag
     )
     out = jax.shard_map(
         ring,

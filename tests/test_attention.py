@@ -134,6 +134,71 @@ def test_flash_gqa_rejects_indivisible_heads():
         flash_attention(q, k[:, :4], v[:, :4])
 
 
+def test_flash_position_vectors_mask_arbitrary_layouts():
+    """q/k_positions drive the causal mask: a permuted (zigzag-style)
+    layout through flash must equal attending in natural order and
+    permuting the result — forward and gradients."""
+    s = 256
+    rng = np.random.default_rng(0)
+    perm = jnp.asarray(rng.permutation(s).astype(np.int32))
+    q, k, v = random_qkv(jax.random.PRNGKey(15), (1, 2, s, 32))
+
+    qp = jnp.take(q, perm, axis=2)
+    kp = jnp.take(k, perm, axis=2)
+    vp = jnp.take(v, perm, axis=2)
+
+    def loss_pos(qp, kp, vp):
+        out = flash_attention(
+            qp, kp, vp, causal=True, q_positions=perm, k_positions=perm
+        )
+        return (out * 0.01).sum()
+
+    def loss_ref(q, k, v):
+        return (mha_reference(q, k, v, causal=True) * 0.01).sum()
+
+    out_pos = flash_attention(
+        qp, kp, vp, causal=True, q_positions=perm, k_positions=perm
+    )
+    out_ref = jnp.take(mha_reference(q, k, v, causal=True), perm, axis=2)
+    np.testing.assert_allclose(out_pos, out_ref, atol=2e-5, rtol=2e-5)
+
+    g_pos = jax.grad(loss_pos, argnums=(0, 1, 2))(qp, kp, vp)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_pos, g_ref):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(jnp.take(b, perm, axis=2)),
+            atol=2e-5, rtol=2e-4,
+        )
+
+
+def test_flash_cross_lengths_with_positions():
+    """K/V shorter than Q (a ring K/V shard): positions select which keys
+    each query may see."""
+    q, _, _ = random_qkv(jax.random.PRNGKey(16), (1, 2, 256, 32))
+    k = jax.random.normal(jax.random.PRNGKey(17), (1, 2, 128, 32))
+    v = jax.random.normal(jax.random.PRNGKey(18), (1, 2, 128, 32))
+    q_pos = jnp.arange(256, dtype=jnp.int32)
+    k_pos = jnp.arange(128, dtype=jnp.int32) + 64  # keys live at 64..191
+
+    out = flash_attention(
+        q, k, v, causal=True, q_positions=q_pos, k_positions=k_pos,
+        block_q=64, block_k=64,
+    )
+    # dense oracle on the same mask
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (32**-0.5)
+    mask = q_pos[:, None] >= k_pos[None, :]
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    # rows with no visible key (q_pos < 64) are undefined in the oracle;
+    # compare only fully-defined rows
+    ref = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    np.testing.assert_allclose(
+        out[:, :, 64:], ref[:, :, 64:], atol=2e-5, rtol=2e-5
+    )
+    # flash defines fully-masked rows as zero output
+    np.testing.assert_allclose(out[:, :, :64], 0.0, atol=1e-6)
+
+
 class TestShardedFlash:
     """flash_attention_sharded: the shard_map wrapper that keeps the Pallas
     kernel collective-free under a sharded jit (a bare pallas_call forces
