@@ -329,6 +329,46 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
     else:
         report("flash_bwd", skipped="budget")
 
+    # -- long context: flash fwd+bwd at S=16k (dense spills/OOMs there) ----
+    if remaining() > 40:
+        try:
+            from covalent_tpu_plugin.ops.attention import flash_attention
+
+            b, h, s, d = (1, 2, 2048, 64) if small else (1, 8, 16384, 64)
+            q = jax.random.normal(jax.random.PRNGKey(0), (b, h, s, d), jnp.bfloat16)
+            k = jax.random.normal(jax.random.PRNGKey(1), (b, h, s, d), jnp.bfloat16)
+            v = jax.random.normal(jax.random.PRNGKey(2), (b, h, s, d), jnp.bfloat16)
+            grad_fn = jax.jit(
+                jax.grad(
+                    lambda q, k, v: flash_attention(q, k, v, causal=True)
+                    .astype(jnp.float32).sum(),
+                    argnums=(0, 1, 2),
+                )
+            )
+            holder = {}
+
+            def dispatch():
+                holder["g"] = grad_fn(q, k, v)
+
+            def fetch():
+                jax.device_get(holder["g"][0][0, 0, 0, 0])
+
+            unit = unit_seconds(dispatch, fetch, target_s=3.0, cap=8)
+            # attention flops: 4*S^2*D fwd + 10*S^2*D bwd, * 0.5 causal
+            # (matches the kernels' own CostEstimates in ops/attention.py)
+            att_tflops = 14 * b * h * s * s * d * 0.5 / unit / 1e12
+            report(
+                "flash_long",
+                seq_len=s,
+                fwd_bwd_ms=round(unit * 1e3, 2),
+                attn_tflops=round(att_tflops, 2),
+                note="dense S^2 path spills at this length (see benchmarks/)",
+            )
+        except Exception as error:  # noqa: BLE001
+            report("flash_long", error=repr(error))
+    else:
+        report("flash_long", skipped="budget")
+
     # -- 125M-class LM train step + MFU (BASELINE config 5's model, 1 chip) -
     if remaining() > 75:
         try:
@@ -566,6 +606,8 @@ async def main() -> None:
         "flash_fwd_4k_speedup": sub("flash_fwd", "speedup"),
         "flash_fwd_4k_ms": sub("flash_fwd", "flash_ms"),
         "flash_bwd_4k_speedup": sub("flash_bwd", "speedup"),
+        "flash_16k_fwd_bwd_ms": sub("flash_long", "fwd_bwd_ms"),
+        "flash_16k_attn_tflops": sub("flash_long", "attn_tflops"),
         "lm125m_step_ms": sub("lm_step", "step_ms"),
         "lm125m_tokens_per_s": sub("lm_step", "tokens_per_s"),
         "lm125m_mfu": sub("lm_step", "mfu"),

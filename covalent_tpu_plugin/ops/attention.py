@@ -205,7 +205,8 @@ def _positions_2d(q_positions, k_positions, seq_len_q: int, seq_len_k: int):
 
 def _flash_forward(
     q, k, v, q_positions, k_positions, causal: bool,
-    block_q: int | None, block_k: int | None, interpret: bool
+    block_q: int | None, block_k: int | None, interpret: bool,
+    out_dtype=None,
 ):
     batch, heads, seq_len, head_dim = q.shape
     seq_len_k = k.shape[2]
@@ -249,7 +250,8 @@ def _flash_forward(
         in_specs=[qo_spec, kv_spec, kv_spec, qpos_spec, kpos_spec],
         out_specs=[qo_spec, lse_spec],
         out_shape=[
-            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            # out_dtype=f32 lets ring callers merge unrounded block partials
+            jax.ShapeDtypeStruct(q.shape, out_dtype or q.dtype),
             jax.ShapeDtypeStruct((batch, heads, seq_len, 1), jnp.float32),
         ],
         scratch_shapes=[
@@ -400,7 +402,7 @@ def _flash_bwd_dq_kernel(
 
 def _flash_backward(
     q, k, v, out, lse, g, q_positions, k_positions, causal: bool,
-    interpret: bool, delta=None
+    interpret: bool, delta=None, grad_dtype=None
 ):
     """FlashAttention-2 backward: two Pallas sweeps, O(S·D) HBM."""
     batch, heads, seq_len, head_dim = q.shape
@@ -450,8 +452,10 @@ def _flash_backward(
                   stat_spec_q, qpos_spec_q, kpos_spec_k],
         out_specs=[kv_spec_k, kv_spec_k],
         out_shape=[
-            jax.ShapeDtypeStruct(k.shape, k.dtype),
-            jax.ShapeDtypeStruct(v.shape, v.dtype),
+            # grad_dtype=f32: ring callers sum one partial per hop and must
+            # not pay a bf16 rounding at every hop
+            jax.ShapeDtypeStruct(k.shape, grad_dtype or k.dtype),
+            jax.ShapeDtypeStruct(v.shape, grad_dtype or v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, head_dim), jnp.float32),  # dk accumulator
@@ -476,7 +480,7 @@ def _flash_backward(
         in_specs=[qo_spec_i, kv_spec_j, kv_spec_j, qo_spec_i, stat_spec_i,
                   stat_spec_i, qpos_spec_i, kpos_spec_j],
         out_specs=qo_spec_i,
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=jax.ShapeDtypeStruct(q.shape, grad_dtype or q.dtype),
         scratch_shapes=[
             pltpu.VMEM((block_q, head_dim), jnp.float32),  # dq accumulator
         ],

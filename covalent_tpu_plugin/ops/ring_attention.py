@@ -177,8 +177,11 @@ def _ring_flash_fwd_pass(q, k, v, axis_name, causal, zigzag, interpret):
         k_idx = _shard_indices(src, n, seq_local, zigzag)
 
         def attend(_):
+            # f32 block outputs: the merge below sums one partial per hop
+            # and must not pay a bf16 rounding at each one.
             return _flash_forward(
-                q, k_cur, v_cur, q_idx, k_idx, causal, None, None, interpret
+                q, k_cur, v_cur, q_idx, k_idx, causal, None, None, interpret,
+                out_dtype=jnp.float32,
             )
 
         if causal and not zigzag:
@@ -186,7 +189,7 @@ def _ring_flash_fwd_pass(q, k, v, axis_name, causal, zigzag, interpret):
             # (the lockstep ring still waits on the ppermute either way).
             def skip(_):
                 return (
-                    jnp.zeros(q.shape, q.dtype),
+                    jnp.zeros(q.shape, jnp.float32),
                     jnp.full(stat_shape, _NEG_INF, jnp.float32),
                 )
 
@@ -201,7 +204,7 @@ def _ring_flash_fwd_pass(q, k, v, axis_name, causal, zigzag, interpret):
         lse_new = jnp.logaddexp(lse_run, lse_blk)
         w_run = jnp.exp(lse_run - lse_new)
         w_blk = jnp.exp(lse_blk - lse_new)
-        o_new = o_run * w_run + o_blk.astype(jnp.float32) * w_blk
+        o_new = o_run * w_run + o_blk * w_blk
         k_next = ring_permute(k_cur, axis_name, shift=1)
         v_next = ring_permute(v_cur, axis_name, shift=1)
         return (o_new, lse_new, k_next, v_next), ()
@@ -229,17 +232,19 @@ def _ring_flash_bwd_pass(q, k, v, out, lse, g, axis_name, causal, zigzag,
         k_idx = _shard_indices(src, n, seq_local, zigzag)
 
         def attend(_):
+            # f32 per-hop gradient partials (grad_dtype): n bf16 roundings
+            # per accumulator would otherwise stack up around the ring.
             return _flash_backward(
                 q, k_cur, v_cur, out, lse, g, q_idx, k_idx, causal, interpret,
-                delta=delta,
+                delta=delta, grad_dtype=jnp.float32,
             )
 
         if causal and not zigzag:
             def skip(_):
                 return (
-                    jnp.zeros(q.shape, q.dtype),
-                    jnp.zeros(k.shape, k.dtype),
-                    jnp.zeros(v.shape, v.dtype),
+                    jnp.zeros(q.shape, jnp.float32),
+                    jnp.zeros(k.shape, jnp.float32),
+                    jnp.zeros(v.shape, jnp.float32),
                 )
 
             needed = jnp.min(k_idx) <= jnp.max(q_idx)
@@ -247,9 +252,9 @@ def _ring_flash_bwd_pass(q, k, v, out, lse, g, axis_name, causal, zigzag,
         else:
             dq_blk, dk_blk, dv_blk = attend(None)
 
-        dq_acc = dq_acc + dq_blk.astype(jnp.float32)
-        dk_cur = dk_cur + dk_blk.astype(jnp.float32)
-        dv_cur = dv_cur + dv_blk.astype(jnp.float32)
+        dq_acc = dq_acc + dq_blk
+        dk_cur = dk_cur + dk_blk
+        dv_cur = dv_cur + dv_blk
         # dk/dv partials ride the ring WITH their k/v shards; after n
         # rotations each shard (and its accumulated gradient) is home.
         k_next = ring_permute(k_cur, axis_name, shift=1)
