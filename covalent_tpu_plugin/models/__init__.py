@@ -8,6 +8,7 @@ pretrain config, both written mesh-first so the same code spans one chip to
 a pod.
 """
 
+from .data import synthetic_lm_batch, synthetic_lm_batches
 from .mlp import MLP, MnistCNN, synthetic_mnist
 from .transformer import TransformerConfig, TransformerLM, lm_125m_config
 from .train import (
@@ -24,6 +25,8 @@ __all__ = [
     "MLP",
     "MnistCNN",
     "synthetic_mnist",
+    "synthetic_lm_batch",
+    "synthetic_lm_batches",
     "TransformerConfig",
     "TransformerLM",
     "lm_125m_config",

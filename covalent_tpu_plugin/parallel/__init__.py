@@ -30,6 +30,8 @@ _EXPORTS = {
     "param_shardings": ".sharding",
     "replicated": ".sharding",
     "shard_batch": ".sharding",
+    "shard_batch_per_process": ".sharding",
+    "process_local_slice": ".sharding",
 }
 
 
@@ -55,6 +57,8 @@ __all__ = [
     "param_shardings",
     "batch_sharding",
     "shard_batch",
+    "shard_batch_per_process",
+    "process_local_slice",
     "replicated",
     "psum",
     "all_gather",

@@ -12,6 +12,7 @@ from __future__ import annotations
 from typing import Any
 
 import jax
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 #: logical axis -> mesh axis (or None = replicated).  t5x/Megatron-flavored:
@@ -77,6 +78,60 @@ def shard_batch(batch: Any, mesh: Mesh, rules=DEFAULT_RULES) -> Any:
         return jax.device_put(x, NamedSharding(mesh, spec))
 
     return jax.tree_util.tree_map(place, batch)
+
+
+def shard_batch_per_process(
+    local_batch: Any, mesh: Mesh, rules=DEFAULT_RULES
+) -> Any:
+    """Multi-host batch feeding: each process supplies only ITS slice.
+
+    ``shard_batch`` device_puts a host-global array, which requires every
+    process to hold the whole batch; on a pod each host instead reads just
+    its own shard of the input stream and this helper assembles the global
+    array from the per-process pieces
+    (``jax.make_array_from_process_local_data``).  Leaves are sharded on
+    dim 0 over the data axes; scalars replicate (every process must pass
+    the same value).  Single-process meshes degenerate to ``shard_batch``
+    semantics.
+    """
+
+    def place(x):
+        x = np.asarray(x)
+        if x.ndim == 0:
+            sharding = replicated(mesh)
+        else:
+            spec = logical_spec(("batch",) + (None,) * (x.ndim - 1), rules)
+            sharding = NamedSharding(mesh, spec)
+        return jax.make_array_from_process_local_data(sharding, x)
+
+    return jax.tree_util.tree_map(place, local_batch)
+
+
+def process_local_slice(batch: Any, axis: int = 0) -> Any:
+    """This process's contiguous shard of a host-global batch (dim ``axis``).
+
+    The slicing contract matching ``shard_batch_per_process``: process ``i``
+    of ``N`` owns rows ``[i*B/N, (i+1)*B/N)``.  Useful when a data source
+    yields global batches but each pod worker should feed only its share.
+    """
+    index = jax.process_index()
+    count = jax.process_count()
+
+    def cut(x):
+        x = np.asarray(x)
+        if x.ndim == 0:
+            return x
+        if x.shape[axis] % count:
+            raise ValueError(
+                f"batch dim {x.shape[axis]} not divisible by "
+                f"process count {count}"
+            )
+        span = x.shape[axis] // count
+        slicer = [slice(None)] * x.ndim
+        slicer[axis] = slice(index * span, (index + 1) * span)
+        return x[tuple(slicer)]
+
+    return jax.tree_util.tree_map(cut, batch)
 
 
 def param_shardings(params: Any, mesh: Mesh, rules=DEFAULT_RULES) -> Any:
