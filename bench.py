@@ -203,7 +203,7 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
 
             from covalent_tpu_plugin.models.mlp import MLP, synthetic_mnist
 
-            steps, batch_size = (10, 128) if small else (30, 256)
+            batch_size = 128 if small else 256
             data = synthetic_mnist(batch_size)
             batch = {
                 "image": jnp.asarray(data["image"]),
@@ -235,7 +235,9 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
             def fetch():
                 holder["final"] = float(jax.device_get(holder["loss"]))
 
-            unit = unit_seconds(dispatch, fetch, target_s=4.0, cap=steps)
+            # High cap: a ~1 ms step needs many units per batch or the
+            # fetch round-trip's jitter dominates the delta.
+            unit = unit_seconds(dispatch, fetch, target_s=4.0, cap=400)
             report(
                 "mnist",
                 steps_per_s=round(1.0 / unit, 2),

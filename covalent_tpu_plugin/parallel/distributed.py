@@ -56,6 +56,11 @@ def coordinator_spec(
         if not workers:
             raise ValueError("coordinator_spec needs workers or coordinator_address")
         host = workers[0].split("@", 1)[-1]
+        # Strip a :ssh-port suffix (host:2222) — the data plane dials its
+        # own port; IPv6-style colon-bearing hosts pass through whole.
+        front, sep, maybe_port = host.rpartition(":")
+        if sep and maybe_port.isdigit() and ":" not in front:
+            host = front
         coordinator_address = f"{host}:{port}"
     if num_processes is None:
         num_processes = len(workers or [])

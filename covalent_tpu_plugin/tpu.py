@@ -100,6 +100,18 @@ _EXECUTOR_PLUGIN_DEFAULTS = {
 }
 
 
+def _split_host_port(hostport: str) -> tuple[str, int | None]:
+    """Split a ``host[:port]`` worker address.
+
+    Only a single all-digit suffix counts as a port — IPv6 literals and
+    other colon-bearing names pass through whole as the hostname.
+    """
+    host, sep, port = hostport.rpartition(":")
+    if sep and port.isdigit() and ":" not in host:
+        return host, int(port)
+    return hostport, None
+
+
 class TaskStatus(str, Enum):
     """Remote task state from one combined status round-trip."""
 
@@ -328,7 +340,8 @@ class TPUExecutor(RemoteExecutor):
             external, internal = self._discover_endpoints()[0]
             return f"{internal or external}:{self.coordinator_port}"
         host = self._worker_addresses()[0]
-        host = host.split("@", 1)[-1]  # strip user@ for the data plane
+        # Strip user@ and any :ssh-port — the data plane dials its own port.
+        host, _ = _split_host_port(host.split("@", 1)[-1])
         return f"{host}:{self.coordinator_port}"
 
     # ------------------------------------------------------------------ #
@@ -349,10 +362,13 @@ class TPUExecutor(RemoteExecutor):
     def _make_transport(self, address: str) -> Transport:
         if self.transport_kind == "local":
             return LocalTransport()
+        username = address.split("@", 1)[0] if "@" in address else self.username
+        host, port = _split_host_port(address.split("@", 1)[-1])
         return SSHTransport(
-            hostname=address.split("@", 1)[-1],
-            username=address.split("@", 1)[0] if "@" in address else self.username,
+            hostname=host,
+            username=username,
             ssh_key_file=self.ssh_key_file,
+            port=port or 22,
             strict_host_keys=self.strict_host_keys,
         )
 

@@ -263,6 +263,30 @@ def test_electron_end_to_end_over_ssh(fake_ssh_bin, tmp_path, run_async):
     assert leftovers == []
 
 
+def test_executor_parses_user_host_port_addresses(tmp_path):
+    """Worker addresses accept user@host:port; the ssh port never leaks
+    into the jax.distributed coordinator address."""
+    from covalent_tpu_plugin import TPUExecutor
+
+    key = tmp_path / "id_rsa"
+    key.write_text("k")
+    ex = TPUExecutor(
+        transport="ssh",
+        workers=["alice@w0:2222", "w1"],
+        ssh_key_file=str(key),
+        cache_dir=str(tmp_path / "cache"),
+        use_agent=False,
+    )
+    t0 = ex._make_transport("alice@w0:2222")
+    assert (t0.hostname, t0.username, t0.port) == ("w0", "alice", 2222)
+    t1 = ex._make_transport("w1")
+    assert (t1.hostname, t1.port) == ("w1", 22)
+    assert ex._coordinator_address() == f"w0:{ex.coordinator_port}"
+    # IPv6-style colon-bearing hosts pass through whole, not as host:port.
+    t6 = ex._make_transport("fe80::1")
+    assert (t6.hostname, t6.port) == ("fe80::1", 22)
+
+
 def test_executor_missing_key_raises(fake_ssh_bin, tmp_path, run_async):
     """Reference _validate_credentials (ssh.py:317-335)."""
     from covalent_tpu_plugin import TPUExecutor
