@@ -9,6 +9,7 @@ a pod.
 """
 
 from .data import synthetic_lm_batch, synthetic_lm_batches
+from .decode import generate, init_cache
 from .mlp import MLP, MnistCNN, synthetic_mnist
 from .transformer import TransformerConfig, TransformerLM, lm_125m_config
 from .train import (
@@ -27,6 +28,8 @@ __all__ = [
     "synthetic_mnist",
     "synthetic_lm_batch",
     "synthetic_lm_batches",
+    "generate",
+    "init_cache",
     "TransformerConfig",
     "TransformerLM",
     "lm_125m_config",

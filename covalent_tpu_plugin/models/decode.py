@@ -1,0 +1,109 @@
+"""Autoregressive generation with a per-layer KV cache.
+
+The training-side ``TransformerLM`` recomputes attention over the full
+prefix; generation instead runs the model in ``decode=True`` mode — each
+layer appends this step's K/V at a cache cursor (flax "cache" collection)
+and attends a single-token query over the cached prefix, so a step costs
+O(S·D) attention reads instead of O(S²·D) recompute.
+
+The loop is a ``lax.fori_loop`` writing into a fixed (B, P+N) token buffer
+— fully jittable, one compilation for any prompt content of a given shape.
+The prompt region is teacher-forced (generated tokens only land past it),
+which warms the cache and keeps the loop body uniform for XLA.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from .transformer import TransformerLM
+
+
+def _decode_model(model: TransformerLM) -> TransformerLM:
+    if model.config.decode:
+        return model
+    return TransformerLM(dataclasses.replace(model.config, decode=True))
+
+
+def init_cache(model: TransformerLM, batch_size: int) -> Any:
+    """Zeroed per-layer KV cache sized ``config.max_seq``.
+
+    Shapes come from ``jax.eval_shape`` over the decoder's init — no
+    parameters are ever materialised (a bare init would sample the full
+    weight set just to throw it away).
+    """
+    decoder = _decode_model(model)
+    abstract = jax.eval_shape(
+        lambda rng, tokens: decoder.init(rng, tokens),
+        jax.random.PRNGKey(0),
+        jnp.zeros((batch_size, 1), jnp.int32),
+    )
+    return jax.tree_util.tree_map(
+        lambda leaf: jnp.zeros(leaf.shape, leaf.dtype), abstract["cache"]
+    )
+
+
+def generate(
+    model: TransformerLM,
+    params: Any,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    rng: jax.Array | None = None,
+) -> jax.Array:
+    """Generate ``max_new_tokens`` continuations of ``prompt`` ((B, P) int32).
+
+    ``temperature=0`` is greedy argmax; otherwise softmax sampling at the
+    given temperature (requires ``rng``).  Returns the full (B, P+N) token
+    buffer.  Wrap in ``jax.jit`` for repeated use — everything inside is a
+    single compiled loop.
+    """
+    decoder = _decode_model(model)
+    config = decoder.config
+    batch, prompt_len = prompt.shape
+    total = prompt_len + max_new_tokens
+    if total > config.max_seq:
+        raise ValueError(
+            f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
+            f"exceeds config.max_seq ({config.max_seq})"
+        )
+    if temperature > 0 and rng is None:
+        raise ValueError("sampling (temperature > 0) requires rng")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    cache = init_cache(model, batch)
+    buffer = jnp.zeros((batch, total), jnp.int32)
+    buffer = jax.lax.dynamic_update_slice(buffer, prompt, (0, 0))
+
+    def body(t, carry):
+        buffer, cache, rng = carry
+        token = jax.lax.dynamic_slice(buffer, (0, t), (batch, 1))
+        logits, mutated = decoder.apply(
+            {"params": params, "cache": cache}, token, mutable=["cache"]
+        )
+        cache = mutated["cache"]
+        step_logits = logits[:, 0].astype(jnp.float32)  # (B, vocab)
+        rng, sample_key = jax.random.split(rng)
+        if temperature > 0:
+            chosen = jax.random.categorical(
+                sample_key, step_logits / temperature, axis=-1
+            )
+        else:
+            chosen = jnp.argmax(step_logits, axis=-1)
+        chosen = chosen.astype(jnp.int32)
+        # Inside the prompt the next token is teacher-forced; past it, the
+        # model's choice lands in the buffer.
+        existing = jax.lax.dynamic_slice(buffer, (0, t + 1), (batch, 1))[:, 0]
+        next_token = jnp.where(t + 1 >= prompt_len, chosen, existing)
+        buffer = jax.lax.dynamic_update_slice(
+            buffer, next_token[:, None], (0, t + 1)
+        )
+        return buffer, cache, rng
+
+    buffer, _, _ = jax.lax.fori_loop(0, total - 1, body, (buffer, cache, rng))
+    return buffer

@@ -73,6 +73,7 @@ def make_train_step(
     state_shardings: Any,
     rules=DEFAULT_RULES,
     donate_state: bool = True,
+    accumulate_steps: int = 1,
 ) -> Callable[[TrainState, Any], tuple[TrainState, dict]]:
     """Build the jitted sharded train step.
 
@@ -80,13 +81,56 @@ def make_train_step(
     sharded over the data axes; gradients and metrics come out as the mesh
     demands (XLA inserts the psums).  The state is donated — its buffers are
     reused for the updated state, halving peak HBM.
+
+    ``accumulate_steps > 1`` enables gradient accumulation: every batch
+    leaf carries a leading microbatch axis of that length (dim 1 is then
+    the data-sharded batch dim), a ``lax.scan`` accumulates mean gradients
+    across the microbatches — activation memory stays one microbatch — and
+    the optimizer applies once.  With mean-reducing losses and equal-size
+    microbatches this is exactly the full-batch gradient.
     """
+    def grads_of(params, apply_fn, batch):
+        return jax.value_and_grad(
+            lambda p: loss_fn(p, apply_fn, batch)
+        )(params)
+
     def step(state: TrainState, batch: Any) -> tuple[TrainState, dict]:
         with nn.logical_axis_rules(list(rules)):
-            def compute_loss(params):
-                return loss_fn(params, state.apply_fn, batch)
+            if accumulate_steps == 1:
+                loss, grads = grads_of(state.params, state.apply_fn, batch)
+            else:
+                lead = {
+                    leaf.shape[0] for leaf in jax.tree_util.tree_leaves(batch)
+                }
+                if lead != {accumulate_steps}:
+                    raise ValueError(
+                        f"accumulate_steps={accumulate_steps} but batch "
+                        f"leaves have leading axis {sorted(lead)}; every "
+                        "leaf needs a leading microbatch axis of that length"
+                    )
 
-            loss, grads = jax.value_and_grad(compute_loss)(state.params)
+                def micro(carry, microbatch):
+                    loss_acc, grads_acc = carry
+                    loss, grads = grads_of(
+                        state.params, state.apply_fn, microbatch
+                    )
+                    return (
+                        loss_acc + loss,
+                        jax.tree_util.tree_map(jnp.add, grads_acc, grads),
+                    ), None
+
+                zeros = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+                )
+                (loss, grads), _ = jax.lax.scan(
+                    micro, (jnp.zeros((), jnp.float32), zeros), batch
+                )
+                scale = 1.0 / accumulate_steps
+                loss = loss * scale
+                grads = jax.tree_util.tree_map(
+                    lambda g, p: (g * scale).astype(p.dtype),
+                    grads, state.params,
+                )
             new_state = state.apply_gradients(grads=grads)
             metrics = {
                 "loss": loss,

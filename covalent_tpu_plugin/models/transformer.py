@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.attention import (
+    _NEG_INF,
     flash_attention,
     flash_attention_sharded,
     mha_reference,
@@ -49,6 +50,9 @@ class TransformerConfig:
     #: head on the MXU's fast path (the loss re-casts to f32 for softmax).
     logits_dtype: Any = jnp.float32
     attention: str = "auto"          # auto | flash | reference | ring
+    #: incremental decoding: layers keep a (max_seq) K/V cache in the flax
+    #: "cache" collection and consume one token slice per apply.
+    decode: bool = False
     remat: bool = False
     #: "full" recomputes everything in backward; "dots" saves matmul outputs
     #: (jax dots_with_no_batch_dims_saveable) — ~half the recompute FLOPs for
@@ -69,12 +73,17 @@ def lm_125m_config(**overrides) -> TransformerConfig:
     return TransformerConfig(**overrides)
 
 
-def _rotary(x: jax.Array, base: float = 10000.0) -> jax.Array:
-    """Rotary position embedding over (B, S, H, D) with D even."""
+def _rotary(x: jax.Array, base: float = 10000.0, offset=0) -> jax.Array:
+    """Rotary position embedding over (B, S, H, D) with D even.
+
+    ``offset`` shifts the position index — incremental decoding applies the
+    embedding for absolute position ``offset + t`` to a length-1 slice.
+    """
     _, seq_len, _, head_dim = x.shape
     half = head_dim // 2
     freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    angles = jnp.arange(seq_len, dtype=jnp.float32)[:, None] * freqs[None, :]
+    positions = offset + jnp.arange(seq_len, dtype=jnp.float32)
+    angles = positions[:, None] * freqs[None, :]
     cos = jnp.cos(angles)[None, :, None, :].astype(x.dtype)
     sin = jnp.sin(angles)[None, :, None, :].astype(x.dtype)
     x1, x2 = x[..., :half], x[..., half:]
@@ -128,6 +137,9 @@ class Attention(nn.Module):
         k = nn.with_logical_constraint(k, ("batch", "seq", kv_axis, "kv"))
         v = nn.with_logical_constraint(v, ("batch", "seq", kv_axis, "kv"))
 
+        if cfg.decode:
+            return self._decode_step(q, k, v, kv_heads)
+
         q = _rotary(q)
         k = _rotary(k)
 
@@ -158,7 +170,12 @@ class Attention(nn.Module):
             out = mha_reference(qh, kh, vh, causal=True)
         out = out.transpose(0, 2, 1, 3)
 
-        out = nn.DenseGeneral(
+        out = self._out_proj(out)
+        return nn.with_logical_constraint(out, ("batch", "seq", "embed"))
+
+    def _out_proj(self, out):
+        cfg = self.config
+        return nn.DenseGeneral(
             features=cfg.d_model,
             axis=(-2, -1),
             use_bias=False,
@@ -172,7 +189,63 @@ class Attention(nn.Module):
             ),
             name="out_proj",
         )(out)
-        return nn.with_logical_constraint(out, ("batch", "seq", "embed"))
+
+    def _decode_step(self, q, k, v, kv_heads: int):
+        """One incremental step: append K/V at the cache cursor, attend the
+        (B, 1) query over every cached position <= cursor.
+
+        The cache lives in the flax "cache" collection (initialised zeroed
+        by ``model.init(..)`` with ``decode=True``); single-token decode is
+        bandwidth-bound, so the attention is a plain einsum — no flash.
+        """
+        cfg = self.config
+        batch = q.shape[0]
+        cached_k = self.variable(
+            "cache", "cached_k", jnp.zeros,
+            (batch, cfg.max_seq, kv_heads, cfg.head_dim), cfg.dtype,
+        )
+        cached_v = self.variable(
+            "cache", "cached_v", jnp.zeros,
+            (batch, cfg.max_seq, kv_heads, cfg.head_dim), cfg.dtype,
+        )
+        cursor = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+        )
+        if self.is_initializing():
+            # init only materialises the zeroed cache; no attention math.
+            return self._out_proj(jnp.zeros_like(q))
+
+        if q.shape[1] != 1:
+            raise ValueError(
+                f"decode=True consumes one token per step, got {q.shape[1]}"
+            )
+        pos = cursor.value
+        q = _rotary(q, offset=pos)
+        k = _rotary(k, offset=pos)
+        cached_k.value = jax.lax.dynamic_update_slice(
+            cached_k.value, k.astype(cfg.dtype), (0, pos, 0, 0)
+        )
+        cached_v.value = jax.lax.dynamic_update_slice(
+            cached_v.value, v.astype(cfg.dtype), (0, pos, 0, 0)
+        )
+        cursor.value = pos + 1
+
+        group = cfg.n_heads // kv_heads
+        # (B,1,H,D) x (B,S,Hkv,D), query heads grouped over their kv head.
+        qg = q.reshape(batch, kv_heads, group, cfg.head_dim)  # squeeze seq=1
+        scores = jnp.einsum(
+            "bhgd,bshd->bhgs", qg, cached_k.value,
+            preferred_element_type=jnp.float32,
+        ) * (cfg.head_dim**-0.5)
+        visible = jnp.arange(cfg.max_seq) <= pos
+        scores = jnp.where(visible[None, None, None, :], scores, _NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        out = jnp.einsum(
+            "bhgs,bshd->bhgd", probs, cached_v.value,
+            preferred_element_type=jnp.float32,
+        )
+        out = out.reshape(batch, 1, cfg.n_heads, cfg.head_dim)
+        return self._out_proj(out.astype(cfg.dtype))
 
 
 class MlpBlock(nn.Module):
@@ -253,7 +326,7 @@ class TransformerLM(nn.Module):
         if cfg.scan_layers:
             x, _ = nn.scan(
                 lambda module, carry, _: (module(carry), None),
-                variable_axes={"params": 0},
+                variable_axes={"params": 0, "cache": 0},
                 split_rngs={"params": True},
                 length=cfg.n_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},

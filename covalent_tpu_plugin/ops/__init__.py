@@ -2,12 +2,24 @@
 
 The reference contains no kernels or model code whatsoever (SURVEY §2 —
 100% Python control-plane).  These ops are the compute layer the TPU north
-star runs inside electrons: a Pallas flash-attention kernel for the MXU hot
-path and a ring-attention implementation for long-context sequence
-parallelism over the mesh's ``seq`` axis.
+star runs inside electrons: Pallas flash attention (FlashAttention-2
+forward + backward, GQA, position-vector masking, a shard_map wrapper for
+sharded meshes) and ring attention — einsum or flash-kernel block pairs —
+for long-context sequence parallelism over the mesh's ``seq`` axis.
 """
 
-from .attention import flash_attention, mha_reference
-from .ring_attention import ring_attention
+from .attention import flash_attention, flash_attention_sharded, mha_reference
+from .ring_attention import (
+    ring_attention,
+    ring_flash_attention,
+    sequence_parallel_attention,
+)
 
-__all__ = ["flash_attention", "mha_reference", "ring_attention"]
+__all__ = [
+    "flash_attention",
+    "flash_attention_sharded",
+    "mha_reference",
+    "ring_attention",
+    "ring_flash_attention",
+    "sequence_parallel_attention",
+]

@@ -108,7 +108,7 @@ def test_two_process_data_parallel_lm_training(tmp_path, run_async):
         python_path=sys.executable,
         poll_freq=0.2,
         coordinator_port=_free_port(),
-        task_timeout=240.0,
+        task_timeout=600.0,
         use_agent=False,
         task_env={
             "PYTHONPATH": repo_root + os.pathsep + os.environ.get("PYTHONPATH", ""),
@@ -145,7 +145,7 @@ def test_two_process_distributed_psum(tmp_path, run_async, use_agent):
         python_path=sys.executable,
         poll_freq=0.2,
         coordinator_port=_free_port(),
-        task_timeout=180.0,
+        task_timeout=600.0,
         use_agent=use_agent,
         task_env={
             "PYTHONPATH": repo_root + os.pathsep + os.environ.get("PYTHONPATH", ""),

@@ -189,6 +189,50 @@ def test_lm_gqa_trains_under_tensor_parallelism():
     assert np.isfinite(float(metrics["loss"]))
 
 
+def test_gradient_accumulation_matches_full_batch():
+    """accumulate_steps=2 over half-size microbatches must produce exactly
+    the full-batch update (mean loss + linear gradients)."""
+    mesh = make_mesh(MeshPlan(data=2))
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+        max_seq=16, dtype=jnp.float32, attention="reference",
+    )
+    model = TransformerLM(cfg)
+    rng = np.random.default_rng(4)
+    tokens = rng.integers(0, 64, size=(8, 17)).astype(np.int32)
+
+    def build():
+        batch = shard_batch({"tokens": tokens}, mesh)
+        state, shardings = make_sharded_train_state(
+            model, optax.sgd(1e-2), jax.random.PRNGKey(0),
+            batch["tokens"][:, :-1], mesh,
+        )
+        return state, shardings
+
+    state_full, shardings = build()
+    step_full = make_train_step(lm_loss, mesh, shardings)
+    state_full, metrics_full = step_full(
+        state_full, shard_batch({"tokens": tokens}, mesh)
+    )
+
+    state_acc, shardings = build()
+    step_acc = make_train_step(lm_loss, mesh, shardings, accumulate_steps=2)
+    micro = {"tokens": tokens.reshape(2, 4, 17)}  # leading accumulation axis
+    state_acc, metrics_acc = step_acc(
+        state_acc, jax.tree_util.tree_map(jnp.asarray, micro)
+    )
+
+    np.testing.assert_allclose(
+        float(metrics_acc["loss"]), float(metrics_full["loss"]), rtol=1e-5
+    )
+    full_leaves = jax.tree_util.tree_leaves(state_full.params)
+    acc_leaves = jax.tree_util.tree_leaves(state_acc.params)
+    for a, b in zip(acc_leaves, full_leaves):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-6, rtol=1e-5
+        )
+
+
 def test_synthetic_lm_stream_is_deterministic_and_learnable():
     from covalent_tpu_plugin.models import synthetic_lm_batch, synthetic_lm_batches
 
