@@ -440,6 +440,50 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
     else:
         report("lm_step", skipped="budget")
 
+    # -- 125M generation throughput (KV-cache decode) ----------------------
+    if remaining() > 60:
+        try:
+            from covalent_tpu_plugin.models import (
+                TransformerLM,
+                generate,
+                lm_125m_config,
+            )
+
+            if small:
+                gen_config = lm_125m_config(
+                    max_seq=128, n_layers=2, d_model=256, n_heads=4,
+                    d_ff=1024, vocab_size=4096,
+                )
+                bsz, prompt_len, new_tokens = 2, 16, 32
+            else:
+                gen_config = lm_125m_config(max_seq=512)
+                bsz, prompt_len, new_tokens = 8, 128, 128
+            model = TransformerLM(gen_config)
+            prompt = jax.random.randint(
+                jax.random.PRNGKey(0), (bsz, prompt_len), 0,
+                gen_config.vocab_size,
+            )
+            params = model.init(jax.random.PRNGKey(1), prompt)["params"]
+            gen = jax.jit(
+                lambda p, t: generate(model, p, t, max_new_tokens=new_tokens)
+            )
+            jax.device_get(gen(params, prompt)[0, -1])  # compile + warm
+            t0 = time.monotonic()
+            out = gen(params, prompt)
+            jax.device_get(out[0, -1])
+            elapsed = time.monotonic() - t0
+            report(
+                "lm_decode",
+                new_tokens=new_tokens,
+                batch=bsz,
+                tokens_per_s=round(bsz * new_tokens / elapsed),
+                ms_per_token=round(elapsed / new_tokens * 1e3, 2),
+            )
+        except Exception as error:  # noqa: BLE001
+            report("lm_decode", error=repr(error))
+    else:
+        report("lm_decode", skipped="budget")
+
     progress.close()
     return results
 
@@ -613,6 +657,8 @@ async def main() -> None:
         "lm125m_step_ms": sub("lm_step", "step_ms"),
         "lm125m_tokens_per_s": sub("lm_step", "tokens_per_s"),
         "lm125m_mfu": sub("lm_step", "mfu"),
+        "lm125m_decode_tokens_per_s": sub("lm_decode", "tokens_per_s"),
+        "lm125m_decode_ms_per_token": sub("lm_decode", "ms_per_token"),
     }
     emit(final)
 

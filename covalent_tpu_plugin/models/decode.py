@@ -1,15 +1,16 @@
 """Autoregressive generation with a per-layer KV cache.
 
 The training-side ``TransformerLM`` recomputes attention over the full
-prefix; generation instead runs the model in ``decode=True`` mode — each
-layer appends this step's K/V at a cache cursor (flax "cache" collection)
-and attends a single-token query over the cached prefix, so a step costs
-O(S·D) attention reads instead of O(S²·D) recompute.
+prefix; generation instead runs the model in ``decode=True`` mode: one
+batched *prefill* pass pushes the whole prompt's K/V into each layer's
+cache (flax "cache" collection), then each decode step appends a single
+token at the cache cursor and attends the cached prefix — a step costs
+O(S·D) attention reads instead of O(S²·D) recompute, and time-to-first-
+token is one forward pass, not P sequential steps.
 
-The loop is a ``lax.fori_loop`` writing into a fixed (B, P+N) token buffer
-— fully jittable, one compilation for any prompt content of a given shape.
-The prompt region is teacher-forced (generated tokens only land past it),
-which warms the cache and keeps the loop body uniform for XLA.
+The decode loop is a ``lax.fori_loop`` writing into a fixed (B, P+N)
+token buffer — fully jittable, one compilation for any prompt content of
+a given shape.
 """
 
 from __future__ import annotations
@@ -65,6 +66,8 @@ def generate(
     decoder = _decode_model(model)
     config = decoder.config
     batch, prompt_len = prompt.shape
+    if max_new_tokens <= 0:
+        return prompt
     total = prompt_len + max_new_tokens
     if total > config.max_seq:
         raise ValueError(
@@ -80,6 +83,28 @@ def generate(
     buffer = jnp.zeros((batch, total), jnp.int32)
     buffer = jax.lax.dynamic_update_slice(buffer, prompt, (0, 0))
 
+    def choose(step_logits, rng):
+        rng, sample_key = jax.random.split(rng)
+        if temperature > 0:
+            chosen = jax.random.categorical(
+                sample_key, step_logits.astype(jnp.float32) / temperature,
+                axis=-1,
+            )
+        else:
+            chosen = jnp.argmax(step_logits.astype(jnp.float32), axis=-1)
+        return chosen.astype(jnp.int32), rng
+
+    # Prefill: one batched pass pushes the whole prompt into the caches and
+    # yields the first generated token from the prompt's last logits.
+    prefill_logits, mutated = decoder.apply(
+        {"params": params, "cache": cache}, prompt, mutable=["cache"]
+    )
+    cache = mutated["cache"]
+    first, rng = choose(prefill_logits[:, -1], rng)
+    buffer = jax.lax.dynamic_update_slice(
+        buffer, first[:, None], (0, prompt_len)
+    )
+
     def body(t, carry):
         buffer, cache, rng = carry
         token = jax.lax.dynamic_slice(buffer, (0, t), (batch, 1))
@@ -87,23 +112,13 @@ def generate(
             {"params": params, "cache": cache}, token, mutable=["cache"]
         )
         cache = mutated["cache"]
-        step_logits = logits[:, 0].astype(jnp.float32)  # (B, vocab)
-        rng, sample_key = jax.random.split(rng)
-        if temperature > 0:
-            chosen = jax.random.categorical(
-                sample_key, step_logits / temperature, axis=-1
-            )
-        else:
-            chosen = jnp.argmax(step_logits, axis=-1)
-        chosen = chosen.astype(jnp.int32)
-        # Inside the prompt the next token is teacher-forced; past it, the
-        # model's choice lands in the buffer.
-        existing = jax.lax.dynamic_slice(buffer, (0, t + 1), (batch, 1))[:, 0]
-        next_token = jnp.where(t + 1 >= prompt_len, chosen, existing)
+        chosen, rng = choose(logits[:, 0], rng)
         buffer = jax.lax.dynamic_update_slice(
-            buffer, next_token[:, None], (0, t + 1)
+            buffer, chosen[:, None], (0, t + 1)
         )
         return buffer, cache, rng
 
-    buffer, _, _ = jax.lax.fori_loop(0, total - 1, body, (buffer, cache, rng))
+    buffer, _, _ = jax.lax.fori_loop(
+        prompt_len, total - 1, body, (buffer, cache, rng)
+    )
     return buffer

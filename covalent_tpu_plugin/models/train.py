@@ -87,7 +87,9 @@ def make_train_step(
     the data-sharded batch dim), a ``lax.scan`` accumulates mean gradients
     across the microbatches — activation memory stays one microbatch — and
     the optimizer applies once.  With mean-reducing losses and equal-size
-    microbatches this is exactly the full-batch gradient.
+    microbatches this equals the full-batch gradient up to f32
+    reduction-order rounding (the accumulator is f32 regardless of param
+    dtype).
     """
     def grads_of(params, apply_fn, batch):
         return jax.value_and_grad(

@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.attention import (
-    _NEG_INF,
+    NEG_INF,
     flash_attention,
     flash_attention_sharded,
     mha_reference,
@@ -191,15 +191,19 @@ class Attention(nn.Module):
         )(out)
 
     def _decode_step(self, q, k, v, kv_heads: int):
-        """One incremental step: append K/V at the cache cursor, attend the
-        (B, 1) query over every cached position <= cursor.
+        """Incremental attention against the layer's K/V cache.
 
-        The cache lives in the flax "cache" collection (initialised zeroed
-        by ``model.init(..)`` with ``decode=True``); single-token decode is
-        bandwidth-bound, so the attention is a plain einsum — no flash.
+        A multi-token call is a *prefill*: the whole slab's K/V land in the
+        cache at the cursor, then the slab attends the cache with per-row
+        causal visibility — correct at cursor 0 (classic prefill) and at a
+        non-zero cursor (chunked prefill keeps its cached context).  A
+        single-token call is a decode step.  The cache lives in the flax
+        "cache" collection (zero-initialised via ``decode=True`` init);
+        decode is bandwidth-bound, so the attention is a plain einsum — no
+        flash.
         """
         cfg = self.config
-        batch = q.shape[0]
+        batch, slab = q.shape[:2]
         cached_k = self.variable(
             "cache", "cached_k", jnp.zeros,
             (batch, cfg.max_seq, kv_heads, cfg.head_dim), cfg.dtype,
@@ -215,10 +219,6 @@ class Attention(nn.Module):
             # init only materialises the zeroed cache; no attention math.
             return self._out_proj(jnp.zeros_like(q))
 
-        if q.shape[1] != 1:
-            raise ValueError(
-                f"decode=True consumes one token per step, got {q.shape[1]}"
-            )
         pos = cursor.value
         q = _rotary(q, offset=pos)
         k = _rotary(k, offset=pos)
@@ -228,23 +228,27 @@ class Attention(nn.Module):
         cached_v.value = jax.lax.dynamic_update_slice(
             cached_v.value, v.astype(cfg.dtype), (0, pos, 0, 0)
         )
-        cursor.value = pos + 1
+        cursor.value = pos + slab
 
+        # One path for prefill slabs AND single-token steps: the slab's
+        # queries attend the whole cache with per-row causal visibility
+        # (query at absolute position pos+i sees cache slots <= pos+i), so
+        # chunked prefill at a non-zero cursor keeps its cached context.
         group = cfg.n_heads // kv_heads
-        # (B,1,H,D) x (B,S,Hkv,D), query heads grouped over their kv head.
-        qg = q.reshape(batch, kv_heads, group, cfg.head_dim)  # squeeze seq=1
+        qg = q.reshape(batch, slab, kv_heads, group, cfg.head_dim)
         scores = jnp.einsum(
-            "bhgd,bshd->bhgs", qg, cached_k.value,
+            "bqhgd,bshd->bhgqs", qg, cached_k.value,
             preferred_element_type=jnp.float32,
         ) * (cfg.head_dim**-0.5)
-        visible = jnp.arange(cfg.max_seq) <= pos
-        scores = jnp.where(visible[None, None, None, :], scores, _NEG_INF)
+        q_positions = pos + jnp.arange(slab)
+        visible = jnp.arange(cfg.max_seq)[None, :] <= q_positions[:, None]
+        scores = jnp.where(visible[None, None, None, :, :], scores, NEG_INF)
         probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
         out = jnp.einsum(
-            "bhgs,bshd->bhgd", probs, cached_v.value,
+            "bhgqs,bshd->bqhgd", probs, cached_v.value,
             preferred_element_type=jnp.float32,
         )
-        out = out.reshape(batch, 1, cfg.n_heads, cfg.head_dim)
+        out = out.reshape(batch, slab, cfg.n_heads, cfg.head_dim)
         return self._out_proj(out.astype(cfg.dtype))
 
 

@@ -32,7 +32,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-_NEG_INF = -1e30
+#: Finite stand-in for -inf in masked scores (finite so downstream
+#: exp/logaddexp arithmetic can never produce NaN).  Public: the model's
+#: decode path masks with the same constant.
+NEG_INF = -1e30
+_NEG_INF = NEG_INF
 
 # MXU-sweep winners on v5e at S=4096 (see flash_attention docstring).
 _DEFAULT_BLOCK_Q = 512

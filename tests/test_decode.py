@@ -76,6 +76,39 @@ def test_cached_decode_logits_match_bf16():
         )
 
 
+def test_chunked_prefill_keeps_cached_context():
+    """Feeding the prompt in two multi-token chunks must equal one full
+    forward — the second chunk's queries attend the first chunk's cache."""
+    from covalent_tpu_plugin.models.decode import _decode_model, init_cache
+
+    model = TransformerLM(BASE)
+    tokens = jax.random.randint(jax.random.PRNGKey(5), (2, 12), 0, BASE.vocab_size)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    full = model.apply({"params": params}, tokens)
+
+    decoder = _decode_model(model)
+    cache = init_cache(model, 2)
+    first, mutated = decoder.apply(
+        {"params": params, "cache": cache}, tokens[:, :7], mutable=["cache"]
+    )
+    second, _ = decoder.apply(
+        {"params": params, "cache": mutated["cache"]}, tokens[:, 7:],
+        mutable=["cache"],
+    )
+    got = jnp.concatenate([first, second], axis=1)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(full), atol=2e-4, rtol=2e-4
+    )
+
+
+def test_generate_zero_new_tokens_is_identity():
+    model = TransformerLM(BASE)
+    prompt = jax.random.randint(jax.random.PRNGKey(6), (2, 5), 0, BASE.vocab_size)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    out = generate(model, params, prompt, max_new_tokens=0)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(prompt))
+
+
 def test_generate_is_jittable_and_prompt_preserved():
     model = TransformerLM(BASE)
     prompt = jax.random.randint(jax.random.PRNGKey(2), (3, 4), 0, BASE.vocab_size)
