@@ -248,6 +248,75 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
     else:
         report("mnist", skipped="budget")
 
+    # -- 125M-class LM train step + MFU (BASELINE config 5's model, 1 chip) -
+    if remaining() > 75:
+        try:
+            import optax
+
+            from covalent_tpu_plugin.models.train import (
+                TrainState,
+                lm_loss,
+            )
+            from covalent_tpu_plugin.models.transformer import (
+                TransformerLM,
+                lm_125m_config,
+            )
+
+            if small:
+                bsz, seq = 2, 256
+                config = lm_125m_config(
+                    max_seq=seq, n_layers=2, d_model=256, n_heads=4,
+                    d_ff=1024, vocab_size=4096, remat=True,
+                )
+            else:
+                bsz, seq = 4, 1024
+                config = lm_125m_config(max_seq=seq, remat=True)
+            model = TransformerLM(config=config)
+            # seq+1 tokens: lm_loss shifts by one, so the model sees exactly
+            # `seq` positions (a tileable multiple of 128 for flash).
+            tokens = jax.random.randint(
+                jax.random.PRNGKey(0), (bsz, seq + 1), 0, config.vocab_size
+            )
+            params = model.init(jax.random.PRNGKey(1), tokens[:, :-1])["params"]
+            state = TrainState.create(
+                apply_fn=model.apply, params=params, tx=optax.adamw(3e-4)
+            )
+            n_params = model.parameter_count(params)
+
+            @jax.jit
+            def step(state, tokens):
+                loss, grads = jax.value_and_grad(
+                    lambda p: lm_loss(p, state.apply_fn, {"tokens": tokens})
+                )(state.params)
+                return state.apply_gradients(grads=grads), loss
+
+            holder = {"state": state}
+
+            def dispatch():
+                holder["state"], holder["loss"] = step(holder["state"], tokens)
+
+            def fetch():
+                holder["final"] = float(jax.device_get(holder["loss"]))
+
+            step_s = unit_seconds(dispatch, fetch, target_s=5.0, cap=10)
+            final_loss = holder["final"]
+            # 6ND for fwd+bwd (+ remat recompute ~ +1 fwd -> 8ND ceiling;
+            # report the standard 6ND so MFU is comparable across frameworks)
+            lm_tflops = 6 * n_params * bsz * seq / step_s / 1e12
+            report(
+                "lm_step",
+                n_params=n_params,
+                step_ms=round(step_s * 1e3, 1),
+                tokens_per_s=round(bsz * seq / step_s),
+                tflops_6nd=round(lm_tflops, 2),
+                mfu=mfu(lm_tflops),
+                final_loss=round(final_loss, 4),
+            )
+        except Exception as error:  # noqa: BLE001
+            report("lm_step", error=repr(error))
+    else:
+        report("lm_step", skipped="budget")
+
     # -- flash attention forward vs dense (long-context hot op) ------------
     if remaining() > 50:
         try:
@@ -371,75 +440,6 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
     else:
         report("flash_long", skipped="budget")
 
-    # -- 125M-class LM train step + MFU (BASELINE config 5's model, 1 chip) -
-    if remaining() > 75:
-        try:
-            import optax
-
-            from covalent_tpu_plugin.models.train import (
-                TrainState,
-                lm_loss,
-            )
-            from covalent_tpu_plugin.models.transformer import (
-                TransformerLM,
-                lm_125m_config,
-            )
-
-            if small:
-                bsz, seq = 2, 256
-                config = lm_125m_config(
-                    max_seq=seq, n_layers=2, d_model=256, n_heads=4,
-                    d_ff=1024, vocab_size=4096, remat=True,
-                )
-            else:
-                bsz, seq = 4, 1024
-                config = lm_125m_config(max_seq=seq, remat=True)
-            model = TransformerLM(config=config)
-            # seq+1 tokens: lm_loss shifts by one, so the model sees exactly
-            # `seq` positions (a tileable multiple of 128 for flash).
-            tokens = jax.random.randint(
-                jax.random.PRNGKey(0), (bsz, seq + 1), 0, config.vocab_size
-            )
-            params = model.init(jax.random.PRNGKey(1), tokens[:, :-1])["params"]
-            state = TrainState.create(
-                apply_fn=model.apply, params=params, tx=optax.adamw(3e-4)
-            )
-            n_params = model.parameter_count(params)
-
-            @jax.jit
-            def step(state, tokens):
-                loss, grads = jax.value_and_grad(
-                    lambda p: lm_loss(p, state.apply_fn, {"tokens": tokens})
-                )(state.params)
-                return state.apply_gradients(grads=grads), loss
-
-            holder = {"state": state}
-
-            def dispatch():
-                holder["state"], holder["loss"] = step(holder["state"], tokens)
-
-            def fetch():
-                holder["final"] = float(jax.device_get(holder["loss"]))
-
-            step_s = unit_seconds(dispatch, fetch, target_s=5.0, cap=10)
-            final_loss = holder["final"]
-            # 6ND for fwd+bwd (+ remat recompute ~ +1 fwd -> 8ND ceiling;
-            # report the standard 6ND so MFU is comparable across frameworks)
-            lm_tflops = 6 * n_params * bsz * seq / step_s / 1e12
-            report(
-                "lm_step",
-                n_params=n_params,
-                step_ms=round(step_s * 1e3, 1),
-                tokens_per_s=round(bsz * seq / step_s),
-                tflops_6nd=round(lm_tflops, 2),
-                mfu=mfu(lm_tflops),
-                final_loss=round(final_loss, 4),
-            )
-        except Exception as error:  # noqa: BLE001
-            report("lm_step", error=repr(error))
-    else:
-        report("lm_step", skipped="budget")
-
     # -- 125M generation throughput (KV-cache decode) ----------------------
     if remaining() > 60:
         try:
@@ -468,16 +468,21 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
                 lambda p, t: generate(model, p, t, max_new_tokens=new_tokens)
             )
             jax.device_get(gen(params, prompt)[0, -1])  # compile + warm
-            t0 = time.monotonic()
-            out = gen(params, prompt)
-            jax.device_get(out[0, -1])
-            elapsed = time.monotonic() - t0
+            elapsed = float("inf")
+            for _ in range(2):  # best-of-2 against tunnel jitter
+                t0 = time.monotonic()
+                out = gen(params, prompt)
+                jax.device_get(out[0, -1])
+                elapsed = min(elapsed, time.monotonic() - t0)
+            # One batched prefill + (new_tokens - 1) decode steps share the
+            # wall; metrics are labelled end-to-end, not per decode step.
             report(
                 "lm_decode",
+                prompt_len=prompt_len,
                 new_tokens=new_tokens,
                 batch=bsz,
-                tokens_per_s=round(bsz * new_tokens / elapsed),
-                ms_per_token=round(elapsed / new_tokens * 1e3, 2),
+                e2e_tokens_per_s=round(bsz * new_tokens / elapsed),
+                e2e_ms_per_new_token=round(elapsed / new_tokens * 1e3, 2),
             )
         except Exception as error:  # noqa: BLE001
             report("lm_decode", error=repr(error))
@@ -657,8 +662,8 @@ async def main() -> None:
         "lm125m_step_ms": sub("lm_step", "step_ms"),
         "lm125m_tokens_per_s": sub("lm_step", "tokens_per_s"),
         "lm125m_mfu": sub("lm_step", "mfu"),
-        "lm125m_decode_tokens_per_s": sub("lm_decode", "tokens_per_s"),
-        "lm125m_decode_ms_per_token": sub("lm_decode", "ms_per_token"),
+        "lm125m_decode_tokens_per_s": sub("lm_decode", "e2e_tokens_per_s"),
+        "lm125m_decode_ms_per_token": sub("lm_decode", "e2e_ms_per_new_token"),
     }
     emit(final)
 

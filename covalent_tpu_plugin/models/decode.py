@@ -66,14 +66,14 @@ def generate(
     decoder = _decode_model(model)
     config = decoder.config
     batch, prompt_len = prompt.shape
-    if max_new_tokens <= 0:
-        return prompt
-    total = prompt_len + max_new_tokens
+    total = prompt_len + max(max_new_tokens, 0)
     if total > config.max_seq:
         raise ValueError(
             f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
             f"exceeds config.max_seq ({config.max_seq})"
         )
+    if max_new_tokens <= 0:
+        return prompt.astype(jnp.int32)
     if temperature > 0 and rng is None:
         raise ValueError("sampling (temperature > 0) requires rng")
     if rng is None:

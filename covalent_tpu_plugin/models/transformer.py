@@ -201,9 +201,18 @@ class Attention(nn.Module):
         "cache" collection (zero-initialised via ``decode=True`` init);
         decode is bandwidth-bound, so the attention is a plain einsum — no
         flash.
+
+        Contract for direct cache users: the cursor plus the slab must not
+        exceed ``max_seq`` — the cursor is traced, so an overflow cannot be
+        detected here; ``generate()`` enforces it for the packaged path
+        (``dynamic_update_slice`` would clamp and silently corrupt slots).
         """
         cfg = self.config
         batch, slab = q.shape[:2]
+        if slab > cfg.max_seq:
+            raise ValueError(
+                f"slab of {slab} tokens exceeds config.max_seq {cfg.max_seq}"
+            )
         cached_k = self.variable(
             "cache", "cached_k", jnp.zeros,
             (batch, cfg.max_seq, kv_heads, cfg.head_dim), cfg.dtype,
