@@ -10,6 +10,7 @@ a pod.
 
 from .data import synthetic_lm_batch, synthetic_lm_batches
 from .decode import generate, init_cache
+from .pipeline_lm import pipeline_lm_forward, pipeline_lm_loss
 from .mlp import MLP, MnistCNN, synthetic_mnist
 from .transformer import TransformerConfig, TransformerLM, lm_125m_config
 from .train import (
@@ -30,6 +31,8 @@ __all__ = [
     "synthetic_lm_batches",
     "generate",
     "init_cache",
+    "pipeline_lm_forward",
+    "pipeline_lm_loss",
     "TransformerConfig",
     "TransformerLM",
     "lm_125m_config",

@@ -1,0 +1,147 @@
+"""Pipeline-parallel forward/loss for the transformer LM.
+
+Glue between :mod:`..parallel.pipeline` (the generic GPipe schedule) and
+``TransformerLM``: the scanned block stack's leading layer axis becomes the
+pipeline's stage axis — each ``pipe`` device holds ``n_layers / n_stages``
+layers — while the (cheap) embedding, final norm, and lm_head replicate and
+run outside the pipelined region.  One ``jax.grad`` of
+:func:`pipeline_lm_loss` trains the pipeline; the transpose of the
+scan + ppermute schedule is the backward pipeline.
+
+Requires ``config.scan_layers=True`` (the stacked-parameter layout IS the
+stage partition) and a per-microbatch-shape-preserving block, which the
+transformer's blocks are.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ..ops.attention import flash_attention, mha_reference, on_tpu
+from ..parallel.pipeline import pipeline_stages, pipelined
+from ..parallel.sharding import unbox
+from .train import cross_entropy_loss
+from .transformer import TransformerLM, _rotary
+
+
+def _rmsnorm(scale: jax.Array, x: jax.Array, dtype) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    norm = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + 1e-6)
+    return (norm * scale).astype(dtype)
+
+
+def _block_forward(cfg, p: Any, x: jax.Array) -> jax.Array:
+    """One transformer block on RAW (unboxed) params.
+
+    Functional mirror of ``transformer.Block`` — flax module machinery
+    (param boxing, logical constraints) misfires inside shard_map's manual
+    mesh, so the pipelined region computes with plain einsums.  Numerical
+    equality with ``Block.apply`` is pinned by the pipeline LM tests.
+    """
+    dt = cfg.dtype
+    att = p["attention"]
+
+    h = _rmsnorm(p["ln_attn"]["scale"], x, dt)
+    q = jnp.einsum("bsd,dhk->bshk", h, att["q_proj"]["kernel"].astype(dt))
+    k = jnp.einsum("bsd,dhk->bshk", h, att["k_proj"]["kernel"].astype(dt))
+    v = jnp.einsum("bsd,dhk->bshk", h, att["v_proj"]["kernel"].astype(dt))
+    q = _rotary(q)
+    k = _rotary(k)
+    qh, kh, vh = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+    impl = cfg.attention
+    if impl == "auto":
+        impl = "flash" if on_tpu() else "reference"
+    if impl == "flash":
+        out = flash_attention(qh, kh, vh, causal=True)
+    else:
+        out = mha_reference(qh, kh, vh, causal=True)
+    out = out.transpose(0, 2, 1, 3)
+    attn = jnp.einsum("bshk,hkd->bsd", out, att["out_proj"]["kernel"].astype(dt))
+    x = x + attn
+
+    h = _rmsnorm(p["ln_mlp"]["scale"], x, dt)
+    h = jnp.einsum("bsd,df->bsf", h, p["mlp"]["wi"]["kernel"].astype(dt))
+    h = jax.nn.gelu(h)
+    h = jnp.einsum("bsf,fd->bsd", h, p["mlp"]["wo"]["kernel"].astype(dt))
+    return x + h
+
+
+def pipeline_lm_forward(
+    model: TransformerLM,
+    params: Any,
+    tokens: jax.Array,
+    mesh: Mesh,
+    n_micro: int,
+    axis_name: str = "pipe",
+) -> jax.Array:
+    """Logits for (B, S) tokens with the block stack pipelined over
+    ``mesh``'s ``pipe`` axis, ``n_micro`` microbatches deep.
+
+    ``params`` is the ordinary (possibly flax-``Partitioned``-boxed)
+    ``model.init(...)['params']`` tree; batch must divide ``n_micro``.
+    """
+    cfg = model.config
+    if not cfg.scan_layers:
+        raise ValueError("pipeline parallelism needs config.scan_layers=True")
+    n_stages = mesh.shape[axis_name]
+    raw = unbox(params)
+    batch, seq_len = tokens.shape
+    if batch % n_micro:
+        raise ValueError(f"batch {batch} not divisible by n_micro {n_micro}")
+
+    # Embedding — replicated, outside the pipelined region.
+    x = jnp.asarray(raw["embedding"], cfg.dtype)[tokens]
+
+    micro = x.reshape(n_micro, batch // n_micro, seq_len, cfg.d_model)
+    stacked = pipeline_stages(raw["layers"], n_stages)
+
+    block = _block_forward
+    if cfg.remat:
+        # Honour the config's rematerialisation on the pipelined path too:
+        # recompute block internals in backward instead of storing every
+        # per-tick activation (the scan over ticks multiplies what would
+        # otherwise be stored).
+        block = jax.checkpoint(_block_forward, static_argnums=(0,))
+
+    def stage_fn(stage_layers, h):
+        def body(h, layer_params):
+            return block(cfg, layer_params, h), None
+
+        h, _ = jax.lax.scan(body, h, stage_layers)
+        return h
+
+    out = pipelined(stage_fn, mesh, axis_name=axis_name)(stacked, micro)
+    x = out.reshape(batch, seq_len, cfg.d_model)
+
+    # Final norm + head — replicated, outside the pipeline.
+    x = _rmsnorm(raw["ln_final"]["scale"], x, cfg.dtype)
+    logits = jnp.einsum(
+        "bsd,dv->bsv",
+        x.astype(cfg.logits_dtype),
+        jnp.asarray(raw["lm_head"]["kernel"], cfg.logits_dtype),
+    )
+    return logits
+
+
+def pipeline_lm_loss(
+    model: TransformerLM,
+    params: Any,
+    batch: dict,
+    mesh: Mesh,
+    n_micro: int,
+    axis_name: str = "pipe",
+) -> jax.Array:
+    """Next-token loss over ``{"tokens": (B, S)}``, pipelined.
+
+    Differentiable: ``jax.value_and_grad`` of this (w.r.t. ``params``) is a
+    pipeline-parallel train step's core.
+    """
+    tokens = batch["tokens"]
+    logits = pipeline_lm_forward(
+        model, params, tokens[:, :-1], mesh, n_micro, axis_name
+    )
+    return cross_entropy_loss(logits, tokens[:, 1:])

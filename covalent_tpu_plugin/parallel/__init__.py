@@ -32,6 +32,9 @@ _EXPORTS = {
     "shard_batch": ".sharding",
     "shard_batch_per_process": ".sharding",
     "process_local_slice": ".sharding",
+    "pipelined": ".pipeline",
+    "pipeline_apply": ".pipeline",
+    "pipeline_stages": ".pipeline",
 }
 
 
@@ -59,6 +62,9 @@ __all__ = [
     "shard_batch",
     "shard_batch_per_process",
     "process_local_slice",
+    "pipelined",
+    "pipeline_apply",
+    "pipeline_stages",
     "replicated",
     "psum",
     "all_gather",

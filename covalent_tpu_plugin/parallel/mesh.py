@@ -8,6 +8,7 @@ inner axes to ICI, per the standard TPU scaling recipe):
   just-in-time); batch is sharded over ``data × fsdp``
 * ``tensor`` — Megatron-style tensor parallelism inside layers
 * ``seq``    — sequence/context parallelism (ring attention)
+* ``pipe``   — pipeline parallelism (GPipe microbatch schedule, pipeline.py)
 
 A dimension of 1 erases the axis's cost without changing program structure,
 so one train-step definition serves every topology from v5e-1 to multi-host
@@ -20,7 +21,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
-AXES = ("data", "fsdp", "tensor", "seq")
+AXES = ("data", "fsdp", "tensor", "seq", "pipe")
 
 
 @dataclass(frozen=True)
@@ -31,13 +32,20 @@ class MeshPlan:
     fsdp: int = 1
     tensor: int = 1
     seq: int = 1
+    pipe: int = 1
 
     @property
     def sizes(self) -> dict[str, int]:
-        return {"data": self.data, "fsdp": self.fsdp, "tensor": self.tensor, "seq": self.seq}
+        return {
+            "data": self.data,
+            "fsdp": self.fsdp,
+            "tensor": self.tensor,
+            "seq": self.seq,
+            "pipe": self.pipe,
+        }
 
     def total(self) -> int:
-        return self.data * self.fsdp * self.tensor * self.seq
+        return self.data * self.fsdp * self.tensor * self.seq * self.pipe
 
 
 def make_mesh(plan: MeshPlan, devices=None):
@@ -56,7 +64,7 @@ def make_mesh(plan: MeshPlan, devices=None):
             f"mesh plan {plan.sizes} needs {plan.total()} devices, got {len(devices)}"
         )
     array = np.array(devices[: plan.total()]).reshape(
-        plan.data, plan.fsdp, plan.tensor, plan.seq
+        plan.data, plan.fsdp, plan.tensor, plan.seq, plan.pipe
     )
     return Mesh(array, AXES)
 

@@ -1,0 +1,147 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a mesh axis.
+
+The TPU-native formulation: stage parameters are the *sharded leading axis*
+of a stacked pytree (one slice per device along the ``pipe`` mesh axis),
+activations hop stage-to-stage with ``lax.ppermute`` (one ICI neighbor hop
+per tick), and the schedule is a ``lax.scan`` over ``M + S - 1`` ticks — at
+tick ``t`` stage ``s`` processes microbatch ``t - s`` (the classic GPipe
+diagonal; the ``S - 1`` edge ticks are the pipeline bubble).  Reverse-mode
+autodiff through the scan + ppermute yields the backward schedule
+automatically, so one ``jax.grad`` trains the pipeline.
+
+The reference has no parallelism of any kind (SURVEY §2.4); this completes
+the framework's axis set (data/fsdp/tensor/seq/pipe), all expressed through
+the same mesh + collectives machinery.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_stages(params: Any, n_stages: int) -> Any:
+    """Reshape a stacked-layer pytree (leading axis ``n_layers``) into
+    ``(n_stages, layers_per_stage, ...)`` for pipe-axis sharding."""
+
+    def split(leaf):
+        if leaf.shape[0] % n_stages:
+            raise ValueError(
+                f"layer axis {leaf.shape[0]} not divisible by "
+                f"{n_stages} pipeline stages"
+            )
+        return leaf.reshape(n_stages, leaf.shape[0] // n_stages, *leaf.shape[1:])
+
+    return jax.tree_util.tree_map(split, params)
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    microbatches: jax.Array,
+    *,
+    axis_name: str = "pipe",
+) -> jax.Array:
+    """Per-shard GPipe schedule — call under ``shard_map``.
+
+    ``stage_params`` is ONE stage's slice (the shard_map in_spec consumes
+    the stacked leading axis); ``microbatches`` is ``(M, ...)`` and must be
+    identical on every stage (replicated over the pipe axis).
+    ``stage_fn(stage_params, x) -> y`` must preserve ``x``'s shape.
+    Returns this stage's ``(M, ...)`` outputs — only the LAST stage's are
+    the pipeline's outputs (the wrapper selects them).
+    """
+    n_stages = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    n_micro = microbatches.shape[0]
+    ticks = n_micro + n_stages - 1
+
+    def tick(carry, t):
+        recv, outputs = carry
+        # Stage 0 draws from the microbatch queue; later stages consume the
+        # activation their predecessor pushed last tick.
+        feed_idx = jnp.clip(t, 0, n_micro - 1)
+        feed = lax.dynamic_index_in_dim(microbatches, feed_idx, keepdims=False)
+        x = jnp.where(stage == 0, feed, recv)
+        y = stage_fn(stage_params, x)
+        # A completed microbatch leaves the last stage at tick t with index
+        # t - (S-1); edge ticks (the bubble) write nothing.
+        out_idx = t - (n_stages - 1)
+        valid = jnp.logical_and(out_idx >= 0, out_idx < n_micro)
+        updated = lax.dynamic_update_index_in_dim(
+            outputs, y, jnp.clip(out_idx, 0, n_micro - 1), axis=0
+        )
+        outputs = jnp.where(valid, updated, outputs)
+        # One neighbor hop: stage s hands its activation to s+1 (the wrap
+        # to stage 0 carries no meaning; stage 0 never reads recv).
+        recv = lax.ppermute(
+            y, axis_name,
+            [(i, (i + 1) % n_stages) for i in range(n_stages)],
+        )
+        return (recv, outputs), ()
+
+    recv0 = jnp.zeros_like(microbatches[0])
+    outputs0 = jnp.zeros_like(microbatches)
+    (_, outputs), _ = lax.scan(tick, (recv0, outputs0), jnp.arange(ticks))
+    return outputs
+
+
+def pipelined(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    mesh: Mesh,
+    *,
+    axis_name: str = "pipe",
+    batch_axes: tuple[str, ...] = ("data", "fsdp"),
+) -> Callable[[Any, jax.Array], jax.Array]:
+    """Wrap ``stage_fn`` into a pipeline over ``mesh``'s ``axis_name`` axis.
+
+    Returns ``fn(stacked_params, microbatches) -> outputs`` operating on
+    global arrays: ``stacked_params`` has a leading ``n_stages`` axis
+    (sharded over the pipe axis — each device materialises only its
+    stage), ``microbatches`` is ``(M, B, ...)`` with ``B`` sharded over the
+    data axes and everything replicated over pipe.  Composes with dp/fsdp
+    in the same shard_map.
+    """
+    batch_axes = tuple(a for a in batch_axes if a in mesh.shape)
+    if axis_name not in mesh.shape:
+        raise ValueError(f"mesh has no axis {axis_name!r}: {dict(mesh.shape)}")
+
+    def body(stage_params, microbatches):
+        # The pipe-sharded in_spec leaves a singleton stage axis on every
+        # leaf; stage_fn works with its own stage's params directly.
+        stage_params = jax.tree_util.tree_map(
+            lambda leaf: jnp.squeeze(leaf, axis=0), stage_params
+        )
+        outputs = pipeline_apply(
+            stage_fn, stage_params, microbatches, axis_name=axis_name
+        )
+        # Every stage produced an (M, ...) buffer; only the last stage's is
+        # the pipeline output.  Broadcast it so the result is replicated
+        # over pipe (valid under any later collective or host fetch).
+        return _broadcast_from_last(outputs, axis_name)
+
+    def fn(stacked_params, microbatches):
+        params_spec = jax.tree_util.tree_map(
+            lambda _: P(axis_name), stacked_params
+        )
+        mb_spec = P(None, batch_axes) if batch_axes else P()
+        return jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(params_spec, mb_spec),
+            out_specs=mb_spec,
+            check_vma=False,
+        )(stacked_params, microbatches)
+
+    return fn
+
+
+def _broadcast_from_last(x: jax.Array, axis_name: str) -> jax.Array:
+    """Every stage gets the last stage's value (psum of a one-hot mask)."""
+    n = lax.axis_size(axis_name)
+    is_last = (lax.axis_index(axis_name) == n - 1).astype(x.dtype)
+    return lax.psum(x * is_last, axis_name)

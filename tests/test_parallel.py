@@ -26,7 +26,7 @@ from covalent_tpu_plugin.parallel.mesh import AXES
 def test_mesh_plan_and_axes():
     mesh = make_mesh(MeshPlan(data=2, fsdp=2, tensor=2))
     assert mesh.axis_names == AXES
-    assert mesh.shape == {"data": 2, "fsdp": 2, "tensor": 2, "seq": 1}
+    assert mesh.shape == {"data": 2, "fsdp": 2, "tensor": 2, "seq": 1, "pipe": 1}
 
 
 def test_mesh_plan_wrong_device_count():
@@ -41,7 +41,7 @@ def test_auto_mesh_defaults_to_data_parallel():
 
 def test_auto_mesh_with_model_axes():
     mesh = auto_mesh(tensor=2, seq=2)
-    assert mesh.shape == {"data": 2, "fsdp": 1, "tensor": 2, "seq": 2}
+    assert mesh.shape == {"data": 2, "fsdp": 1, "tensor": 2, "seq": 2, "pipe": 1}
     with pytest.raises(ValueError, match="not divisible"):
         auto_mesh(tensor=3)
 
