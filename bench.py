@@ -607,21 +607,31 @@ async def main() -> None:
     stop = asyncio.Event()
     tailer = asyncio.create_task(tail_progress(progress_path, collected, stop))
     try:
-        await asyncio.wait_for(
-            executor.run(
-                accelerator_electron,
-                [progress_path, TPU_BUDGET_S - 15.0],
-                {},
-                {"dispatch_id": "accel", "node_id": 0},
-            ),
-            TPU_BUDGET_S,
-        )
-    except Exception as error:  # noqa: BLE001
-        emit({"phase": "tpu", "error": repr(error)})
-        try:
-            await asyncio.wait_for(executor.cancel(), 10)
-        except Exception:  # noqa: BLE001
-            pass
+        # Two attempts: the experimental PJRT backend's init occasionally
+        # hangs outright (fresh subprocess = fresh tunnel connection).  A
+        # retry only makes sense when the first attempt produced NOTHING —
+        # if init succeeded, the budget is simply spent.
+        for attempt, budget in enumerate((TPU_BUDGET_S, TPU_BUDGET_S / 2)):
+            try:
+                await asyncio.wait_for(
+                    executor.run(
+                        accelerator_electron,
+                        [progress_path, budget - 15.0],
+                        {},
+                        {"dispatch_id": f"accel{attempt}", "node_id": 0},
+                    ),
+                    budget,
+                )
+                break
+            except Exception as error:  # noqa: BLE001
+                emit({"phase": "tpu", "attempt": attempt, "error": repr(error)})
+                try:
+                    await asyncio.wait_for(executor.cancel(), 10)
+                except Exception:  # noqa: BLE001
+                    pass
+                await asyncio.sleep(1)  # let the tailer drain partial lines
+                if "init" in collected:
+                    break  # backend came up; a rerun can't buy time back
     finally:
         stop.set()
         try:
