@@ -10,6 +10,7 @@ a pod.
 
 from .data import synthetic_lm_batch, synthetic_lm_batches
 from .decode import generate, init_cache
+from .moe import MoEMlp, lm_loss_with_moe_aux
 from .pipeline_lm import pipeline_lm_forward, pipeline_lm_loss
 from .mlp import MLP, MnistCNN, synthetic_mnist
 from .transformer import TransformerConfig, TransformerLM, lm_125m_config
@@ -31,6 +32,8 @@ __all__ = [
     "synthetic_lm_batches",
     "generate",
     "init_cache",
+    "MoEMlp",
+    "lm_loss_with_moe_aux",
     "pipeline_lm_forward",
     "pipeline_lm_loss",
     "TransformerConfig",

@@ -1,0 +1,140 @@
+"""Mixture-of-experts MLP: Switch-style top-1 routing, einsum dispatch.
+
+The TPU-native MoE formulation (Mesh-TensorFlow lineage): routing becomes
+dense one-hot dispatch/combine einsums over a capacity-bounded buffer —
+no gathers, no dynamic shapes, so XLA tiles everything onto the MXU and,
+with the ``expert`` logical axis mapped to a mesh axis, inserts the
+expert-parallel all-to-alls automatically from the shardings (the
+scaling-book recipe; nothing here hand-writes a collective).
+
+Semantics (Switch Transformer):
+  * top-1 routing with softmax gate scaling;
+  * per-call capacity ``C = ceil(capacity_factor * N / E)`` over the
+    flattened token set; tokens over capacity are *dropped* — they
+    contribute zero from the expert layer and ride the residual;
+  * the standard load-balance auxiliary loss is sown into the
+    ``"intermediates"`` collection (``moe_aux``) for the loss function to
+    collect (:func:`lm_loss_with_moe_aux`).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MoEMlp(nn.Module):
+    """Drop-in MLP replacement: route each token to one of ``n_experts``."""
+
+    config: object  # TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        n_experts = cfg.moe_experts
+        batch, seq_len, d_model = x.shape
+        n_tokens = batch * seq_len
+        capacity = int(
+            -(-cfg.moe_capacity_factor * n_tokens // n_experts)  # ceil
+        )
+        capacity = max(1, min(capacity, n_tokens))
+
+        router = nn.DenseGeneral(
+            features=n_experts,
+            use_bias=False,
+            dtype=jnp.float32,  # routing decisions in f32, always
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_partitioning(
+                nn.initializers.normal(0.02), ("embed", None)
+            ),
+            name="router",
+        )
+        tokens = x.reshape(n_tokens, d_model)
+        gates = jax.nn.softmax(router(tokens.astype(jnp.float32)), axis=-1)
+        expert_index = jnp.argmax(gates, axis=-1)                 # (N,)
+        expert_gate = jnp.max(gates, axis=-1)                     # (N,)
+        expert_onehot = jax.nn.one_hot(expert_index, n_experts)   # (N, E)
+
+        # Load-balance aux (Switch eq. 4): E * sum_e f_e * P_e, minimised
+        # at uniform routing where it equals 1.
+        fraction = expert_onehot.mean(axis=0)
+        prob_mass = gates.mean(axis=0)
+        self.sow(
+            "intermediates", "moe_aux",
+            n_experts * jnp.sum(fraction * prob_mass),
+        )
+
+        # Position of each token within its expert's capacity buffer; the
+        # cumsum is over the flat token order (deterministic priority).
+        position = jnp.cumsum(expert_onehot, axis=0) * expert_onehot - 1.0
+        kept = (position >= 0) & (position < capacity)
+        position = jnp.clip(position, 0, capacity - 1).astype(jnp.int32)
+        # Dispatch tensor (N, E, C): one-hot in both expert and slot.
+        dispatch = (
+            expert_onehot[:, :, None]
+            * jax.nn.one_hot(position, capacity)
+            * kept[:, :, None]
+        ).astype(cfg.dtype)
+
+        expert_in = jnp.einsum(
+            "nec,nd->ecd", dispatch, tokens.astype(cfg.dtype)
+        )
+        wi = self.param(
+            "wi",
+            nn.with_partitioning(
+                nn.initializers.normal(0.02), ("expert", "embed", "expert_mlp")
+            ),
+            (n_experts, d_model, cfg.d_ff),
+            cfg.param_dtype,
+        )
+        wo = self.param(
+            "wo",
+            nn.with_partitioning(
+                nn.initializers.normal(0.02 / (2 * cfg.n_layers) ** 0.5),
+                ("expert", "expert_mlp", "embed"),
+            ),
+            (n_experts, cfg.d_ff, d_model),
+            cfg.param_dtype,
+        )
+        h = jnp.einsum("ecd,edf->ecf", expert_in, wi.astype(cfg.dtype))
+        h = nn.gelu(h)
+        expert_out = jnp.einsum("ecf,efd->ecd", h, wo.astype(cfg.dtype))
+
+        # Combine: gate-scaled return trip; dropped tokens get zero (their
+        # dispatch row is all-zero) and survive through the residual.
+        combine = dispatch * expert_gate[:, None, None].astype(cfg.dtype)
+        out = jnp.einsum("nec,ecd->nd", combine, expert_out)
+        out = out.reshape(batch, seq_len, d_model)
+        return nn.with_logical_constraint(out, ("batch", "seq", "embed"))
+
+
+def collect_moe_aux(intermediates) -> jax.Array:
+    """Sum every sown ``moe_aux`` scalar in an intermediates collection.
+
+    Filters by key so unrelated sown diagnostics can never leak into the
+    training loss.
+    """
+    total = jnp.zeros((), jnp.float32)
+    flat = jax.tree_util.tree_flatten_with_path(intermediates)[0]
+    for path, leaf in flat:
+        if any(getattr(entry, "key", None) == "moe_aux" for entry in path):
+            total = total + jnp.sum(leaf)
+    return total
+
+
+def lm_loss_with_moe_aux(params, apply_fn, batch, aux_weight: float = 0.01):
+    """Next-token loss + weighted MoE load-balance loss.
+
+    Use in place of :func:`..train.lm_loss` for MoE configs; works with
+    ``make_train_step`` unchanged.
+    """
+    from .train import cross_entropy_loss
+
+    tokens = batch["tokens"]
+    logits, variables = apply_fn(
+        {"params": params}, tokens[:, :-1], mutable=["intermediates"]
+    )
+    loss = cross_entropy_loss(logits, tokens[:, 1:])
+    aux = collect_moe_aux(variables.get("intermediates", {}))
+    return loss + aux_weight * aux

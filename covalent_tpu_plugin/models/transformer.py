@@ -53,6 +53,11 @@ class TransformerConfig:
     #: incremental decoding: layers keep a (max_seq) K/V cache in the flax
     #: "cache" collection and consume one token slice per apply.
     decode: bool = False
+    #: mixture-of-experts: > 0 replaces every block's MLP with a Switch-
+    #: style top-1 MoE of that many experts (models/moe.py); the "expert"
+    #: logical axis shards them over the tensor mesh axis.
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
     remat: bool = False
     #: "full" recomputes everything in backward; "dots" saves matmul outputs
     #: (jax dots_with_no_batch_dims_saveable) — ~half the recompute FLOPs for
@@ -298,9 +303,13 @@ class Block(nn.Module):
         x = x + Attention(self.config, name="attention")(
             RMSNorm(self.config.dtype, name="ln_attn")(x)
         )
-        x = x + MlpBlock(self.config, name="mlp")(
-            RMSNorm(self.config.dtype, name="ln_mlp")(x)
-        )
+        if self.config.moe_experts > 0:
+            from .moe import MoEMlp
+
+            mlp = MoEMlp(self.config, name="moe")
+        else:
+            mlp = MlpBlock(self.config, name="mlp")
+        x = x + mlp(RMSNorm(self.config.dtype, name="ln_mlp")(x))
         return nn.with_logical_constraint(x, ("batch", "seq", "embed"))
 
 
@@ -339,7 +348,9 @@ class TransformerLM(nn.Module):
         if cfg.scan_layers:
             x, _ = nn.scan(
                 lambda module, carry, _: (module(carry), None),
-                variable_axes={"params": 0, "cache": 0},
+                # "intermediates" must be declared or scan silently drops
+                # sown values (the MoE load-balance aux loss rides there).
+                variable_axes={"params": 0, "cache": 0, "intermediates": 0},
                 split_rngs={"params": True},
                 length=cfg.n_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},

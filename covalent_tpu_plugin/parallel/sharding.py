@@ -29,6 +29,11 @@ DEFAULT_RULES: tuple[tuple[str, Any], ...] = (
     # sharding them like "heads" would demand impossible divisibility.
     ("kv_heads", None),
     ("kv", None),
+    # MoE: experts shard over tensor (expert parallelism — XLA inserts the
+    # all-to-alls from these shardings); the per-expert hidden dim must
+    # then stay unsharded, hence a distinct logical name from "mlp".
+    ("expert", "tensor"),
+    ("expert_mlp", None),
     ("mlp", "tensor"),
     ("vocab", "tensor"),
     ("layers", None),

@@ -71,6 +71,17 @@ def test_pipeline_composes_with_data_axis():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
+def test_pipeline_fewer_microbatches_than_stages():
+    """M < S (bubble-dominated edge): the diagonal schedule must still
+    deliver every microbatch's output."""
+    ws, micro, dense, stage_fn = toy_setup()
+    micro = micro[:2]  # M=2 over S=4 stages
+    mesh = make_mesh(MeshPlan(pipe=4))
+    out = pipelined(stage_fn, mesh)(pipeline_stages(ws, 4), micro)
+    ref = jnp.stack([dense(ws, micro[m]) for m in range(2)])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
 def test_pipeline_stages_validates_divisibility():
     ws = jnp.zeros((6, 4, 4))
     with pytest.raises(ValueError, match="divisible"):
