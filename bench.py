@@ -43,7 +43,13 @@ OVERHEAD_PROBES = 5
 # sub-phases, each of which self-skips as the electron's deadline nears.
 OVERHEAD_BUDGET_S = float(os.environ.get("BENCH_OVERHEAD_BUDGET_S", "60"))
 FANOUT_BUDGET_S = float(os.environ.get("BENCH_FANOUT_BUDGET_S", "45"))
-TPU_BUDGET_S = float(os.environ.get("BENCH_TPU_BUDGET_S", "240"))
+TPU_BUDGET_S = float(os.environ.get("BENCH_TPU_BUDGET_S", "300"))
+#: Persistent XLA compilation cache shared across bench runs (and with the
+#: driver's run): compiles over the tunneled backend cost tens of seconds
+#: each, and they dominate the accelerator-phase budget on a cold cache.
+JAX_CACHE_DIR = os.environ.get(
+    "JAX_COMPILATION_CACHE_DIR", "/tmp/covalent-tpu-jax-cache"
+)
 
 
 def emit(obj: dict) -> None:
@@ -72,6 +78,7 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
     progress = open(progress_path, "a", buffering=1)
 
     def report(subphase: str, **data):
+        data["at_s"] = round(time.monotonic() - t_start, 1)
         results[subphase] = data
         progress.write(json.dumps({"subphase": subphase, **data}) + "\n")
 
@@ -80,8 +87,23 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
 
     # -- backend init (the round-1 killer: measure it explicitly) ----------
     t0 = time.monotonic()
+    import os
+
     import jax
     import jax.numpy as jnp
+
+    try:  # persistent compile cache: tunnel compiles cost 10s of seconds.
+        # The env var is always supplied via task_env (JAX_CACHE_DIR at
+        # module level); the fallback only covers out-of-bench reuse.
+        cache_dir = os.environ["JAX_COMPILATION_CACHE_DIR"]
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        compile_cache = cache_dir
+    except Exception as error:  # noqa: BLE001 - cache is an optimisation,
+        # but a silent cold cache re-creates the budget overrun this fixes:
+        # surface the reason in the init line.
+        compile_cache = f"disabled: {error!r}"
 
     devices = jax.devices()
     device_kind = devices[0].device_kind
@@ -92,6 +114,7 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
         backend=backend,
         device_kind=device_kind,
         n_devices=len(devices),
+        compile_cache=compile_cache,
     )
 
     # Peak bf16 dense TFLOP/s per chip, for MFU (public spec sheets).
@@ -532,7 +555,8 @@ async def main() -> None:
         poll_freq=0.2,
         pool_preload="cloudpickle",
         task_env={
-            "PYTHONPATH": repo_root + os.pathsep + os.environ.get("PYTHONPATH", "")
+            "PYTHONPATH": repo_root + os.pathsep + os.environ.get("PYTHONPATH", ""),
+            "JAX_COMPILATION_CACHE_DIR": JAX_CACHE_DIR,
         },
     )
     emit({"phase": "start", "pid": os.getpid(), "budgets_s": {

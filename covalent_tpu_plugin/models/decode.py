@@ -48,6 +48,39 @@ def init_cache(model: TransformerLM, batch_size: int) -> Any:
     )
 
 
+def _filter_top_k(logits: jax.Array, top_k: int) -> jax.Array:
+    """Mask all but the ``top_k`` largest logits per row to NEG_INF.
+
+    ``jax.lax.top_k`` keeps the shape static, so the filter is jittable for
+    any fixed ``top_k``.
+    """
+    from ..ops.attention import NEG_INF
+
+    kth = jax.lax.top_k(logits, top_k)[0][..., -1:]  # (..., 1)
+    return jnp.where(logits < kth, NEG_INF, logits)
+
+
+def _filter_top_p(logits: jax.Array, top_p: float) -> jax.Array:
+    """Nucleus filter: keep the smallest prefix of the sorted distribution
+    whose cumulative probability reaches ``top_p``; mask the rest.
+
+    Static-shape formulation: sort once, compute the cumulative softmax
+    mass *before* each position, and mask tokens whose preceding mass
+    already covers ``top_p`` (the first token always survives).
+    """
+    from ..ops.attention import NEG_INF
+
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    mass_before = jnp.cumsum(probs, axis=-1) - probs
+    cutoff_idx = jnp.sum((mass_before < top_p).astype(jnp.int32), axis=-1)
+    # Logit value at the last kept (sorted) position is the threshold.
+    threshold = jnp.take_along_axis(
+        sorted_logits, jnp.maximum(cutoff_idx - 1, 0)[..., None], axis=-1
+    )
+    return jnp.where(logits < threshold, NEG_INF, logits)
+
+
 def generate(
     model: TransformerLM,
     params: Any,
@@ -55,13 +88,17 @@ def generate(
     max_new_tokens: int,
     temperature: float = 0.0,
     rng: jax.Array | None = None,
+    top_k: int | None = None,
+    top_p: float | None = None,
 ) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt`` ((B, P) int32).
 
     ``temperature=0`` is greedy argmax; otherwise softmax sampling at the
-    given temperature (requires ``rng``).  Returns the full (B, P+N) token
-    buffer.  Wrap in ``jax.jit`` for repeated use — everything inside is a
-    single compiled loop.
+    given temperature (requires ``rng``), optionally restricted to the
+    ``top_k`` highest logits and/or the ``top_p`` nucleus (applied in that
+    order, the HF/transformers convention).  Returns the full (B, P+N)
+    token buffer.  Wrap in ``jax.jit`` for repeated use — everything inside
+    is a single compiled loop.
     """
     decoder = _decode_model(model)
     config = decoder.config
@@ -72,10 +109,16 @@ def generate(
             f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
             f"exceeds config.max_seq ({config.max_seq})"
         )
-    if max_new_tokens <= 0:
-        return prompt.astype(jnp.int32)
     if temperature > 0 and rng is None:
         raise ValueError("sampling (temperature > 0) requires rng")
+    if temperature <= 0 and (top_k is not None or top_p is not None):
+        raise ValueError("top_k/top_p require sampling (temperature > 0)")
+    if top_k is not None and not 1 <= top_k <= config.vocab_size:
+        raise ValueError(f"top_k must be in [1, {config.vocab_size}], got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if max_new_tokens <= 0:
+        return prompt.astype(jnp.int32)
     if rng is None:
         rng = jax.random.PRNGKey(0)
 
@@ -86,10 +129,12 @@ def generate(
     def choose(step_logits, rng):
         rng, sample_key = jax.random.split(rng)
         if temperature > 0:
-            chosen = jax.random.categorical(
-                sample_key, step_logits.astype(jnp.float32) / temperature,
-                axis=-1,
-            )
+            scaled = step_logits.astype(jnp.float32) / temperature
+            if top_k is not None:
+                scaled = _filter_top_k(scaled, top_k)
+            if top_p is not None:
+                scaled = _filter_top_p(scaled, top_p)
+            chosen = jax.random.categorical(sample_key, scaled, axis=-1)
         else:
             chosen = jnp.argmax(step_logits.astype(jnp.float32), axis=-1)
         return chosen.astype(jnp.int32), rng

@@ -32,6 +32,7 @@ from ..ops.attention import (
     on_tpu,
 )
 from ..ops.ring_attention import sequence_parallel_attention
+from .moe import MoEMlp
 
 
 @dataclasses.dataclass(frozen=True)
@@ -304,8 +305,6 @@ class Block(nn.Module):
             RMSNorm(self.config.dtype, name="ln_attn")(x)
         )
         if self.config.moe_experts > 0:
-            from .moe import MoEMlp
-
             mlp = MoEMlp(self.config, name="moe")
         else:
             mlp = MlpBlock(self.config, name="mlp")

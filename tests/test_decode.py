@@ -147,3 +147,66 @@ def test_generate_rejects_overlong_and_missing_rng():
         generate(model, params, prompt, 10)
     with pytest.raises(ValueError, match="rng"):
         generate(model, params, prompt[:, :4], 2, temperature=0.5)
+
+
+def test_top_k_filter_masks_exactly_k():
+    from covalent_tpu_plugin.models.decode import _filter_top_k
+    from covalent_tpu_plugin.ops.attention import NEG_INF
+
+    logits = jnp.asarray([[1.0, 5.0, 3.0, 2.0], [0.0, -1.0, 4.0, 4.0]])
+    out = np.asarray(_filter_top_k(logits, 2))
+    neg = np.float32(NEG_INF)
+    np.testing.assert_array_equal(
+        out[0], np.asarray([neg, 5.0, 3.0, neg], np.float32)
+    )
+    # Row 2 has a tie at the kth value: both 4.0s survive the >= threshold.
+    np.testing.assert_array_equal(
+        out[1], np.asarray([neg, neg, 4.0, 4.0], np.float32)
+    )
+
+
+def test_top_p_filter_keeps_nucleus():
+    from covalent_tpu_plugin.models.decode import _filter_top_p
+
+    # softmax([2, 1, 0, -3]) ~ [0.662, 0.244, 0.090, 0.004]: top_p=0.6 keeps
+    # the first token only, 0.9 keeps two, 1.0 keeps everything.
+    logits = jnp.asarray([[2.0, 1.0, 0.0, -3.0]])
+    keep = lambda p: (np.asarray(_filter_top_p(logits, p)) > -1e29)[0]
+    np.testing.assert_array_equal(keep(0.6), [True, False, False, False])
+    np.testing.assert_array_equal(keep(0.9), [True, True, False, False])
+    np.testing.assert_array_equal(keep(1.0), [True, True, True, True])
+
+
+def test_top_k1_sampling_equals_greedy():
+    """top_k=1 collapses sampling to argmax whatever the temperature."""
+    model = TransformerLM(BASE)
+    prompt = jax.random.randint(jax.random.PRNGKey(9), (2, 4), 0, BASE.vocab_size)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    greedy = generate(model, params, prompt, 6)
+    sampled = generate(
+        model, params, prompt, 6, temperature=2.0,
+        rng=jax.random.PRNGKey(3), top_k=1,
+    )
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(sampled))
+
+
+def test_top_filters_are_jittable_and_validated():
+    model = TransformerLM(BASE)
+    prompt = jnp.zeros((1, 3), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    jitted = jax.jit(
+        lambda p, t, r: generate(
+            model, p, t, 5, temperature=0.8, rng=r, top_k=8, top_p=0.9
+        )
+    )
+    out = jitted(params, prompt, jax.random.PRNGKey(0))
+    assert out.shape == (1, 8)
+    assert 0 <= int(jnp.min(out)) and int(jnp.max(out)) < BASE.vocab_size
+    with pytest.raises(ValueError, match="top_k/top_p require"):
+        generate(model, params, prompt, 2, top_k=4)
+    with pytest.raises(ValueError, match="top_k must be"):
+        generate(model, params, prompt, 2, temperature=1.0,
+                 rng=jax.random.PRNGKey(0), top_k=0)
+    with pytest.raises(ValueError, match="top_p must be"):
+        generate(model, params, prompt, 2, temperature=1.0,
+                 rng=jax.random.PRNGKey(0), top_p=1.5)
