@@ -271,75 +271,6 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
     else:
         report("mnist", skipped="budget")
 
-    # -- 125M-class LM train step + MFU (BASELINE config 5's model, 1 chip) -
-    if remaining() > 75:
-        try:
-            import optax
-
-            from covalent_tpu_plugin.models.train import (
-                TrainState,
-                lm_loss,
-            )
-            from covalent_tpu_plugin.models.transformer import (
-                TransformerLM,
-                lm_125m_config,
-            )
-
-            if small:
-                bsz, seq = 2, 256
-                config = lm_125m_config(
-                    max_seq=seq, n_layers=2, d_model=256, n_heads=4,
-                    d_ff=1024, vocab_size=4096, remat=True,
-                )
-            else:
-                bsz, seq = 4, 1024
-                config = lm_125m_config(max_seq=seq, remat=True)
-            model = TransformerLM(config=config)
-            # seq+1 tokens: lm_loss shifts by one, so the model sees exactly
-            # `seq` positions (a tileable multiple of 128 for flash).
-            tokens = jax.random.randint(
-                jax.random.PRNGKey(0), (bsz, seq + 1), 0, config.vocab_size
-            )
-            params = model.init(jax.random.PRNGKey(1), tokens[:, :-1])["params"]
-            state = TrainState.create(
-                apply_fn=model.apply, params=params, tx=optax.adamw(3e-4)
-            )
-            n_params = model.parameter_count(params)
-
-            @jax.jit
-            def step(state, tokens):
-                loss, grads = jax.value_and_grad(
-                    lambda p: lm_loss(p, state.apply_fn, {"tokens": tokens})
-                )(state.params)
-                return state.apply_gradients(grads=grads), loss
-
-            holder = {"state": state}
-
-            def dispatch():
-                holder["state"], holder["loss"] = step(holder["state"], tokens)
-
-            def fetch():
-                holder["final"] = float(jax.device_get(holder["loss"]))
-
-            step_s = unit_seconds(dispatch, fetch, target_s=5.0, cap=10)
-            final_loss = holder["final"]
-            # 6ND for fwd+bwd (+ remat recompute ~ +1 fwd -> 8ND ceiling;
-            # report the standard 6ND so MFU is comparable across frameworks)
-            lm_tflops = 6 * n_params * bsz * seq / step_s / 1e12
-            report(
-                "lm_step",
-                n_params=n_params,
-                step_ms=round(step_s * 1e3, 1),
-                tokens_per_s=round(bsz * seq / step_s),
-                tflops_6nd=round(lm_tflops, 2),
-                mfu=mfu(lm_tflops),
-                final_loss=round(final_loss, 4),
-            )
-        except Exception as error:  # noqa: BLE001
-            report("lm_step", error=repr(error))
-    else:
-        report("lm_step", skipped="budget")
-
     # -- flash attention forward vs dense (long-context hot op) ------------
     if remaining() > 50:
         try:
@@ -462,6 +393,83 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
             report("flash_long", error=repr(error))
     else:
         report("flash_long", skipped="budget")
+
+    # -- 125M-class LM train step + MFU (BASELINE config 5's model, 1 chip) -
+    if remaining() > 75:
+        try:
+            import optax
+
+            from covalent_tpu_plugin.models.train import (
+                TrainState,
+                lm_loss,
+            )
+            from covalent_tpu_plugin.models.transformer import (
+                TransformerLM,
+                lm_125m_config,
+            )
+
+            # Sweep winner on v5e (benchmarks/LM_STEP_SWEEP.md): unrolled
+            # layers let XLA optimise across block boundaries (+33% over
+            # lax.scan), dots-remat recomputes only the cheap elementwise
+            # ops, and bsz 8 saturates the chip without b16's compile cost.
+            if small:
+                bsz, seq = 2, 256
+                config = lm_125m_config(
+                    max_seq=seq, n_layers=2, d_model=256, n_heads=4,
+                    d_ff=1024, vocab_size=4096, remat=True,
+                    remat_policy="dots", scan_layers=False,
+                )
+            else:
+                bsz, seq = 8, 1024
+                config = lm_125m_config(
+                    max_seq=seq, remat=True, remat_policy="dots",
+                    scan_layers=False,
+                )
+            model = TransformerLM(config=config)
+            # seq+1 tokens: lm_loss shifts by one, so the model sees exactly
+            # `seq` positions (a tileable multiple of 128 for flash).
+            tokens = jax.random.randint(
+                jax.random.PRNGKey(0), (bsz, seq + 1), 0, config.vocab_size
+            )
+            params = model.init(jax.random.PRNGKey(1), tokens[:, :-1])["params"]
+            state = TrainState.create(
+                apply_fn=model.apply, params=params, tx=optax.adamw(3e-4)
+            )
+            n_params = model.parameter_count(params)
+
+            @jax.jit
+            def step(state, tokens):
+                loss, grads = jax.value_and_grad(
+                    lambda p: lm_loss(p, state.apply_fn, {"tokens": tokens})
+                )(state.params)
+                return state.apply_gradients(grads=grads), loss
+
+            holder = {"state": state}
+
+            def dispatch():
+                holder["state"], holder["loss"] = step(holder["state"], tokens)
+
+            def fetch():
+                holder["final"] = float(jax.device_get(holder["loss"]))
+
+            step_s = unit_seconds(dispatch, fetch, target_s=5.0, cap=10)
+            final_loss = holder["final"]
+            # 6ND for fwd+bwd (+ remat recompute ~ +1 fwd -> 8ND ceiling;
+            # report the standard 6ND so MFU is comparable across frameworks)
+            lm_tflops = 6 * n_params * bsz * seq / step_s / 1e12
+            report(
+                "lm_step",
+                n_params=n_params,
+                step_ms=round(step_s * 1e3, 1),
+                tokens_per_s=round(bsz * seq / step_s),
+                tflops_6nd=round(lm_tflops, 2),
+                mfu=mfu(lm_tflops),
+                final_loss=round(final_loss, 4),
+            )
+        except Exception as error:  # noqa: BLE001
+            report("lm_step", error=repr(error))
+    else:
+        report("lm_step", skipped="budget")
 
     # -- 125M generation throughput (KV-cache decode) ----------------------
     if remaining() > 60:

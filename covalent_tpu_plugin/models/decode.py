@@ -109,8 +109,10 @@ def generate(
             f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
             f"exceeds config.max_seq ({config.max_seq})"
         )
-    if temperature > 0 and rng is None:
-        raise ValueError("sampling (temperature > 0) requires rng")
+    # Argument-shape validation fires even for max_new_tokens <= 0 (a bad
+    # combination is a caller bug worth surfacing); the rng requirement
+    # only applies when sampling will actually happen, preserving the
+    # original "zero new tokens is identity" contract.
     if temperature <= 0 and (top_k is not None or top_p is not None):
         raise ValueError("top_k/top_p require sampling (temperature > 0)")
     if top_k is not None and not 1 <= top_k <= config.vocab_size:
@@ -119,6 +121,8 @@ def generate(
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if max_new_tokens <= 0:
         return prompt.astype(jnp.int32)
+    if temperature > 0 and rng is None:
+        raise ValueError("sampling (temperature > 0) requires rng")
     if rng is None:
         rng = jax.random.PRNGKey(0)
 

@@ -1,0 +1,45 @@
+"""Live-Covalent smoke: one electron through a real Covalent server.
+
+The reference CI's strongest gate starts a Covalent server and imports the
+plugin through Covalent's own loader (reference .github/workflows/
+tests.yml:80-84); this script goes one step further and dispatches a
+1-electron lattice on ``executor="tpu"`` (resolved via the setup.py entry
+point) through that server.  It is NOT a pytest test: covalent is not
+installable in the sandbox (see tests/test_covalent_interop.py for the
+stub tier), so CI's optional `covalent-interop` job runs it directly
+after `covalent start -d`.
+
+Exit 0 = dispatch reached COMPLETED with the right result.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main() -> int:
+    import covalent as ct
+
+    # The loader gate: the entry point `tpu = covalent_tpu_plugin.tpu`
+    # must surface the class under covalent.executor.
+    from covalent.executor import TPUExecutor  # noqa: F401
+
+    executor = TPUExecutor(transport="local", poll_freq=0.5)
+
+    @ct.electron(executor=executor)
+    def square(x):
+        return x * x
+
+    @ct.lattice
+    def flow(x):
+        return square(x)
+
+    dispatch_id = ct.dispatch(flow)(7)
+    result = ct.get_result(dispatch_id, wait=True)
+    print("status:", result.status, "result:", result.result)
+    ok = str(result.status) == "COMPLETED" and result.result == 49
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
