@@ -9,7 +9,7 @@ a pod.
 """
 
 from .data import synthetic_lm_batch, synthetic_lm_batches
-from .decode import generate, init_cache
+from .decode import generate, inference_params, init_cache
 from .moe import MoEMlp, lm_loss_with_moe_aux
 from .pipeline_lm import pipeline_lm_forward, pipeline_lm_loss
 from .mlp import MLP, MnistCNN, synthetic_mnist
@@ -31,6 +31,7 @@ __all__ = [
     "synthetic_lm_batch",
     "synthetic_lm_batches",
     "generate",
+    "inference_params",
     "init_cache",
     "MoEMlp",
     "lm_loss_with_moe_aux",

@@ -48,6 +48,22 @@ def init_cache(model: TransformerLM, batch_size: int) -> Any:
     )
 
 
+def inference_params(params: Any) -> Any:
+    """Cast f32 master weights to bf16 for serving.
+
+    Decode steps are HBM-bandwidth-bound — every step re-reads the full
+    weight set — so halving the bytes is a direct speedup: measured +10%
+    tokens/s scanned and +48% with ``scan_layers=False`` on the v5e 125M
+    decode (benchmarks/DECODE_SWEEP.md).  Non-f32 leaves (e.g. int
+    embeddings) pass through untouched; training should keep the f32
+    masters, this is a serving-side copy.
+    """
+    return jax.tree_util.tree_map(
+        lambda p: p.astype(jnp.bfloat16) if p.dtype == jnp.float32 else p,
+        params,
+    )
+
+
 def _filter_top_k(logits: jax.Array, top_k: int) -> jax.Array:
     """Mask all but the ``top_k`` largest logits per row to NEG_INF.
 

@@ -87,6 +87,11 @@ _EXECUTOR_PLUGIN_DEFAULTS = {
     "max_connection_attempts": 5,
     "retry_wait_time": 5.0,
     "do_cleanup": True,
+    # Run cleanup as a background task after the result is returned: saves
+    # the rm round-trips (~3 ms/electron on the local transport, one SSH
+    # round-trip on pods) from the electron's critical path.  Off by
+    # default so run() returning implies the workdir contract is settled.
+    "defer_cleanup": False,
     "strict_host_keys": True,
     "coordinator_port": 8476,
     "task_timeout": 0.0,
@@ -177,6 +182,7 @@ class TPUExecutor(RemoteExecutor):
         max_connection_attempts: int | None = None,
         retry_wait_time: float | None = None,
         do_cleanup: bool | None = None,
+        defer_cleanup: bool | None = None,
         strict_host_keys: bool | None = None,
         coordinator_port: int | None = None,
         task_timeout: float | None = None,
@@ -223,6 +229,8 @@ class TPUExecutor(RemoteExecutor):
         )
         self.retry_wait_time = float(resolve(retry_wait_time, "retry_wait_time"))
         self.do_cleanup = bool(resolve(do_cleanup, "do_cleanup"))
+        self.defer_cleanup = bool(resolve(defer_cleanup, "defer_cleanup"))
+        self._cleanup_tasks: set[asyncio.Task] = set()
         self.strict_host_keys = bool(resolve(strict_host_keys, "strict_host_keys"))
         self.coordinator_port = int(resolve(coordinator_port, "coordinator_port"))
         self.task_timeout = float(resolve(task_timeout, "task_timeout"))
@@ -994,6 +1002,18 @@ class TPUExecutor(RemoteExecutor):
                     app_log.warning("cancel: could not kill %s on %s: %s", pid, address, err)
             self._active.pop(op_id, None)
 
+    async def _logged_cleanup(
+        self, conns: list[Transport], staged: StagedTask
+    ) -> None:
+        """Deferred-cleanup wrapper: nobody awaits the task's exception, so
+        a failure must reach the log (not just asyncio's GC warning)."""
+        try:
+            await self.cleanup(conns, staged)
+        except Exception as err:  # noqa: BLE001
+            app_log.warning(
+                "deferred cleanup for %s failed: %s", staged.operation_id, err
+            )
+
     async def cleanup(
         self, conns: list[Transport], staged: StagedTask
     ) -> None:
@@ -1019,7 +1039,7 @@ class TPUExecutor(RemoteExecutor):
                 files.append(staged.remote_result_file)
             else:
                 files.append(f"{staged.remote_result_file}.done.{process_id}")
-            result = await conn.run("rm -f " + " ".join(shlex.quote(p) for p in files))
+            result = await conn.remove(files)
             if result.exit_status != 0:
                 app_log.warning(
                     "cleanup on %s: %s", conn.address, result.stderr.strip()
@@ -1088,11 +1108,24 @@ class TPUExecutor(RemoteExecutor):
         self._owns_pool = True
         self._agents = {}
         self._agent_locks = {}
+        if self._cleanup_tasks:
+            # Old-loop tasks can't be awaited from here; the staged files
+            # they would have removed leak, so say so.
+            app_log.warning(
+                "dropping %d pending deferred-cleanup task(s) from the "
+                "previous event loop; their staged files may leak",
+                len(self._cleanup_tasks),
+            )
+        self._cleanup_tasks = set()
         self._preflighted.clear()
         self._bound_loop = loop
 
     async def close(self) -> None:
         """Release agent channels + pooled transports (once per executor)."""
+        pending = [t for t in self._cleanup_tasks if not t.done()]
+        if pending:
+            await asyncio.gather(*pending, return_exceptions=True)
+        self._cleanup_tasks.clear()
         for client in self._agents.values():
             if client is not None:
                 await client.close()
@@ -1227,7 +1260,16 @@ class TPUExecutor(RemoteExecutor):
 
             if self.do_cleanup:
                 with timer.stage("cleanup"):
-                    await self.cleanup(conns, staged)
+                    if self.defer_cleanup:
+                        # Result is in hand; the rm round-trips happen off
+                        # the critical path.  close() drains stragglers.
+                        task = asyncio.create_task(
+                            self._logged_cleanup(conns, staged)
+                        )
+                        self._cleanup_tasks.add(task)
+                        task.add_done_callback(self._cleanup_tasks.discard)
+                    else:
+                        await self.cleanup(conns, staged)
 
             if exception is not None:
                 # Re-raise the remote exception locally (ssh.py:581-583);

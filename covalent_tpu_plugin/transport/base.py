@@ -56,6 +56,18 @@ class Transport(ABC):
     async def close(self) -> None:
         """Release the channel (idempotent)."""
 
+    async def remove(self, paths: list[str]) -> CommandResult:
+        """Best-effort delete of worker-side files (cleanup hot path).
+
+        Default rides ``run("rm -f ...")`` — one round-trip on remote
+        backends, matching the reference's cleanup (ssh.py:313-315).
+        Backends with direct filesystem access override this to skip the
+        shell entirely (a ``/bin/sh`` spawn costs ~3 ms per electron).
+        """
+        import shlex
+
+        return await self.run("rm -f " + " ".join(shlex.quote(p) for p in paths))
+
     async def start_process(self, command: str, describe: str = ""):
         """Start a long-lived remote process with piped stdin/stdout.
 

@@ -11,6 +11,7 @@ config 1.
 from __future__ import annotations
 
 import asyncio
+import os
 import shutil
 
 from .base import CommandResult, Transport, TransportError
@@ -54,6 +55,30 @@ class LocalTransport(Transport):
         return await start_local_process(
             ["/bin/sh", "-c", f"exec {command}"],
             describe or f"local:{command.split()[0]}",
+        )
+
+    async def remove(self, paths: list[str]) -> CommandResult:
+        """Direct unlink — no shell spawn on the cleanup hot path.
+
+        Mirrors ``rm -f``: missing files are fine, other per-path failures
+        (permissions, a directory) don't stop the batch and surface as a
+        nonzero exit + stderr so the caller's warning path fires.
+        """
+
+        def unlink_all() -> list[str]:
+            errors = []
+            for path in paths:
+                try:
+                    os.remove(path)
+                except FileNotFoundError:
+                    pass
+                except OSError as err:
+                    errors.append(f"{path}: {err}")
+            return errors
+
+        errors = await asyncio.to_thread(unlink_all)
+        return CommandResult(
+            exit_status=1 if errors else 0, stdout="", stderr="; ".join(errors)
         )
 
     async def put(self, local_path: str, remote_path: str) -> None:

@@ -210,3 +210,19 @@ def test_top_filters_are_jittable_and_validated():
     with pytest.raises(ValueError, match="top_p must be"):
         generate(model, params, prompt, 2, temperature=1.0,
                  rng=jax.random.PRNGKey(0), top_p=1.5)
+
+
+def test_inference_params_casts_only_f32():
+    from covalent_tpu_plugin.models import inference_params
+
+    model = TransformerLM(BASE)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    cast = inference_params({"w": params, "step": jnp.zeros((), jnp.int32)})
+    leaves = jax.tree_util.tree_leaves(cast["w"])
+    assert all(leaf.dtype == jnp.bfloat16 for leaf in leaves)
+    assert cast["step"].dtype == jnp.int32  # non-f32 passthrough
+    # Generation still runs end to end on the serving copy.
+    out = generate(model, cast["w"], prompt, 4)
+    assert out.shape == (1, 8)
+    assert 0 <= int(jnp.min(out)) and int(jnp.max(out)) < BASE.vocab_size

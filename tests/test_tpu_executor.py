@@ -530,3 +530,23 @@ def test_profile_dir_absent_by_default(tmp_path):
 
     spec = json.load(open(staged.local_spec_files[0]))
     assert "profile_dir" not in spec
+
+
+def test_run_deferred_cleanup_completes_by_close(tmp_path, run_async):
+    """defer_cleanup: run() returns before the rm round-trips; close()
+    drains them, so by teardown the same artifacts are gone as in the
+    synchronous path."""
+    fake = FakeTransport(scripted_ok_responses())
+    fake.result_payload = (1, None)
+    ex = make_executor(tmp_path, fake, defer_cleanup=True)
+
+    async def flow():
+        out = await ex.run(lambda: None, [], {}, METADATA)
+        # Deferred task may not have run yet; close() must wait for it.
+        await ex.close()
+        return out
+
+    assert run_async(flow()) == 1
+    assert any(c.startswith("rm -f") for c in fake.commands)
+    assert not any((tmp_path / "cache").glob("function_*"))
+    assert "cleanup" in ex.last_timings

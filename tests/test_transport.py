@@ -7,6 +7,8 @@ success, immediate failure with ``retry_connect=False``, and exhausted
 retries.
 """
 
+import os
+
 import pytest
 
 from covalent_tpu_plugin.transport import (
@@ -157,3 +159,31 @@ def test_pool_discard_forces_redial(run_async):
 
     run_async(flow())
     assert len(created) == 2
+
+
+def test_local_remove_unlinks_without_shell(tmp_path, run_async):
+    t = LocalTransport()
+    paths = [str(tmp_path / f"f{i}") for i in range(3)]
+    for p in paths[:2]:
+        open(p, "w").close()
+    # Third path doesn't exist: remove must stay best-effort quiet.
+    result = run_async(t.remove(paths))
+    assert result.exit_status == 0
+    assert not any(os.path.exists(p) for p in paths)
+
+
+def test_base_remove_rides_run(run_async):
+    class Recorder(LocalTransport):
+        def __init__(self):
+            super().__init__()
+            self.commands = []
+
+        async def run(self, command, timeout=None):
+            self.commands.append(command)
+            return await super().run(command, timeout)
+
+    t = Recorder()
+    # Skip the subclass override to exercise the ABC's rm -f default.
+    run_async(Transport.remove(t, ["/tmp/does-not-exist-xyz", "a b.txt"]))
+    assert t.commands and t.commands[0].startswith("rm -f ")
+    assert "'a b.txt'" in t.commands[0]  # quoting
