@@ -522,6 +522,38 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
                 e2e_tokens_per_s=round(bsz * new_tokens / elapsed),
                 e2e_ms_per_new_token=round(elapsed / new_tokens * 1e3, 2),
             )
+
+            if remaining() > 30:
+                # Weight-only int8 serving (models/quant.py): halves the
+                # per-step HBM reads again on top of the bf16 cast.  Own
+                # try so a quant failure can't lose the bf16 line above.
+                try:
+                    from covalent_tpu_plugin.models import quantize_lm
+
+                    qmodel, qparams = quantize_lm(model, params)
+                    qparams = inference_params(qparams)
+                    qgen = jax.jit(
+                        lambda p, t: generate(
+                            qmodel, p, t, max_new_tokens=new_tokens
+                        )
+                    )
+                    jax.device_get(qgen(qparams, prompt)[0, -1])  # warm
+                    q_elapsed = float("inf")
+                    for _ in range(2):
+                        t0 = time.monotonic()
+                        out = qgen(qparams, prompt)
+                        jax.device_get(out[0, -1])
+                        q_elapsed = min(q_elapsed, time.monotonic() - t0)
+                    report(
+                        "lm_decode_int8",
+                        batch=bsz,
+                        tokens_per_s=round(bsz * new_tokens / q_elapsed),
+                        ms_per_new_token=round(q_elapsed / new_tokens * 1e3, 2),
+                    )
+                except Exception as error:  # noqa: BLE001
+                    report("lm_decode_int8", error=repr(error))
+            else:
+                report("lm_decode_int8", skipped="budget")
         except Exception as error:  # noqa: BLE001
             report("lm_decode", error=repr(error))
     else:
@@ -714,6 +746,7 @@ async def main() -> None:
         "lm125m_mfu": sub("lm_step", "mfu"),
         "lm125m_decode_tokens_per_s": sub("lm_decode", "e2e_tokens_per_s"),
         "lm125m_decode_ms_per_token": sub("lm_decode", "e2e_ms_per_new_token"),
+        "lm125m_decode_int8_tokens_per_s": sub("lm_decode_int8", "tokens_per_s"),
     }
     emit(final)
 

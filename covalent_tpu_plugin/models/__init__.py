@@ -12,6 +12,7 @@ from .data import synthetic_lm_batch, synthetic_lm_batches
 from .decode import generate, inference_params, init_cache
 from .moe import MoEMlp, lm_loss_with_moe_aux
 from .pipeline_lm import pipeline_lm_forward, pipeline_lm_loss
+from .quant import QuantDenseGeneral, quantize_lm
 from .mlp import MLP, MnistCNN, synthetic_mnist
 from .transformer import TransformerConfig, TransformerLM, lm_125m_config
 from .train import (
@@ -37,6 +38,8 @@ __all__ = [
     "lm_loss_with_moe_aux",
     "pipeline_lm_forward",
     "pipeline_lm_loss",
+    "QuantDenseGeneral",
+    "quantize_lm",
     "TransformerConfig",
     "TransformerLM",
     "lm_125m_config",

@@ -33,6 +33,7 @@ from ..ops.attention import (
 )
 from ..ops.ring_attention import sequence_parallel_attention
 from .moe import MoEMlp
+from .quant import dense_general
 
 
 @dataclasses.dataclass(frozen=True)
@@ -73,6 +74,10 @@ class TransformerConfig:
     #: device mesh: required for attention="ring"; with attention="flash"
     #: it switches the kernel to the shard_map (collective-free) path.
     mesh: Any = None
+    #: weight-only int8 serving: every dense layer stores an int8 kernel +
+    #: per-channel scale (models/quant.py).  Build via quantize_lm(), not
+    #: by hand — the param tree shape changes.
+    quantized: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -123,13 +128,14 @@ class Attention(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.config
-        dense = lambda name, features, axes: nn.DenseGeneral(  # noqa: E731
+        dense = lambda name, features, axes: dense_general(  # noqa: E731
+            cfg.quantized,
             features=features,
             axis=-1,
-            use_bias=False,
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
-            kernel_init=nn.with_partitioning(nn.initializers.normal(0.02), axes),
+            kernel_init=nn.initializers.normal(0.02),
+            kernel_axes=axes,
             name=name,
         )
         kv_heads = cfg.n_kv_heads or cfg.n_heads
@@ -186,18 +192,16 @@ class Attention(nn.Module):
 
     def _out_proj(self, out):
         cfg = self.config
-        return nn.DenseGeneral(
+        return dense_general(
+            cfg.quantized,
             features=cfg.d_model,
             axis=(-2, -1),
-            use_bias=False,
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
             # residual-output kernel: depth-scaled init (GPT-2 convention,
             # matching MlpBlock's wo) keeps residual-stream variance flat
-            kernel_init=nn.with_partitioning(
-                nn.initializers.normal(0.02 / (2 * cfg.n_layers) ** 0.5),
-                ("heads", "kv", "embed"),
-            ),
+            kernel_init=nn.initializers.normal(0.02 / (2 * cfg.n_layers) ** 0.5),
+            kernel_axes=("heads", "kv", "embed"),
             name="out_proj",
         )(out)
 
@@ -278,24 +282,24 @@ class MlpBlock(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.config
-        h = nn.DenseGeneral(
+        h = dense_general(
+            cfg.quantized,
             features=cfg.d_ff,
-            use_bias=False,
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
-            kernel_init=nn.with_partitioning(nn.initializers.normal(0.02), ("embed", "mlp")),
+            kernel_init=nn.initializers.normal(0.02),
+            kernel_axes=("embed", "mlp"),
             name="wi",
         )(x)
         h = nn.with_logical_constraint(h, ("batch", "seq", "mlp"))
         h = nn.gelu(h)
-        h = nn.DenseGeneral(
+        h = dense_general(
+            cfg.quantized,
             features=cfg.d_model,
-            use_bias=False,
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
-            kernel_init=nn.with_partitioning(
-                nn.initializers.normal(0.02 / (2 * cfg.n_layers) ** 0.5), ("mlp", "embed")
-            ),
+            kernel_init=nn.initializers.normal(0.02 / (2 * cfg.n_layers) ** 0.5),
+            kernel_axes=("mlp", "embed"),
             name="wo",
         )(h)
         return nn.with_logical_constraint(h, ("batch", "seq", "embed"))
@@ -364,12 +368,13 @@ class TransformerLM(nn.Module):
                 x = block_cls(cfg, name=f"layer_{i}")(x)
 
         x = RMSNorm(cfg.dtype, name="ln_final")(x)
-        logits = nn.DenseGeneral(
+        logits = dense_general(
+            cfg.quantized,
             features=cfg.vocab_size,
-            use_bias=False,
             dtype=cfg.logits_dtype,  # f32 default; bf16 for the MXU fast path
             param_dtype=cfg.param_dtype,
-            kernel_init=nn.with_partitioning(nn.initializers.normal(0.02), ("embed", "vocab")),
+            kernel_init=nn.initializers.normal(0.02),
+            kernel_axes=("embed", "vocab"),
             name="lm_head",
         )(x)
         return nn.with_logical_constraint(logits, ("batch", "seq", "vocab"))

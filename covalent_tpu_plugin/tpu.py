@@ -1123,8 +1123,19 @@ class TPUExecutor(RemoteExecutor):
     async def close(self) -> None:
         """Release agent channels + pooled transports (once per executor)."""
         pending = [t for t in self._cleanup_tasks if not t.done()]
-        if pending:
-            await asyncio.gather(*pending, return_exceptions=True)
+        loop = asyncio.get_running_loop()
+        current = [t for t in pending if t.get_loop() is loop]
+        if len(current) != len(pending):
+            # close() called from a fresh asyncio.run before any run():
+            # tasks bound to the old loop can't be awaited here (gather
+            # would raise), only dropped — same contract as the loop guard.
+            app_log.warning(
+                "dropping %d deferred-cleanup task(s) bound to a previous "
+                "event loop; their staged files may leak",
+                len(pending) - len(current),
+            )
+        if current:
+            await asyncio.gather(*current, return_exceptions=True)
         self._cleanup_tasks.clear()
         for client in self._agents.values():
             if client is not None:

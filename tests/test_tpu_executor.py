@@ -550,3 +550,21 @@ def test_run_deferred_cleanup_completes_by_close(tmp_path, run_async):
     assert any(c.startswith("rm -f") for c in fake.commands)
     assert not any((tmp_path / "cache").glob("function_*"))
     assert "cleanup" in ex.last_timings
+
+
+def test_close_on_new_loop_drops_stale_cleanup_tasks(tmp_path):
+    """defer_cleanup + successive asyncio.run(): close() on a fresh loop
+    must not crash on tasks bound to the old loop (it drops + warns)."""
+    import asyncio
+
+    fake = FakeTransport(scripted_ok_responses())
+    fake.result_payload = (1, None)
+    ex = make_executor(tmp_path, fake, defer_cleanup=True)
+
+    async def first():
+        return await ex.run(lambda: None, [], {}, METADATA)
+
+    assert asyncio.run(first()) == 1
+    # The deferred task (if still pending) now belongs to a closed loop.
+    asyncio.run(ex.close())  # must not raise
+    assert not ex._cleanup_tasks
