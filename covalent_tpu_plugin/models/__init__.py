@@ -12,6 +12,17 @@ from .data import synthetic_lm_batch, synthetic_lm_batches
 from .decode import generate, inference_params, init_cache
 from .moe import MoEMlp, lm_loss_with_moe_aux
 from .pipeline_lm import pipeline_lm_forward, pipeline_lm_loss
+from .lora import (
+    LoRATrainState,
+    add_lora,
+    lora_mask,
+    lora_optimizer,
+    lora_train_params,
+    make_lora_train_state,
+    make_lora_train_step,
+    merge_lora,
+    quantize_then_lora,
+)
 from .quant import QuantDenseGeneral, quantize_lm
 from .mlp import MLP, MnistCNN, synthetic_mnist
 from .transformer import TransformerConfig, TransformerLM, lm_125m_config
@@ -40,6 +51,15 @@ __all__ = [
     "pipeline_lm_loss",
     "QuantDenseGeneral",
     "quantize_lm",
+    "LoRATrainState",
+    "add_lora",
+    "lora_mask",
+    "lora_optimizer",
+    "lora_train_params",
+    "make_lora_train_state",
+    "make_lora_train_step",
+    "merge_lora",
+    "quantize_then_lora",
     "TransformerConfig",
     "TransformerLM",
     "lm_125m_config",

@@ -1,0 +1,359 @@
+"""LoRA / QLoRA fine-tuning over the transformer's dense layers.
+
+Low-rank adaptation (Hu et al. 2021): each targeted dense layer learns a
+rank-``r`` update ``y += (x @ A) @ B * (alpha / r)`` while the base kernel
+stays frozen.  ``B`` initialises to zero, so an adapted model is *exactly*
+the base model at step 0.  With ``quantized=True`` the frozen base kernel
+is the weight-only int8 form (models/quant.py) — QLoRA: full fine-tuning
+quality knobs at int8 serving memory.
+
+TPU notes: the adapter matmuls are rank-``r`` GEMMs that XLA fuses into
+the surrounding computation; the frozen int8 base rides the same
+in-register-cast path as serving.
+
+Two training styles:
+
+* **Float base** — the standard train step works unchanged with
+  :func:`lora_optimizer` (multi_transform routing frozen leaves to
+  ``set_to_zero``; do NOT use bare ``optax.masked``, which passes
+  unmasked gradients through unchanged and silently un-freezes the
+  base)::
+
+      lmodel, lparams = add_lora(model, params, rank=16)
+      tx = lora_optimizer(optax.adamw(1e-4), lparams)
+      ...train as usual...
+      merged = merge_lora(lmodel, lparams)   # plain-model params again
+
+* **int8 base (QLoRA)** — ``jax.grad`` refuses int8 inputs, so the step
+  must differentiate only the adapter leaves.  :func:`make_lora_train_step`
+  does the split/combine::
+
+      qlmodel, qlparams = quantize_then_lora(model, params, rank=16)
+      state = make_lora_train_state(qlparams, optax.adamw(1e-4))
+      step = make_lora_train_step(lm_loss, qlmodel.apply, optax.adamw(1e-4))
+      state, loss = step(state, batch)
+      params = lora_train_params(state)      # full tree for apply/generate
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from .quant import _as_tuple, quantize_array
+
+
+class LoRADenseGeneral(nn.Module):
+    """``nn.DenseGeneral`` twin with a trainable low-rank bypass.
+
+    Declares the base layer's own params (``kernel`` [+ ``scale`` when
+    ``quantized``]) plus ``lora_a``/``lora_b``, so a pretrained checkpoint
+    fills the base leaves 1:1 and the adapters start fresh.
+    """
+
+    features: Any
+    kernel_axes: Sequence[str]
+    rank: int
+    alpha: float = 16.0
+    axis: Any = -1
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    quantized: bool = False
+    kernel_init: Any = nn.initializers.normal(0.02)
+
+    @nn.compact
+    def __call__(self, x):
+        features = _as_tuple(self.features)
+        axis = tuple(a % x.ndim for a in _as_tuple(self.axis))
+        contract_shape = tuple(x.shape[a] for a in axis)
+        n_in = len(contract_shape)
+        kernel_axes = tuple(self.kernel_axes)
+        dims = ((axis, tuple(range(n_in))), ((), ()))
+        x = x.astype(self.dtype)
+
+        if self.quantized:
+            kernel = self.param(
+                "kernel",
+                nn.with_partitioning(nn.initializers.zeros_init(), kernel_axes),
+                contract_shape + features,
+                jnp.int8,
+            )
+            scale = self.param(
+                "scale",
+                nn.with_partitioning(
+                    nn.initializers.ones_init(), kernel_axes[n_in:]
+                ),
+                features,
+                self.param_dtype,
+            )
+            y = jax.lax.dot_general(x, kernel.astype(self.dtype), dims)
+            y = y * scale.astype(self.dtype)
+        else:
+            kernel = self.param(
+                "kernel",
+                nn.with_partitioning(self.kernel_init, kernel_axes),
+                contract_shape + features,
+                self.param_dtype,
+            )
+            y = jax.lax.dot_general(x, kernel.astype(self.dtype), dims)
+
+        # Adapters: A contracts like the kernel down to rank, B expands to
+        # the feature dims.  B starts at zero => adapted == base at step 0.
+        lora_a = self.param(
+            "lora_a",
+            nn.with_partitioning(
+                nn.initializers.normal(1.0 / self.rank),
+                kernel_axes[:n_in] + (None,),
+            ),
+            contract_shape + (self.rank,),
+            self.param_dtype,
+        )
+        lora_b = self.param(
+            "lora_b",
+            nn.with_partitioning(
+                nn.initializers.zeros_init(), (None,) + kernel_axes[n_in:]
+            ),
+            (self.rank,) + features,
+            self.param_dtype,
+        )
+        h = jax.lax.dot_general(x, lora_a.astype(self.dtype), dims)
+        update = jax.lax.dot_general(
+            h, lora_b.astype(self.dtype), (((h.ndim - 1,), (0,)), ((), ()))
+        )
+        return y + update * (self.alpha / self.rank)
+
+
+def add_lora(model, params, rank: int, alpha: float = 16.0):
+    """(lora model, lora params) from a trained LM.
+
+    The adapted config swaps targeted denses for :class:`LoRADenseGeneral`
+    (``lora_rank``/``lora_alpha``); base leaves copy from ``params``
+    (quantizing them first when the source model is already
+    ``quantized=True``-shaped is the caller's job — pass a quantized
+    model+params pair to get QLoRA), adapters materialise fresh from an
+    ``jax.eval_shape`` structure template (no base weights are ever
+    re-initialised).  Requires ``scan_layers=False`` like the quant path.
+    """
+    from .transformer import TransformerLM
+
+    config = model.config
+    if config.scan_layers:
+        raise ValueError("add_lora requires scan_layers=False")
+    if rank < 1:
+        raise ValueError(f"rank must be >= 1, got {rank}")
+    lmodel = TransformerLM(
+        dataclasses.replace(config, lora_rank=rank, lora_alpha=alpha)
+    )
+    template = unbox_params(
+        jax.eval_shape(
+            lambda: lmodel.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 1), jnp.int32)
+            )["params"]
+        )
+    )
+    base = unbox_params(params)
+    root_key = jax.random.PRNGKey(0)
+    counter = [0]
+
+    def fresh_adapter(name, shape, dtype):
+        counter[0] += 1
+        if name == "lora_b":
+            return jnp.zeros(shape, dtype)  # => identity at step 0
+        return (
+            jax.random.normal(jax.random.fold_in(root_key, counter[0]), shape)
+            / rank
+        ).astype(dtype)
+
+    def fill(template_node, base_node, path=()):
+        if not isinstance(template_node, dict):
+            return base_node
+        return {
+            key: (
+                fresh_adapter(key, template_node[key].shape,
+                              template_node[key].dtype)
+                if key in ("lora_a", "lora_b")
+                else fill(template_node[key], base_node[key], path + (key,))
+            )
+            for key in template_node
+        }
+
+    return lmodel, fill(template, base)
+
+
+def lora_mask(params) -> Any:
+    """Pytree of bools: True on adapter leaves — for ``optax.masked``."""
+
+    def rec(tree, in_adapter):
+        if isinstance(tree, dict):
+            return {
+                key: rec(value, in_adapter or key in ("lora_a", "lora_b"))
+                for key, value in tree.items()
+            }
+        return in_adapter
+
+    return rec(params, False)
+
+
+def lora_optimizer(inner, params):
+    """Optax transform training ONLY the adapters; the base is frozen.
+
+    ``optax.multi_transform`` routes adapter leaves to ``inner`` and
+    everything else to ``set_to_zero`` — the safe formulation (bare
+    ``optax.masked(inner, mask)`` leaves unmasked gradients untouched and
+    silently un-freezes the base).
+    """
+    import optax
+
+    labels = jax.tree_util.tree_map(
+        lambda is_adapter: "lora" if is_adapter else "frozen",
+        lora_mask(params),
+    )
+    return optax.multi_transform(
+        {"lora": inner, "frozen": optax.set_to_zero()}, labels
+    )
+
+
+def merge_lora(model, params):
+    """Fold the adapters into plain dense kernels.
+
+    Returns (plain model, plain params): ``kernel += A @ B * alpha/r``.
+    Refuses quantized bases — folding a float update into an int8 kernel
+    would requantize; dequantize-merge-requantize explicitly if wanted.
+    """
+    from .transformer import TransformerLM
+
+    config = model.config
+    if config.quantized:
+        raise ValueError("merge_lora requires a float base (quantized=False)")
+    if not config.lora_rank:
+        raise ValueError("model has no adapters (lora_rank=0)")
+    scaling = config.lora_alpha / config.lora_rank
+    plain = TransformerLM(
+        dataclasses.replace(config, lora_rank=0, lora_alpha=16.0)
+    )
+
+    def rec(tree):
+        if not isinstance(tree, dict):
+            return tree
+        if "lora_a" in tree:
+            a32 = tree["lora_a"].astype(jnp.float32)
+            b32 = tree["lora_b"].astype(jnp.float32)
+            n_in = a32.ndim - 1
+            update = jax.lax.dot_general(
+                a32, b32, (((n_in,), (0,)), ((), ()))
+            )
+            kernel = tree["kernel"]
+            merged = (kernel.astype(jnp.float32) + update * scaling).astype(
+                kernel.dtype
+            )
+            return {
+                key: (merged if key == "kernel" else value)
+                for key, value in tree.items()
+                if key not in ("lora_a", "lora_b")
+            }
+        return {key: rec(value) for key, value in tree.items()}
+
+    return plain, rec(unbox_params(params))
+
+
+def unbox_params(tree):
+    """Strip flax ``Partitioned`` boxes (shared with the quant path)."""
+    return jax.tree_util.tree_map(
+        lambda leaf: leaf.value if isinstance(leaf, nn.Partitioned) else leaf,
+        tree,
+        is_leaf=lambda leaf: isinstance(leaf, nn.Partitioned),
+    )
+
+
+def quantize_then_lora(model, params, rank: int, alpha: float = 16.0):
+    """QLoRA in one call: int8-freeze the base, then attach adapters."""
+    from .quant import quantize_lm
+
+    qmodel, qparams = quantize_lm(model, params)
+    return add_lora(qmodel, qparams, rank=rank, alpha=alpha)
+
+
+# --------------------------------------------------------------------- #
+# Adapter-only train step (required for QLoRA: jax.grad refuses int8    #
+# inputs, so the frozen base must stay outside the differentiated tree) #
+# --------------------------------------------------------------------- #
+
+
+@struct.dataclass
+class LoRATrainState:
+    """Adapters (trainable), frozen base leaves, and the optimizer state.
+
+    ``mask``/``treedef`` are static: they record where each flattened leaf
+    belongs so :func:`lora_train_params` can reassemble the full tree.
+    """
+
+    adapters: Any
+    frozen: Any
+    opt_state: Any
+    mask: Any = struct.field(pytree_node=False)
+    treedef: Any = struct.field(pytree_node=False)
+
+
+def _combine(adapters, frozen, mask, treedef):
+    it = iter(adapters)
+    leaves = [next(it) if m else f for f, m in zip(frozen, mask)]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def make_lora_train_state(params, tx) -> LoRATrainState:
+    """Split ``params`` into trainable adapters + frozen base."""
+    params = unbox_params(params)
+    mask = tuple(jax.tree_util.tree_leaves(lora_mask(params)))
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    if not any(mask):
+        raise ValueError("params carry no lora_a/lora_b leaves — add_lora first")
+    adapters = [leaf for leaf, m in zip(leaves, mask) if m]
+    # Frozen slots keep their leaf; adapter slots hold a placeholder that
+    # _combine never reads.
+    frozen = [None if m else leaf for leaf, m in zip(leaves, mask)]
+    return LoRATrainState(
+        adapters=adapters,
+        frozen=frozen,
+        opt_state=tx.init(adapters),
+        mask=mask,
+        treedef=treedef,
+    )
+
+
+def lora_train_params(state: LoRATrainState):
+    """The full parameter tree (for apply/generate/merge)."""
+    return _combine(state.adapters, state.frozen, state.mask, state.treedef)
+
+
+def make_lora_train_step(loss_fn, apply_fn, tx):
+    """Jitted step differentiating ONLY the adapters.
+
+    ``loss_fn(params, apply_fn, batch) -> scalar`` — same contract as
+    ``train.lm_loss``, so the existing losses drop in.  Works for float
+    and int8 (QLoRA) bases alike; the frozen leaves enter the forward as
+    plain inputs, never as differentiated arguments.
+    """
+    import optax
+
+    @jax.jit
+    def step(state: LoRATrainState, batch):
+        def inner(adapters):
+            params = _combine(adapters, state.frozen, state.mask, state.treedef)
+            return loss_fn(params, apply_fn, batch)
+
+        loss, grads = jax.value_and_grad(inner)(state.adapters)
+        updates, opt_state = tx.update(grads, state.opt_state, state.adapters)
+        return (
+            state.replace(
+                adapters=optax.apply_updates(state.adapters, updates),
+                opt_state=opt_state,
+            ),
+            loss,
+        )
+
+    return step

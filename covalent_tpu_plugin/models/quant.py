@@ -99,8 +99,29 @@ def dense_general(
     dtype=jnp.bfloat16,
     param_dtype=jnp.float32,
     name: str,
+    lora_rank: int = 0,
+    lora_alpha: float = 16.0,
 ):
-    """The transformer's one dense-layer factory: float or int8-serving."""
+    """The transformer's one dense-layer factory.
+
+    Float, int8-serving, or either with LoRA adapters on top — all four
+    combinations share param names, so checkpoints line up across modes.
+    """
+    if lora_rank:
+        from .lora import LoRADenseGeneral  # deferred: lora imports quant
+
+        return LoRADenseGeneral(
+            features=features,
+            kernel_axes=tuple(kernel_axes),
+            rank=lora_rank,
+            alpha=lora_alpha,
+            axis=axis,
+            dtype=dtype,
+            param_dtype=param_dtype,
+            quantized=quantized,
+            kernel_init=kernel_init,
+            name=name,
+        )
     if quantized:
         return QuantDenseGeneral(
             features=features,
@@ -159,6 +180,11 @@ def quantize_lm(model, params) -> tuple[Any, Any]:
         )
     if config.moe_experts:
         raise ValueError("quantize_lm does not support MoE models yet")
+    if config.lora_rank:
+        raise ValueError(
+            "quantize the base first, then attach adapters "
+            "(lora.quantize_then_lora)"
+        )
     qmodel = TransformerLM(dataclasses.replace(config, quantized=True))
 
     def unbox(tree):

@@ -78,6 +78,14 @@ class TransformerConfig:
     #: per-channel scale (models/quant.py).  Build via quantize_lm(), not
     #: by hand — the param tree shape changes.
     quantized: bool = False
+    #: LoRA fine-tuning (models/lora.py): > 0 attaches rank-r adapters to
+    #: the targeted denses.  Build via add_lora()/quantize_then_lora().
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+    #: which dense layers get adapters (attention + MLP, not the lm_head).
+    lora_targets: tuple = (
+        "q_proj", "k_proj", "v_proj", "out_proj", "wi", "wo",
+    )
 
     @property
     def head_dim(self) -> int:
@@ -137,6 +145,8 @@ class Attention(nn.Module):
             kernel_init=nn.initializers.normal(0.02),
             kernel_axes=axes,
             name=name,
+            lora_rank=cfg.lora_rank if name in cfg.lora_targets else 0,
+            lora_alpha=cfg.lora_alpha,
         )
         kv_heads = cfg.n_kv_heads or cfg.n_heads
         if cfg.n_heads % kv_heads:
@@ -203,6 +213,8 @@ class Attention(nn.Module):
             kernel_init=nn.initializers.normal(0.02 / (2 * cfg.n_layers) ** 0.5),
             kernel_axes=("heads", "kv", "embed"),
             name="out_proj",
+            lora_rank=cfg.lora_rank if "out_proj" in cfg.lora_targets else 0,
+            lora_alpha=cfg.lora_alpha,
         )(out)
 
     def _decode_step(self, q, k, v, kv_heads: int):
@@ -290,6 +302,8 @@ class MlpBlock(nn.Module):
             kernel_init=nn.initializers.normal(0.02),
             kernel_axes=("embed", "mlp"),
             name="wi",
+            lora_rank=cfg.lora_rank if "wi" in cfg.lora_targets else 0,
+            lora_alpha=cfg.lora_alpha,
         )(x)
         h = nn.with_logical_constraint(h, ("batch", "seq", "mlp"))
         h = nn.gelu(h)
@@ -301,6 +315,8 @@ class MlpBlock(nn.Module):
             kernel_init=nn.initializers.normal(0.02 / (2 * cfg.n_layers) ** 0.5),
             kernel_axes=("mlp", "embed"),
             name="wo",
+            lora_rank=cfg.lora_rank if "wo" in cfg.lora_targets else 0,
+            lora_alpha=cfg.lora_alpha,
         )(h)
         return nn.with_logical_constraint(h, ("batch", "seq", "embed"))
 
@@ -376,6 +392,8 @@ class TransformerLM(nn.Module):
             kernel_init=nn.initializers.normal(0.02),
             kernel_axes=("embed", "vocab"),
             name="lm_head",
+            lora_rank=cfg.lora_rank if "lm_head" in cfg.lora_targets else 0,
+            lora_alpha=cfg.lora_alpha,
         )(x)
         return nn.with_logical_constraint(logits, ("batch", "seq", "vocab"))
 

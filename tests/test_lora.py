@@ -1,0 +1,205 @@
+"""LoRA / QLoRA: exact-at-init, masked training, merge equivalence.
+
+The decisive properties: adapters with B=0 leave the model bit-identical
+to the base; optax.masked training moves ONLY the adapters; folding the
+adapters back in reproduces the adapted model with plain dense kernels.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from covalent_tpu_plugin.models import (
+    TransformerConfig,
+    TransformerLM,
+    add_lora,
+    lora_mask,
+    merge_lora,
+    quantize_then_lora,
+)
+from covalent_tpu_plugin.models.train import lm_loss
+
+BASE = TransformerConfig(
+    vocab_size=64,
+    d_model=32,
+    n_layers=2,
+    n_heads=4,
+    d_ff=64,
+    max_seq=32,
+    dtype=jnp.float32,
+    attention="reference",
+    scan_layers=False,
+)
+
+
+def setup(rank=4, cfg=BASE):
+    model = TransformerLM(cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 9), 0, cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    lmodel, lparams = add_lora(model, params, rank=rank)
+    return model, params, lmodel, lparams, tokens
+
+
+def test_lora_is_identity_at_init():
+    model, params, lmodel, lparams, tokens = setup()
+    base = model.apply({"params": params}, tokens)
+    adapted = lmodel.apply({"params": lparams}, tokens)
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(adapted))
+
+
+def test_lora_mask_marks_only_adapters():
+    _, _, _, lparams, _ = setup()
+    mask = lora_mask(lparams)
+    flat = jax.tree_util.tree_flatten_with_path(mask)[0]
+    adapters = [m for path, m in flat if any(
+        getattr(e, "key", None) in ("lora_a", "lora_b") for e in path)]
+    others = [m for path, m in flat if not any(
+        getattr(e, "key", None) in ("lora_a", "lora_b") for e in path)]
+    assert adapters and all(adapters)
+    assert others and not any(others)
+
+
+def test_masked_training_moves_only_adapters_and_learns():
+    from covalent_tpu_plugin.models.lora import lora_optimizer
+
+    _, _, lmodel, lparams, tokens = setup(rank=8)
+    tx = lora_optimizer(optax.adam(3e-2), lparams)
+    opt_state = tx.init(lparams)
+
+    @jax.jit
+    def step(params, opt_state):
+        loss, grads = jax.value_and_grad(
+            lambda p: lm_loss(p, lmodel.apply, {"tokens": tokens})
+        )(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    params = lparams
+    losses = []
+    for _ in range(12):
+        params, opt_state, loss = step(params, opt_state)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+    # Base leaves are untouched; adapter leaves moved.
+    flat_before = jax.tree_util.tree_flatten_with_path(lparams)[0]
+    flat_after = dict(jax.tree_util.tree_flatten_with_path(params)[0])
+    for path, before in flat_before:
+        after = flat_after[path]
+        is_adapter = any(
+            getattr(e, "key", None) in ("lora_a", "lora_b") for e in path
+        )
+        same = np.array_equal(np.asarray(before), np.asarray(after))
+        if is_adapter and "lora_b" in str(path):
+            assert not same, f"adapter {path} never trained"
+        if not is_adapter:
+            assert same, f"frozen leaf {path} moved"
+
+
+def test_merge_lora_reproduces_adapted_model():
+    _, _, lmodel, lparams, tokens = setup(rank=8)
+    # Nudge the adapters off zero so the merge is non-trivial.
+    lparams = jax.tree_util.tree_map_with_path(
+        lambda path, leaf: (
+            leaf + 0.01
+            if any(getattr(e, "key", None) == "lora_b" for e in path)
+            else leaf
+        ),
+        lparams,
+    )
+    adapted = lmodel.apply({"params": lparams}, tokens)
+    plain_model, plain_params = merge_lora(lmodel, lparams)
+    merged = plain_model.apply({"params": plain_params}, tokens)
+    np.testing.assert_allclose(
+        np.asarray(merged), np.asarray(adapted), atol=2e-5, rtol=2e-5
+    )
+    # The merged tree is a plain checkpoint: no adapter leaves anywhere.
+    assert not any(
+        getattr(e, "key", None) in ("lora_a", "lora_b")
+        for path, _ in jax.tree_util.tree_flatten_with_path(plain_params)[0]
+        for e in path
+    )
+
+
+def test_qlora_runs_and_starts_at_quant_baseline():
+    from covalent_tpu_plugin.models import quantize_lm
+
+    model = TransformerLM(BASE)
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (1, 7), 0, BASE.vocab_size)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    qmodel, qparams = quantize_lm(model, params)
+    qlmodel, qlparams = quantize_then_lora(model, params, rank=4)
+    np.testing.assert_array_equal(
+        np.asarray(qmodel.apply({"params": qparams}, tokens)),
+        np.asarray(qlmodel.apply({"params": qlparams}, tokens)),
+    )
+    # int8 base survived the adapter attach.
+    kernels = [
+        leaf
+        for path, leaf in jax.tree_util.tree_flatten_with_path(qlparams)[0]
+        if any(getattr(e, "key", None) == "kernel" for e in path)
+    ]
+    assert kernels and all(k.dtype == jnp.int8 for k in kernels)
+
+
+def test_add_lora_validation():
+    model = TransformerLM(dataclasses.replace(BASE, scan_layers=True))
+    tokens = jnp.zeros((1, 4), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    with pytest.raises(ValueError, match="scan_layers"):
+        add_lora(model, params, rank=4)
+    with pytest.raises(ValueError, match="rank"):
+        add_lora(TransformerLM(BASE), params, rank=0)
+
+
+def test_qlora_training_updates_only_adapters():
+    """The split train step differentiates only adapter leaves, so a
+    frozen int8 base trains without jax.grad's inexact-dtype error."""
+    import optax as _optax
+
+    from covalent_tpu_plugin.models import (
+        lora_train_params,
+        make_lora_train_state,
+        make_lora_train_step,
+    )
+
+    model = TransformerLM(BASE)
+    tokens = jax.random.randint(jax.random.PRNGKey(5), (2, 9), 0, BASE.vocab_size)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    qlmodel, qlparams = quantize_then_lora(model, params, rank=8)
+
+    tx = _optax.adam(3e-2)
+    state = make_lora_train_state(qlparams, tx)
+    step = make_lora_train_step(lm_loss, qlmodel.apply, tx)
+    losses = []
+    for _ in range(12):
+        state, loss = step(state, {"tokens": tokens})
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+    # Frozen int8 base untouched; the reassembled tree still applies.
+    out_params = lora_train_params(state)
+    kernels = [
+        leaf
+        for path, leaf in jax.tree_util.tree_flatten_with_path(out_params)[0]
+        if any(getattr(e, "key", None) == "kernel" for e in path)
+    ]
+    assert kernels and all(k.dtype == jnp.int8 for k in kernels)
+    out = qlmodel.apply({"params": out_params}, tokens)
+    assert np.isfinite(np.asarray(out, np.float32)).all()
+
+
+def test_make_lora_train_state_rejects_plain_params():
+    from covalent_tpu_plugin.models import make_lora_train_state
+    import optax as _optax
+
+    model = TransformerLM(BASE)
+    tokens = jnp.zeros((1, 4), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    with pytest.raises(ValueError, match="add_lora"):
+        make_lora_train_state(params, _optax.adam(1e-3))
