@@ -30,7 +30,7 @@ Two training styles:
 
       qlmodel, qlparams = quantize_then_lora(model, params, rank=16)
       state = make_lora_train_state(qlparams, optax.adamw(1e-4))
-      step = make_lora_train_step(lm_loss, qlmodel.apply, optax.adamw(1e-4))
+      step = make_lora_train_step(lm_loss, qlmodel.apply)
       state, loss = step(state, batch)
       params = lora_train_params(state)      # full tree for apply/generate
 """
@@ -45,7 +45,7 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
-from .quant import _as_tuple, quantize_array
+from .quant import _as_tuple
 
 
 class LoRADenseGeneral(nn.Module):
@@ -169,7 +169,7 @@ def add_lora(model, params, rank: int, alpha: float = 16.0):
             / rank
         ).astype(dtype)
 
-    def fill(template_node, base_node, path=()):
+    def fill(template_node, base_node):
         if not isinstance(template_node, dict):
             return base_node
         return {
@@ -177,7 +177,7 @@ def add_lora(model, params, rank: int, alpha: float = 16.0):
                 fresh_adapter(key, template_node[key].shape,
                               template_node[key].dtype)
                 if key in ("lora_a", "lora_b")
-                else fill(template_node[key], base_node[key], path + (key,))
+                else fill(template_node[key], base_node[key])
             )
             for key in template_node
         }
@@ -262,12 +262,10 @@ def merge_lora(model, params):
 
 
 def unbox_params(tree):
-    """Strip flax ``Partitioned`` boxes (shared with the quant path)."""
-    return jax.tree_util.tree_map(
-        lambda leaf: leaf.value if isinstance(leaf, nn.Partitioned) else leaf,
-        tree,
-        is_leaf=lambda leaf: isinstance(leaf, nn.Partitioned),
-    )
+    """Strip flax ``Partitioned`` boxes (delegates to the shared helper)."""
+    from ..parallel.sharding import unbox
+
+    return unbox(tree)
 
 
 def quantize_then_lora(model, params, rank: int, alpha: float = 16.0):
@@ -288,8 +286,11 @@ def quantize_then_lora(model, params, rank: int, alpha: float = 16.0):
 class LoRATrainState:
     """Adapters (trainable), frozen base leaves, and the optimizer state.
 
-    ``mask``/``treedef`` are static: they record where each flattened leaf
-    belongs so :func:`lora_train_params` can reassemble the full tree.
+    ``mask``/``treedef``/``tx`` are static: the first two record where
+    each flattened leaf belongs so :func:`lora_train_params` can
+    reassemble the full tree; carrying ``tx`` here means the step always
+    updates with the optimizer whose ``opt_state`` it holds (passing a
+    second, different tx to the step would silently win otherwise).
     """
 
     adapters: Any
@@ -297,6 +298,7 @@ class LoRATrainState:
     opt_state: Any
     mask: Any = struct.field(pytree_node=False)
     treedef: Any = struct.field(pytree_node=False)
+    tx: Any = struct.field(pytree_node=False)
 
 
 def _combine(adapters, frozen, mask, treedef):
@@ -322,6 +324,7 @@ def make_lora_train_state(params, tx) -> LoRATrainState:
         opt_state=tx.init(adapters),
         mask=mask,
         treedef=treedef,
+        tx=tx,
     )
 
 
@@ -330,13 +333,14 @@ def lora_train_params(state: LoRATrainState):
     return _combine(state.adapters, state.frozen, state.mask, state.treedef)
 
 
-def make_lora_train_step(loss_fn, apply_fn, tx):
+def make_lora_train_step(loss_fn, apply_fn):
     """Jitted step differentiating ONLY the adapters.
 
     ``loss_fn(params, apply_fn, batch) -> scalar`` — same contract as
     ``train.lm_loss``, so the existing losses drop in.  Works for float
     and int8 (QLoRA) bases alike; the frozen leaves enter the forward as
-    plain inputs, never as differentiated arguments.
+    plain inputs, never as differentiated arguments.  The optimizer is
+    the one carried by the state (:func:`make_lora_train_state`).
     """
     import optax
 
@@ -347,7 +351,9 @@ def make_lora_train_step(loss_fn, apply_fn, tx):
             return loss_fn(params, apply_fn, batch)
 
         loss, grads = jax.value_and_grad(inner)(state.adapters)
-        updates, opt_state = tx.update(grads, state.opt_state, state.adapters)
+        updates, opt_state = state.tx.update(
+            grads, state.opt_state, state.adapters
+        )
         return (
             state.replace(
                 adapters=optax.apply_updates(state.adapters, updates),

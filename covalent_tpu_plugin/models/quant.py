@@ -185,15 +185,9 @@ def quantize_lm(model, params) -> tuple[Any, Any]:
             "quantize the base first, then attach adapters "
             "(lora.quantize_then_lora)"
         )
+    from ..parallel.sharding import unbox
+
     qmodel = TransformerLM(dataclasses.replace(config, quantized=True))
-
-    def unbox(tree):
-        return jax.tree_util.tree_map(
-            lambda leaf: leaf.value if isinstance(leaf, nn.Partitioned) else leaf,
-            tree,
-            is_leaf=lambda leaf: isinstance(leaf, nn.Partitioned),
-        )
-
     template = unbox(
         jax.eval_shape(
             lambda: qmodel.init(

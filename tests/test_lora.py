@@ -174,7 +174,7 @@ def test_qlora_training_updates_only_adapters():
 
     tx = _optax.adam(3e-2)
     state = make_lora_train_state(qlparams, tx)
-    step = make_lora_train_step(lm_loss, qlmodel.apply, tx)
+    step = make_lora_train_step(lm_loss, qlmodel.apply)
     losses = []
     for _ in range(12):
         state, loss = step(state, {"tokens": tokens})
