@@ -24,6 +24,7 @@ from .lora import (
     quantize_then_lora,
 )
 from .quant import QuantDenseGeneral, quantize_lm
+from .speculative import speculative_generate
 from .mlp import MLP, MnistCNN, synthetic_mnist
 from .transformer import TransformerConfig, TransformerLM, lm_125m_config
 from .train import (
@@ -51,6 +52,7 @@ __all__ = [
     "pipeline_lm_loss",
     "QuantDenseGeneral",
     "quantize_lm",
+    "speculative_generate",
     "LoRATrainState",
     "add_lora",
     "lora_mask",

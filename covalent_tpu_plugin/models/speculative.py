@@ -1,0 +1,191 @@
+"""Greedy speculative decoding: draft proposes, target verifies in slabs.
+
+A small draft model decodes ``draft_len`` tokens autoregressively, then
+the target model scores all of them in ONE forward slab; the longest
+agreeing prefix is committed plus the target's correction at the first
+mismatch.  Every committed token is the target's own greedy choice, so
+the output is **bit-identical to ``generate(target, ...)``** — the draft
+only changes how many target forward passes are needed (one per round
+instead of one per token).  On TPU the verify slab is a k-token prefill,
+far better MXU utilisation than k single-token steps.
+
+Design notes (all static-shape, one jittable ``lax.while_loop``):
+
+* each round REWINDS both KV caches to the committed prefix by setting
+  their ``cache_index`` leaves — stale entries beyond the cursor are
+  overwritten before they can be read, so no cache copying happens;
+* no "bonus token" on full acceptance: a round commits at most
+  ``draft_len`` tokens.  This keeps every round's cursor arithmetic
+  identical (no lag/catch-up branches) at the cost of one extra round
+  per fully-accepted window;
+* batched prompts accept the MINIMUM match length across rows — still
+  exact (recomputed tokens are recomputed identically), just less
+  speedup when rows diverge;
+* greedy only: sampling would need rejection-sampling acceptance
+  (Leviathan et al. 2023) to stay distribution-exact.
+
+The reference has no serving path at all; this composes with the other
+serving modes (bf16 cast, int8 quant — any decode-capable model pair
+with one vocabulary works).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from .decode import _decode_model, init_cache
+from .transformer import TransformerLM
+
+
+def _set_cursor(cache: Any, value) -> Any:
+    """Return ``cache`` with every layer's ``cache_index`` set to value.
+
+    ``full_like`` keeps the leaf's shape: under scanned layers the cursor
+    is stacked per layer (shape ``(L,)``), unrolled it is a scalar.
+    """
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: (
+            jnp.full_like(leaf, value)
+            if any(getattr(e, "key", None) == "cache_index" for e in path)
+            else leaf
+        ),
+        cache,
+    )
+
+
+def speculative_generate(
+    target_model: TransformerLM,
+    target_params: Any,
+    draft_model: TransformerLM,
+    draft_params: Any,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    draft_len: int = 4,
+    return_stats: bool = False,
+):
+    """Greedy generation of ``max_new_tokens``; exact target output.
+
+    Returns the (B, P+N) buffer, plus ``{"rounds": ...}`` when
+    ``return_stats`` — target forward passes = rounds + 1 (prefill), vs
+    ``max_new_tokens`` for plain decoding; the ratio is the speculative
+    win at whatever agreement the draft earns.
+    """
+    if draft_len < 1:
+        raise ValueError(f"draft_len must be >= 1, got {draft_len}")
+    if target_model.config.vocab_size != draft_model.config.vocab_size:
+        raise ValueError("target and draft must share a vocabulary")
+    target = _decode_model(target_model)
+    draft = _decode_model(draft_model)
+    batch, prompt_len = prompt.shape
+    if max_new_tokens <= 0:  # identity, like generate(): no headroom needed
+        out = prompt.astype(jnp.int32)
+        return (out, {"rounds": jnp.zeros((), jnp.int32)}) if return_stats else out
+    total = prompt_len + max_new_tokens
+    # Verify slabs may scribble up to draft_len-1 positions past the
+    # committed end; both caches and the buffer carry that headroom.
+    headroom = total + draft_len
+    for name, model in (("target", target), ("draft", draft)):
+        if headroom > model.config.max_seq:
+            raise ValueError(
+                f"{name} max_seq {model.config.max_seq} < prompt + "
+                f"max_new_tokens + draft_len = {headroom}"
+            )
+
+    buffer = jnp.zeros((batch, headroom), jnp.int32)
+    buffer = jax.lax.dynamic_update_slice(buffer, prompt, (0, 0))
+
+    # Prefill both models; the target's prefill logits give token #1 (the
+    # same first token plain generate() emits).
+    t_cache = init_cache(target_model, batch)
+    d_cache = init_cache(draft_model, batch)
+    t_logits, mutated = target.apply(
+        {"params": target_params, "cache": t_cache}, prompt, mutable=["cache"]
+    )
+    t_cache = mutated["cache"]
+    _, mutated = draft.apply(
+        {"params": draft_params, "cache": d_cache}, prompt, mutable=["cache"]
+    )
+    d_cache = mutated["cache"]
+    first = jnp.argmax(t_logits[:, -1].astype(jnp.float32), axis=-1)
+    buffer = jax.lax.dynamic_update_slice(
+        buffer, first[:, None].astype(jnp.int32), (0, prompt_len)
+    )
+
+    k = draft_len
+
+    def draft_k(buffer, length, d_cache):
+        """k sequential draft steps from the committed prefix."""
+        d_cache = _set_cursor(d_cache, length - 1)
+        token0 = jax.lax.dynamic_slice(buffer, (0, length - 1), (batch, 1))
+
+        def body(_, carry):
+            d_cache, token, drafted = carry
+            logits, mutated = draft.apply(
+                {"params": draft_params, "cache": d_cache},
+                token,
+                mutable=["cache"],
+            )
+            nxt = jnp.argmax(
+                logits[:, -1].astype(jnp.float32), axis=-1
+            ).astype(jnp.int32)[:, None]
+            drafted = jnp.concatenate([drafted[:, 1:], nxt], axis=1)
+            return mutated["cache"], nxt, drafted
+
+        d_cache, _, drafted = jax.lax.fori_loop(
+            0, k, body, (d_cache, token0, jnp.zeros((batch, k), jnp.int32))
+        )
+        return d_cache, drafted  # (B, k): d_1..d_k
+
+    def round_body(carry):
+        buffer, n_generated, t_cache, d_cache, rounds = carry
+        length = prompt_len + n_generated  # committed tokens in buffer
+
+        d_cache, drafted = draft_k(buffer, length, d_cache)
+
+        # Target verifies the k candidates in one slab: feeding
+        # [committed_last, d_1..d_{k-1}] at cursor length-1 yields the
+        # target's greedy choice for each of the k positions.
+        t_cache = _set_cursor(t_cache, length - 1)
+        last = jax.lax.dynamic_slice(buffer, (0, length - 1), (batch, 1))
+        slab = jnp.concatenate([last, drafted[:, : k - 1]], axis=1)
+        logits, mutated = target.apply(
+            {"params": target_params, "cache": t_cache}, slab, mutable=["cache"]
+        )
+        t_cache = mutated["cache"]
+        greedy = jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(
+            jnp.int32
+        )  # (B, k): g_1..g_k
+
+        match = (drafted == greedy).astype(jnp.int32)
+        run = jnp.min(
+            jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+        )  # min leading agreement across the batch
+        commit = jnp.minimum(run + 1, k)
+
+        # Positions < run take the draft (== target) tokens; the first
+        # mismatch takes the target's correction; later slots are scratch
+        # that the next round overwrites before reading.
+        merged = jnp.where(jnp.arange(k)[None, :] < run, drafted, greedy)
+        buffer = jax.lax.dynamic_update_slice(buffer, merged, (0, length))
+        return (
+            buffer,
+            n_generated + commit,
+            t_cache,
+            d_cache,
+            rounds + 1,
+        )
+
+    def cond(carry):
+        return carry[1] < max_new_tokens
+
+    buffer, _, _, _, rounds = jax.lax.while_loop(
+        cond,
+        round_body,
+        (buffer, jnp.ones((), jnp.int32), t_cache, d_cache,
+         jnp.zeros((), jnp.int32)),
+    )
+    out = jax.lax.dynamic_slice(buffer, (0, 0), (batch, total))
+    return (out, {"rounds": rounds}) if return_stats else out

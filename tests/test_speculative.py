@@ -1,0 +1,113 @@
+"""Speculative decoding: the output must be BIT-IDENTICAL to plain
+greedy generation from the target model, for any draft — agreement only
+changes the round count, never a token.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from covalent_tpu_plugin.models import (
+    TransformerConfig,
+    TransformerLM,
+    generate,
+    speculative_generate,
+)
+
+TARGET_CFG = TransformerConfig(
+    vocab_size=64,
+    d_model=32,
+    n_layers=2,
+    n_heads=4,
+    d_ff=64,
+    max_seq=48,
+    dtype=jnp.float32,
+    attention="reference",
+)
+DRAFT_CFG = dataclasses.replace(TARGET_CFG, d_model=16, n_layers=1, n_heads=2, d_ff=32)
+
+
+def build(cfg, seed, prompt):
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(seed), prompt)["params"]
+    return model, params
+
+
+@pytest.mark.parametrize("draft_len", [1, 2, 4, 5])
+@pytest.mark.parametrize("batch", [1, 3])
+def test_speculative_matches_greedy_any_draft(draft_len, batch):
+    """Random, disagreeing draft: worst case for speedup, but the tokens
+    must still be exactly the target's greedy continuation."""
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (batch, 5), 0, 64)
+    target, tparams = build(TARGET_CFG, 0, prompt)
+    draft, dparams = build(DRAFT_CFG, 7, prompt)
+
+    want = np.asarray(generate(target, tparams, prompt, 12))
+    got = np.asarray(
+        speculative_generate(
+            target, tparams, draft, dparams, prompt, 12, draft_len=draft_len
+        )
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_self_draft_commits_full_windows():
+    """Draft == target: every window fully accepted, so rounds collapse
+    to ceil((N-1)/k) — the mechanism's upper bound."""
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (1, 4), 0, 64)
+    target, tparams = build(TARGET_CFG, 0, prompt)
+    max_new, k = 13, 4
+    out, stats = speculative_generate(
+        target, tparams, target, tparams, prompt, max_new, draft_len=k,
+        return_stats=True,
+    )
+    want = np.asarray(generate(target, tparams, prompt, max_new))
+    np.testing.assert_array_equal(np.asarray(out), want)
+    assert int(stats["rounds"]) == -(-(max_new - 1) // k)  # ceil
+
+
+def test_speculative_is_jittable():
+    prompt = jnp.zeros((2, 3), jnp.int32)
+    target, tparams = build(TARGET_CFG, 0, prompt)
+    draft, dparams = build(DRAFT_CFG, 5, prompt)
+    fn = jax.jit(
+        lambda tp, dp, t: speculative_generate(
+            target, tp, draft, dp, t, 8, draft_len=3
+        )
+    )
+    out = fn(tparams, dparams, prompt)
+    assert out.shape == (2, 11)
+    np.testing.assert_array_equal(
+        np.asarray(out), np.asarray(fn(tparams, dparams, prompt))
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out), np.asarray(generate(target, tparams, prompt, 8))
+    )
+
+
+def test_speculative_edge_cases_and_validation():
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    target, tparams = build(TARGET_CFG, 0, prompt)
+    draft, dparams = build(DRAFT_CFG, 5, prompt)
+
+    out = speculative_generate(target, tparams, draft, dparams, prompt, 0)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(prompt))
+
+    one = speculative_generate(target, tparams, draft, dparams, prompt, 1)
+    np.testing.assert_array_equal(
+        np.asarray(one), np.asarray(generate(target, tparams, prompt, 1))
+    )
+
+    with pytest.raises(ValueError, match="draft_len"):
+        speculative_generate(target, tparams, draft, dparams, prompt, 4,
+                             draft_len=0)
+    with pytest.raises(ValueError, match="max_seq"):
+        speculative_generate(target, tparams, draft, dparams, prompt, 42,
+                             draft_len=4)
+    small_vocab = dataclasses.replace(DRAFT_CFG, vocab_size=32)
+    other, oparams = build(small_vocab, 3, prompt)
+    with pytest.raises(ValueError, match="vocabulary"):
+        speculative_generate(target, tparams, other, oparams, prompt, 4)
