@@ -8,9 +8,10 @@ token at the cache cursor and attends the cached prefix — a step costs
 O(S·D) attention reads instead of O(S²·D) recompute, and time-to-first-
 token is one forward pass, not P sequential steps.
 
-The decode loop is a ``lax.fori_loop`` writing into a fixed (B, P+N)
+The decode loop is a ``lax.while_loop`` writing into a fixed (B, P+N)
 token buffer — fully jittable, one compilation for any prompt content of
-a given shape.
+a given shape, with an early exit once every row has emitted EOS (when
+``eos_token_id`` is set; otherwise it runs the full ``max_new_tokens``).
 """
 
 from __future__ import annotations
@@ -106,15 +107,20 @@ def generate(
     rng: jax.Array | None = None,
     top_k: int | None = None,
     top_p: float | None = None,
+    eos_token_id: int | None = None,
+    pad_token_id: int | None = None,
 ) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt`` ((B, P) int32).
 
     ``temperature=0`` is greedy argmax; otherwise softmax sampling at the
     given temperature (requires ``rng``), optionally restricted to the
     ``top_k`` highest logits and/or the ``top_p`` nucleus (applied in that
-    order, the HF/transformers convention).  Returns the full (B, P+N)
-    token buffer.  Wrap in ``jax.jit`` for repeated use — everything inside
-    is a single compiled loop.
+    order, the HF/transformers convention).  ``eos_token_id`` stops a row
+    once it emits EOS: its remaining slots fill with ``pad_token_id``
+    (default: the EOS id), and the loop exits early when every row has
+    finished.  Returns the full (B, P+N) token buffer.  Wrap in
+    ``jax.jit`` for repeated use — everything inside is a single compiled
+    loop.
     """
     decoder = _decode_model(model)
     config = decoder.config
@@ -135,6 +141,8 @@ def generate(
         raise ValueError(f"top_k must be in [1, {config.vocab_size}], got {top_k}")
     if top_p is not None and not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if pad_token_id is not None and eos_token_id is None:
+        raise ValueError("pad_token_id requires eos_token_id")
     if max_new_tokens <= 0:
         return prompt.astype(jnp.int32)
     if temperature > 0 and rng is None:
@@ -159,6 +167,15 @@ def generate(
             chosen = jnp.argmax(step_logits.astype(jnp.float32), axis=-1)
         return chosen.astype(jnp.int32), rng
 
+    pad = eos_token_id if pad_token_id is None else pad_token_id
+
+    def finish(chosen, done):
+        """Apply EOS bookkeeping to a step's chosen tokens."""
+        if eos_token_id is None:
+            return chosen, done
+        chosen = jnp.where(done, jnp.int32(pad), chosen)
+        return chosen, done | (chosen == eos_token_id)
+
     # Prefill: one batched pass pushes the whole prompt into the caches and
     # yields the first generated token from the prompt's last logits.
     prefill_logits, mutated = decoder.apply(
@@ -166,24 +183,37 @@ def generate(
     )
     cache = mutated["cache"]
     first, rng = choose(prefill_logits[:, -1], rng)
+    done = jnp.zeros((batch,), bool)
+    first, done = finish(first, done)
     buffer = jax.lax.dynamic_update_slice(
         buffer, first[:, None], (0, prompt_len)
     )
 
-    def body(t, carry):
-        buffer, cache, rng = carry
+    def body(carry):
+        buffer, cache, rng, t, done = carry
         token = jax.lax.dynamic_slice(buffer, (0, t), (batch, 1))
         logits, mutated = decoder.apply(
             {"params": params, "cache": cache}, token, mutable=["cache"]
         )
         cache = mutated["cache"]
         chosen, rng = choose(logits[:, 0], rng)
+        chosen, done = finish(chosen, done)
         buffer = jax.lax.dynamic_update_slice(
             buffer, chosen[:, None], (0, t + 1)
         )
-        return buffer, cache, rng
+        return buffer, cache, rng, t + 1, done
 
-    buffer, _, _ = jax.lax.fori_loop(
-        prompt_len, total - 1, body, (buffer, cache, rng)
+    def cond(carry):
+        _, _, _, t, done = carry
+        return (t < total - 1) & ~jnp.all(done)
+
+    buffer, _, _, t, done = jax.lax.while_loop(
+        cond, body, (buffer, cache, rng, jnp.asarray(prompt_len), done)
     )
+    if eos_token_id is not None:
+        # An early exit (all rows done) leaves columns > t unwritten;
+        # stamp them with the pad token so finished rows read uniformly.
+        # Without early exit t == total-1 and this is a no-op.
+        cols = jnp.arange(total)[None, :]
+        buffer = jnp.where(cols > t, jnp.int32(pad), buffer)
     return buffer

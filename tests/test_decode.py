@@ -226,3 +226,59 @@ def test_inference_params_casts_only_f32():
     out = generate(model, cast["w"], prompt, 4)
     assert out.shape == (1, 8)
     assert 0 <= int(jnp.min(out)) and int(jnp.max(out)) < BASE.vocab_size
+
+
+def test_eos_stops_row_and_pads():
+    """Force EOS: a row that emits eos_token_id freezes to pad tokens and
+    the non-eos path is unchanged."""
+    model = TransformerLM(BASE)
+    prompt = jax.random.randint(jax.random.PRNGKey(11), (2, 4), 0, BASE.vocab_size)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    plain = np.asarray(generate(model, params, prompt, 8))
+    # Pick the token the model actually emits first as "EOS" for row 0.
+    eos = int(plain[0, 4])
+    out = np.asarray(
+        generate(model, params, prompt, 8, eos_token_id=eos, pad_token_id=63)
+    )
+    # Row 0 hit EOS immediately: the rest of the row is pad.
+    assert out[0, 4] == eos
+    assert (out[0, 5:] == 63).all()
+    # Other rows keep generating until their own EOS (if any); prefixes
+    # before any EOS match plain generation.
+    for b in range(2):
+        row = plain[b]
+        hits = np.where(row[4:] == eos)[0]
+        n_valid = (hits[0] + 1) if hits.size else 8
+        np.testing.assert_array_equal(out[b, : 4 + n_valid], row[: 4 + n_valid])
+
+
+def test_eos_all_rows_early_exit_matches_prefix():
+    """When every row finishes early the loop exits; emitted prefixes are
+    identical to the non-eos run, tails are pad."""
+    model = TransformerLM(BASE)
+    prompt = jnp.zeros((2, 3), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    plain = np.asarray(generate(model, params, prompt, 10))
+    eos = int(plain[0, 3])  # both rows identical (same prompt): instant EOS
+    out = np.asarray(generate(model, params, prompt, 10, eos_token_id=eos))
+    assert (out[:, 3] == eos).all()
+    assert (out[:, 4:] == eos).all()  # pad defaults to the eos id
+
+
+def test_eos_is_jittable():
+    model = TransformerLM(BASE)
+    prompt = jnp.zeros((1, 3), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    jitted = jax.jit(
+        lambda p, t: generate(model, p, t, 6, eos_token_id=0, pad_token_id=1)
+    )
+    out = jitted(params, prompt)
+    assert out.shape == (1, 9)
+
+
+def test_pad_without_eos_rejected():
+    model = TransformerLM(BASE)
+    prompt = jnp.zeros((1, 3), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    with pytest.raises(ValueError, match="pad_token_id requires"):
+        generate(model, params, prompt, 4, pad_token_id=0)
