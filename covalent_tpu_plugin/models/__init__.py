@@ -8,6 +8,7 @@ pretrain config, both written mesh-first so the same code spans one chip to
 a pod.
 """
 
+from .beam import beam_search
 from .data import synthetic_lm_batch, synthetic_lm_batches
 from .decode import generate, inference_params, init_cache
 from .moe import MoEMlp, lm_loss_with_moe_aux
@@ -43,6 +44,7 @@ __all__ = [
     "synthetic_mnist",
     "synthetic_lm_batch",
     "synthetic_lm_batches",
+    "beam_search",
     "generate",
     "inference_params",
     "init_cache",

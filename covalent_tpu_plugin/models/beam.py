@@ -1,0 +1,185 @@
+"""Beam search over the KV-cache decoder.
+
+Width-``W`` beam search per prompt row: every step scores all ``W * V``
+continuations, keeps the global top ``W``, and reorders the KV caches to
+follow their parent beams (a batch-axis gather on every cache leaf — no
+recompute).  ``beam_width=1`` degenerates to exactly :func:`..decode
+.generate`'s greedy path, which is the correctness oracle.
+
+TPU shape notes: beams ride the batch axis (``B*W`` rows), so every
+matmul stays a single large GEMM; the top-W is one ``lax.top_k`` over
+``(B, W*V)``; the cache reorder is a gather XLA fuses with the step.
+The prompt is prefilled already tiled to ``B*W`` rows — W× redundant
+prefill compute for a much simpler cache story (one shape end to end);
+fine at serving prompt lengths, noted here for honesty.
+
+EOS semantics: a finished beam is frozen — its only continuation is
+another EOS at zero additional log-probability — so finished hypotheses
+compete with ongoing ones on their final score.  ``length_penalty``
+(GNMT-style ``len**alpha`` divisor) applies to the final ranking.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import NEG_INF
+from .decode import _decode_model, init_cache
+from .transformer import TransformerLM
+
+
+def _gather_beams(cache: Any, rows: jax.Array, n_rows: int) -> Any:
+    """Reorder every cache leaf's batch axis by ``rows``.
+
+    K/V leaves are ``(B, S, H, D)`` unrolled or ``(L, B, S, H, D)`` under
+    scanned layers, so the batch axis is ``ndim - 4`` — a layout fact,
+    not a size heuristic (sizes can collide, e.g. ``L == B*W``).  Cursor
+    leaves (ndim < 4) pass through: they are per-layer, not per-beam.
+    """
+
+    def gather(leaf):
+        if leaf.ndim < 4:
+            return leaf
+        axis = leaf.ndim - 4
+        assert leaf.shape[axis] == n_rows, (leaf.shape, n_rows)
+        return jnp.take(leaf, rows, axis=axis)
+
+    return jax.tree_util.tree_map(gather, cache)
+
+
+def beam_search(
+    model: TransformerLM,
+    params: Any,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    beam_width: int = 4,
+    eos_token_id: int | None = None,
+    length_penalty: float = 1.0,
+) -> tuple[jax.Array, jax.Array]:
+    """Beam-decode ``prompt`` ((B, P) int32).
+
+    Returns ``(tokens, scores)``: tokens ``(B, W, P+N)`` and total
+    log-probabilities ``(B, W)``, sorted best-first per row (scores
+    divided by ``len**length_penalty`` for the ranking; the returned
+    scores are the raw sums).  Fully jittable.
+    """
+    if beam_width < 1:
+        raise ValueError(f"beam_width must be >= 1, got {beam_width}")
+    decoder = _decode_model(model)
+    config = decoder.config
+    batch, prompt_len = prompt.shape
+    total = prompt_len + max(max_new_tokens, 0)
+    if total > config.max_seq:
+        raise ValueError(
+            f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
+            f"exceeds config.max_seq ({config.max_seq})"
+        )
+    width = beam_width
+    vocab = config.vocab_size
+    if max_new_tokens <= 0:
+        tokens = jnp.broadcast_to(
+            prompt[:, None, :], (batch, width, prompt_len)
+        ).astype(jnp.int32)
+        return tokens, jnp.zeros((batch, width), jnp.float32)
+
+    rows = batch * width
+    tiled = jnp.repeat(prompt, width, axis=0)  # (B*W, P)
+    cache = init_cache(model, rows)
+    buffer = jnp.zeros((rows, total), jnp.int32)
+    buffer = jax.lax.dynamic_update_slice(buffer, tiled, (0, 0))
+
+    prefill_logits, mutated = decoder.apply(
+        {"params": params, "cache": cache}, tiled, mutable=["cache"]
+    )
+    cache = mutated["cache"]
+    logprobs = jax.nn.log_softmax(
+        prefill_logits[:, -1].astype(jnp.float32), axis=-1
+    )  # (B*W, V); all W copies of a row are identical here
+
+    # First step: top-W distinct tokens per original row seed the beams.
+    first_scores, first_tokens = jax.lax.top_k(
+        logprobs.reshape(batch, width, vocab)[:, 0], width
+    )  # (B, W)
+    scores = first_scores  # (B, W)
+    buffer = jax.lax.dynamic_update_slice(
+        buffer,
+        first_tokens.reshape(rows, 1).astype(jnp.int32),
+        (0, prompt_len),
+    )
+    finished = (
+        (first_tokens == eos_token_id)
+        if eos_token_id is not None
+        else jnp.zeros((batch, width), bool)
+    )
+    lengths = jnp.ones((batch, width), jnp.float32)  # generated tokens
+
+    def body(carry):
+        buffer, cache, scores, finished, lengths, t = carry
+        token = jax.lax.dynamic_slice(buffer, (0, t), (rows, 1))
+        logits, mutated = decoder.apply(
+            {"params": params, "cache": cache}, token, mutable=["cache"]
+        )
+        cache = mutated["cache"]
+        logprobs = jax.nn.log_softmax(
+            logits[:, 0].astype(jnp.float32), axis=-1
+        ).reshape(batch, width, vocab)
+        if eos_token_id is not None:
+            # Frozen beams: only EOS continues, for free.
+            frozen = jnp.full((vocab,), NEG_INF).at[eos_token_id].set(0.0)
+            logprobs = jnp.where(
+                finished[:, :, None], frozen[None, None, :], logprobs
+            )
+        candidates = scores[:, :, None] + logprobs  # (B, W, V)
+        scores, flat_idx = jax.lax.top_k(
+            candidates.reshape(batch, width * vocab), width
+        )
+        parent = flat_idx // vocab  # (B, W) beam each winner extends
+        chosen = (flat_idx % vocab).astype(jnp.int32)
+
+        # Follow the parents: reorder buffer rows + every cache leaf.
+        row_idx = (
+            jnp.arange(batch)[:, None] * width + parent
+        ).reshape(rows)
+        buffer = jnp.take(buffer, row_idx, axis=0)
+        cache = _gather_beams(cache, row_idx, rows)
+        lengths = jnp.take_along_axis(lengths, parent, axis=1)
+        if eos_token_id is not None:
+            was_finished = jnp.take_along_axis(finished, parent, axis=1)
+            # A frozen beam's forced EOS padding doesn't count as length.
+            lengths = jnp.where(was_finished, lengths, lengths + 1.0)
+            finished = was_finished | (chosen == eos_token_id)
+        else:
+            lengths = lengths + 1.0
+        buffer = jax.lax.dynamic_update_slice(
+            buffer, chosen.reshape(rows, 1), (0, t + 1)
+        )
+        return buffer, cache, scores, finished, lengths, t + 1
+
+    def cond(carry):
+        _, _, _, finished, _, t = carry
+        return (t < total - 1) & ~jnp.all(finished)
+
+    buffer, _, scores, _, lengths, t = jax.lax.while_loop(
+        cond,
+        body,
+        (buffer, cache, scores, finished, lengths,
+         jnp.asarray(prompt_len)),
+    )
+    if eos_token_id is not None:
+        # An early exit (all beams frozen) leaves columns > t unwritten;
+        # stamp them with EOS as the in-loop freezing would have.
+        cols = jnp.arange(total)[None, :]
+        buffer = jnp.where(cols > t, jnp.int32(eos_token_id), buffer)
+
+    tokens = buffer.reshape(batch, width, total)
+    # GNMT-style ranking: each hypothesis's score over ITS OWN generated
+    # length (frozen padding excluded), so short finished beams compete
+    # fairly with long ongoing ones.  Raw sums are what's returned.
+    ranking = scores / (lengths ** length_penalty)
+    order = jnp.argsort(-ranking, axis=1)
+    tokens = jnp.take_along_axis(tokens, order[:, :, None], axis=1)
+    scores = jnp.take_along_axis(scores, order, axis=1)
+    return tokens, scores
