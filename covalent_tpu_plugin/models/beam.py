@@ -50,6 +50,16 @@ def _gather_beams(cache: Any, rows: jax.Array, n_rows: int) -> Any:
     return jax.tree_util.tree_map(gather, cache)
 
 
+def rank_hypotheses(
+    scores: jax.Array, lengths: jax.Array, length_penalty: float
+) -> jax.Array:
+    """GNMT-style ranking keys: each hypothesis's raw log-prob sum over
+    ITS OWN generated length (frozen EOS padding excluded) to the
+    ``length_penalty`` power — short finished beams compete fairly with
+    long ongoing ones.  ``length_penalty=0`` ranks by raw sums."""
+    return scores / (lengths ** length_penalty)
+
+
 def beam_search(
     model: TransformerLM,
     params: Any,
@@ -66,8 +76,11 @@ def beam_search(
     divided by ``len**length_penalty`` for the ranking; the returned
     scores are the raw sums).  Fully jittable.
     """
-    if beam_width < 1:
-        raise ValueError(f"beam_width must be >= 1, got {beam_width}")
+    if not 1 <= beam_width <= model.config.vocab_size:
+        raise ValueError(
+            f"beam_width must be in [1, vocab_size={model.config.vocab_size}]"
+            f", got {beam_width}"
+        )
     decoder = _decode_model(model)
     config = decoder.config
     batch, prompt_len = prompt.shape
@@ -175,11 +188,7 @@ def beam_search(
         buffer = jnp.where(cols > t, jnp.int32(eos_token_id), buffer)
 
     tokens = buffer.reshape(batch, width, total)
-    # GNMT-style ranking: each hypothesis's score over ITS OWN generated
-    # length (frozen padding excluded), so short finished beams compete
-    # fairly with long ongoing ones.  Raw sums are what's returned.
-    ranking = scores / (lengths ** length_penalty)
-    order = jnp.argsort(-ranking, axis=1)
+    order = jnp.argsort(-rank_hypotheses(scores, lengths, length_penalty), axis=1)
     tokens = jnp.take_along_axis(tokens, order[:, :, None], axis=1)
     scores = jnp.take_along_axis(scores, order, axis=1)
     return tokens, scores
