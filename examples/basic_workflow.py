@@ -1,0 +1,52 @@
+"""The canonical workflow: electrons composed into a lattice, dispatched
+through TPUExecutor — the same shape as the reference plugin's README
+example (reference README.md:46-60), no Covalent server required.
+
+Run:  python examples/basic_workflow.py
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from covalent_tpu_plugin import TPUExecutor
+from covalent_tpu_plugin.workflow import dispatch_sync, electron, lattice
+
+workdir = tempfile.mkdtemp(prefix="covalent-tpu-example-")
+executor = TPUExecutor(
+    transport="local",
+    cache_dir=os.path.join(workdir, "cache"),
+    remote_cache=os.path.join(workdir, "remote"),
+    python_path=sys.executable,
+    poll_freq=0.2,
+    task_env={"JAX_PLATFORMS": "cpu"},  # drop this pin on a real TPU VM
+)
+
+
+@electron(executor=executor)
+def dot(n: int) -> float:
+    import jax.numpy as jnp
+
+    x = jnp.arange(n, dtype=jnp.float32)
+    return float(x @ x)
+
+
+@electron(executor=executor)
+def scale(value: float, factor: float) -> float:
+    return value * factor
+
+
+@lattice
+def flow(n: int, factor: float) -> float:
+    return scale(dot(n), factor)
+
+
+if __name__ == "__main__":
+    result = dispatch_sync(flow)(1000, 0.5)
+    print("status:", result.status)
+    print("result:", result.result)
+    # f32 accumulation order differs across backends; compare loosely.
+    expected = sum(i * i for i in range(1000)) * 0.5
+    assert abs(result.result - expected) / expected < 1e-5

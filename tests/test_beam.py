@@ -1,8 +1,10 @@
-"""Beam search: width-1 greedy oracle, score dominance, EOS freezing.
+"""Beam search: width-1 greedy oracle, true-logprob scores, EOS freezing.
 
 The decisive properties: beam_width=1 reproduces generate()'s greedy
-tokens exactly; wider beams never score worse than greedy (they search a
-superset); frozen EOS beams only ever continue with EOS at zero cost.
+tokens exactly; returned scores equal independently recomputed sequence
+log-probs; frozen EOS beams only ever continue with EOS at zero cost.
+(Wider beams beating greedy is a fixed-seed expectation, not an
+invariant — beam search can prune the greedy path.)
 """
 
 import dataclasses
@@ -118,9 +120,10 @@ def test_beam_is_jittable_and_validates():
 
 
 def test_rank_hypotheses_reorders_by_per_length_score():
-    """The GNMT divisor must re-rank a short strong hypothesis above a
-    long weak one — unit-checked on handcrafted scores/lengths so a
-    regression in the ranking math can't hide behind search stochasticity."""
+    """The GNMT divisor must promote a long cheap-per-token hypothesis
+    over a short expensive one that wins on raw sums — unit-checked on
+    handcrafted scores/lengths so a regression in the ranking math can't
+    hide behind search stochasticity."""
     from covalent_tpu_plugin.models.beam import rank_hypotheses
 
     # Beam A: 20 tokens, sum -1.0 (cheap per token, -0.05).  Beam B: 2
