@@ -49,8 +49,8 @@ def _block_forward(cfg, p: Any, x: jax.Array) -> jax.Array:
     q = jnp.einsum("bsd,dhk->bshk", h, att["q_proj"]["kernel"].astype(dt))
     k = jnp.einsum("bsd,dhk->bshk", h, att["k_proj"]["kernel"].astype(dt))
     v = jnp.einsum("bsd,dhk->bshk", h, att["v_proj"]["kernel"].astype(dt))
-    q = _rotary(q)
-    k = _rotary(k)
+    q = _rotary(q, base=cfg.rope_base)
+    k = _rotary(k, base=cfg.rope_base)
     qh, kh, vh = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
     impl = cfg.attention
     if impl == "auto":

@@ -78,6 +78,11 @@ class TransformerConfig:
     #: per-channel scale (models/quant.py).  Build via quantize_lm(), not
     #: by hand — the param tree shape changes.
     quantized: bool = False
+    #: rotary embedding wavelength base (theta).  10k is the GPT-NeoX/
+    #: llama default; raising it (e.g. 500k, llama-3 style) stretches the
+    #: position resolution for long-context training — the standard knob
+    #: behind context extension.
+    rope_base: float = 10000.0
     #: LoRA fine-tuning (models/lora.py): > 0 attaches rank-r adapters to
     #: the targeted denses.  Build via add_lora()/quantize_then_lora().
     lora_rank: int = 0
@@ -167,8 +172,8 @@ class Attention(nn.Module):
         if cfg.decode:
             return self._decode_step(q, k, v, kv_heads)
 
-        q = _rotary(q)
-        k = _rotary(k)
+        q = _rotary(q, base=cfg.rope_base)
+        k = _rotary(k, base=cfg.rope_base)
 
         # (B, S, H, D) -> (B, H, S, D) for the attention kernels
         qh, kh, vh = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
@@ -256,8 +261,8 @@ class Attention(nn.Module):
             return self._out_proj(jnp.zeros_like(q))
 
         pos = cursor.value
-        q = _rotary(q, offset=pos)
-        k = _rotary(k, offset=pos)
+        q = _rotary(q, base=cfg.rope_base, offset=pos)
+        k = _rotary(k, base=cfg.rope_base, offset=pos)
         cached_k.value = jax.lax.dynamic_update_slice(
             cached_k.value, k.astype(cfg.dtype), (0, pos, 0, 0)
         )

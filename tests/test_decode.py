@@ -282,3 +282,24 @@ def test_pad_without_eos_rejected():
     params = model.init(jax.random.PRNGKey(0), prompt)["params"]
     with pytest.raises(ValueError, match="pad_token_id requires"):
         generate(model, params, prompt, 4, pad_token_id=0)
+
+
+def test_rope_base_changes_positions_but_keeps_cache_consistency():
+    """A non-default rope_base must (a) change logits vs the default
+    (the knob is live) and (b) keep cached decode == full recompute
+    (prefill and decode apply the same wavelengths at the same absolute
+    positions)."""
+    cfg = dataclasses.replace(BASE, rope_base=500_000.0)
+    model = TransformerLM(cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(12), (2, 5), 0, cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+
+    default_model = TransformerLM(BASE)
+    assert not np.allclose(
+        np.asarray(model.apply({"params": params}, prompt)),
+        np.asarray(default_model.apply({"params": params}, prompt)),
+    )
+
+    got = generate(model, params, prompt, max_new_tokens=6)
+    want = naive_greedy(model, params, prompt, 6)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

@@ -164,3 +164,21 @@ def test_pipeline_lm_requires_scanned_layers():
     params = model.init(jax.random.PRNGKey(0), tokens)["params"]
     with pytest.raises(ValueError, match="scan_layers"):
         pipeline_lm_forward(model, params, tokens, mesh, n_micro=2)
+
+def test_pipeline_lm_matches_dense_at_nondefault_rope_base():
+    """rope_base must thread into the pipelined block's rotary too —
+    a hardcoded default there silently diverges from the dense model."""
+    mesh = make_mesh(MeshPlan(pipe=4))
+    import dataclasses
+
+    cfg = dataclasses.replace(LM_CFG, rope_base=500_000.0)
+    model = TransformerLM(cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (2, 17), 0, 64)
+    params = model.init(jax.random.PRNGKey(0), tokens[:, :-1])["params"]
+    logits_pp = pipeline_lm_forward(
+        model, params, tokens[:, :-1], mesh, n_micro=2
+    )
+    logits_ref = model.apply({"params": params}, tokens[:, :-1])
+    np.testing.assert_allclose(
+        np.asarray(logits_pp), np.asarray(logits_ref), atol=2e-4, rtol=2e-4
+    )
