@@ -165,6 +165,7 @@ def test_pipeline_lm_requires_scanned_layers():
     with pytest.raises(ValueError, match="scan_layers"):
         pipeline_lm_forward(model, params, tokens, mesh, n_micro=2)
 
+
 def test_pipeline_lm_matches_dense_at_nondefault_rope_base():
     """rope_base must thread into the pipelined block's rotary too —
     a hardcoded default there silently diverges from the dense model."""

@@ -191,3 +191,30 @@ def test_executor_reused_across_separate_dispatches(tmp_path):
     second = ct.dispatch_sync(flow)(5)  # same executor, new dispatch
     assert first.status is ct.Status.COMPLETED and first.result == 8
     assert second.status is ct.Status.COMPLETED and second.result == 10
+
+
+def test_concurrent_electron_stress(tmp_path, run_async):
+    """16-way fan-out through one executor + resident pool: every result
+    lands, no cross-task contamination, per-task state fully released."""
+    from .helpers import make_local_executor
+
+    ex = make_local_executor(
+        tmp_path, use_agent=True, poll_freq=0.05, defer_cleanup=True
+    )
+
+    def square(i):
+        return i * i
+
+    async def flow():
+        results = await asyncio.gather(
+            *(
+                ex.run(square, [i], {}, {"dispatch_id": "stress", "node_id": i})
+                for i in range(16)
+            )
+        )
+        await ex.close()
+        return results
+
+    assert run_async(flow()) == [i * i for i in range(16)]
+    assert not ex._active  # per-operation state all released
+    assert not ex._cleanup_tasks
