@@ -529,7 +529,15 @@ class TPUExecutor(RemoteExecutor):
                 f'eval "$(conda shell.bash hook)" && conda activate '
                 f"{shlex.quote(self.conda_env)}"
             )
-        checks.append(f"{self.python_path} -c 'import sys; print(sys.version_info[0])'")
+        # -E -S skips site/sitecustomize processing: the check only needs
+        # the interpreter's existence + major version, and a site hook that
+        # imports heavy ML runtimes (as TPU-VM images do) would turn a
+        # ~30 ms probe into seconds of first-electron latency.  (-E -S and
+        # not -I: python2 rejects -I, which would mask the dedicated
+        # "not python3" diagnostic below with an option error.)
+        checks.append(
+            f"{self.python_path} -E -S -c 'import sys; print(sys.version_info[0])'"
+        )
         return " && ".join(checks)
 
     async def _preflight(self, conn: Transport) -> None:
