@@ -56,9 +56,9 @@ def _block_forward(cfg, p: Any, x: jax.Array) -> jax.Array:
     if impl == "auto":
         impl = "flash" if on_tpu() else "reference"
     if impl == "flash":
-        out = flash_attention(qh, kh, vh, causal=True)
+        out = flash_attention(qh, kh, vh, causal=True, window=cfg.sliding_window)
     else:
-        out = mha_reference(qh, kh, vh, causal=True)
+        out = mha_reference(qh, kh, vh, causal=True, window=cfg.sliding_window)
     out = out.transpose(0, 2, 1, 3)
     attn = jnp.einsum("bshk,hkd->bsd", out, att["out_proj"]["kernel"].astype(dt))
     x = x + attn

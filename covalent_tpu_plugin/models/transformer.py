@@ -78,6 +78,11 @@ class TransformerConfig:
     #: per-channel scale (models/quant.py).  Build via quantize_lm(), not
     #: by hand — the param tree shape changes.
     quantized: bool = False
+    #: sliding-window (Mistral-style local) attention: each query sees
+    #: only the `sliding_window` most recent positions.  Flash tiles
+    #: outside the band are skipped (compute O(S·w)); unsupported with
+    #: attention="ring" (shard the window over heads/batch instead).
+    sliding_window: int | None = None
     #: rotary embedding wavelength base (theta).  10k is the GPT-NeoX/
     #: llama default; raising it (e.g. 500k, llama-3 style) stretches the
     #: position resolution for long-context training — the standard knob
@@ -91,6 +96,15 @@ class TransformerConfig:
     lora_targets: tuple = (
         "q_proj", "k_proj", "v_proj", "out_proj", "wi", "wo",
     )
+
+    def __post_init__(self):
+        if self.sliding_window is not None and self.sliding_window < 1:
+            # Validated here (not only in the kernels) because the cached
+            # decode path masks the band itself — a 0/negative window there
+            # would silently attend nothing and softmax over garbage.
+            raise ValueError(
+                f"sliding_window must be >= 1, got {self.sliding_window}"
+            )
 
     @property
     def head_dim(self) -> int:
@@ -183,6 +197,11 @@ class Attention(nn.Module):
         if impl == "ring":
             if cfg.mesh is None:
                 raise ValueError("attention='ring' requires config.mesh")
+            if cfg.sliding_window is not None:
+                raise ValueError(
+                    "sliding_window is unsupported with attention='ring' — "
+                    "a window fits on-device; shard batch/heads instead"
+                )
             if kv_heads != cfg.n_heads:
                 # Ring shards over sequence, not heads: materialising the
                 # group repeat is cheap relative to the ring's kv transfers.
@@ -195,11 +214,18 @@ class Attention(nn.Module):
                 # Bare pallas_call is opaque to sharding propagation — under
                 # a sharded jit it would all-gather Q/K/V to every device;
                 # the shard_map wrapper keeps each (batch, head) block local.
-                out = flash_attention_sharded(qh, kh, vh, cfg.mesh, causal=True)
+                out = flash_attention_sharded(
+                    qh, kh, vh, cfg.mesh, causal=True,
+                    window=cfg.sliding_window,
+                )
             else:
-                out = flash_attention(qh, kh, vh, causal=True)
+                out = flash_attention(
+                    qh, kh, vh, causal=True, window=cfg.sliding_window
+                )
         else:
-            out = mha_reference(qh, kh, vh, causal=True)
+            out = mha_reference(
+                qh, kh, vh, causal=True, window=cfg.sliding_window
+            )
         out = out.transpose(0, 2, 1, 3)
 
         out = self._out_proj(out)
@@ -282,7 +308,10 @@ class Attention(nn.Module):
             preferred_element_type=jnp.float32,
         ) * (cfg.head_dim**-0.5)
         q_positions = pos + jnp.arange(slab)
-        visible = jnp.arange(cfg.max_seq)[None, :] <= q_positions[:, None]
+        slots = jnp.arange(cfg.max_seq)[None, :]
+        visible = slots <= q_positions[:, None]
+        if cfg.sliding_window is not None:
+            visible &= slots > q_positions[:, None] - cfg.sliding_window
         scores = jnp.where(visible[None, None, None, :, :], scores, NEG_INF)
         probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
         out = jnp.einsum(

@@ -61,19 +61,59 @@ def on_tpu() -> bool:
     return "tpu" in (device.platform + " " + getattr(device, "device_kind", "")).lower()
 
 
+def _band_visible(qpos, kpos, window: int | None):
+    """Causal(-band) visibility on broadcastable position grids: row sees
+    column iff ``q >= k`` and (windowed) ``q - k < window`` — the ONE
+    definition of the band, shared by every kernel and the dense oracle."""
+    mask = qpos >= kpos
+    if window is not None:
+        mask = jnp.logical_and(mask, qpos - kpos < window)
+    return mask
+
+
+def _band_tile_needed(qpos_tile, kpos_tile, causal: bool, window: int | None):
+    """Whether a (query tile, key tile) pair intersects the visible band.
+
+    ``min(k) <= max(q)`` kills tiles wholly in the future; with a window,
+    ``max(k) > min(q) - window`` kills tiles wholly behind the band.  The
+    same bounds serve all three sweeps (for dk/dv the roles read swapped
+    but the inequalities are algebraically identical).
+    """
+    needed = True if not causal else (
+        jnp.min(kpos_tile) <= jnp.max(qpos_tile)
+    )
+    if window is not None:
+        needed = jnp.logical_and(
+            needed, jnp.max(kpos_tile) > jnp.min(qpos_tile) - window
+        )
+    return needed
+
+
+def _check_window(window, causal) -> None:
+    if window is None:
+        return
+    if not causal:
+        raise ValueError("window (sliding-window attention) requires causal")
+    if window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+
+
 def mha_reference(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
     causal: bool = True,
     scale: float | None = None,
+    window: int | None = None,
 ) -> jax.Array:
     """Dense multi-head attention oracle.  Shapes: (B, H, S, D).
 
     Grouped-query attention: ``k``/``v`` may carry fewer heads than ``q``
     (``H_q % H_kv == 0``); each kv head serves a contiguous group of query
-    heads, matching the flash kernel's convention.
+    heads, matching the flash kernel's convention.  ``window=w`` masks to
+    the sliding causal band: row ``i`` sees columns ``(i-w, i]``.
     """
+    _check_window(window, causal)
     if k.shape[1] != q.shape[1]:
         group = _gqa_group(q, k)
         k = jnp.repeat(k, group, axis=1)
@@ -87,7 +127,7 @@ def mha_reference(
         s_q, s_k = q.shape[2], k.shape[2]
         qi = jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 0)
         ki = jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 1)
-        scores = jnp.where(qi >= ki, scores, _NEG_INF)
+        scores = jnp.where(_band_visible(qi, ki, window), scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum(
         "bhqk,bhkd->bhqd", probs.astype(v.dtype), v, preferred_element_type=jnp.float32
@@ -97,7 +137,7 @@ def mha_reference(
 
 def _flash_kernel(q_ref, k_ref, v_ref, qpos_ref, kpos_ref, o_ref, lse_ref,
                   m_ref, l_ref, acc_ref,
-                  *, causal: bool, scale: float):
+                  *, causal: bool, scale: float, window: int | None = None):
     """One (query tile, key tile) grid cell.
 
     The key-tile index is the *innermost* grid dimension, so for a fixed
@@ -122,9 +162,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, qpos_ref, kpos_ref, o_ref, lse_ref,
     # default contiguous layout (reproducing the classic above-diagonal
     # skip, ~2x fewer ops) and conservative-but-correct for arbitrary
     # ring/striped position vectors.
-    needed = True if not causal else (
-        jnp.min(kpos_ref[:, :]) <= jnp.max(qpos_ref[:, :])
-    )
+    needed = _band_tile_needed(qpos_ref[:, :], kpos_ref[:, :], causal, window)
 
     @pl.when(needed)
     def _tile():
@@ -145,7 +183,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, qpos_ref, kpos_ref, o_ref, lse_ref,
             # Masking reads GLOBAL positions — (BQ,1) against (1,BK) —
             # so striped/rotated layouts (ring attention) mask correctly;
             # contiguous arange positions reproduce the classic diagonal.
-            mask = qpos_ref[:, :] >= kpos_ref[:, :]
+            mask = _band_visible(qpos_ref[:, :], kpos_ref[:, :], window)
             s = jnp.where(mask, s, _NEG_INF)
 
         m_prev = m_ref[:]
@@ -210,7 +248,7 @@ def _positions_2d(q_positions, k_positions, seq_len_q: int, seq_len_k: int):
 def _flash_forward(
     q, k, v, q_positions, k_positions, causal: bool,
     block_q: int | None, block_k: int | None, interpret: bool,
-    out_dtype=None,
+    out_dtype=None, window: int | None = None,
 ):
     batch, heads, seq_len, head_dim = q.shape
     seq_len_k = k.shape[2]
@@ -246,7 +284,9 @@ def _flash_forward(
     qpos_spec = pl.BlockSpec((block_q, 1), lambda b, h, i, j: (i, 0))
     kpos_spec = pl.BlockSpec((1, block_k), lambda b, h, i, j: (0, j))
     lse_spec = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0))
-    kernel = functools.partial(_flash_kernel, causal=causal, scale=scale)
+    kernel = functools.partial(
+        _flash_kernel, causal=causal, scale=scale, window=window
+    )
     flops_factor = 0.5 if causal else 1.0
     out, lse = pl.pallas_call(
         kernel,
@@ -282,7 +322,7 @@ _DEFAULT_BWD_BLOCK = 1024
 def _flash_bwd_dkdv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qpos_ref, kpos_ref,
     dk_ref, dv_ref, dk_acc, dv_acc,
-    *, causal: bool, scale: float
+    *, causal: bool, scale: float, window: int | None = None
 ):
     """One (kv head, key tile, group member, query tile) cell of the dk/dv
     sweep, grid (B, H_kv, KT, G, QT).
@@ -306,9 +346,7 @@ def _flash_bwd_dkdv_kernel(
     # A query tile entirely in the past of this key tile contributes no
     # gradient under causal masking; the position-tile bound check is exact
     # for contiguous layouts and conservative for striped ones.
-    needed = True if not causal else (
-        jnp.max(qpos_ref[:, :]) >= jnp.min(kpos_ref[:, :])
-    )
+    needed = _band_tile_needed(qpos_ref[:, :], kpos_ref[:, :], causal, window)
 
     @pl.when(needed)
     def _tile():
@@ -326,7 +364,9 @@ def _flash_bwd_dkdv_kernel(
         ) * scale  # (BQ, BK) f32
         p = jnp.exp(s - lse)  # exactly the forward's normalised probabilities
         if causal:
-            p = jnp.where(qpos_ref[:, :] >= kpos_ref[:, :], p, 0.0)
+            p = jnp.where(
+                _band_visible(qpos_ref[:, :], kpos_ref[:, :], window), p, 0.0
+            )
 
         # dV += P^T dO ; dP = dO V^T ; dS = P*(dP - delta)*scale ; dK += dS^T Q
         dv_acc[:] += jax.lax.dot_general(
@@ -355,7 +395,7 @@ def _flash_bwd_dkdv_kernel(
 def _flash_bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qpos_ref, kpos_ref,
     dq_ref, dq_acc,
-    *, causal: bool, scale: float
+    *, causal: bool, scale: float, window: int | None = None
 ):
     """One (query tile, key tile) cell of the dq sweep (key tiles innermost)."""
     kt = pl.program_id(3)
@@ -365,9 +405,7 @@ def _flash_bwd_dq_kernel(
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    needed = True if not causal else (
-        jnp.min(kpos_ref[:, :]) <= jnp.max(qpos_ref[:, :])
-    )
+    needed = _band_tile_needed(qpos_ref[:, :], kpos_ref[:, :], causal, window)
 
     @pl.when(needed)
     def _tile():
@@ -385,7 +423,9 @@ def _flash_bwd_dq_kernel(
         ) * scale
         p = jnp.exp(s - lse)
         if causal:
-            p = jnp.where(qpos_ref[:, :] >= kpos_ref[:, :], p, 0.0)
+            p = jnp.where(
+                _band_visible(qpos_ref[:, :], kpos_ref[:, :], window), p, 0.0
+            )
 
         dp = jax.lax.dot_general(
             do, v_tile,
@@ -406,7 +446,7 @@ def _flash_bwd_dq_kernel(
 
 def _flash_backward(
     q, k, v, out, lse, g, q_positions, k_positions, causal: bool,
-    interpret: bool, delta=None, grad_dtype=None
+    interpret: bool, delta=None, grad_dtype=None, window: int | None = None
 ):
     """FlashAttention-2 backward: two Pallas sweeps, O(S·D) HBM."""
     batch, heads, seq_len, head_dim = q.shape
@@ -450,7 +490,9 @@ def _flash_backward(
     qpos_spec_q = pl.BlockSpec((block_q, 1), lambda b, h, i, gi, j: (j, 0))
     kpos_spec_k = pl.BlockSpec((1, block_k), lambda b, h, i, gi, j: (0, i))
     dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkdv_kernel, causal=causal, scale=scale),
+        functools.partial(
+            _flash_bwd_dkdv_kernel, causal=causal, scale=scale, window=window
+        ),
         grid=(batch, kv_heads, seq_len_k // block_k, group, seq_len // block_q),
         in_specs=[qo_spec_q, kv_spec_k, kv_spec_k, qo_spec_q, stat_spec_q,
                   stat_spec_q, qpos_spec_q, kpos_spec_k],
@@ -479,7 +521,9 @@ def _flash_backward(
     qpos_spec_i = pl.BlockSpec((block_q, 1), lambda b, h, i, j: (i, 0))
     kpos_spec_j = pl.BlockSpec((1, block_k), lambda b, h, i, j: (0, j))
     dq = pl.pallas_call(
-        functools.partial(_flash_bwd_dq_kernel, causal=causal, scale=scale),
+        functools.partial(
+            _flash_bwd_dq_kernel, causal=causal, scale=scale, window=window
+        ),
         grid=(batch, heads, seq_len // block_q, seq_len_k // block_k),
         in_specs=[qo_spec_i, kv_spec_j, kv_spec_j, qo_spec_i, stat_spec_i,
                   stat_spec_i, qpos_spec_i, kpos_spec_j],
@@ -501,27 +545,30 @@ def _pos_zero(positions):
     return jnp.zeros(jnp.shape(positions), dtype=jax.dtypes.float0)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
 def _flash(q, k, v, q_positions, k_positions, causal, block_q, block_k,
-           interpret):
+           interpret, window):
     out, _ = _flash_forward(
-        q, k, v, q_positions, k_positions, causal, block_q, block_k, interpret
+        q, k, v, q_positions, k_positions, causal, block_q, block_k, interpret,
+        window=window,
     )
     return out
 
 
 def _flash_fwd(q, k, v, q_positions, k_positions, causal, block_q, block_k,
-               interpret):
+               interpret, window):
     out, lse = _flash_forward(
-        q, k, v, q_positions, k_positions, causal, block_q, block_k, interpret
+        q, k, v, q_positions, k_positions, causal, block_q, block_k, interpret,
+        window=window,
     )
     return out, (q, k, v, out, lse, q_positions, k_positions)
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
+def _flash_bwd(causal, block_q, block_k, interpret, window, residuals, g):
     q, k, v, out, lse, q_positions, k_positions = residuals
     dq, dk, dv = _flash_backward(
-        q, k, v, out, lse, g, q_positions, k_positions, causal, interpret
+        q, k, v, out, lse, g, q_positions, k_positions, causal, interpret,
+        window=window,
     )
     return dq, dk, dv, _pos_zero(q_positions), _pos_zero(k_positions)
 
@@ -540,6 +587,7 @@ def flash_attention(
     block_q: int | None = None,
     block_k: int | None = None,
     interpret: bool | None = None,
+    window: int | None = None,
 ) -> jax.Array:
     """Flash attention over (B, H, S, D) inputs.
 
@@ -563,11 +611,18 @@ def flash_attention(
     benchmarks/ATTENTION_SWEEP.md), auto-shrunk by halving to divide any
     sequence length; explicitly passed blocks must divide the sequence
     exactly.
+
+    ``window=w`` (sliding-window / Mistral-style local attention,
+    requires ``causal``) restricts each query to the ``w`` most recent
+    positions; tiles wholly outside the band are skipped in the forward
+    AND both backward sweeps, so compute scales O(S·w) instead of O(S²).
     """
+    _check_window(window, causal)
     if interpret is None:
         interpret = not on_tpu()
     return _flash(
-        q, k, v, q_positions, k_positions, causal, block_q, block_k, interpret
+        q, k, v, q_positions, k_positions, causal, block_q, block_k,
+        interpret, window,
     )
 
 
