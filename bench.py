@@ -404,6 +404,48 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
     else:
         report("flash_long", skipped="budget")
 
+    # -- sliding-window flash at the same long-context shape ---------------
+    if remaining() > 30:
+        try:
+            from covalent_tpu_plugin.ops.attention import flash_attention
+
+            b, h, s, d = (1, 2, 2048, 64) if small else (1, 8, 16384, 64)
+            win = 256 if small else 1024
+            q = jax.random.normal(jax.random.PRNGKey(0), (b, h, s, d), jnp.bfloat16)
+            k = jax.random.normal(jax.random.PRNGKey(1), (b, h, s, d), jnp.bfloat16)
+            v = jax.random.normal(jax.random.PRNGKey(2), (b, h, s, d), jnp.bfloat16)
+            grad_fn = jax.jit(
+                jax.grad(
+                    lambda q, k, v: flash_attention(
+                        q, k, v, causal=True, window=win
+                    ).astype(jnp.float32).sum(),
+                    argnums=(0, 1, 2),
+                )
+            )
+            holder = {}
+
+            def dispatch():
+                holder["g"] = grad_fn(q, k, v)
+
+            def fetch():
+                jax.device_get(holder["g"][0][0, 0, 0, 0])
+
+            unit = unit_seconds(dispatch, fetch, target_s=3.0, cap=8)
+            full_ms = (results.get("flash_long") or {}).get("fwd_bwd_ms")
+            report(
+                "flash_window",
+                seq_len=s,
+                window=win,
+                fwd_bwd_ms=round(unit * 1e3, 2),
+                speedup_vs_full=(
+                    round(full_ms / (unit * 1e3), 2) if full_ms else None
+                ),
+            )
+        except Exception as error:  # noqa: BLE001
+            report("flash_window", error=repr(error))
+    else:
+        report("flash_window", skipped="budget")
+
     # -- 125M-class LM train step + MFU (BASELINE config 5's model, 1 chip) -
     if remaining() > 75:
         try:
@@ -769,6 +811,8 @@ async def main() -> None:
         "flash_bwd_4k_speedup": sub("flash_bwd", "speedup"),
         "flash_16k_fwd_bwd_ms": sub("flash_long", "fwd_bwd_ms"),
         "flash_16k_attn_tflops": sub("flash_long", "attn_tflops"),
+        "flash_16k_window1k_ms": sub("flash_window", "fwd_bwd_ms"),
+        "flash_16k_window1k_speedup": sub("flash_window", "speedup_vs_full"),
         "lm125m_step_ms": sub("lm_step", "step_ms"),
         "lm125m_tokens_per_s": sub("lm_step", "tokens_per_s"),
         "lm125m_mfu": sub("lm_step", "mfu"),

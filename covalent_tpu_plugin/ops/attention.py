@@ -288,6 +288,11 @@ def _flash_forward(
         _flash_kernel, causal=causal, scale=scale, window=window
     )
     flops_factor = 0.5 if causal else 1.0
+    if window is not None:
+        # The band covers ~S*w of the S^2 score matrix; feeding the causal
+        # half-estimate to the compiler's cost model would overstate a
+        # w<<S kernel by ~S/(2w) and skew latency-hiding decisions.
+        flops_factor = min(flops_factor, window / max(seq_len_k, 1))
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -468,6 +473,11 @@ def _flash_backward(
         )
 
     flops_factor = 0.5 if causal else 1.0
+    if window is not None:
+        # The band covers ~S*w of the S^2 score matrix; feeding the causal
+        # half-estimate to the compiler's cost model would overstate a
+        # w<<S kernel by ~S/(2w) and skew latency-hiding decisions.
+        flops_factor = min(flops_factor, window / max(seq_len_k, 1))
     cost = pl.CostEstimate(
         flops=int(10 * batch * heads * seq_len * seq_len_k * head_dim * flops_factor),
         bytes_accessed=int(8 * batch * heads * seq_len * head_dim * q.dtype.itemsize),
