@@ -364,31 +364,39 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
     else:
         report("flash_bwd", skipped="budget")
 
-    # -- long context: flash fwd+bwd at S=16k (dense spills/OOMs there) ----
+    # -- long context: flash fwd+bwd at S=16k (dense spills/OOMs there), --
+    # -- then the same shape through the sliding-window band ---------------
     if remaining() > 40:
         try:
             from covalent_tpu_plugin.ops.attention import flash_attention
 
             b, h, s, d = (1, 2, 2048, 64) if small else (1, 8, 16384, 64)
+            win = 256 if small else 1024
             q = jax.random.normal(jax.random.PRNGKey(0), (b, h, s, d), jnp.bfloat16)
             k = jax.random.normal(jax.random.PRNGKey(1), (b, h, s, d), jnp.bfloat16)
             v = jax.random.normal(jax.random.PRNGKey(2), (b, h, s, d), jnp.bfloat16)
-            grad_fn = jax.jit(
-                jax.grad(
-                    lambda q, k, v: flash_attention(q, k, v, causal=True)
-                    .astype(jnp.float32).sum(),
-                    argnums=(0, 1, 2),
+
+            def bwd_unit(window):
+                """One fwd+bwd timing at this shape; window=None = full."""
+                grad_fn = jax.jit(
+                    jax.grad(
+                        lambda q, k, v: flash_attention(
+                            q, k, v, causal=True, window=window
+                        ).astype(jnp.float32).sum(),
+                        argnums=(0, 1, 2),
+                    )
                 )
-            )
-            holder = {}
+                holder = {}
 
-            def dispatch():
-                holder["g"] = grad_fn(q, k, v)
+                def dispatch():
+                    holder["g"] = grad_fn(q, k, v)
 
-            def fetch():
-                jax.device_get(holder["g"][0][0, 0, 0, 0])
+                def fetch():
+                    jax.device_get(holder["g"][0][0, 0, 0, 0])
 
-            unit = unit_seconds(dispatch, fetch, target_s=3.0, cap=8)
+                return unit_seconds(dispatch, fetch, target_s=3.0, cap=8)
+
+            unit = bwd_unit(None)
             # attention flops: 4*S^2*D fwd + 10*S^2*D bwd, * 0.5 causal
             # (matches the kernels' own CostEstimates in ops/attention.py)
             att_tflops = 14 * b * h * s * s * d * 0.5 / unit / 1e12
@@ -399,51 +407,21 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
                 attn_tflops=round(att_tflops, 2),
                 note="dense S^2 path spills at this length (see benchmarks/)",
             )
+            if remaining() > 25:
+                win_unit = bwd_unit(win)
+                report(
+                    "flash_window",
+                    seq_len=s,
+                    window=win,
+                    fwd_bwd_ms=round(win_unit * 1e3, 2),
+                    speedup_vs_full=round(unit / win_unit, 2),
+                )
+            else:
+                report("flash_window", skipped="budget")
         except Exception as error:  # noqa: BLE001
             report("flash_long", error=repr(error))
     else:
         report("flash_long", skipped="budget")
-
-    # -- sliding-window flash at the same long-context shape ---------------
-    if remaining() > 30:
-        try:
-            from covalent_tpu_plugin.ops.attention import flash_attention
-
-            b, h, s, d = (1, 2, 2048, 64) if small else (1, 8, 16384, 64)
-            win = 256 if small else 1024
-            q = jax.random.normal(jax.random.PRNGKey(0), (b, h, s, d), jnp.bfloat16)
-            k = jax.random.normal(jax.random.PRNGKey(1), (b, h, s, d), jnp.bfloat16)
-            v = jax.random.normal(jax.random.PRNGKey(2), (b, h, s, d), jnp.bfloat16)
-            grad_fn = jax.jit(
-                jax.grad(
-                    lambda q, k, v: flash_attention(
-                        q, k, v, causal=True, window=win
-                    ).astype(jnp.float32).sum(),
-                    argnums=(0, 1, 2),
-                )
-            )
-            holder = {}
-
-            def dispatch():
-                holder["g"] = grad_fn(q, k, v)
-
-            def fetch():
-                jax.device_get(holder["g"][0][0, 0, 0, 0])
-
-            unit = unit_seconds(dispatch, fetch, target_s=3.0, cap=8)
-            full_ms = (results.get("flash_long") or {}).get("fwd_bwd_ms")
-            report(
-                "flash_window",
-                seq_len=s,
-                window=win,
-                fwd_bwd_ms=round(unit * 1e3, 2),
-                speedup_vs_full=(
-                    round(full_ms / (unit * 1e3), 2) if full_ms else None
-                ),
-            )
-        except Exception as error:  # noqa: BLE001
-            report("flash_window", error=repr(error))
-    else:
         report("flash_window", skipped="budget")
 
     # -- 125M-class LM train step + MFU (BASELINE config 5's model, 1 chip) -

@@ -44,9 +44,16 @@ def init_cache(model: TransformerLM, batch_size: int) -> Any:
         jax.random.PRNGKey(0),
         jnp.zeros((batch_size, 1), jnp.int32),
     )
-    return jax.tree_util.tree_map(
-        lambda leaf: jnp.zeros(leaf.shape, leaf.dtype), abstract["cache"]
-    )
+
+    def materialise(path, leaf):
+        if any(getattr(e, "key", None) == "slot_positions" for e in path):
+            # The rolling cache's "never written" sentinel is -1; zeroing
+            # it would make every empty ring slot claim absolute position
+            # 0 and leak phantom zero-K/V entries into early softmaxes.
+            return jnp.full(leaf.shape, -1, leaf.dtype)
+        return jnp.zeros(leaf.shape, leaf.dtype)
+
+    return jax.tree_util.tree_map_with_path(materialise, abstract["cache"])
 
 
 def inference_params(params: Any) -> Any:
@@ -126,7 +133,16 @@ def generate(
     config = decoder.config
     batch, prompt_len = prompt.shape
     total = prompt_len + max(max_new_tokens, 0)
-    if total > config.max_seq:
+    if config.rolling_cache:
+        # The circular cache frees generation from max_seq: only the
+        # prompt (one prefill slab at position 0) must fit the ring.
+        if prompt_len > config.sliding_window:
+            raise ValueError(
+                f"rolling_cache prefill of {prompt_len} tokens exceeds "
+                f"sliding_window ({config.sliding_window}); chunk or "
+                "truncate the prompt"
+            )
+    elif total > config.max_seq:
         raise ValueError(
             f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
             f"exceeds config.max_seq ({config.max_seq})"

@@ -77,6 +77,14 @@ def speculative_generate(
         raise ValueError(f"draft_len must be >= 1, got {draft_len}")
     if target_model.config.vocab_size != draft_model.config.vocab_size:
         raise ValueError("target and draft must share a vocabulary")
+    if target_model.config.rolling_cache or draft_model.config.rolling_cache:
+        # Verify slabs are multi-token writes at pos > 0: when one wraps
+        # the ring it erases band-edge entries earlier rows still need
+        # (the documented-lossy case), silently breaking the bit-exactness
+        # contract.  Refuse rather than approximate.
+        raise ValueError(
+            "speculative_generate does not support rolling_cache models"
+        )
     target = _decode_model(target_model)
     draft = _decode_model(draft_model)
     batch, prompt_len = prompt.shape

@@ -83,6 +83,13 @@ class TransformerConfig:
     #: outside the band are skipped (compute O(S·w)); unsupported with
     #: attention="ring" (shard the window over heads/batch instead).
     sliding_window: int | None = None
+    #: StreamingLLM-style circular KV cache for decode: cache length is
+    #: `sliding_window` instead of `max_seq` and generation can run past
+    #: max_seq at O(window) memory.  Requires sliding_window; exact for
+    #: the generate() flow (one prefill at position 0 + single-token
+    #: steps); a multi-token slab written at pos > 0 that wraps the ring
+    #: erases band-edge entries its earlier rows should still see.
+    rolling_cache: bool = False
     #: rotary embedding wavelength base (theta).  10k is the GPT-NeoX/
     #: llama default; raising it (e.g. 500k, llama-3 style) stretches the
     #: position resolution for long-context training — the standard knob
@@ -105,6 +112,8 @@ class TransformerConfig:
             raise ValueError(
                 f"sliding_window must be >= 1, got {self.sliding_window}"
             )
+        if self.rolling_cache and self.sliding_window is None:
+            raise ValueError("rolling_cache requires sliding_window")
 
     @property
     def head_dim(self) -> int:
@@ -267,21 +276,31 @@ class Attention(nn.Module):
         """
         cfg = self.config
         batch, slab = q.shape[:2]
-        if slab > cfg.max_seq:
+        rolling = cfg.rolling_cache
+        cache_len = cfg.sliding_window if rolling else cfg.max_seq
+        if slab > cache_len:
             raise ValueError(
-                f"slab of {slab} tokens exceeds config.max_seq {cfg.max_seq}"
+                f"slab of {slab} tokens exceeds the cache length {cache_len}"
             )
         cached_k = self.variable(
             "cache", "cached_k", jnp.zeros,
-            (batch, cfg.max_seq, kv_heads, cfg.head_dim), cfg.dtype,
+            (batch, cache_len, kv_heads, cfg.head_dim), cfg.dtype,
         )
         cached_v = self.variable(
             "cache", "cached_v", jnp.zeros,
-            (batch, cfg.max_seq, kv_heads, cfg.head_dim), cfg.dtype,
+            (batch, cache_len, kv_heads, cfg.head_dim), cfg.dtype,
         )
         cursor = self.variable(
             "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
         )
+        if rolling:
+            # Which absolute position each circular slot currently holds;
+            # -1 = never written.  Makes the band mask exact across wraps
+            # with no modular-arithmetic reconstruction.
+            slot_pos = self.variable(
+                "cache", "slot_positions",
+                lambda: jnp.full((cache_len,), -1, jnp.int32),
+            )
         if self.is_initializing():
             # init only materialises the zeroed cache; no attention math.
             return self._out_proj(jnp.zeros_like(q))
@@ -289,12 +308,21 @@ class Attention(nn.Module):
         pos = cursor.value
         q = _rotary(q, base=cfg.rope_base, offset=pos)
         k = _rotary(k, base=cfg.rope_base, offset=pos)
-        cached_k.value = jax.lax.dynamic_update_slice(
-            cached_k.value, k.astype(cfg.dtype), (0, pos, 0, 0)
-        )
-        cached_v.value = jax.lax.dynamic_update_slice(
-            cached_v.value, v.astype(cfg.dtype), (0, pos, 0, 0)
-        )
+        q_positions = pos + jnp.arange(slab)
+        if rolling:
+            # Circular write: token at absolute position p lands in slot
+            # p % W (a scatter — dynamic_update_slice can't wrap).
+            idx = q_positions % cache_len
+            cached_k.value = cached_k.value.at[:, idx].set(k.astype(cfg.dtype))
+            cached_v.value = cached_v.value.at[:, idx].set(v.astype(cfg.dtype))
+            slot_pos.value = slot_pos.value.at[idx].set(q_positions)
+        else:
+            cached_k.value = jax.lax.dynamic_update_slice(
+                cached_k.value, k.astype(cfg.dtype), (0, pos, 0, 0)
+            )
+            cached_v.value = jax.lax.dynamic_update_slice(
+                cached_v.value, v.astype(cfg.dtype), (0, pos, 0, 0)
+            )
         cursor.value = pos + slab
 
         # One path for prefill slabs AND single-token steps: the slab's
@@ -307,11 +335,19 @@ class Attention(nn.Module):
             "bqhgd,bshd->bhgqs", qg, cached_k.value,
             preferred_element_type=jnp.float32,
         ) * (cfg.head_dim**-0.5)
-        q_positions = pos + jnp.arange(slab)
-        slots = jnp.arange(cfg.max_seq)[None, :]
-        visible = slots <= q_positions[:, None]
-        if cfg.sliding_window is not None:
-            visible &= slots > q_positions[:, None] - cfg.sliding_window
+        if rolling:
+            # Mask by each slot's recorded absolute position: the band is
+            # exact whether or not the cache has wrapped, and a query in
+            # this slab can see same-slab earlier tokens (their slots were
+            # just written) but not slots later tokens will overwrite.
+            sp = slot_pos.value[None, :]
+            visible = (sp >= 0) & (sp <= q_positions[:, None])
+            visible &= sp > q_positions[:, None] - cfg.sliding_window
+        else:
+            slots = jnp.arange(cache_len)[None, :]
+            visible = slots <= q_positions[:, None]
+            if cfg.sliding_window is not None:
+                visible &= slots > q_positions[:, None] - cfg.sliding_window
         scores = jnp.where(visible[None, None, None, :, :], scores, NEG_INF)
         probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
         out = jnp.einsum(

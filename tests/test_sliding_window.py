@@ -204,3 +204,80 @@ def test_ring_rejects_window():
 def test_config_rejects_nonpositive_window():
     with pytest.raises(ValueError, match="sliding_window must be"):
         dataclasses.replace(BASE, sliding_window=0)
+
+
+ROLLING = dataclasses.replace(BASE, rolling_cache=True)
+
+
+def test_rolling_cache_matches_standard_within_max_seq():
+    """While total length fits max_seq, the ring must produce exactly the
+    standard windowed cache's tokens AND logits — including after the
+    ring wraps.  (Token-only comparison once hid a phantom-slot bug whose
+    logit error didn't happen to flip an argmax.)"""
+    from covalent_tpu_plugin.models.decode import _decode_model, init_cache
+
+    model = TransformerLM(BASE)
+    rolling = TransformerLM(ROLLING)
+    for seed in (1, 2, 3):
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(seed), (2, 4), 0, BASE.vocab_size
+        )
+        params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+        # Prefill logits bit-for-tolerance, not just their argmax.
+        std_logits, _ = _decode_model(model).apply(
+            {"params": params, "cache": init_cache(model, 2)}, prompt,
+            mutable=["cache"],
+        )
+        roll_logits, _ = _decode_model(rolling).apply(
+            {"params": params, "cache": init_cache(rolling, 2)}, prompt,
+            mutable=["cache"],
+        )
+        np.testing.assert_allclose(
+            np.asarray(roll_logits), np.asarray(std_logits),
+            atol=1e-5, rtol=1e-5,
+        )
+        want = generate(model, params, prompt, 20)  # wraps the ring 3x
+        got = generate(rolling, params, prompt, 20)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_rolling_cache_generates_past_max_seq():
+    """The point of the ring: generation beyond max_seq at O(window)
+    memory, with finite outputs and an intact prompt."""
+    model = TransformerLM(ROLLING)
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (1, 5), 0, BASE.vocab_size)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    n_new = BASE.max_seq + 10  # 42 > max_seq=32
+    out = jax.jit(lambda p, t: generate(model, p, t, n_new))(params, prompt)
+    assert out.shape == (1, 5 + n_new)
+    arr = np.asarray(out)
+    np.testing.assert_array_equal(arr[:, :5], np.asarray(prompt))
+    assert (arr >= 0).all() and (arr < BASE.vocab_size).all()
+    # The ring really is window-sized, not max_seq-sized.
+    from covalent_tpu_plugin.models.decode import init_cache
+
+    cache = init_cache(model, 1)
+    k_leaves = [
+        leaf for path, leaf in jax.tree_util.tree_flatten_with_path(cache)[0]
+        if any(getattr(e, "key", None) == "cached_k" for e in path)
+    ]
+    assert all(leaf.shape[-3] == BASE.sliding_window for leaf in k_leaves)
+
+
+def test_rolling_cache_validation():
+    with pytest.raises(ValueError, match="rolling_cache requires"):
+        dataclasses.replace(BASE, sliding_window=None, rolling_cache=True)
+    model = TransformerLM(ROLLING)
+    long_prompt = jnp.zeros((1, 10), jnp.int32)  # > window of 6
+    params = TransformerLM(BASE).init(
+        jax.random.PRNGKey(0), long_prompt[:, :4]
+    )["params"]
+    with pytest.raises(ValueError, match="exceeds"):
+        generate(model, params, long_prompt, 4)
+    # Speculative decoding refuses rolling models outright.
+    from covalent_tpu_plugin.models import speculative_generate
+
+    with pytest.raises(ValueError, match="rolling_cache"):
+        speculative_generate(
+            model, params, model, params, long_prompt[:, :4], 4
+        )
