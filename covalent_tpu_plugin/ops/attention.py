@@ -61,41 +61,170 @@ def on_tpu() -> bool:
     return "tpu" in (device.platform + " " + getattr(device, "device_kind", "")).lower()
 
 
-def _band_visible(qpos, kpos, window: int | None):
+def _band_visible(qpos, kpos, window: int | None, sinks: int = 0):
     """Causal(-band) visibility on broadcastable position grids: row sees
-    column iff ``q >= k`` and (windowed) ``q - k < window`` — the ONE
-    definition of the band, shared by every kernel and the dense oracle."""
-    mask = qpos >= kpos
-    if window is not None:
-        mask = jnp.logical_and(mask, qpos - kpos < window)
-    return mask
+    column iff ``q >= k`` and (windowed) ``q - k < window``, OR — with
+    ``sinks`` (StreamingLLM attention sinks) — ``k < sinks`` and
+    ``q >= k``.  The ONE definition of the band, shared by every kernel
+    and the dense oracle."""
+    causal_ok = qpos >= kpos
+    if window is None:
+        return causal_ok
+    in_band = qpos - kpos < window
+    if sinks:
+        in_band = jnp.logical_or(in_band, kpos < sinks)
+    return jnp.logical_and(causal_ok, in_band)
 
 
-def _band_tile_needed(qpos_tile, kpos_tile, causal: bool, window: int | None):
+def _band_tile_needed(qpos_tile, kpos_tile, causal: bool, window: int | None,
+                      sinks: int = 0):
     """Whether a (query tile, key tile) pair intersects the visible band.
 
     ``min(k) <= max(q)`` kills tiles wholly in the future; with a window,
-    ``max(k) > min(q) - window`` kills tiles wholly behind the band.  The
-    same bounds serve all three sweeps (for dk/dv the roles read swapped
-    but the inequalities are algebraically identical).
+    ``max(k) > min(q) - window`` kills tiles wholly behind the band —
+    unless the tile holds sink columns (``min(k) < sinks``), which stay
+    visible at any distance.  The same bounds serve all three sweeps (for
+    dk/dv the roles read swapped but the inequalities are algebraically
+    identical).
     """
     needed = True if not causal else (
         jnp.min(kpos_tile) <= jnp.max(qpos_tile)
     )
     if window is not None:
-        needed = jnp.logical_and(
-            needed, jnp.max(kpos_tile) > jnp.min(qpos_tile) - window
-        )
+        behind_ok = jnp.max(kpos_tile) > jnp.min(qpos_tile) - window
+        if sinks:
+            behind_ok = jnp.logical_or(behind_ok, jnp.min(kpos_tile) < sinks)
+        needed = jnp.logical_and(needed, behind_ok)
     return needed
 
 
-def _check_window(window, causal) -> None:
+def _check_window(window, causal, sinks: int = 0) -> None:
+    if sinks:
+        if sinks < 0:
+            raise ValueError(f"sinks must be >= 0, got {sinks}")
+        if window is None:
+            raise ValueError("sinks (attention sinks) require a window")
     if window is None:
         return
     if not causal:
         raise ValueError("window (sliding-window attention) requires causal")
     if window < 1:
         raise ValueError(f"window must be >= 1, got {window}")
+
+
+# --- Band-only grid (windowed attention, contiguous positions) -----------
+#
+# With a sliding window the visible band covers only ~S·w of the S² score
+# matrix.  The `@pl.when` tile-skip alone saves the MXU work but the grid
+# still *visits* (and DMAs) every K/V tile — at S=16k/w=1k that is ~8× of
+# wasted HBM traffic (measured: the windowed win saturated near 2× of a
+# ~16× opportunity, BENCH_r02).  When positions are the default contiguous
+# arange, the tiles a query tile needs are statically a contiguous run of
+# ~⌈(BQ+w)/BK⌉+1 key tiles, so the sweep dimension can be shrunk to that
+# run with a q-tile-relative index_map.  The index_map clamps to the last
+# real tile; the kernel decides liveness from grid ids + static block
+# sizes (NOT the clamped DMA index), so a clamped duplicate tile is never
+# double-counted.  Striped/ring position vectors fall back to the full
+# grid with the @pl.when skip.
+
+
+def _banded_n_inner_kt(seq_q: int, seq_k: int, block_q: int, block_k: int,
+                       window: int) -> int | None:
+    """Static length of the inner key-tile sweep for the banded forward/dq
+    grids: the max number of key tiles any query tile's band touches.
+    Returns None when the band covers the full sweep anyway (no gain)."""
+    kt_full = seq_k // block_k
+    worst = 0
+    for i in range(seq_q // block_q):
+        lo = max(0, (i * block_q - (window - 1)) // block_k)
+        hi = min(kt_full - 1, ((i + 1) * block_q - 1) // block_k)
+        if hi >= lo:
+            worst = max(worst, hi - lo + 1)
+    return worst if 0 < worst < kt_full else None
+
+
+def _banded_n_inner_qt(seq_q: int, seq_k: int, block_q: int, block_k: int,
+                       window: int) -> int | None:
+    """Static length of the inner query-tile sweep for the banded dk/dv
+    grid: the max number of query tiles any key tile's band touches."""
+    qt_full = seq_q // block_q
+    worst = 0
+    for jk in range(seq_k // block_k):
+        lo = (jk * block_k) // block_q
+        hi = min(qt_full - 1, ((jk + 1) * block_k - 1 + window - 1) // block_q)
+        if hi >= lo:
+            worst = max(worst, hi - lo + 1)
+    return worst if 0 < worst < qt_full else None
+
+
+def _band_kt_lo(i, block_q: int, block_k: int, window: int):
+    """Traced first key tile of query tile ``i``'s band (contiguous pos)."""
+    return jnp.maximum(i * block_q - (window - 1), 0) // block_k
+
+
+def _band_kt_live(i, jj, block_q: int, block_k: int, window: int,
+                  kt_full: int):
+    """Whether inner step ``jj`` of query tile ``i`` is a live band tile
+    (vs. a clamped duplicate past the causal edge)."""
+    hi = jnp.minimum(((i + 1) * block_q - 1) // block_k, kt_full - 1)
+    return _band_kt_lo(i, block_q, block_k, window) + jj <= hi
+
+
+def _band_qt_lo(jk, block_q: int, block_k: int):
+    """Traced first query tile of key tile ``jk``'s band (causal bound)."""
+    return (jk * block_k) // block_q
+
+
+def _banded_sweep_kt(seq_q: int, seq_k: int, block_q: int, block_k: int,
+                     window, enabled: bool):
+    """(steps, tile_index_fn, band) for a key-tile inner sweep.
+
+    Banded (shrunken, q-tile-relative clamped indexing) when it helps;
+    otherwise the full sweep with identity indexing and ``band=None``.
+    The ONE constructor for the forward and dq grids, so clamp-bound or
+    geometry changes happen in a single place.
+    """
+    kt_full = seq_k // block_k
+    n_inner = (
+        _banded_n_inner_kt(seq_q, seq_k, block_q, block_k, window)
+        if enabled else None
+    )
+    if n_inner is None:
+        return kt_full, (lambda i, jj: jj), None
+
+    def tile(i, jj):
+        return jnp.minimum(
+            _band_kt_lo(i, block_q, block_k, window) + jj, kt_full - 1
+        )
+
+    return n_inner, tile, (block_q, block_k, kt_full)
+
+
+def _banded_sweep_qt(seq_q: int, seq_k: int, block_q: int, block_k: int,
+                     window, enabled: bool):
+    """(steps, tile_index_fn, band) for the dk/dv query-tile inner sweep."""
+    qt_full = seq_q // block_q
+    n_inner = (
+        _banded_n_inner_qt(seq_q, seq_k, block_q, block_k, window)
+        if enabled else None
+    )
+    if n_inner is None:
+        return qt_full, (lambda jk, qq: qq), None
+
+    def tile(jk, qq):
+        return jnp.minimum(
+            _band_qt_lo(jk, block_q, block_k) + qq, qt_full - 1
+        )
+
+    return n_inner, tile, (block_q, block_k, qt_full)
+
+
+def _band_qt_live(jk, qq, block_q: int, block_k: int, window: int,
+                  qt_full: int):
+    hi = jnp.minimum(
+        ((jk + 1) * block_k - 1 + window - 1) // block_q, qt_full - 1
+    )
+    return _band_qt_lo(jk, block_q, block_k) + qq <= hi
 
 
 def mha_reference(
@@ -105,15 +234,18 @@ def mha_reference(
     causal: bool = True,
     scale: float | None = None,
     window: int | None = None,
+    sinks: int = 0,
 ) -> jax.Array:
     """Dense multi-head attention oracle.  Shapes: (B, H, S, D).
 
     Grouped-query attention: ``k``/``v`` may carry fewer heads than ``q``
     (``H_q % H_kv == 0``); each kv head serves a contiguous group of query
     heads, matching the flash kernel's convention.  ``window=w`` masks to
-    the sliding causal band: row ``i`` sees columns ``(i-w, i]``.
+    the sliding causal band: row ``i`` sees columns ``(i-w, i]``;
+    ``sinks=k`` (StreamingLLM) keeps the first ``k`` columns visible to
+    every row alongside the band.
     """
-    _check_window(window, causal)
+    _check_window(window, causal, sinks)
     if k.shape[1] != q.shape[1]:
         group = _gqa_group(q, k)
         k = jnp.repeat(k, group, axis=1)
@@ -127,7 +259,7 @@ def mha_reference(
         s_q, s_k = q.shape[2], k.shape[2]
         qi = jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 0)
         ki = jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 1)
-        scores = jnp.where(_band_visible(qi, ki, window), scores, _NEG_INF)
+        scores = jnp.where(_band_visible(qi, ki, window, sinks), scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum(
         "bhqk,bhkd->bhqd", probs.astype(v.dtype), v, preferred_element_type=jnp.float32
@@ -137,7 +269,8 @@ def mha_reference(
 
 def _flash_kernel(q_ref, k_ref, v_ref, qpos_ref, kpos_ref, o_ref, lse_ref,
                   m_ref, l_ref, acc_ref,
-                  *, causal: bool, scale: float, window: int | None = None):
+                  *, causal: bool, scale: float, window: int | None = None,
+                  sinks: int = 0, band: tuple[int, int, int] | None = None):
     """One (query tile, key tile) grid cell.
 
     The key-tile index is the *innermost* grid dimension, so for a fixed
@@ -162,7 +295,20 @@ def _flash_kernel(q_ref, k_ref, v_ref, qpos_ref, kpos_ref, o_ref, lse_ref,
     # default contiguous layout (reproducing the classic above-diagonal
     # skip, ~2x fewer ops) and conservative-but-correct for arbitrary
     # ring/striped position vectors.
-    needed = _band_tile_needed(qpos_ref[:, :], kpos_ref[:, :], causal, window)
+    needed = _band_tile_needed(
+        qpos_ref[:, :], kpos_ref[:, :], causal, window, sinks
+    )
+    if band is not None:
+        # Banded grid: the inner sweep visits only the band's tile run; a
+        # step past the causal edge DMA'd a clamped duplicate whose
+        # position tile would wrongly read "needed" — liveness must come
+        # from grid ids + static geometry, never the DMA'd positions.
+        block_q, block_k, kt_full = band
+        needed = jnp.logical_and(
+            needed,
+            _band_kt_live(pl.program_id(2), kt, block_q, block_k, window,
+                          kt_full),
+        )
 
     @pl.when(needed)
     def _tile():
@@ -183,7 +329,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, qpos_ref, kpos_ref, o_ref, lse_ref,
             # Masking reads GLOBAL positions — (BQ,1) against (1,BK) —
             # so striped/rotated layouts (ring attention) mask correctly;
             # contiguous arange positions reproduce the classic diagonal.
-            mask = _band_visible(qpos_ref[:, :], kpos_ref[:, :], window)
+            mask = _band_visible(qpos_ref[:, :], kpos_ref[:, :], window, sinks)
             s = jnp.where(mask, s, _NEG_INF)
 
         m_prev = m_ref[:]
@@ -248,7 +394,7 @@ def _positions_2d(q_positions, k_positions, seq_len_q: int, seq_len_k: int):
 def _flash_forward(
     q, k, v, q_positions, k_positions, causal: bool,
     block_q: int | None, block_k: int | None, interpret: bool,
-    out_dtype=None, window: int | None = None,
+    out_dtype=None, window: int | None = None, sinks: int = 0,
 ):
     batch, heads, seq_len, head_dim = q.shape
     seq_len_k = k.shape[2]
@@ -273,26 +419,38 @@ def _flash_forward(
 
     group = _gqa_group(q, k)
     qpos, kpos = _positions_2d(q_positions, k_positions, seq_len, seq_len_k)
-    grid = (batch, heads, seq_len // block_q, seq_len_k // block_k)
+    contiguous = q_positions is None and k_positions is None
+    # Attention sinks splinter the needed key tiles into two runs (sink
+    # tiles + band run) — not yet a banded grid shape; fall back to the
+    # full grid with the @pl.when tile-skip when sinks are on.
+    steps, _kj, band = _banded_sweep_kt(
+        seq_len, seq_len_k, block_q, block_k, window,
+        window is not None and causal and contiguous and not sinks,
+    )
+    grid = (batch, heads, seq_len // block_q, steps)
     qo_spec = pl.BlockSpec(
         (1, 1, block_q, head_dim), lambda b, h, i, j: (b, h, i, 0)
     )
+    qpos_spec = pl.BlockSpec((block_q, 1), lambda b, h, i, j: (i, 0))
+    lse_spec = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0))
     # GQA: each query head reads its group's shared kv head (h // group).
     kv_spec = pl.BlockSpec(
-        (1, 1, block_k, head_dim), lambda b, h, i, j: (b, h // group, j, 0)
+        (1, 1, block_k, head_dim),
+        lambda b, h, i, j: (b, h // group, _kj(i, j), 0),
     )
-    qpos_spec = pl.BlockSpec((block_q, 1), lambda b, h, i, j: (i, 0))
-    kpos_spec = pl.BlockSpec((1, block_k), lambda b, h, i, j: (0, j))
-    lse_spec = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0))
+    kpos_spec = pl.BlockSpec((1, block_k), lambda b, h, i, j: (0, _kj(i, j)))
     kernel = functools.partial(
-        _flash_kernel, causal=causal, scale=scale, window=window
+        _flash_kernel, causal=causal, scale=scale, window=window,
+        sinks=sinks, band=band,
     )
     flops_factor = 0.5 if causal else 1.0
     if window is not None:
-        # The band covers ~S*w of the S^2 score matrix; feeding the causal
-        # half-estimate to the compiler's cost model would overstate a
-        # w<<S kernel by ~S/(2w) and skew latency-hiding decisions.
-        flops_factor = min(flops_factor, window / max(seq_len_k, 1))
+        # The band covers ~S*(w+sinks) of the S^2 score matrix; feeding
+        # the causal half-estimate to the compiler's cost model would
+        # overstate a w<<S kernel by ~S/(2w) and skew latency-hiding.
+        flops_factor = min(
+            flops_factor, (window + sinks) / max(seq_len_k, 1)
+        )
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -327,7 +485,8 @@ _DEFAULT_BWD_BLOCK = 1024
 def _flash_bwd_dkdv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qpos_ref, kpos_ref,
     dk_ref, dv_ref, dk_acc, dv_acc,
-    *, causal: bool, scale: float, window: int | None = None
+    *, causal: bool, scale: float, window: int | None = None,
+    sinks: int = 0, band: tuple[int, int, int] | None = None
 ):
     """One (kv head, key tile, group member, query tile) cell of the dk/dv
     sweep, grid (B, H_kv, KT, G, QT).
@@ -351,7 +510,18 @@ def _flash_bwd_dkdv_kernel(
     # A query tile entirely in the past of this key tile contributes no
     # gradient under causal masking; the position-tile bound check is exact
     # for contiguous layouts and conservative for striped ones.
-    needed = _band_tile_needed(qpos_ref[:, :], kpos_ref[:, :], causal, window)
+    needed = _band_tile_needed(
+        qpos_ref[:, :], kpos_ref[:, :], causal, window, sinks
+    )
+    if band is not None:
+        # Banded grid: liveness from grid ids + static geometry (clamped
+        # duplicate tiles must not double-count) — see forward kernel.
+        block_q, block_k, qt_full = band
+        needed = jnp.logical_and(
+            needed,
+            _band_qt_live(pl.program_id(2), qt, block_q, block_k, window,
+                          qt_full),
+        )
 
     @pl.when(needed)
     def _tile():
@@ -370,7 +540,8 @@ def _flash_bwd_dkdv_kernel(
         p = jnp.exp(s - lse)  # exactly the forward's normalised probabilities
         if causal:
             p = jnp.where(
-                _band_visible(qpos_ref[:, :], kpos_ref[:, :], window), p, 0.0
+                _band_visible(qpos_ref[:, :], kpos_ref[:, :], window, sinks),
+                p, 0.0,
             )
 
         # dV += P^T dO ; dP = dO V^T ; dS = P*(dP - delta)*scale ; dK += dS^T Q
@@ -400,7 +571,8 @@ def _flash_bwd_dkdv_kernel(
 def _flash_bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qpos_ref, kpos_ref,
     dq_ref, dq_acc,
-    *, causal: bool, scale: float, window: int | None = None
+    *, causal: bool, scale: float, window: int | None = None,
+    sinks: int = 0, band: tuple[int, int, int] | None = None
 ):
     """One (query tile, key tile) cell of the dq sweep (key tiles innermost)."""
     kt = pl.program_id(3)
@@ -410,7 +582,16 @@ def _flash_bwd_dq_kernel(
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    needed = _band_tile_needed(qpos_ref[:, :], kpos_ref[:, :], causal, window)
+    needed = _band_tile_needed(
+        qpos_ref[:, :], kpos_ref[:, :], causal, window, sinks
+    )
+    if band is not None:
+        block_q, block_k, kt_full = band
+        needed = jnp.logical_and(
+            needed,
+            _band_kt_live(pl.program_id(2), kt, block_q, block_k, window,
+                          kt_full),
+        )
 
     @pl.when(needed)
     def _tile():
@@ -429,7 +610,8 @@ def _flash_bwd_dq_kernel(
         p = jnp.exp(s - lse)
         if causal:
             p = jnp.where(
-                _band_visible(qpos_ref[:, :], kpos_ref[:, :], window), p, 0.0
+                _band_visible(qpos_ref[:, :], kpos_ref[:, :], window, sinks),
+                p, 0.0,
             )
 
         dp = jax.lax.dot_general(
@@ -451,7 +633,8 @@ def _flash_bwd_dq_kernel(
 
 def _flash_backward(
     q, k, v, out, lse, g, q_positions, k_positions, causal: bool,
-    interpret: bool, delta=None, grad_dtype=None, window: int | None = None
+    interpret: bool, delta=None, grad_dtype=None, window: int | None = None,
+    sinks: int = 0,
 ):
     """FlashAttention-2 backward: two Pallas sweeps, O(S·D) HBM."""
     batch, heads, seq_len, head_dim = q.shape
@@ -474,36 +657,49 @@ def _flash_backward(
 
     flops_factor = 0.5 if causal else 1.0
     if window is not None:
-        # The band covers ~S*w of the S^2 score matrix; feeding the causal
-        # half-estimate to the compiler's cost model would overstate a
-        # w<<S kernel by ~S/(2w) and skew latency-hiding decisions.
-        flops_factor = min(flops_factor, window / max(seq_len_k, 1))
+        # The band covers ~S*(w+sinks) of the S^2 score matrix; feeding
+        # the causal half-estimate to the compiler's cost model would
+        # overstate a w<<S kernel by ~S/(2w) and skew latency-hiding.
+        flops_factor = min(flops_factor, (window + sinks) / max(seq_len_k, 1))
     cost = pl.CostEstimate(
         flops=int(10 * batch * heads * seq_len * seq_len_k * head_dim * flops_factor),
         bytes_accessed=int(8 * batch * heads * seq_len * head_dim * q.dtype.itemsize),
         transcendentals=int(batch * heads * seq_len * seq_len_k * flops_factor),
     )
 
+    contiguous = q_positions is None and k_positions is None
+    # Sinks splinter the tile runs: full grid + tile-skip (see forward).
+    banded = window is not None and causal and contiguous and not sinks
+    qt_full = seq_len // block_q
+    kt_full = seq_len_k // block_k
+
     # dk/dv sweep — grid (B, H_kv, KT, G, QT): group member + query tile are
     # innermost so one (kv head, key tile) output block accumulates across
-    # every query head in its group (see kernel docstring).
+    # every query head in its group (see kernel docstring).  With a window
+    # the QT sweep shrinks to the band's query-tile run (see forward).
+    n_inner_qt, _qi, band_kv = _banded_sweep_qt(
+        seq_len, seq_len_k, block_q, block_k, window, banded
+    )
+
     qo_spec_q = pl.BlockSpec(
         (1, 1, block_q, head_dim),
-        lambda b, h, i, gi, j: (b, h * group + gi, j, 0),
+        lambda b, h, i, gi, j: (b, h * group + gi, _qi(i, j), 0),
     )
     kv_spec_k = pl.BlockSpec(
         (1, 1, block_k, head_dim), lambda b, h, i, gi, j: (b, h, i, 0)
     )
     stat_spec_q = pl.BlockSpec(
-        (1, 1, block_q, 1), lambda b, h, i, gi, j: (b, h * group + gi, j, 0)
+        (1, 1, block_q, 1),
+        lambda b, h, i, gi, j: (b, h * group + gi, _qi(i, j), 0),
     )
-    qpos_spec_q = pl.BlockSpec((block_q, 1), lambda b, h, i, gi, j: (j, 0))
+    qpos_spec_q = pl.BlockSpec((block_q, 1), lambda b, h, i, gi, j: (_qi(i, j), 0))
     kpos_spec_k = pl.BlockSpec((1, block_k), lambda b, h, i, gi, j: (0, i))
     dk, dv = pl.pallas_call(
         functools.partial(
-            _flash_bwd_dkdv_kernel, causal=causal, scale=scale, window=window
+            _flash_bwd_dkdv_kernel, causal=causal, scale=scale, window=window,
+            sinks=sinks, band=band_kv,
         ),
-        grid=(batch, kv_heads, seq_len_k // block_k, group, seq_len // block_q),
+        grid=(batch, kv_heads, kt_full, group, n_inner_qt),
         in_specs=[qo_spec_q, kv_spec_k, kv_spec_k, qo_spec_q, stat_spec_q,
                   stat_spec_q, qpos_spec_q, kpos_spec_k],
         out_specs=[kv_spec_k, kv_spec_k],
@@ -521,20 +717,27 @@ def _flash_backward(
         cost_estimate=cost,
     )(q, k, v, g, lse, delta, qpos, kpos)
 
+    # dq sweep — banded exactly like the forward (key tiles innermost).
+    n_inner_kt, _kj, band_q = _banded_sweep_kt(
+        seq_len, seq_len_k, block_q, block_k, window, banded
+    )
+
     qo_spec_i = pl.BlockSpec(
         (1, 1, block_q, head_dim), lambda b, h, i, j: (b, h, i, 0)
     )
     kv_spec_j = pl.BlockSpec(
-        (1, 1, block_k, head_dim), lambda b, h, i, j: (b, h // group, j, 0)
+        (1, 1, block_k, head_dim),
+        lambda b, h, i, j: (b, h // group, _kj(i, j), 0),
     )
     stat_spec_i = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0))
     qpos_spec_i = pl.BlockSpec((block_q, 1), lambda b, h, i, j: (i, 0))
-    kpos_spec_j = pl.BlockSpec((1, block_k), lambda b, h, i, j: (0, j))
+    kpos_spec_j = pl.BlockSpec((1, block_k), lambda b, h, i, j: (0, _kj(i, j)))
     dq = pl.pallas_call(
         functools.partial(
-            _flash_bwd_dq_kernel, causal=causal, scale=scale, window=window
+            _flash_bwd_dq_kernel, causal=causal, scale=scale, window=window,
+            sinks=sinks, band=band_q,
         ),
-        grid=(batch, heads, seq_len // block_q, seq_len_k // block_k),
+        grid=(batch, heads, qt_full, n_inner_kt),
         in_specs=[qo_spec_i, kv_spec_j, kv_spec_j, qo_spec_i, stat_spec_i,
                   stat_spec_i, qpos_spec_i, kpos_spec_j],
         out_specs=qo_spec_i,
@@ -555,30 +758,31 @@ def _pos_zero(positions):
     return jnp.zeros(jnp.shape(positions), dtype=jax.dtypes.float0)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
 def _flash(q, k, v, q_positions, k_positions, causal, block_q, block_k,
-           interpret, window):
+           interpret, window, sinks):
     out, _ = _flash_forward(
         q, k, v, q_positions, k_positions, causal, block_q, block_k, interpret,
-        window=window,
+        window=window, sinks=sinks,
     )
     return out
 
 
 def _flash_fwd(q, k, v, q_positions, k_positions, causal, block_q, block_k,
-               interpret, window):
+               interpret, window, sinks):
     out, lse = _flash_forward(
         q, k, v, q_positions, k_positions, causal, block_q, block_k, interpret,
-        window=window,
+        window=window, sinks=sinks,
     )
     return out, (q, k, v, out, lse, q_positions, k_positions)
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, window, residuals, g):
+def _flash_bwd(causal, block_q, block_k, interpret, window, sinks,
+               residuals, g):
     q, k, v, out, lse, q_positions, k_positions = residuals
     dq, dk, dv = _flash_backward(
         q, k, v, out, lse, g, q_positions, k_positions, causal, interpret,
-        window=window,
+        window=window, sinks=sinks,
     )
     return dq, dk, dv, _pos_zero(q_positions), _pos_zero(k_positions)
 
@@ -598,6 +802,7 @@ def flash_attention(
     block_k: int | None = None,
     interpret: bool | None = None,
     window: int | None = None,
+    sinks: int = 0,
 ) -> jax.Array:
     """Flash attention over (B, H, S, D) inputs.
 
@@ -624,15 +829,19 @@ def flash_attention(
 
     ``window=w`` (sliding-window / Mistral-style local attention,
     requires ``causal``) restricts each query to the ``w`` most recent
-    positions; tiles wholly outside the band are skipped in the forward
-    AND both backward sweeps, so compute scales O(S·w) instead of O(S²).
+    positions; with default contiguous positions the grids visit ONLY the
+    band's tiles (compute and DMA scale O(S·w) instead of O(S²)).
+    ``sinks=k`` (StreamingLLM attention sinks) keeps columns ``< k``
+    visible to every row alongside the band — the full grid with the
+    tile-level skip then applies (a sink run + band run is not a single
+    banded sweep).
     """
-    _check_window(window, causal)
+    _check_window(window, causal, sinks)
     if interpret is None:
         interpret = not on_tpu()
     return _flash(
         q, k, v, q_positions, k_positions, causal, block_q, block_k,
-        interpret, window,
+        interpret, window, sinks,
     )
 
 

@@ -117,6 +117,72 @@ def test_flash_backward_matches_reference(window):
         )
 
 
+def test_banded_grid_static_geometry():
+    """The band-only grid really shrinks the inner sweep: at S=16k,
+    w=1k with the default fwd/bwd tiles the key-tile (and query-tile)
+    sweeps drop from 16 steps to 2 — this is the DMA-skip that turns the
+    windowed win from ~2x into ~O(S/w)."""
+    from covalent_tpu_plugin.ops.attention import (
+        _banded_n_inner_kt, _banded_n_inner_qt,
+    )
+
+    assert _banded_n_inner_kt(16384, 16384, 512, 1024, 1024) == 2
+    assert _banded_n_inner_qt(16384, 16384, 1024, 1024, 1024) == 2
+    # Window >= sequence: no shrink possible, full grid (None) expected.
+    assert _banded_n_inner_kt(256, 256, 64, 64, 10_000) is None
+    assert _banded_n_inner_qt(256, 256, 64, 64, 10_000) is None
+    # Tiny window still visits >= 1 tile per query tile.
+    assert _banded_n_inner_kt(256, 256, 64, 64, 1) == 1
+
+
+@pytest.mark.parametrize("bq,bk", [(64, 128), (128, 64), (64, 64)])
+def test_banded_grid_clamped_edges_exact(bq, bk):
+    """Block shapes where the band's first tiles clamp at 0 and the causal
+    edge produces duplicate (dead) DMA steps: liveness must come from grid
+    arithmetic, not the clamped position tiles, or edge tiles double-count."""
+    q, k, v = qkv(s=512)
+    for window in (100, 130, 257):
+        want = np.asarray(
+            mha_reference(q, k, v, causal=True, window=window), np.float32
+        )
+        got = np.asarray(
+            flash_attention(
+                q, k, v, causal=True, window=window, block_q=bq, block_k=bk
+            ),
+            np.float32,
+        )
+        np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_banded_backward_gqa_exact():
+    """Banded dk/dv sweep must still sum gradients over the GQA group."""
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = jax.random.normal(ks[0], (1, 4, 512, 64))
+    k = jax.random.normal(ks[1], (1, 2, 512, 64))
+    v = jax.random.normal(ks[2], (1, 2, 512, 64))
+
+    def loss(fn):
+        return lambda q, k, v: (
+            fn(q, k, v).astype(jnp.float32) * jnp.cos(jnp.arange(64.0))
+        ).sum()
+
+    g_ref = jax.grad(
+        loss(lambda q, k, v: mha_reference(q, k, v, causal=True, window=150)),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    g_flash = jax.grad(
+        loss(lambda q, k, v: flash_attention(
+            q, k, v, causal=True, window=150, block_q=128, block_k=128
+        )),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            atol=5e-5, rtol=5e-5,
+        )
+
+
 def test_window_equals_full_causal_when_wider_than_sequence():
     q, k, v = qkv(s=128)
     full = np.asarray(flash_attention(q, k, v, causal=True), np.float32)
@@ -190,15 +256,22 @@ def test_windowed_pipeline_matches_dense():
     )
 
 
-def test_ring_rejects_window():
+def test_windowed_ring_model_matches_reference_model():
+    """sliding_window + attention='ring' compose (the banded ring): the
+    model's logits must equal the windowed reference-attention model's."""
     from covalent_tpu_plugin.parallel import MeshPlan, make_mesh
 
     mesh = make_mesh(MeshPlan(seq=2, data=4))
     cfg = dataclasses.replace(BASE, attention="ring", mesh=mesh)
     model = TransformerLM(cfg)
-    tokens = jnp.zeros((4, 8), jnp.int32)
-    with pytest.raises(ValueError, match="sliding_window is unsupported"):
-        model.init(jax.random.PRNGKey(0), tokens)
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (4, 8), 0, BASE.vocab_size)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    ref_model = TransformerLM(dataclasses.replace(BASE))
+    got = model.apply({"params": params}, tokens)
+    want = ref_model.apply({"params": params}, tokens)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-4, rtol=2e-4
+    )
 
 
 def test_config_rejects_nonpositive_window():
