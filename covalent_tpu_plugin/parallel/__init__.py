@@ -24,6 +24,7 @@ _EXPORTS = {
     "MeshPlan": ".mesh",
     "auto_mesh": ".mesh",
     "make_mesh": ".mesh",
+    "make_hybrid_mesh": ".mesh",
     "DEFAULT_RULES": ".sharding",
     "batch_sharding": ".sharding",
     "logical_sharding": ".sharding",
@@ -55,6 +56,7 @@ __all__ = [
     "MeshPlan",
     "auto_mesh",
     "make_mesh",
+    "make_hybrid_mesh",
     "DEFAULT_RULES",
     "logical_sharding",
     "param_shardings",

@@ -34,6 +34,69 @@ def test_mesh_plan_wrong_device_count():
         make_mesh(MeshPlan(data=16))
 
 
+def test_hybrid_mesh_axis_placement():
+    """2 virtual slices x 4 devices: the DCN axis must span slices (each
+    data-coordinate = one whole slice) and every ICI axis must stay
+    inside one slice — the property that keeps tensor/seq collectives
+    off DCN."""
+    from covalent_tpu_plugin.parallel.mesh import make_hybrid_mesh
+
+    devices = jax.devices()
+    mesh = make_hybrid_mesh(
+        MeshPlan(data=2, tensor=2, seq=2), n_slices=2
+    )
+    assert mesh.shape == {"data": 2, "fsdp": 1, "tensor": 2, "seq": 2, "pipe": 1}
+    arr = mesh.devices  # (2, 1, 2, 2, 1)
+    slice_of = {d: i // 4 for i, d in enumerate(devices)}
+    for di in range(2):
+        slice_ids = {
+            slice_of[d] for d in arr[di].ravel()
+        }
+        assert slice_ids == {di}, (di, slice_ids)
+
+
+def test_hybrid_mesh_dcn_axis_choice_and_validation():
+    from covalent_tpu_plugin.parallel.mesh import make_hybrid_mesh
+
+    # fsdp over DCN: data stays an in-slice axis.
+    mesh = make_hybrid_mesh(
+        MeshPlan(data=4, fsdp=2), n_slices=2, dcn_axis="fsdp"
+    )
+    devices = jax.devices()
+    slice_of = {d: i // 4 for i, d in enumerate(devices)}
+    arr = mesh.devices  # (4, 2, 1, 1, 1)
+    for fi in range(2):
+        assert {slice_of[d] for d in arr[:, fi].ravel()} == {fi}
+    # DCN-axis extent must equal the slice count.
+    with pytest.raises(ValueError, match="must equal the slice count"):
+        make_hybrid_mesh(MeshPlan(data=4, fsdp=2), n_slices=2)
+    # Slice-less topologies require an explicit n_slices.
+    with pytest.raises(ValueError, match="n_slices"):
+        make_hybrid_mesh(MeshPlan(data=2, tensor=2))
+    with pytest.raises(ValueError, match="not divisible"):
+        make_hybrid_mesh(MeshPlan(data=3), n_slices=3)
+
+
+def test_hybrid_mesh_runs_a_sharded_step():
+    """A psum over the ICI axes + one over the DCN axis both execute on
+    the hybrid mesh (virtual slices on the CPU tier)."""
+    from covalent_tpu_plugin.parallel.mesh import make_hybrid_mesh
+
+    mesh = make_hybrid_mesh(MeshPlan(data=2, tensor=4), n_slices=2)
+
+    def body(x):
+        intra = jax.lax.psum(x, "tensor")   # ICI collective
+        inter = jax.lax.psum(intra, "data")  # DCN collective
+        return inter
+
+    x = jnp.arange(8.0)
+    out = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=P(("data", "tensor")), out_specs=P(("data", "tensor")),
+    )(x)
+    np.testing.assert_allclose(np.asarray(out), np.full(8, x.sum()))
+
+
 def test_auto_mesh_defaults_to_data_parallel():
     mesh = auto_mesh()
     assert mesh.shape["data"] == 8
