@@ -79,17 +79,26 @@ class TransformerConfig:
     #: by hand — the param tree shape changes.
     quantized: bool = False
     #: sliding-window (Mistral-style local) attention: each query sees
-    #: only the `sliding_window` most recent positions.  Flash tiles
-    #: outside the band are skipped (compute O(S·w)); unsupported with
-    #: attention="ring" (shard the window over heads/batch instead).
+    #: only the `sliding_window` most recent positions.  Flash grids visit
+    #: only the band's tiles (compute AND DMA O(S·w)); with
+    #: attention="ring" the banded ring truncates to the hops the band
+    #: reaches (ops/ring_attention.py).
     sliding_window: int | None = None
     #: StreamingLLM-style circular KV cache for decode: cache length is
-    #: `sliding_window` instead of `max_seq` and generation can run past
-    #: max_seq at O(window) memory.  Requires sliding_window; exact for
-    #: the generate() flow (one prefill at position 0 + single-token
-    #: steps); a multi-token slab written at pos > 0 that wraps the ring
-    #: erases band-edge entries its earlier rows should still see.
+    #: `sliding_window + attention_sinks` instead of `max_seq` and
+    #: generation can run past max_seq at O(window) memory.  Requires
+    #: sliding_window; exact for the generate() flow (one prefill at
+    #: position 0 + single-token steps); a multi-token slab written at
+    #: pos > 0 that wraps the ring erases band-edge entries its earlier
+    #: rows should still see.
     rolling_cache: bool = False
+    #: attention sinks (StreamingLLM): the first `attention_sinks`
+    #: positions stay visible to every query alongside the sliding band,
+    #: and the rolling cache pins their slots (never overwritten).  Known
+    #: to stabilise long windowed decode where window-only attention
+    #: drifts once position 0 rolls out of the band.  Requires
+    #: sliding_window; unsupported with attention="ring".
+    attention_sinks: int = 0
     #: rotary embedding wavelength base (theta).  10k is the GPT-NeoX/
     #: llama default; raising it (e.g. 500k, llama-3 style) stretches the
     #: position resolution for long-context training — the standard knob
@@ -114,6 +123,13 @@ class TransformerConfig:
             )
         if self.rolling_cache and self.sliding_window is None:
             raise ValueError("rolling_cache requires sliding_window")
+        if self.attention_sinks:
+            if self.attention_sinks < 0:
+                raise ValueError(
+                    f"attention_sinks must be >= 0, got {self.attention_sinks}"
+                )
+            if self.sliding_window is None:
+                raise ValueError("attention_sinks require sliding_window")
 
     @property
     def head_dim(self) -> int:
@@ -206,10 +222,13 @@ class Attention(nn.Module):
         if impl == "ring":
             if cfg.mesh is None:
                 raise ValueError("attention='ring' requires config.mesh")
-            if cfg.sliding_window is not None:
+            if cfg.attention_sinks:
+                # Sink columns live on shard 0 only; every hop would need
+                # them resident (a broadcast, not a rotation).  Deferred:
+                # keep shard 0's first tokens via a one-time all-gather of
+                # the sink slab before the ring.
                 raise ValueError(
-                    "sliding_window is unsupported with attention='ring' — "
-                    "a window fits on-device; shard batch/heads instead"
+                    "attention_sinks are unsupported with attention='ring'"
                 )
             if kv_heads != cfg.n_heads:
                 # Ring shards over sequence, not heads: materialising the
@@ -217,7 +236,12 @@ class Attention(nn.Module):
                 group = cfg.n_heads // kv_heads
                 kh = jnp.repeat(kh, group, axis=1)
                 vh = jnp.repeat(vh, group, axis=1)
-            out = sequence_parallel_attention(qh, kh, vh, cfg.mesh, causal=True)
+            # sliding_window composes: the banded ring masks each hop by
+            # global positions and (contiguous layout) truncates the ring
+            # to the hops intersecting the band (ops/ring_attention.py).
+            out = sequence_parallel_attention(
+                qh, kh, vh, cfg.mesh, causal=True, window=cfg.sliding_window
+            )
         elif impl == "flash":
             if cfg.mesh is not None:
                 # Bare pallas_call is opaque to sharding propagation — under
@@ -225,15 +249,17 @@ class Attention(nn.Module):
                 # the shard_map wrapper keeps each (batch, head) block local.
                 out = flash_attention_sharded(
                     qh, kh, vh, cfg.mesh, causal=True,
-                    window=cfg.sliding_window,
+                    window=cfg.sliding_window, sinks=cfg.attention_sinks,
                 )
             else:
                 out = flash_attention(
-                    qh, kh, vh, causal=True, window=cfg.sliding_window
+                    qh, kh, vh, causal=True, window=cfg.sliding_window,
+                    sinks=cfg.attention_sinks,
                 )
         else:
             out = mha_reference(
-                qh, kh, vh, causal=True, window=cfg.sliding_window
+                qh, kh, vh, causal=True, window=cfg.sliding_window,
+                sinks=cfg.attention_sinks,
             )
         out = out.transpose(0, 2, 1, 3)
 
@@ -277,7 +303,12 @@ class Attention(nn.Module):
         cfg = self.config
         batch, slab = q.shape[:2]
         rolling = cfg.rolling_cache
-        cache_len = cfg.sliding_window if rolling else cfg.max_seq
+        sinks = cfg.attention_sinks
+        # Rolling ring = pinned sink slots [0, sinks) + circular band
+        # region [sinks, sinks + window).
+        cache_len = (
+            cfg.sliding_window + sinks if rolling else cfg.max_seq
+        )
         if slab > cache_len:
             raise ValueError(
                 f"slab of {slab} tokens exceeds the cache length {cache_len}"
@@ -311,8 +342,17 @@ class Attention(nn.Module):
         q_positions = pos + jnp.arange(slab)
         if rolling:
             # Circular write: token at absolute position p lands in slot
-            # p % W (a scatter — dynamic_update_slice can't wrap).
-            idx = q_positions % cache_len
+            # p (pinned) while p < sinks, else sinks + (p - sinks) % W —
+            # sink tokens are never overwritten by the rolling band (a
+            # scatter — dynamic_update_slice can't wrap).
+            if sinks:
+                idx = jnp.where(
+                    q_positions < sinks,
+                    q_positions,
+                    sinks + (q_positions - sinks) % cfg.sliding_window,
+                )
+            else:
+                idx = q_positions % cache_len
             cached_k.value = cached_k.value.at[:, idx].set(k.astype(cfg.dtype))
             cached_v.value = cached_v.value.at[:, idx].set(v.astype(cfg.dtype))
             slot_pos.value = slot_pos.value.at[idx].set(q_positions)
@@ -340,14 +380,22 @@ class Attention(nn.Module):
             # exact whether or not the cache has wrapped, and a query in
             # this slab can see same-slab earlier tokens (their slots were
             # just written) but not slots later tokens will overwrite.
+            # Sink positions stay visible at any distance (their slots are
+            # pinned, so they are always present to see).
             sp = slot_pos.value[None, :]
             visible = (sp >= 0) & (sp <= q_positions[:, None])
-            visible &= sp > q_positions[:, None] - cfg.sliding_window
+            in_band = sp > q_positions[:, None] - cfg.sliding_window
+            if sinks:
+                in_band |= sp < sinks
+            visible &= in_band
         else:
             slots = jnp.arange(cache_len)[None, :]
             visible = slots <= q_positions[:, None]
             if cfg.sliding_window is not None:
-                visible &= slots > q_positions[:, None] - cfg.sliding_window
+                in_band = slots > q_positions[:, None] - cfg.sliding_window
+                if sinks:
+                    in_band |= slots < sinks
+                visible &= in_band
         scores = jnp.where(visible[None, None, None, :, :], scores, NEG_INF)
         probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
         out = jnp.einsum(

@@ -163,6 +163,94 @@ def test_ring_flash_gradients_zigzag(seq_mesh):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)
 
 
+def test_ring_steps_truncation():
+    """The banded ring's hop count: own shard + ceil((w-1)/L) predecessors,
+    never more than n; zigzag and unwindowed keep the full ring."""
+    from covalent_tpu_plugin.ops.ring_attention import _ring_steps
+
+    assert _ring_steps(8, 64, None, False) == 8       # no window: full ring
+    assert _ring_steps(8, 64, 64, True) == 8          # zigzag: full ring
+    assert _ring_steps(8, 64, 1, False) == 1          # w=1: own shard only
+    assert _ring_steps(8, 64, 64, False) == 2         # w=L: one predecessor
+    assert _ring_steps(8, 64, 65, False) == 2
+    assert _ring_steps(8, 64, 128, False) == 3
+    assert _ring_steps(8, 64, 10_000, False) == 8     # clamped at n
+
+
+@pytest.mark.parametrize("impl", ["einsum", "flash"])
+@pytest.mark.parametrize("window", [1, 16, 100, 400])
+def test_windowed_ring_matches_reference(seq_mesh, impl, window):
+    """Banded ring (contiguous default layout + truncated scan) must equal
+    the dense windowed oracle at windows inside one shard, spanning
+    shards, and wider than the sequence."""
+    q, k, v = (
+        jax.random.normal(jax.random.PRNGKey(70 + i), (1, 2, 128, 16))
+        for i in range(3)
+    )
+    out = sequence_parallel_attention(
+        q, k, v, seq_mesh, causal=True, impl=impl, window=window
+    )
+    ref = mha_reference(q, k, v, causal=True, window=window)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+
+@pytest.mark.parametrize("impl", ["einsum", "flash"])
+def test_windowed_ring_zigzag_matches_reference(seq_mesh, impl):
+    """Explicit zigzag still composes with the window (full ring, exact
+    position masking)."""
+    q, k, v = (
+        jax.random.normal(jax.random.PRNGKey(80 + i), (1, 2, 128, 16))
+        for i in range(3)
+    )
+    out = sequence_parallel_attention(
+        q, k, v, seq_mesh, causal=True, impl=impl, window=40, zigzag=True
+    )
+    ref = mha_reference(q, k, v, causal=True, window=40)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_windowed_ring_gradients(seq_mesh):
+    """Truncated-ring backward: dk/dv partials must land back on their home
+    shards (the re-homing ppermute) and match dense windowed grads."""
+    q, k, v = (
+        jax.random.normal(jax.random.PRNGKey(90 + i), (1, 2, 64, 8))
+        for i in range(3)
+    )
+
+    for impl in ("einsum", "flash"):
+        def loss_ring(q, k, v):
+            return (
+                sequence_parallel_attention(
+                    q, k, v, seq_mesh, causal=True, impl=impl, window=20
+                ) * 0.1
+            ).sum()
+
+        def loss_ref(q, k, v):
+            return (mha_reference(q, k, v, causal=True, window=20) * 0.1).sum()
+
+        g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_ring, g_ref):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4
+            )
+
+
+def test_windowed_ring_rejects_noncausal(seq_mesh):
+    q, k, v = (
+        jax.random.normal(jax.random.PRNGKey(i), (1, 2, 64, 16))
+        for i in range(3)
+    )
+    with pytest.raises(ValueError, match="requires causal"):
+        sequence_parallel_attention(
+            q, k, v, seq_mesh, causal=False, window=8
+        )
+
+
 def test_zigzag_rejects_indivisible_seq(seq_mesh):
     q, k, v = (
         jax.random.normal(jax.random.PRNGKey(i), (1, 2, 24, 16))
