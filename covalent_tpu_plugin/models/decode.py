@@ -91,6 +91,13 @@ def _filter_top_p(logits: jax.Array, top_p: float) -> jax.Array:
     Static-shape formulation: sort once, compute the cumulative softmax
     mass *before* each position, and mask tokens whose preceding mass
     already covers ``top_p`` (the first token always survives).
+
+    Tie semantics: the filter thresholds by logit *value*, so every token
+    tied with the cutoff logit survives and the kept nucleus can exceed
+    ``top_p`` mass by the tied tokens' probability (HF masks by sorted
+    index instead, arbitrarily breaking the tie by sort order).  Keeping
+    all equal-probability tokens is the deliberate choice here: which of
+    two identical-logit tokens "ranks" first is numerically meaningless.
     """
     from ..ops.attention import NEG_INF
 
@@ -135,12 +142,14 @@ def generate(
     total = prompt_len + max(max_new_tokens, 0)
     if config.rolling_cache:
         # The circular cache frees generation from max_seq: only the
-        # prompt (one prefill slab at position 0) must fit the ring.
-        if prompt_len > config.sliding_window:
+        # prompt (one prefill slab at position 0) must fit the ring
+        # (pinned sink slots + circular band region).
+        capacity = config.sliding_window + config.attention_sinks
+        if prompt_len > capacity:
             raise ValueError(
                 f"rolling_cache prefill of {prompt_len} tokens exceeds "
-                f"sliding_window ({config.sliding_window}); chunk or "
-                "truncate the prompt"
+                f"the cache capacity ({capacity} = sliding_window + "
+                "attention_sinks); chunk or truncate the prompt"
             )
     elif total > config.max_seq:
         raise ValueError(

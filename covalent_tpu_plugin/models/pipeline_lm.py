@@ -56,9 +56,15 @@ def _block_forward(cfg, p: Any, x: jax.Array) -> jax.Array:
     if impl == "auto":
         impl = "flash" if on_tpu() else "reference"
     if impl == "flash":
-        out = flash_attention(qh, kh, vh, causal=True, window=cfg.sliding_window)
+        out = flash_attention(
+            qh, kh, vh, causal=True, window=cfg.sliding_window,
+            sinks=cfg.attention_sinks,
+        )
     else:
-        out = mha_reference(qh, kh, vh, causal=True, window=cfg.sliding_window)
+        out = mha_reference(
+            qh, kh, vh, causal=True, window=cfg.sliding_window,
+            sinks=cfg.attention_sinks,
+        )
     out = out.transpose(0, 2, 1, 3)
     attn = jnp.einsum("bshk,hkd->bsd", out, att["out_proj"]["kernel"].astype(dt))
     x = x + attn
