@@ -13,10 +13,14 @@ The prompt is prefilled already tiled to ``B*W`` rows — W× redundant
 prefill compute for a much simpler cache story (one shape end to end);
 fine at serving prompt lengths, noted here for honesty.
 
-EOS semantics: a finished beam is frozen — its only continuation is
-another EOS at zero additional log-probability — so finished hypotheses
-compete with ongoing ones on their final score.  ``length_penalty``
-(GNMT-style ``len**alpha`` divisor) applies to the final ranking.
+EOS semantics (the HF/fairseq convention): each step considers the top
+``2W`` candidates; those ending in EOS are *banked* into a per-row
+finished pool (the best ``W`` by ranking key) and the top ``W`` non-EOS
+candidates stay active, so finished hypotheses never occupy active
+slots and are never lost to eviction.  The final ranking merges the
+pool with the surviving active beams.  ``length_penalty`` (GNMT-style
+``len**alpha`` divisor) applies to pool retention and the final
+ranking, never to the active search.
 """
 
 from __future__ import annotations
@@ -85,7 +89,21 @@ def beam_search(
     config = decoder.config
     batch, prompt_len = prompt.shape
     total = prompt_len + max(max_new_tokens, 0)
-    if total > config.max_seq:
+    if config.rolling_cache:
+        # The circular cache frees beams from max_seq exactly as it frees
+        # generate(): the ring's slot-position mask is per-absolute-position
+        # and the per-layer cursor/slot vectors are shared across beams
+        # (all rows advance in lockstep), so _gather_beams' batch-axis
+        # reorder composes with the ring untouched.  Only the prompt (the
+        # one prefill slab at position 0) must fit the ring.
+        capacity = config.sliding_window + config.attention_sinks
+        if prompt_len > capacity:
+            raise ValueError(
+                f"rolling_cache prefill of {prompt_len} tokens exceeds "
+                f"the cache capacity ({capacity} = sliding_window + "
+                "attention_sinks); chunk or truncate the prompt"
+            )
+    elif total > config.max_seq:
         raise ValueError(
             f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
             f"exceeds config.max_seq ({config.max_seq})"
@@ -112,25 +130,79 @@ def beam_search(
         prefill_logits[:, -1].astype(jnp.float32), axis=-1
     )  # (B*W, V); all W copies of a row are identical here
 
-    # First step: top-W distinct tokens per original row seed the beams.
-    first_scores, first_tokens = jax.lax.top_k(
-        logprobs.reshape(batch, width, vocab)[:, 0], width
-    )  # (B, W)
-    scores = first_scores  # (B, W)
+    # Candidate fan per step: 2W (the HF convention) so that up to W EOS
+    # candidates can be banked while W non-EOS ones still fill the active
+    # set.  Clamped for toy vocabularies.
+    kk = min(2 * width, vocab) if eos_token_id is not None else width
+
+    # Finished-hypothesis pool: the best W EOS-terminated candidates per
+    # row so far, by ranking key.  Kept OUT of the active set — a banked
+    # hypothesis can never be evicted by ongoing beams, and active slots
+    # are never wasted on frozen beams.
+    pool_scores = jnp.full((batch, width), NEG_INF, jnp.float32)
+    pool_lengths = jnp.ones((batch, width), jnp.float32)
+    pool_tokens = jnp.zeros((batch, width, total), jnp.int32)
+
+    def bank(pool, cand_scores, cand_lengths, cand_tokens):
+        """Merge EOS candidates into the pool, keep the top W by key."""
+        pool_scores, pool_lengths, pool_tokens = pool
+        merged_scores = jnp.concatenate([pool_scores, cand_scores], axis=1)
+        merged_lengths = jnp.concatenate([pool_lengths, cand_lengths], axis=1)
+        merged_tokens = jnp.concatenate([pool_tokens, cand_tokens], axis=1)
+        _, keep = jax.lax.top_k(
+            rank_hypotheses(merged_scores, merged_lengths, length_penalty),
+            width,
+        )
+        return (
+            jnp.take_along_axis(merged_scores, keep, axis=1),
+            jnp.take_along_axis(merged_lengths, keep, axis=1),
+            jnp.take_along_axis(merged_tokens, keep[:, :, None], axis=1),
+        )
+
+    # Seeding: top-kk distinct first tokens per row; EOS seeds go straight
+    # to the pool, the top W non-EOS seed the active beams.
+    seed_scores, seed_tokens = jax.lax.top_k(
+        logprobs.reshape(batch, width, vocab)[:, 0], kk
+    )  # (B, kk)
+    if eos_token_id is not None:
+        is_eos = seed_tokens == eos_token_id
+        cols = jnp.arange(total)[None, None, :]
+        # Seed hypothesis = prompt + EOS padding (same for every slot; the
+        # scores mask keeps non-EOS slots out of the pool).
+        padded = jnp.pad(
+            prompt.astype(jnp.int32), ((0, 0), (0, total - prompt_len))
+        )
+        seed_rows = jnp.where(
+            cols >= prompt_len, jnp.int32(eos_token_id),
+            jnp.broadcast_to(padded[:, None, :], (batch, kk, total)),
+        )
+        pool_scores, pool_lengths, pool_tokens = bank(
+            (pool_scores, pool_lengths, pool_tokens),
+            jnp.where(is_eos, seed_scores, NEG_INF),
+            jnp.ones((batch, kk), jnp.float32),
+            seed_rows,
+        )
+        masked = jnp.where(is_eos, NEG_INF, seed_scores)
+        scores, sel = jax.lax.top_k(masked, width)
+        first_tokens = jnp.take_along_axis(seed_tokens, sel, axis=1)
+        # Toy vocabularies (< 2W tokens) can leave fewer than W non-EOS
+        # candidates: dead slots keep NEG_INF scores and decode EOS
+        # padding rather than extending a garbage token.
+        first_tokens = jnp.where(
+            scores <= NEG_INF / 2, jnp.int32(eos_token_id), first_tokens
+        )
+    else:
+        scores, first_tokens = seed_scores, seed_tokens
     buffer = jax.lax.dynamic_update_slice(
         buffer,
         first_tokens.reshape(rows, 1).astype(jnp.int32),
         (0, prompt_len),
     )
-    finished = (
-        (first_tokens == eos_token_id)
-        if eos_token_id is not None
-        else jnp.zeros((batch, width), bool)
-    )
     lengths = jnp.ones((batch, width), jnp.float32)  # generated tokens
 
     def body(carry):
-        buffer, cache, scores, finished, lengths, t = carry
+        (buffer, cache, scores, lengths,
+         pool_scores, pool_lengths, pool_tokens, t) = carry
         token = jax.lax.dynamic_slice(buffer, (0, t), (rows, 1))
         logits, mutated = decoder.apply(
             {"params": params, "cache": cache}, token, mutable=["cache"]
@@ -139,18 +211,43 @@ def beam_search(
         logprobs = jax.nn.log_softmax(
             logits[:, 0].astype(jnp.float32), axis=-1
         ).reshape(batch, width, vocab)
-        if eos_token_id is not None:
-            # Frozen beams: only EOS continues, for free.
-            frozen = jnp.full((vocab,), NEG_INF).at[eos_token_id].set(0.0)
-            logprobs = jnp.where(
-                finished[:, :, None], frozen[None, None, :], logprobs
-            )
         candidates = scores[:, :, None] + logprobs  # (B, W, V)
-        scores, flat_idx = jax.lax.top_k(
-            candidates.reshape(batch, width * vocab), width
-        )
-        parent = flat_idx // vocab  # (B, W) beam each winner extends
+        cand_scores, flat_idx = jax.lax.top_k(
+            candidates.reshape(batch, width * vocab), kk
+        )  # (B, kk)
+        parent = flat_idx // vocab  # (B, kk) beam each candidate extends
         chosen = (flat_idx % vocab).astype(jnp.int32)
+
+        if eos_token_id is not None:
+            # Bank the EOS candidates: their hypothesis is the parent's
+            # buffer row + EOS, padded with EOS to the fixed width.
+            is_eos = chosen == eos_token_id
+            cols = jnp.arange(total)[None, None, :]
+            cand_rows = jnp.take_along_axis(
+                buffer.reshape(batch, width, total),
+                parent[:, :, None], axis=1,
+            )  # (B, kk, total)
+            cand_rows = jnp.where(
+                cols > t, jnp.int32(eos_token_id), cand_rows
+            )
+            pool_scores, pool_lengths, pool_tokens = bank(
+                (pool_scores, pool_lengths, pool_tokens),
+                jnp.where(is_eos, cand_scores, NEG_INF),
+                jnp.take_along_axis(lengths, parent, axis=1) + 1.0,
+                cand_rows,
+            )
+            # Active set: the top W non-EOS candidates.  Dead slots (all
+            # real candidates were EOS — only possible when vocab < 2W)
+            # decode EOS padding at NEG_INF, never a garbage extension.
+            masked = jnp.where(is_eos, NEG_INF, cand_scores)
+            scores, sel = jax.lax.top_k(masked, width)
+            parent = jnp.take_along_axis(parent, sel, axis=1)
+            chosen = jnp.take_along_axis(chosen, sel, axis=1)
+            chosen = jnp.where(
+                scores <= NEG_INF / 2, jnp.int32(eos_token_id), chosen
+            )
+        else:
+            scores = cand_scores
 
         # Follow the parents: reorder buffer rows + every cache leaf.
         row_idx = (
@@ -158,37 +255,54 @@ def beam_search(
         ).reshape(rows)
         buffer = jnp.take(buffer, row_idx, axis=0)
         cache = _gather_beams(cache, row_idx, rows)
-        lengths = jnp.take_along_axis(lengths, parent, axis=1)
-        if eos_token_id is not None:
-            was_finished = jnp.take_along_axis(finished, parent, axis=1)
-            # A frozen beam's forced EOS padding doesn't count as length.
-            lengths = jnp.where(was_finished, lengths, lengths + 1.0)
-            finished = was_finished | (chosen == eos_token_id)
-        else:
-            lengths = lengths + 1.0
+        lengths = jnp.take_along_axis(lengths, parent, axis=1) + 1.0
         buffer = jax.lax.dynamic_update_slice(
             buffer, chosen.reshape(rows, 1), (0, t + 1)
         )
-        return buffer, cache, scores, finished, lengths, t + 1
+        return (buffer, cache, scores, lengths,
+                pool_scores, pool_lengths, pool_tokens, t + 1)
 
     def cond(carry):
-        _, _, _, finished, _, t = carry
-        return (t < total - 1) & ~jnp.all(finished)
+        scores, t = carry[2], carry[7]
+        keep_going = t < total - 1
+        if eos_token_id is not None and length_penalty >= 0.0:
+            # Sound early exit: ongoing raw scores only decrease
+            # (logprobs <= 0), and for lp >= 0 a non-positive score's
+            # ranking key s / len**lp is largest at the longest possible
+            # length — so s_best / max_new**lp bounds every future
+            # candidate's key.  Once each row's full pool beats that
+            # bound, no future candidate can enter the final top-W.
+            pool_keys = rank_hypotheses(carry[4], carry[5], length_penalty)
+            best_future = jnp.max(scores, axis=1) / (
+                float(max_new_tokens) ** length_penalty
+            )
+            can_improve = (
+                best_future > jnp.min(pool_keys, axis=1)
+            ).any()
+            keep_going = keep_going & can_improve
+        return keep_going
 
-    buffer, _, scores, _, lengths, t = jax.lax.while_loop(
+    (buffer, _, scores, lengths,
+     pool_scores, pool_lengths, pool_tokens, t) = jax.lax.while_loop(
         cond,
         body,
-        (buffer, cache, scores, finished, lengths,
-         jnp.asarray(prompt_len)),
+        (buffer, cache, scores, lengths,
+         pool_scores, pool_lengths, pool_tokens, jnp.asarray(prompt_len)),
     )
-    if eos_token_id is not None:
-        # An early exit (all beams frozen) leaves columns > t unwritten;
-        # stamp them with EOS as the in-loop freezing would have.
-        cols = jnp.arange(total)[None, :]
-        buffer = jnp.where(cols > t, jnp.int32(eos_token_id), buffer)
 
     tokens = buffer.reshape(batch, width, total)
-    order = jnp.argsort(-rank_hypotheses(scores, lengths, length_penalty), axis=1)
+    if eos_token_id is not None:
+        # Early exit leaves active columns > t unwritten: stamp with EOS
+        # (those rows lose to the pool anyway, but must read uniformly).
+        cols = jnp.arange(total)[None, None, :]
+        tokens = jnp.where(cols > t, jnp.int32(eos_token_id), tokens)
+        # Final ranking over survivors AND the banked finished pool.
+        tokens = jnp.concatenate([tokens, pool_tokens], axis=1)
+        scores = jnp.concatenate([scores, pool_scores], axis=1)
+        lengths = jnp.concatenate([lengths, pool_lengths], axis=1)
+    order = jnp.argsort(
+        -rank_hypotheses(scores, lengths, length_penalty), axis=1
+    )[:, :width]
     tokens = jnp.take_along_axis(tokens, order[:, :, None], axis=1)
     scores = jnp.take_along_axis(scores, order, axis=1)
     return tokens, scores
