@@ -231,6 +231,7 @@ class TPUExecutor(RemoteExecutor):
         self.do_cleanup = bool(resolve(do_cleanup, "do_cleanup"))
         self.defer_cleanup = bool(resolve(defer_cleanup, "defer_cleanup"))
         self._cleanup_tasks: set[asyncio.Task] = set()
+        self._closing = False
         self.strict_host_keys = bool(resolve(strict_host_keys, "strict_host_keys"))
         self.coordinator_port = int(resolve(coordinator_port, "coordinator_port"))
         self.task_timeout = float(resolve(task_timeout, "task_timeout"))
@@ -400,9 +401,33 @@ class TPUExecutor(RemoteExecutor):
     def _pool_key(self, address: str) -> str:
         return f"{self.transport_kind}:{address}"
 
+    async def _drain_cleanup_tasks(self, until_empty: bool = False) -> None:
+        """Await pending deferred-cleanup tasks bound to this loop.
+
+        ``until_empty`` keeps re-collecting tasks scheduled while draining
+        — only sound when ``_closing`` stops new ones (close()); a
+        mid-run drain (``_discard_workers``) snapshots once instead, or
+        concurrent electrons could starve it indefinitely.
+        """
+        loop = asyncio.get_running_loop()
+        while True:
+            current = [
+                t for t in self._cleanup_tasks
+                if not t.done() and t.get_loop() is loop
+            ]
+            if not current:
+                return
+            await asyncio.gather(*current, return_exceptions=True)
+            if not until_empty:
+                return
+
     async def _discard_workers(self) -> None:
         """Drop pooled transports after a mid-run control-plane error so the
         next electron redials instead of reusing a dead channel."""
+        # Deferred-cleanup tasks from earlier electrons hold these same
+        # pooled transports; closing the channels mid-rm would fail their
+        # cleanup and leak the staged files — let them finish first.
+        await self._drain_cleanup_tasks()
         for address in self._worker_addresses():
             await self._pool.discard(self._pool_key(address))
             client = self._agents.pop(address, None)
@@ -1130,20 +1155,22 @@ class TPUExecutor(RemoteExecutor):
 
     async def close(self) -> None:
         """Release agent channels + pooled transports (once per executor)."""
+        # From here on, run() stops deferring cleanup (inline instead): a
+        # task scheduled after this drain begins would race the pool close.
+        self._closing = True
         pending = [t for t in self._cleanup_tasks if not t.done()]
         loop = asyncio.get_running_loop()
-        current = [t for t in pending if t.get_loop() is loop]
-        if len(current) != len(pending):
+        foreign = [t for t in pending if t.get_loop() is not loop]
+        if foreign:
             # close() called from a fresh asyncio.run before any run():
             # tasks bound to the old loop can't be awaited here (gather
             # would raise), only dropped — same contract as the loop guard.
             app_log.warning(
                 "dropping %d deferred-cleanup task(s) bound to a previous "
                 "event loop; their staged files may leak",
-                len(pending) - len(current),
+                len(foreign),
             )
-        if current:
-            await asyncio.gather(*current, return_exceptions=True)
+        await self._drain_cleanup_tasks(until_empty=True)
         self._cleanup_tasks.clear()
         for client in self._agents.values():
             if client is not None:
@@ -1279,9 +1306,11 @@ class TPUExecutor(RemoteExecutor):
 
             if self.do_cleanup:
                 with timer.stage("cleanup"):
-                    if self.defer_cleanup:
+                    if self.defer_cleanup and not self._closing:
                         # Result is in hand; the rm round-trips happen off
-                        # the critical path.  close() drains stragglers.
+                        # the critical path.  close() drains stragglers
+                        # (and flips _closing so late tasks go inline
+                        # rather than racing the pool teardown).
                         task = asyncio.create_task(
                             self._logged_cleanup(conns, staged)
                         )
