@@ -43,12 +43,15 @@ OVERHEAD_PROBES = 5
 # sub-phases, each of which self-skips as the electron's deadline nears.
 OVERHEAD_BUDGET_S = float(os.environ.get("BENCH_OVERHEAD_BUDGET_S", "60"))
 FANOUT_BUDGET_S = float(os.environ.get("BENCH_FANOUT_BUDGET_S", "45"))
-TPU_BUDGET_S = float(os.environ.get("BENCH_TPU_BUDGET_S", "300"))
+TPU_BUDGET_S = float(os.environ.get("BENCH_TPU_BUDGET_S", "390"))
 #: Persistent XLA compilation cache shared across bench runs (and with the
 #: driver's run): compiles over the tunneled backend cost tens of seconds
 #: each, and they dominate the accelerator-phase budget on a cold cache.
+#: Per-user suffix: a fixed world-writable /tmp path could be pre-owned or
+#: poisoned by another local user on shared machines.
 JAX_CACHE_DIR = os.environ.get(
-    "JAX_COMPILATION_CACHE_DIR", "/tmp/covalent-tpu-jax-cache"
+    "JAX_COMPILATION_CACHE_DIR",
+    f"/tmp/covalent-tpu-jax-cache-{os.getuid()}",
 )
 
 
@@ -144,10 +147,24 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
             break
 
     def mfu(tflops):
-        return round(tflops / peak_tflops, 4) if peak_tflops else None
+        """Model FLOP utilisation, clamped at the physical ceiling.
 
-    def unit_seconds(dispatch, fetch, target_s: float, cap: int) -> float:
-        """Seconds per dispatched unit, by two-batch delta timing.
+        A computed MFU > 1.0 is a measurement error by definition (the
+        chip cannot exceed its peak): report 1.0 with the raw value in a
+        warning rather than an impossible number (BENCH_r02 emitted 1.05
+        once under min-of-2 delta timing; median-of-N makes this rare,
+        the clamp makes it impossible).
+        """
+        if not peak_tflops:
+            return None, None
+        raw = tflops / peak_tflops
+        if raw > 1.0:
+            return 1.0, f"measured {raw:.4f} > physical peak; clamped"
+        return round(raw, 4), None
+
+    def unit_seconds(dispatch, fetch, target_s: float, cap: int,
+                     trials: int = 5):
+        """Seconds per dispatched unit, by median-of-N two-batch deltas.
 
         The tunneled/proxied device this bench runs against adds a large
         constant per-fetch round-trip (~65 ms measured) that would
@@ -155,7 +172,15 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
         k-unit batch and dividing by (k - 1) cancels that constant:
         dispatches are async (they only enqueue), the device queue
         serialises them, and ``fetch`` forces a drain.
+
+        The per-trial delta jitters with the round-trip constant; the
+        *median* of N trials is reported (a min would let one low-jitter
+        outlier overstate throughput — the BENCH_r02 >100%-MFU failure
+        mode), together with the spread so the artifact carries its own
+        error bars.  Returns ``(unit_s, stats_dict)``.
         """
+        import statistics as stats_mod
+
         dispatch()
         fetch()  # compiled + warm
         t0 = time.monotonic()
@@ -164,7 +189,7 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
         once = time.monotonic() - t0  # includes the round-trip constant
         k = max(2, min(cap, int(target_s / max(once, 1e-6)) + 1))
         deltas = []
-        for _ in range(2):  # best-of-2: the round-trip constant jitters
+        for _ in range(trials):
             t0 = time.monotonic()
             dispatch()
             fetch()
@@ -176,9 +201,22 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
             ek = time.monotonic() - t0
             if ek > e1:  # jitter can invert tiny deltas; discard, don't clamp
                 deltas.append((ek - e1) / (k - 1))
-        # Both trials jitter-inverted: the single-batch time (round-trip
-        # included) is the honest upper bound, never a fabricated rate.
-        return min(deltas) if deltas else once
+        if not deltas:
+            # Every trial jitter-inverted: the single-batch time (round-trip
+            # included) is the honest upper bound, never a fabricated rate.
+            return once, {"n_deltas": 0, "note": "round-trip bound"}
+        unit = stats_mod.median(deltas)
+        spread = {
+            "n_deltas": len(deltas),
+            "unit_ms_median": round(unit * 1e3, 3),
+            "unit_ms_min": round(min(deltas) * 1e3, 3),
+            "unit_ms_max": round(max(deltas) * 1e3, 3),
+        }
+        if len(deltas) >= 2:
+            spread["unit_ms_stdev"] = round(
+                stats_mod.stdev(deltas) * 1e3, 3
+            )
+        return unit, spread
 
     # Non-TPU backends (the CPU validation tier) get scaled-down shapes so
     # every subphase still executes end to end within the budget.
@@ -214,67 +252,91 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
             # make the latter a no-op, and a fetched scalar can't lie.
             holder["check"] = float(jax.device_get(holder["out"][0, 0]))
 
-        unit = unit_seconds(dispatch, fetch, target_s=6.0, cap=40)
+        unit, spread = unit_seconds(dispatch, fetch, target_s=3.0, cap=40)
         tflops = (2 * n**3 * chain_len) / unit / 1e12
+        mfu_val, mfu_warning = mfu(tflops)
         report(
             "matmul",
             n=n,
             chain_len=chain_len,
             tflops=round(tflops, 2),
-            mfu=mfu(tflops),
+            mfu=mfu_val,
+            **({"mfu_warning": mfu_warning} if mfu_warning else {}),
             peak_tflops=peak_tflops,
             check=holder["check"],  # must be 1.0
+            **spread,
         )
     except Exception as error:  # noqa: BLE001
         report("matmul", error=repr(error))
 
-    # -- MNIST MLP training (north-star electron body) ---------------------
+    # -- MNIST MLP training on a multi-batch stream (north-star electron) --
+    # An epoch-style pass over DISTINCT batches with a falling loss curve —
+    # "trains MNIST end-to-end" (BASELINE config 4) — not a memorize-one-
+    # batch throughput proxy (the BENCH_r02 final_loss=0.0 critique).
     if remaining() > 60:
         try:
+            import numpy as onp
             import optax
             from flax.training import train_state
 
             from covalent_tpu_plugin.models.mlp import MLP, synthetic_mnist
 
             batch_size = 128 if small else 256
-            data = synthetic_mnist(batch_size)
-            batch = {
-                "image": jnp.asarray(data["image"]),
-                "label": jnp.asarray(data["label"]),
-            }
+            n_batches = 24 if small else 64
+            stream = [
+                synthetic_mnist(batch_size, seed=i) for i in range(n_batches)
+            ]
+            images = jnp.asarray(onp.stack([b["image"] for b in stream]))
+            labels = jnp.asarray(onp.stack([b["label"] for b in stream]))
             model = MLP()
             state = train_state.TrainState.create(
                 apply_fn=model.apply,
-                params=model.init(jax.random.PRNGKey(0), batch["image"])["params"],
+                params=model.init(jax.random.PRNGKey(0), images[0])["params"],
                 tx=optax.adam(1e-3),
             )
 
             @jax.jit
-            def step(state, batch):
-                def loss_fn(params):
-                    logits = state.apply_fn({"params": params}, batch["image"])
-                    return optax.softmax_cross_entropy_with_integer_labels(
-                        logits.astype(jnp.float32), batch["label"]
-                    ).mean()
+            def epoch(state):
+                def step(state, batch):
+                    def loss_fn(params):
+                        logits = state.apply_fn(
+                            {"params": params}, batch["image"]
+                        )
+                        return optax.softmax_cross_entropy_with_integer_labels(
+                            logits.astype(jnp.float32), batch["label"]
+                        ).mean()
 
-                loss, grads = jax.value_and_grad(loss_fn)(state.params)
-                return state.apply_gradients(grads=grads), loss
+                    loss, grads = jax.value_and_grad(loss_fn)(state.params)
+                    return state.apply_gradients(grads=grads), loss
 
+                return jax.lax.scan(
+                    step, state, {"image": images, "label": labels}
+                )
+
+            state, losses = epoch(state)  # compile + epoch 1 (fresh params)
+            curve = jax.device_get(losses).astype(float)
             holder = {"state": state}
 
             def dispatch():
-                holder["state"], holder["loss"] = step(holder["state"], batch)
+                holder["state"], holder["losses"] = epoch(holder["state"])
 
             def fetch():
-                holder["final"] = float(jax.device_get(holder["loss"]))
+                holder["last"] = float(jax.device_get(holder["losses"][-1]))
 
-            # High cap: a ~1 ms step needs many units per batch or the
-            # fetch round-trip's jitter dominates the delta.
-            unit = unit_seconds(dispatch, fetch, target_s=4.0, cap=400)
+            # Each unit is a full n_batches-step epoch, so the per-fetch
+            # round-trip constant amortises n_batches-fold on top of the
+            # delta cancellation.
+            unit, spread = unit_seconds(
+                dispatch, fetch, target_s=3.0, cap=40, trials=3
+            )
             report(
                 "mnist",
-                steps_per_s=round(1.0 / unit, 2),
-                final_loss=round(holder["final"], 4),
+                n_batches=n_batches,
+                steps_per_s=round(n_batches / unit, 2),
+                loss_first=round(float(curve[:4].mean()), 4),
+                loss_last=round(float(curve[-4:].mean()), 4),
+                loss_final_epoch=round(holder["last"], 4),
+                **spread,
             )
         except Exception as error:  # noqa: BLE001
             report("mnist", error=repr(error))
@@ -304,16 +366,21 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
                 def fetch():
                     jax.device_get(holder["out"][0, 0, 0, 0])
 
-                return unit_seconds(dispatch, fetch, target_s=3.0, cap=cap)
+                return unit_seconds(
+                    dispatch, fetch, target_s=2.0, cap=cap, trials=3
+                )
 
-            ref_s = bench_fwd(lambda q, k, v: mha_reference(q, k, v, causal=True))
-            flash_s = bench_fwd(lambda q, k, v: flash_attention(q, k, v, causal=True))
+            ref_s, _ = bench_fwd(lambda q, k, v: mha_reference(q, k, v, causal=True))
+            flash_s, spread = bench_fwd(
+                lambda q, k, v: flash_attention(q, k, v, causal=True)
+            )
             report(
                 "flash_fwd",
                 seq_len=s,
                 ref_ms=round(ref_s * 1e3, 2),
                 flash_ms=round(flash_s * 1e3, 2),
                 speedup=round(ref_s / flash_s, 2),
+                **spread,
             )
         except Exception as error:  # noqa: BLE001
             report("flash_fwd", error=repr(error))
@@ -348,16 +415,21 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
                 def fetch():
                     jax.device_get(holder["grads"][0][0, 0, 0, 0])
 
-                return unit_seconds(dispatch, fetch, target_s=3.0, cap=cap)
+                return unit_seconds(
+                    dispatch, fetch, target_s=2.0, cap=cap, trials=3
+                )
 
-            ref_s = bench_bwd(lambda q, k, v: mha_reference(q, k, v, causal=True))
-            flash_s = bench_bwd(lambda q, k, v: flash_attention(q, k, v, causal=True))
+            ref_s, _ = bench_bwd(lambda q, k, v: mha_reference(q, k, v, causal=True))
+            flash_s, spread = bench_bwd(
+                lambda q, k, v: flash_attention(q, k, v, causal=True)
+            )
             report(
                 "flash_bwd",
                 seq_len=s,
                 ref_ms=round(ref_s * 1e3, 2),
                 flash_ms=round(flash_s * 1e3, 2),
                 speedup=round(ref_s / flash_s, 2),
+                **spread,
             )
         except Exception as error:  # noqa: BLE001
             report("flash_bwd", error=repr(error))
@@ -394,9 +466,9 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
                 def fetch():
                     jax.device_get(holder["g"][0][0, 0, 0, 0])
 
-                return unit_seconds(dispatch, fetch, target_s=3.0, cap=8)
+                return unit_seconds(dispatch, fetch, target_s=2.5, cap=8)
 
-            unit = bwd_unit(None)
+            unit, spread = bwd_unit(None)
             # attention flops: 4*S^2*D fwd + 10*S^2*D bwd, * 0.5 causal
             # (matches the kernels' own CostEstimates in ops/attention.py)
             att_tflops = 14 * b * h * s * s * d * 0.5 / unit / 1e12
@@ -406,15 +478,17 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
                 fwd_bwd_ms=round(unit * 1e3, 2),
                 attn_tflops=round(att_tflops, 2),
                 note="dense S^2 path spills at this length (see benchmarks/)",
+                **spread,
             )
             if remaining() > 25:
-                win_unit = bwd_unit(win)
+                win_unit, win_spread = bwd_unit(win)
                 report(
                     "flash_window",
                     seq_len=s,
                     window=win,
                     fwd_bwd_ms=round(win_unit * 1e3, 2),
                     speedup_vs_full=round(unit / win_unit, 2),
+                    **win_spread,
                 )
             else:
                 report("flash_window", skipped="budget")
@@ -482,19 +556,22 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
             def fetch():
                 holder["final"] = float(jax.device_get(holder["loss"]))
 
-            step_s = unit_seconds(dispatch, fetch, target_s=5.0, cap=10)
+            step_s, spread = unit_seconds(dispatch, fetch, target_s=4.0, cap=10)
             final_loss = holder["final"]
             # 6ND for fwd+bwd (+ remat recompute ~ +1 fwd -> 8ND ceiling;
             # report the standard 6ND so MFU is comparable across frameworks)
             lm_tflops = 6 * n_params * bsz * seq / step_s / 1e12
+            mfu_val, mfu_warning = mfu(lm_tflops)
             report(
                 "lm_step",
                 n_params=n_params,
                 step_ms=round(step_s * 1e3, 1),
                 tokens_per_s=round(bsz * seq / step_s),
                 tflops_6nd=round(lm_tflops, 2),
-                mfu=mfu(lm_tflops),
+                mfu=mfu_val,
+                **({"mfu_warning": mfu_warning} if mfu_warning else {}),
                 final_loss=round(final_loss, 4),
+                **spread,
             )
         except Exception as error:  # noqa: BLE001
             report("lm_step", error=repr(error))
@@ -532,31 +609,24 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
             params = inference_params(
                 model.init(jax.random.PRNGKey(1), prompt)["params"]
             )
+            import statistics as stats_mod
+
             gen = jax.jit(
                 lambda p, t: generate(model, p, t, max_new_tokens=new_tokens)
             )
             jax.device_get(gen(params, prompt)[0, -1])  # compile + warm
-            elapsed = float("inf")
-            for _ in range(2):  # best-of-2 against tunnel jitter
-                t0 = time.monotonic()
-                out = gen(params, prompt)
-                jax.device_get(out[0, -1])
-                elapsed = min(elapsed, time.monotonic() - t0)
-            # One batched prefill + (new_tokens - 1) decode steps share the
-            # wall; metrics are labelled end-to-end, not per decode step.
-            report(
-                "lm_decode",
-                prompt_len=prompt_len,
-                new_tokens=new_tokens,
-                batch=bsz,
-                e2e_tokens_per_s=round(bsz * new_tokens / elapsed),
-                e2e_ms_per_new_token=round(elapsed / new_tokens * 1e3, 2),
-            )
 
+            def time_gen(fn, p):
+                t0 = time.monotonic()
+                out = fn(p, prompt)
+                jax.device_get(out[0, -1])
+                return time.monotonic() - t0
+
+            # Weight-only int8 serving (models/quant.py): halves the
+            # per-step HBM reads again on top of the bf16 cast.  Own try
+            # so a quant failure can't lose the bf16 line below.
+            qgen = qparams = None
             if remaining() > 30:
-                # Weight-only int8 serving (models/quant.py): halves the
-                # per-step HBM reads again on top of the bf16 cast.  Own
-                # try so a quant failure can't lose the bf16 line above.
                 try:
                     from covalent_tpu_plugin.models import quantize_lm
 
@@ -568,26 +638,200 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
                         )
                     )
                     jax.device_get(qgen(qparams, prompt)[0, -1])  # warm
-                    q_elapsed = float("inf")
-                    for _ in range(2):
-                        t0 = time.monotonic()
-                        out = qgen(qparams, prompt)
-                        jax.device_get(out[0, -1])
-                        q_elapsed = min(q_elapsed, time.monotonic() - t0)
-                    report(
-                        "lm_decode_int8",
-                        batch=bsz,
-                        tokens_per_s=round(bsz * new_tokens / q_elapsed),
-                        ms_per_new_token=round(q_elapsed / new_tokens * 1e3, 2),
-                    )
                 except Exception as error:  # noqa: BLE001
                     report("lm_decode_int8", error=repr(error))
-            else:
+                    qgen = None
+
+            # Like-for-like A/B: alternate bf16/int8 measurements inside
+            # one phase so tunnel drift hits both arms equally (BENCH_r02's
+            # int8 delta was within cross-session variance).  The int8 arm
+            # keeps its own try at measurement time too — a quant-side
+            # failure mid-loop must not void the bf16 numbers.
+            bf16_times, int8_times = [], []
+            for _ in range(3):
+                bf16_times.append(time_gen(gen, params))
+                if qgen is not None:
+                    try:
+                        int8_times.append(time_gen(qgen, qparams))
+                    except Exception as error:  # noqa: BLE001
+                        report("lm_decode_int8", error=repr(error))
+                        qgen, int8_times = None, []
+            elapsed = stats_mod.median(bf16_times)
+            # One batched prefill + (new_tokens - 1) decode steps share the
+            # wall; metrics are labelled end-to-end, not per decode step.
+            report(
+                "lm_decode",
+                prompt_len=prompt_len,
+                new_tokens=new_tokens,
+                batch=bsz,
+                e2e_tokens_per_s=round(bsz * new_tokens / elapsed),
+                e2e_ms_per_new_token=round(elapsed / new_tokens * 1e3, 2),
+                e2e_s_spread=[round(t, 3) for t in sorted(bf16_times)],
+            )
+            if int8_times:
+                q_elapsed = stats_mod.median(int8_times)
+                report(
+                    "lm_decode_int8",
+                    batch=bsz,
+                    tokens_per_s=round(bsz * new_tokens / q_elapsed),
+                    ms_per_new_token=round(q_elapsed / new_tokens * 1e3, 2),
+                    speedup_vs_bf16_same_phase=round(elapsed / q_elapsed, 3),
+                    e2e_s_spread=[round(t, 3) for t in sorted(int8_times)],
+                )
+            elif qgen is None and remaining() <= 30:
                 report("lm_decode_int8", skipped="budget")
         except Exception as error:  # noqa: BLE001
             report("lm_decode", error=repr(error))
     else:
         report("lm_decode", skipped="budget")
+
+    # -- speculative decoding: trained draft/target pair (VERDICT r2 #4) ---
+    # The serving stack's most advanced feature, previously proven exact
+    # but never proven USEFUL: train a 2-layer draft + 6-layer target on
+    # the learnable synthetic stream (models/data.py — the affine bigram
+    # map drives both models to near-agreement in a few hundred steps),
+    # then measure acceptance rate and end-to-end tokens/s vs plain decode
+    # of the SAME target.
+    if remaining() > 100:
+        try:
+            import statistics as stats_mod
+
+            import optax
+
+            from covalent_tpu_plugin.models import (
+                TransformerLM,
+                generate,
+                inference_params,
+                lm_125m_config,
+                speculative_generate,
+            )
+            from covalent_tpu_plugin.models.data import synthetic_lm_batch
+            from covalent_tpu_plugin.models.train import TrainState, lm_loss
+
+            if small:
+                vocab, seq, train_steps, sbsz = 512, 128, 60, 16
+                spec_new, spec_prompt, spec_bsz = 48, 16, 2
+            else:
+                vocab, seq, train_steps, sbsz = 512, 128, 300, 32
+                spec_new, spec_prompt, spec_bsz = 192, 32, 8
+            draft_len = 4
+            cap = spec_prompt + spec_new + draft_len + 1
+            t_cfg = lm_125m_config(
+                vocab_size=vocab, d_model=256, n_layers=6, n_heads=4,
+                d_ff=1024, max_seq=max(seq, cap), scan_layers=False,
+            )
+            d_cfg = lm_125m_config(
+                vocab_size=vocab, d_model=128, n_layers=2, n_heads=4,
+                d_ff=512, max_seq=max(seq, cap), scan_layers=False,
+            )
+
+            def train_lm(cfg, model_seed):
+                model = TransformerLM(cfg)
+                tokens0 = jnp.asarray(
+                    synthetic_lm_batch(sbsz, seq + 1, vocab, seed=0)["tokens"]
+                )
+                params = model.init(
+                    jax.random.PRNGKey(model_seed), tokens0[:, :-1]
+                )["params"]
+                state = TrainState.create(
+                    apply_fn=model.apply, params=params, tx=optax.adamw(1e-3)
+                )
+
+                @jax.jit
+                def step(state, tokens):
+                    loss, grads = jax.value_and_grad(
+                        lambda p: lm_loss(
+                            p, state.apply_fn, {"tokens": tokens}
+                        )
+                    )(state.params)
+                    return state.apply_gradients(grads=grads), loss
+
+                # Distinct batches each step (seed advances): honest
+                # streaming, same rule the data module's stream uses.
+                # Bail early when the phase budget runs low — a shorter
+                # training run lowers acceptance but still completes the
+                # phase (better than the parent killing the electron).
+                # Always takes step 0 (compile can eat the margin BEFORE
+                # the loop; a zero-step bail would leave loss undefined).
+                loss = None
+                for i in range(train_steps):
+                    if i and i % 25 == 0 and remaining() < 60:
+                        break
+                    tokens = jnp.asarray(
+                        synthetic_lm_batch(
+                            sbsz, seq + 1, vocab, seed=1 + i
+                        )["tokens"]
+                    )
+                    state, loss = step(state, tokens)
+                return model, state.params, float(jax.device_get(loss))
+
+            target_model, target_params, t_loss = train_lm(t_cfg, 1)
+            draft_model, draft_params, d_loss = train_lm(d_cfg, 2)
+            target_params = inference_params(target_params)
+            draft_params = inference_params(draft_params)
+            if remaining() < 45:
+                # Training (or its compiles) ate the margin: the generate
+                # compiles ahead are the expensive part — skip cleanly
+                # rather than letting the parent kill the electron.
+                raise TimeoutError("budget exhausted after draft training")
+
+            prompt = jnp.asarray(
+                synthetic_lm_batch(spec_bsz, spec_prompt, vocab, seed=999)[
+                    "tokens"
+                ]
+            )
+            plain = jax.jit(
+                lambda p, t: generate(
+                    target_model, p, t, max_new_tokens=spec_new
+                )
+            )
+            spec = jax.jit(
+                lambda tp, dp, t: speculative_generate(
+                    target_model, tp, draft_model, dp, t, spec_new,
+                    draft_len=draft_len, return_stats=True,
+                )
+            )
+            out_plain = plain(target_params, prompt)
+            out_spec, stats = spec(target_params, draft_params, prompt)
+            jax.device_get(out_spec[0, -1])  # compile + warm both
+            jax.device_get(out_plain[0, -1])
+            exact = bool(
+                jax.device_get((out_plain == out_spec).all())
+            )  # bit-exactness contract, checked on-device
+            rounds = int(jax.device_get(stats["rounds"]))
+            # rounds * draft_len draft proposals produced spec_new - 1
+            # committed tokens (token #1 comes from the prefill).
+            accept = (spec_new - 1) / max(rounds * draft_len, 1)
+
+            plain_t, spec_t = [], []
+            for _ in range(3):  # alternating A/B, median
+                t0 = time.monotonic()
+                jax.device_get(plain(target_params, prompt)[0, -1])
+                plain_t.append(time.monotonic() - t0)
+                t0 = time.monotonic()
+                out, _ = spec(target_params, draft_params, prompt)
+                jax.device_get(out[0, -1])
+                spec_t.append(time.monotonic() - t0)
+            plain_s = stats_mod.median(plain_t)
+            spec_s = stats_mod.median(spec_t)
+            report(
+                "lm_spec",
+                target_loss=round(t_loss, 3),
+                draft_loss=round(d_loss, 3),
+                exact=exact,
+                rounds=rounds,
+                draft_len=draft_len,
+                accept_rate=round(accept, 3),
+                plain_tokens_per_s=round(spec_bsz * spec_new / plain_s),
+                spec_tokens_per_s=round(spec_bsz * spec_new / spec_s),
+                speedup=round(plain_s / spec_s, 3),
+                plain_s_spread=[round(t, 3) for t in sorted(plain_t)],
+                spec_s_spread=[round(t, 3) for t in sorted(spec_t)],
+            )
+        except Exception as error:  # noqa: BLE001
+            report("lm_spec", error=repr(error))
+    else:
+        report("lm_spec", skipped="budget")
 
     progress.close()
     return results
@@ -782,8 +1026,11 @@ async def main() -> None:
         "backend_init_s": sub("init", "init_s"),
         "matmul4k_tflops": sub("matmul", "tflops"),
         "matmul4k_mfu": sub("matmul", "mfu"),
+        "matmul4k_unit_ms_stdev": sub("matmul", "unit_ms_stdev"),
         "mnist_steps_per_s": sub("mnist", "steps_per_s"),
-        "mnist_final_loss": sub("mnist", "final_loss"),
+        "mnist_n_batches": sub("mnist", "n_batches"),
+        "mnist_loss_first": sub("mnist", "loss_first"),
+        "mnist_loss_last": sub("mnist", "loss_last"),
         "flash_fwd_4k_speedup": sub("flash_fwd", "speedup"),
         "flash_fwd_4k_ms": sub("flash_fwd", "flash_ms"),
         "flash_bwd_4k_speedup": sub("flash_bwd", "speedup"),
@@ -797,6 +1044,14 @@ async def main() -> None:
         "lm125m_decode_tokens_per_s": sub("lm_decode", "e2e_tokens_per_s"),
         "lm125m_decode_ms_per_token": sub("lm_decode", "e2e_ms_per_new_token"),
         "lm125m_decode_int8_tokens_per_s": sub("lm_decode_int8", "tokens_per_s"),
+        "lm125m_decode_int8_speedup_ab": sub(
+            "lm_decode_int8", "speedup_vs_bf16_same_phase"
+        ),
+        "spec_accept_rate": sub("lm_spec", "accept_rate"),
+        "spec_tokens_per_s": sub("lm_spec", "spec_tokens_per_s"),
+        "spec_plain_tokens_per_s": sub("lm_spec", "plain_tokens_per_s"),
+        "spec_speedup": sub("lm_spec", "speedup"),
+        "spec_exact": sub("lm_spec", "exact"),
     }
     emit(final)
 
