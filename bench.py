@@ -468,6 +468,32 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
 
                 return unit_seconds(dispatch, fetch, target_s=2.5, cap=8)
 
+            # Exactness probe for the compiled (Mosaic) banded grid: the
+            # CPU test tier runs the kernel in interpret mode only, so a
+            # Mosaic-specific miscompile of the clamped index maps would
+            # otherwise show up as silently wrong numbers here.
+            from covalent_tpu_plugin.ops.attention import mha_reference
+
+            pq, pk, pv = (
+                jax.random.normal(
+                    jax.random.PRNGKey(7 + i), (1, 2, 512, 64), jnp.bfloat16
+                )
+                for i in range(3)
+            )
+            probe_err = float(
+                jax.device_get(
+                    jnp.max(jnp.abs(
+                        flash_attention(
+                            pq, pk, pv, causal=True, window=96,
+                            block_q=128, block_k=128,
+                        ).astype(jnp.float32)
+                        - mha_reference(
+                            pq, pk, pv, causal=True, window=96
+                        ).astype(jnp.float32)
+                    ))
+                )
+            )
+
             unit, spread = bwd_unit(None)
             # attention flops: 4*S^2*D fwd + 10*S^2*D bwd, * 0.5 causal
             # (matches the kernels' own CostEstimates in ops/attention.py)
@@ -488,6 +514,7 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
                     window=win,
                     fwd_bwd_ms=round(win_unit * 1e3, 2),
                     speedup_vs_full=round(unit / win_unit, 2),
+                    banded_max_err=round(probe_err, 5),
                     **win_spread,
                 )
             else:
