@@ -826,9 +826,12 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
                 jax.device_get((out_plain == out_spec).all())
             )  # bit-exactness contract, checked on-device
             rounds = int(jax.device_get(stats["rounds"]))
-            # rounds * draft_len draft proposals produced spec_new - 1
-            # committed tokens (token #1 comes from the prefill).
-            accept = (spec_new - 1) / max(rounds * draft_len, 1)
+            # Each round commits (accepted drafts + 1): the +1 is the
+            # correction or bonus token.  spec_new - 1 tokens came from
+            # rounds rounds (token #1 is the prefill's), so accepted
+            # drafts = spec_new - 1 - rounds of rounds * draft_len
+            # proposals — the standard acceptance-rate definition.
+            accept = (spec_new - 1 - rounds) / max(rounds * draft_len, 1)
 
             plain_t, spec_t = [], []
             for _ in range(3):  # alternating A/B, median

@@ -14,10 +14,10 @@ Design notes (all static-shape, one jittable ``lax.while_loop``):
 * each round REWINDS both KV caches to the committed prefix by setting
   their ``cache_index`` leaves — stale entries beyond the cursor are
   overwritten before they can be read, so no cache copying happens;
-* no "bonus token" on full acceptance: a round commits at most
-  ``draft_len`` tokens.  This keeps every round's cursor arithmetic
-  identical (no lag/catch-up branches) at the cost of one extra round
-  per fully-accepted window;
+* the verify slab scores ``draft_len + 1`` positions (the last committed
+  token plus all ``draft_len`` drafts), so a fully-accepted window
+  commits ``draft_len + 1`` tokens — the standard "bonus token" — for
+  the same one target forward pass per round;
 * batched prompts accept the MINIMUM match length across rows — still
   exact (recomputed tokens are recomputed identically), just less
   speedup when rows diverge;
@@ -92,7 +92,7 @@ def speculative_generate(
         out = prompt.astype(jnp.int32)
         return (out, {"rounds": jnp.zeros((), jnp.int32)}) if return_stats else out
     total = prompt_len + max_new_tokens
-    # Verify slabs may scribble up to draft_len-1 positions past the
+    # Verify slabs may scribble up to draft_len positions past the
     # committed end; both caches and the buffer carry that headroom.
     headroom = total + draft_len
     for name, model in (("target", target), ("draft", draft)):
@@ -125,9 +125,27 @@ def speculative_generate(
     k = draft_len
 
     def draft_k(buffer, length, d_cache):
-        """k sequential draft steps from the committed prefix."""
-        d_cache = _set_cursor(d_cache, length - 1)
-        token0 = jax.lax.dynamic_slice(buffer, (0, length - 1), (batch, 1))
+        """k sequential draft steps from the committed prefix.
+
+        Feeds the last TWO committed tokens as a slab first: after a
+        fully-accepted (bonus-token) round the draft cache is missing the
+        K/V of the final committed token — it was produced as an output,
+        never consumed — and re-feeding the two-token tail repairs that
+        slot uniformly for every round shape (a partial-accept round just
+        rewrites one already-correct position).
+        """
+        d_cache = _set_cursor(d_cache, length - 2)
+        tail = jax.lax.dynamic_slice(buffer, (0, length - 2), (batch, 2))
+        logits, mutated = draft.apply(
+            {"params": draft_params, "cache": d_cache}, tail, mutable=["cache"]
+        )
+        d_cache = mutated["cache"]
+        first = jnp.argmax(
+            logits[:, -1].astype(jnp.float32), axis=-1
+        ).astype(jnp.int32)[:, None]
+        drafted0 = jnp.concatenate(
+            [jnp.zeros((batch, k - 1), jnp.int32), first], axis=1
+        )
 
         def body(_, carry):
             d_cache, token, drafted = carry
@@ -143,7 +161,7 @@ def speculative_generate(
             return mutated["cache"], nxt, drafted
 
         d_cache, _, drafted = jax.lax.fori_loop(
-            0, k, body, (d_cache, token0, jnp.zeros((batch, k), jnp.int32))
+            0, k - 1, body, (d_cache, first, drafted0)
         )
         return d_cache, drafted  # (B, k): d_1..d_k
 
@@ -153,30 +171,35 @@ def speculative_generate(
 
         d_cache, drafted = draft_k(buffer, length, d_cache)
 
-        # Target verifies the k candidates in one slab: feeding
-        # [committed_last, d_1..d_{k-1}] at cursor length-1 yields the
-        # target's greedy choice for each of the k positions.
+        # Target verifies all k candidates in one slab: feeding
+        # [committed_last, d_1..d_k] at cursor length-1 yields the
+        # target's greedy choice for k+1 positions — the (k+1)-th is the
+        # free "bonus token" committed when every draft agrees.
         t_cache = _set_cursor(t_cache, length - 1)
         last = jax.lax.dynamic_slice(buffer, (0, length - 1), (batch, 1))
-        slab = jnp.concatenate([last, drafted[:, : k - 1]], axis=1)
+        slab = jnp.concatenate([last, drafted], axis=1)  # (B, k+1)
         logits, mutated = target.apply(
             {"params": target_params, "cache": t_cache}, slab, mutable=["cache"]
         )
         t_cache = mutated["cache"]
         greedy = jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(
             jnp.int32
-        )  # (B, k): g_1..g_k
+        )  # (B, k+1): g_1..g_{k+1}
 
-        match = (drafted == greedy).astype(jnp.int32)
+        match = (drafted == greedy[:, :k]).astype(jnp.int32)
         run = jnp.min(
             jnp.sum(jnp.cumprod(match, axis=1), axis=1)
-        )  # min leading agreement across the batch
-        commit = jnp.minimum(run + 1, k)
+        )  # min leading agreement across the batch, 0..k
+        commit = run + 1  # full agreement (run == k) commits the bonus too
 
-        # Positions < run take the draft (== target) tokens; the first
-        # mismatch takes the target's correction; later slots are scratch
-        # that the next round overwrites before reading.
-        merged = jnp.where(jnp.arange(k)[None, :] < run, drafted, greedy)
+        # Positions < run take the draft (== target) tokens; the next
+        # position takes the target's choice (correction at a mismatch,
+        # bonus token after a full match); later slots are scratch that
+        # the next round overwrites before reading.
+        padded = jnp.concatenate(
+            [drafted, jnp.zeros((batch, 1), jnp.int32)], axis=1
+        )
+        merged = jnp.where(jnp.arange(k + 1)[None, :] < run, padded, greedy)
         buffer = jax.lax.dynamic_update_slice(buffer, merged, (0, length))
         return (
             buffer,

@@ -55,18 +55,19 @@ def test_speculative_matches_greedy_any_draft(draft_len, batch):
 
 
 def test_self_draft_commits_full_windows():
-    """Draft == target: every window fully accepted, so rounds collapse
-    to ceil((N-1)/k) — the mechanism's upper bound."""
+    """Draft == target: every window fully accepted, so each round
+    commits draft_len + 1 tokens (the bonus token) and rounds collapse
+    to ceil((N-1)/(k+1)) — the mechanism's upper bound."""
     prompt = jax.random.randint(jax.random.PRNGKey(2), (1, 4), 0, 64)
     target, tparams = build(TARGET_CFG, 0, prompt)
-    max_new, k = 13, 4
+    max_new, k = 11, 4  # ceil(10/5)=2 with the bonus; 3 without it
     out, stats = speculative_generate(
         target, tparams, target, tparams, prompt, max_new, draft_len=k,
         return_stats=True,
     )
     want = np.asarray(generate(target, tparams, prompt, max_new))
     np.testing.assert_array_equal(np.asarray(out), want)
-    assert int(stats["rounds"]) == -(-(max_new - 1) // k)  # ceil
+    assert int(stats["rounds"]) == -(-(max_new - 1) // (k + 1))  # ceil
 
 
 def test_speculative_is_jittable():
