@@ -128,19 +128,28 @@ def _check_window(window, causal, sinks: int = 0) -> None:
 # grid with the @pl.when skip.
 
 
+def _sink_tiles(sinks: int, block_k: int) -> int:
+    """Number of leading key tiles holding sink columns."""
+    return -(-sinks // block_k) if sinks else 0
+
+
 def _banded_n_inner_kt(seq_q: int, seq_k: int, block_q: int, block_k: int,
-                       window: int) -> int | None:
+                       window: int, sinks: int = 0) -> int | None:
     """Static length of the inner key-tile sweep for the banded forward/dq
-    grids: the max number of key tiles any query tile's band touches.
-    Returns None when the band covers the full sweep anyway (no gain)."""
+    grids: a leading run of sink tiles plus the max number of key tiles
+    any query tile's band touches (the band run starts after the sink
+    run — overlapping tiles are visited once, by the sink run).
+    Returns None when that covers the full sweep anyway (no gain)."""
     kt_full = seq_k // block_k
+    nst = _sink_tiles(sinks, block_k)
     worst = 0
     for i in range(seq_q // block_q):
-        lo = max(0, (i * block_q - (window - 1)) // block_k)
+        lo = max(nst, (i * block_q - (window - 1)) // block_k)
         hi = min(kt_full - 1, ((i + 1) * block_q - 1) // block_k)
         if hi >= lo:
             worst = max(worst, hi - lo + 1)
-    return worst if 0 < worst < kt_full else None
+    n_inner = nst + worst
+    return n_inner if 0 < n_inner < kt_full else None
 
 
 def _banded_n_inner_qt(seq_q: int, seq_k: int, block_q: int, block_k: int,
@@ -157,17 +166,27 @@ def _banded_n_inner_qt(seq_q: int, seq_k: int, block_q: int, block_k: int,
     return worst if 0 < worst < qt_full else None
 
 
-def _band_kt_lo(i, block_q: int, block_k: int, window: int):
-    """Traced first key tile of query tile ``i``'s band (contiguous pos)."""
-    return jnp.maximum(i * block_q - (window - 1), 0) // block_k
+def _band_kt_lo(i, block_q: int, block_k: int, window: int, sinks: int = 0):
+    """Traced first key tile of query tile ``i``'s band RUN (contiguous
+    pos): with sinks the run starts after the sink tiles, which the
+    leading sweep steps already visit."""
+    lo = jnp.maximum(i * block_q - (window - 1), 0) // block_k
+    nst = _sink_tiles(sinks, block_k)
+    return jnp.maximum(lo, nst) if nst else lo
 
 
 def _band_kt_live(i, jj, block_q: int, block_k: int, window: int,
-                  kt_full: int):
-    """Whether inner step ``jj`` of query tile ``i`` is a live band tile
-    (vs. a clamped duplicate past the causal edge)."""
+                  kt_full: int, sinks: int = 0):
+    """Whether inner step ``jj`` of query tile ``i`` is a live tile (vs.
+    a clamped duplicate past the causal edge).  Steps below the sink-run
+    length always map to their own (unclamped) tile, so the position
+    check alone is exact for them."""
+    nst = _sink_tiles(sinks, block_k)
     hi = jnp.minimum(((i + 1) * block_q - 1) // block_k, kt_full - 1)
-    return _band_kt_lo(i, block_q, block_k, window) + jj <= hi
+    in_band = _band_kt_lo(i, block_q, block_k, window, sinks) + (jj - nst) <= hi
+    if not nst:
+        return in_band
+    return jnp.where(jj < nst, True, in_band)
 
 
 def _band_qt_lo(jk, block_q: int, block_k: int):
@@ -176,26 +195,29 @@ def _band_qt_lo(jk, block_q: int, block_k: int):
 
 
 def _banded_sweep_kt(seq_q: int, seq_k: int, block_q: int, block_k: int,
-                     window, enabled: bool):
+                     window, enabled: bool, sinks: int = 0):
     """(steps, tile_index_fn, band) for a key-tile inner sweep.
 
-    Banded (shrunken, q-tile-relative clamped indexing) when it helps;
-    otherwise the full sweep with identity indexing and ``band=None``.
-    The ONE constructor for the forward and dq grids, so clamp-bound or
-    geometry changes happen in a single place.
+    Banded (a sink-tile run + the band's q-tile-relative clamped run)
+    when it helps; otherwise the full sweep with identity indexing and
+    ``band=None``.  The ONE constructor for the forward and dq grids, so
+    clamp-bound or geometry changes happen in a single place.
     """
     kt_full = seq_k // block_k
     n_inner = (
-        _banded_n_inner_kt(seq_q, seq_k, block_q, block_k, window)
+        _banded_n_inner_kt(seq_q, seq_k, block_q, block_k, window, sinks)
         if enabled else None
     )
     if n_inner is None:
         return kt_full, (lambda i, jj: jj), None
+    nst = _sink_tiles(sinks, block_k)
 
     def tile(i, jj):
-        return jnp.minimum(
-            _band_kt_lo(i, block_q, block_k, window) + jj, kt_full - 1
+        band_j = jnp.minimum(
+            _band_kt_lo(i, block_q, block_k, window, sinks) + (jj - nst),
+            kt_full - 1,
         )
+        return jnp.where(jj < nst, jj, band_j) if nst else band_j
 
     return n_inner, tile, (block_q, block_k, kt_full)
 
@@ -307,7 +329,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, qpos_ref, kpos_ref, o_ref, lse_ref,
         needed = jnp.logical_and(
             needed,
             _band_kt_live(pl.program_id(2), kt, block_q, block_k, window,
-                          kt_full),
+                          kt_full, sinks),
         )
 
     @pl.when(needed)
@@ -420,12 +442,11 @@ def _flash_forward(
     group = _gqa_group(q, k)
     qpos, kpos = _positions_2d(q_positions, k_positions, seq_len, seq_len_k)
     contiguous = q_positions is None and k_positions is None
-    # Attention sinks splinter the needed key tiles into two runs (sink
-    # tiles + band run) — not yet a banded grid shape; fall back to the
-    # full grid with the @pl.when tile-skip when sinks are on.
+    # With sinks the inner sweep is a sink-tile run + the band run (two
+    # contiguous runs, visited once each — overlaps fold into the sink run).
     steps, _kj, band = _banded_sweep_kt(
         seq_len, seq_len_k, block_q, block_k, window,
-        window is not None and causal and contiguous and not sinks,
+        window is not None and causal and contiguous, sinks,
     )
     grid = (batch, heads, seq_len // block_q, steps)
     qo_spec = pl.BlockSpec(
@@ -590,7 +611,7 @@ def _flash_bwd_dq_kernel(
         needed = jnp.logical_and(
             needed,
             _band_kt_live(pl.program_id(2), kt, block_q, block_k, window,
-                          kt_full),
+                          kt_full, sinks),
         )
 
     @pl.when(needed)
@@ -668,17 +689,20 @@ def _flash_backward(
     )
 
     contiguous = q_positions is None and k_positions is None
-    # Sinks splinter the tile runs: full grid + tile-skip (see forward).
-    banded = window is not None and causal and contiguous and not sinks
+    banded = window is not None and causal and contiguous
     qt_full = seq_len // block_q
     kt_full = seq_len_k // block_k
 
     # dk/dv sweep — grid (B, H_kv, KT, G, QT): group member + query tile are
     # innermost so one (kv head, key tile) output block accumulates across
     # every query head in its group (see kernel docstring).  With a window
-    # the QT sweep shrinks to the band's query-tile run (see forward).
+    # the QT sweep shrinks to the band's query-tile run (see forward) —
+    # except with sinks: a sink KEY tile is read by every later query
+    # tile, so this sweep stays full-grid + tile-skip (the forward and dq
+    # sweeps band their sink run instead; splitting dk/dv into a sink
+    # call + band call is the remaining follow-up).
     n_inner_qt, _qi, band_kv = _banded_sweep_qt(
-        seq_len, seq_len_k, block_q, block_k, window, banded
+        seq_len, seq_len_k, block_q, block_k, window, banded and not sinks
     )
 
     qo_spec_q = pl.BlockSpec(
@@ -719,7 +743,7 @@ def _flash_backward(
 
     # dq sweep — banded exactly like the forward (key tiles innermost).
     n_inner_kt, _kj, band_q = _banded_sweep_kt(
-        seq_len, seq_len_k, block_q, block_k, window, banded
+        seq_len, seq_len_k, block_q, block_k, window, banded, sinks
     )
 
     qo_spec_i = pl.BlockSpec(
@@ -832,9 +856,10 @@ def flash_attention(
     positions; with default contiguous positions the grids visit ONLY the
     band's tiles (compute and DMA scale O(S·w) instead of O(S²)).
     ``sinks=k`` (StreamingLLM attention sinks) keeps columns ``< k``
-    visible to every row alongside the band — the full grid with the
-    tile-level skip then applies (a sink run + band run is not a single
-    banded sweep).
+    visible to every row alongside the band; the forward and dq sweeps
+    band as a sink-tile run + band run, while the dk/dv sweep (whose
+    sink key tiles are read by every query tile) keeps the full grid
+    with the tile-level skip.
     """
     _check_window(window, causal, sinks)
     if interpret is None:

@@ -133,6 +133,11 @@ def test_banded_grid_static_geometry():
     assert _banded_n_inner_qt(256, 256, 64, 64, 10_000) is None
     # Tiny window still visits >= 1 tile per query tile.
     assert _banded_n_inner_kt(256, 256, 64, 64, 1) == 1
+    # Sinks add a leading sink-tile run: one extra step here (sinks <= 64
+    # fit one tile), still far below the 16-tile full sweep.
+    assert _banded_n_inner_kt(16384, 16384, 512, 1024, 1024, sinks=4) == 3
+    # Overlap folds into the sink run (band lo clamps to the sink tiles).
+    assert _banded_n_inner_kt(256, 256, 64, 64, 37, sinks=4) == 3
 
 
 @pytest.mark.parametrize("bq,bk", [(64, 128), (128, 64), (64, 64)])
