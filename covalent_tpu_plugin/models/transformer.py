@@ -51,7 +51,7 @@ class TransformerConfig:
     #: lm_head matmul dtype.  f32 is the conservative default; bf16 runs the
     #: head on the MXU's fast path (the loss re-casts to f32 for softmax).
     logits_dtype: Any = jnp.float32
-    attention: str = "auto"          # auto | flash | reference | ring
+    attention: str = "auto"      # auto | flash | reference | ring | ulysses
     #: incremental decoding: layers keep a (max_seq) K/V cache in the flax
     #: "cache" collection and consume one token slice per apply.
     decode: bool = False
@@ -97,7 +97,8 @@ class TransformerConfig:
     #: and the rolling cache pins their slots (never overwritten).  Known
     #: to stabilise long windowed decode where window-only attention
     #: drifts once position 0 rolls out of the band.  Requires
-    #: sliding_window; unsupported with attention="ring".
+    #: sliding_window; for sequence parallelism use attention="ulysses"
+    #: (the rotating ring cannot keep shard 0's sinks resident).
     attention_sinks: int = 0
     #: rotary embedding wavelength base (theta).  10k is the GPT-NeoX/
     #: llama default; raising it (e.g. 500k, llama-3 style) stretches the
@@ -219,28 +220,34 @@ class Attention(nn.Module):
         impl = cfg.attention
         if impl == "auto":
             impl = "flash" if on_tpu() else "reference"
-        if impl == "ring":
+        if impl in ("ring", "ulysses"):
             if cfg.mesh is None:
-                raise ValueError("attention='ring' requires config.mesh")
-            if cfg.attention_sinks:
+                raise ValueError(f"attention={impl!r} requires config.mesh")
+            if cfg.attention_sinks and impl == "ring":
                 # Sink columns live on shard 0 only; every hop would need
-                # them resident (a broadcast, not a rotation).  Deferred:
-                # keep shard 0's first tokens via a one-time all-gather of
-                # the sink slab before the ring.
+                # them resident (a broadcast, not a rotation).  Use
+                # attention='ulysses' — its full-sequence local attention
+                # composes with sinks unchanged.
                 raise ValueError(
                     "attention_sinks are unsupported with attention='ring'"
+                    " — use attention='ulysses'"
                 )
-            if kv_heads != cfg.n_heads:
+            if impl == "ring" and kv_heads != cfg.n_heads:
                 # Ring shards over sequence, not heads: materialising the
                 # group repeat is cheap relative to the ring's kv transfers.
+                # (Ulysses repeats internally only when needed.)
                 group = cfg.n_heads // kv_heads
                 kh = jnp.repeat(kh, group, axis=1)
                 vh = jnp.repeat(vh, group, axis=1)
             # sliding_window composes: the banded ring masks each hop by
             # global positions and (contiguous layout) truncates the ring
-            # to the hops intersecting the band (ops/ring_attention.py).
+            # to the hops intersecting the band; ulysses swaps
+            # sequence<->heads and runs the banded full-sequence kernel
+            # locally (ops/ring_attention.py).
             out = sequence_parallel_attention(
-                qh, kh, vh, cfg.mesh, causal=True, window=cfg.sliding_window
+                qh, kh, vh, cfg.mesh, causal=True,
+                window=cfg.sliding_window, sinks=cfg.attention_sinks,
+                impl="ulysses" if impl == "ulysses" else None,
             )
         elif impl == "flash":
             if cfg.mesh is not None:

@@ -13,6 +13,7 @@ from .ring_attention import (
     ring_attention,
     ring_flash_attention,
     sequence_parallel_attention,
+    ulysses_attention,
 )
 
 __all__ = [
@@ -22,4 +23,5 @@ __all__ = [
     "ring_attention",
     "ring_flash_attention",
     "sequence_parallel_attention",
+    "ulysses_attention",
 ]

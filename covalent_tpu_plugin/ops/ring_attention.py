@@ -24,8 +24,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..parallel.collectives import ring_permute
-from .attention import _flash_backward, _flash_forward, on_tpu
+from ..parallel.collectives import all_to_all, ring_permute
+from .attention import _flash_backward, _flash_forward, flash_attention, on_tpu
 
 _NEG_INF = -1e30
 
@@ -383,6 +383,59 @@ def ring_flash_attention(
     return _ring_flash(q, k, v, axis_name, causal, zigzag, interpret, window)
 
 
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "seq",
+    causal: bool = True,
+    window: int | None = None,
+    sinks: int = 0,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Per-shard Ulysses (all-to-all) sequence parallelism.
+
+    Call under ``shard_map`` with seq-sharded (B, H, S/n, D).  Two
+    all-to-alls swap shard ownership sequence<->heads: each device runs
+    the flash kernel over the FULL sequence for H/n of the heads, then
+    swaps back.  Communication is 2 all-to-alls of O(B·H·S·D/n) per
+    device (vs the ring's n ppermute hops); because the local attention
+    sees the whole sequence with contiguous positions, the banded
+    windowed grids AND attention sinks compose unchanged — this is the
+    sinks × sequence-parallelism path the rotating ring cannot offer.
+
+    Head divisibility: local heads (H after any tensor sharding) must be
+    divisible by the axis size.  GQA kv tensors with fewer heads are
+    repeated up to H first — acceptable at Ulysses' communication scale,
+    where kv bytes already cross the interconnect.
+    """
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return flash_attention(
+            q, k, v, causal=causal, window=window, sinks=sinks,
+            interpret=interpret,
+        )
+    h_q, h_kv = q.shape[1], k.shape[1]
+    if h_q % n:
+        raise ValueError(
+            f"ulysses needs local heads ({h_q}) divisible by the "
+            f"'{axis_name}' axis ({n})"
+        )
+    if h_kv != h_q:
+        group = h_q // h_kv
+        k = jnp.repeat(k, group, axis=1)
+        v = jnp.repeat(v, group, axis=1)
+    # (B, H, S/n, D) -> (B, H/n, S, D): heads scatter, sequence gathers.
+    q = all_to_all(q, axis_name, split_axis=1, concat_axis=2)
+    k = all_to_all(k, axis_name, split_axis=1, concat_axis=2)
+    v = all_to_all(v, axis_name, split_axis=1, concat_axis=2)
+    out = flash_attention(
+        q, k, v, causal=causal, window=window, sinks=sinks,
+        interpret=interpret,
+    )
+    return all_to_all(out, axis_name, split_axis=2, concat_axis=1)
+
+
 def _stripe_permutation(seq_len: int, n: int) -> jax.Array:
     """Index vector mapping natural order -> zigzag-striped order.
 
@@ -428,6 +481,7 @@ def sequence_parallel_attention(
     zigzag: bool | None = None,
     impl: str | None = None,
     window: int | None = None,
+    sinks: int = 0,
 ) -> jax.Array:
     """Global entry: (B, H, S, D) arrays -> ring attention over ``mesh``.
 
@@ -449,26 +503,51 @@ def sequence_parallel_attention(
     (``_ring_steps``).  Explicit ``zigzag=True`` still composes with the
     window (full ``n`` hops, positions mask exactly).
 
-    ``impl``: ``"flash"`` runs each block pair through the Pallas kernels
-    (O(S/n·D) per-device memory, fwd and bwd), ``"einsum"`` uses the fused
-    dense block path; default auto-selects flash on TPU.
+    ``impl``: ``"flash"`` runs each (q-shard, k-shard) block pair through
+    the Pallas kernels (O(S/n·D) per-device memory, fwd and bwd),
+    ``"einsum"`` uses the fused dense block path, ``"ulysses"`` swaps
+    shard ownership sequence<->heads with two all-to-alls and runs the
+    full-sequence flash kernel on H/n local heads (needs head
+    divisibility; the only impl that composes with ``sinks``); default
+    auto-selects flash on TPU.
     """
     if window is not None and not causal:
         raise ValueError("window (sliding-window attention) requires causal")
     n = mesh.shape[axis_name]
+    if impl is None:
+        impl = "flash" if on_tpu() else "einsum"
+    if impl not in ("flash", "einsum", "ulysses"):
+        raise ValueError(
+            f"impl must be 'flash', 'einsum', or 'ulysses', got {impl!r}"
+        )
+    if sinks and impl != "ulysses":
+        raise ValueError(
+            "sinks require impl='ulysses' (the rotating ring would need "
+            "shard 0's sink slab resident on every hop)"
+        )
+    spec = P(batch_axes, head_axis, axis_name, None)
+    if impl == "ulysses":
+        # Ulysses keeps the contiguous layout (full sequence local after
+        # the swap): zigzag striping has nothing to balance.
+        body = functools.partial(
+            ulysses_attention, axis_name=axis_name, causal=causal,
+            window=window, sinks=sinks,
+        )
+        return jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )(q, k, v)
     if zigzag is None:
         zigzag = (
             causal and n > 1 and q.shape[2] % (2 * n) == 0 and window is None
         )
-    if impl is None:
-        impl = "flash" if on_tpu() else "einsum"
-    if impl not in ("flash", "einsum"):
-        raise ValueError(f"impl must be 'flash' or 'einsum', got {impl!r}")
     if zigzag:
         q = stripe_sequence(q, n)
         k = stripe_sequence(k, n)
         v = stripe_sequence(v, n)
-    spec = P(batch_axes, head_axis, axis_name, None)
     body = ring_flash_attention if impl == "flash" else ring_attention
     ring = functools.partial(
         body, axis_name=axis_name, causal=causal, zigzag=zigzag, window=window
