@@ -25,7 +25,7 @@ from .lora import (
     quantize_then_lora,
 )
 from .quant import QuantDenseGeneral, quantize_lm
-from .speculative import speculative_generate
+from .speculative import speculative_generate, speculative_sample
 from .mlp import MLP, MnistCNN, synthetic_mnist
 from .transformer import TransformerConfig, TransformerLM, lm_125m_config
 from .train import (
@@ -55,6 +55,7 @@ __all__ = [
     "QuantDenseGeneral",
     "quantize_lm",
     "speculative_generate",
+    "speculative_sample",
     "LoRATrainState",
     "add_lora",
     "lora_mask",

@@ -21,8 +21,12 @@ Design notes (all static-shape, one jittable ``lax.while_loop``):
 * batched prompts accept the MINIMUM match length across rows — still
   exact (recomputed tokens are recomputed identically), just less
   speedup when rows diverge;
-* greedy only: sampling would need rejection-sampling acceptance
-  (Leviathan et al. 2023) to stay distribution-exact.
+* sampling lives in :func:`speculative_sample` — rejection-sampling
+  acceptance (Leviathan et al. 2023): accept draft token ``d`` with
+  probability ``min(1, p(d)/q(d))``, resample rejections from the
+  normalised residual ``max(p - q, 0)``, so every committed token is
+  distributed EXACTLY as target sampling at the same
+  temperature/top-k/top-p filters.
 
 The reference has no serving path at all; this composes with the other
 serving modes (bf16 cast, int8 quant — any decode-capable model pair
@@ -217,6 +221,248 @@ def speculative_generate(
         round_body,
         (buffer, jnp.ones((), jnp.int32), t_cache, d_cache,
          jnp.zeros((), jnp.int32)),
+    )
+    out = jax.lax.dynamic_slice(buffer, (0, 0), (batch, total))
+    return (out, {"rounds": rounds}) if return_stats else out
+
+
+def _filtered_logprobs(logits, temperature, top_k, top_p):
+    """Temperature + top-k + top-p filtered log-probabilities (f32).
+
+    The same filter chain :func:`..decode.generate` applies — rejection
+    sampling is exact with respect to whatever filtered target
+    distribution both models are scored under, so draft and target MUST
+    share this transform.
+    """
+    from .decode import _filter_top_k, _filter_top_p
+
+    scaled = logits.astype(jnp.float32) / temperature
+    if top_k is not None:
+        scaled = _filter_top_k(scaled, top_k)
+    if top_p is not None:
+        scaled = _filter_top_p(scaled, top_p)
+    return jax.nn.log_softmax(scaled, axis=-1)
+
+
+def speculative_sample(
+    target_model: TransformerLM,
+    target_params: Any,
+    draft_model: TransformerLM,
+    draft_params: Any,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    draft_len: int = 4,
+    temperature: float = 1.0,
+    rng: jax.Array | None = None,
+    top_k: int | None = None,
+    top_p: float | None = None,
+    return_stats: bool = False,
+):
+    """Speculative SAMPLING: rejection-sampling acceptance, exact in
+    distribution to ``generate(target, ..., temperature, top_k, top_p)``.
+
+    Each round the draft samples ``draft_len`` tokens; the target scores
+    them in one slab; draft token ``d_i`` is accepted with probability
+    ``min(1, p_i(d_i) / q_i(d_i))`` (Leviathan et al. 2023).  The first
+    rejected position resamples from the normalised residual
+    ``max(p_i - q_i, 0)`` — which preserves the target marginal exactly —
+    and a fully-accepted window commits a bonus token sampled from
+    ``p_{k+1}``.  Batched rows commit the MINIMUM accepted run across the
+    batch; a row's discarded accepts are re-proposed with fresh
+    randomness next round, which cannot bias its marginal (the discard
+    decision depends only on other rows' independent randomness).
+
+    Returns the (B, P+N) buffer (+ ``{"rounds": ...}`` with
+    ``return_stats``).  Greedy decoding (temperature 0) lives in
+    :func:`speculative_generate`.
+    """
+    if temperature <= 0:
+        raise ValueError(
+            "speculative_sample requires temperature > 0; use "
+            "speculative_generate for greedy decoding"
+        )
+    if rng is None:
+        raise ValueError("speculative_sample requires rng")
+    if draft_len < 1:
+        raise ValueError(f"draft_len must be >= 1, got {draft_len}")
+    if target_model.config.vocab_size != draft_model.config.vocab_size:
+        raise ValueError("target and draft must share a vocabulary")
+    if target_model.config.rolling_cache or draft_model.config.rolling_cache:
+        raise ValueError(
+            "speculative_sample does not support rolling_cache models"
+        )
+    target = _decode_model(target_model)
+    draft = _decode_model(draft_model)
+    batch, prompt_len = prompt.shape
+    vocab = target.config.vocab_size
+    if max_new_tokens <= 0:
+        out = prompt.astype(jnp.int32)
+        return (out, {"rounds": jnp.zeros((), jnp.int32)}) if return_stats else out
+    total = prompt_len + max_new_tokens
+    headroom = total + draft_len
+    for name, model in (("target", target), ("draft", draft)):
+        if headroom > model.config.max_seq:
+            raise ValueError(
+                f"{name} max_seq {model.config.max_seq} < prompt + "
+                f"max_new_tokens + draft_len = {headroom}"
+            )
+    k = draft_len
+
+    buffer = jnp.zeros((batch, headroom), jnp.int32)
+    buffer = jax.lax.dynamic_update_slice(buffer, prompt, (0, 0))
+
+    t_cache = init_cache(target_model, batch)
+    d_cache = init_cache(draft_model, batch)
+    t_logits, mutated = target.apply(
+        {"params": target_params, "cache": t_cache}, prompt, mutable=["cache"]
+    )
+    t_cache = mutated["cache"]
+    _, mutated = draft.apply(
+        {"params": draft_params, "cache": d_cache}, prompt, mutable=["cache"]
+    )
+    d_cache = mutated["cache"]
+    rng, key = jax.random.split(rng)
+    first = jax.random.categorical(
+        key, _filtered_logprobs(t_logits[:, -1], temperature, top_k, top_p),
+        axis=-1,
+    ).astype(jnp.int32)
+    buffer = jax.lax.dynamic_update_slice(
+        buffer, first[:, None], (0, prompt_len)
+    )
+
+    def draft_k(buffer, length, d_cache, rng):
+        """k sampled draft steps; returns the drafts AND their filtered
+        log-prob tables (needed for acceptance ratios + residuals)."""
+        d_cache = _set_cursor(d_cache, length - 2)
+        tail = jax.lax.dynamic_slice(buffer, (0, length - 2), (batch, 2))
+        logits, mutated = draft.apply(
+            {"params": draft_params, "cache": d_cache}, tail, mutable=["cache"]
+        )
+        d_cache = mutated["cache"]
+        rng, key = jax.random.split(rng)
+        logq0 = _filtered_logprobs(logits[:, -1], temperature, top_k, top_p)
+        first = jax.random.categorical(key, logq0, axis=-1).astype(jnp.int32)
+
+        logq = jnp.zeros((batch, k, vocab), jnp.float32)
+        logq = jax.lax.dynamic_update_slice(
+            logq, logq0[:, None, :], (0, 0, 0)
+        )
+        drafted = jnp.zeros((batch, k), jnp.int32).at[:, 0].set(first)
+
+        def body(i, carry):
+            d_cache, token, drafted, logq, rng = carry
+            logits, mutated = draft.apply(
+                {"params": draft_params, "cache": d_cache},
+                token[:, None],
+                mutable=["cache"],
+            )
+            rng, key = jax.random.split(rng)
+            logq_i = _filtered_logprobs(
+                logits[:, -1], temperature, top_k, top_p
+            )
+            nxt = jax.random.categorical(key, logq_i, axis=-1).astype(jnp.int32)
+            drafted = jax.lax.dynamic_update_slice(
+                drafted, nxt[:, None], (0, i)
+            )
+            logq = jax.lax.dynamic_update_slice(
+                logq, logq_i[:, None, :], (0, i, 0)
+            )
+            return mutated["cache"], nxt, drafted, logq, rng
+
+        d_cache, _, drafted, logq, rng = jax.lax.fori_loop(
+            1, k, body, (d_cache, first, drafted, logq, rng)
+        )
+        return d_cache, drafted, logq, rng
+
+    def round_body(carry):
+        buffer, n_generated, t_cache, d_cache, rounds, rng = carry
+        length = prompt_len + n_generated
+
+        d_cache, drafted, logq, rng = draft_k(buffer, length, d_cache, rng)
+
+        t_cache = _set_cursor(t_cache, length - 1)
+        last = jax.lax.dynamic_slice(buffer, (0, length - 1), (batch, 1))
+        slab = jnp.concatenate([last, drafted], axis=1)  # (B, k+1)
+        logits, mutated = target.apply(
+            {"params": target_params, "cache": t_cache}, slab, mutable=["cache"]
+        )
+        t_cache = mutated["cache"]
+        logp = _filtered_logprobs(logits, temperature, top_k, top_p)
+        # (B, k+1, V): p_1..p_{k+1}
+
+        # Acceptance: u_i < p_i(d_i) / q_i(d_i), vectorised over the k
+        # drafted positions.
+        rng, akey, bkey = jax.random.split(rng, 3)
+        logp_d = jnp.take_along_axis(
+            logp[:, :k, :], drafted[:, :, None], axis=2
+        )[..., 0]  # (B, k)
+        logq_d = jnp.take_along_axis(
+            logq, drafted[:, :, None], axis=2
+        )[..., 0]  # (B, k)
+        u = jax.random.uniform(akey, (batch, k))
+        accept = u < jnp.exp(jnp.minimum(logp_d - logq_d, 0.0))
+        run = jnp.min(
+            jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1)
+        )  # min accepted prefix across the batch, 0..k
+
+        # Boundary token at position `run` (0-indexed in the slab's k+1
+        # outputs): accepted rows keep their draft; rejected rows sample
+        # the normalised residual max(p - q, 0) — exactly the Leviathan
+        # correction.  On a full accept (run == k) everyone samples the
+        # bonus from p_{k+1}; the residual branch is never selected there.
+        p_bnd = jnp.exp(
+            jax.lax.dynamic_slice(
+                logp, (0, run, 0), (batch, 1, vocab)
+            )[:, 0, :]
+        )
+        q_bnd = jnp.exp(
+            jax.lax.dynamic_slice(
+                logq, (0, jnp.minimum(run, k - 1), 0), (batch, 1, vocab)
+            )[:, 0, :]
+        )
+        residual = jnp.maximum(p_bnd - q_bnd, 0.0)
+        # All-zero residual is possible only through fp rounding (p <= q
+        # everywhere yet the draft got rejected); fall back to p.
+        res_mass = jnp.sum(residual, axis=-1, keepdims=True)
+        residual = jnp.where(res_mass > 0, residual / res_mass, p_bnd)
+        res_tok = jax.random.categorical(
+            bkey, jnp.log(jnp.maximum(residual, 1e-37)), axis=-1
+        ).astype(jnp.int32)
+        bonus_tok = jax.random.categorical(
+            bkey, jnp.log(jnp.maximum(p_bnd, 1e-37)), axis=-1
+        ).astype(jnp.int32)
+        accept_bnd = jnp.take_along_axis(
+            accept, jnp.full((batch, 1), jnp.minimum(run, k - 1)), axis=1
+        )[:, 0]
+        drafted_bnd = jnp.take_along_axis(
+            drafted, jnp.full((batch, 1), jnp.minimum(run, k - 1)), axis=1
+        )[:, 0]
+        boundary = jnp.where(
+            run == k,
+            bonus_tok,
+            jnp.where(accept_bnd, drafted_bnd, res_tok),
+        )
+
+        commit = run + 1
+        padded = jnp.concatenate(
+            [drafted, jnp.zeros((batch, 1), jnp.int32)], axis=1
+        )
+        idx = jnp.arange(k + 1)[None, :]
+        merged = jnp.where(
+            idx < run, padded,
+            jnp.where(idx == run, boundary[:, None], padded),
+        )
+        buffer = jax.lax.dynamic_update_slice(buffer, merged, (0, length))
+        return (buffer, n_generated + commit, t_cache, d_cache, rounds + 1, rng)
+
+    def cond(carry):
+        return carry[1] < max_new_tokens
+
+    buffer, _, _, _, rounds, _ = jax.lax.while_loop(
+        cond,
+        round_body,
+        (buffer, jnp.ones((), jnp.int32), t_cache, d_cache,
+         jnp.zeros((), jnp.int32), rng),
     )
     out = jax.lax.dynamic_slice(buffer, (0, 0), (batch, total))
     return (out, {"rounds": rounds}) if return_stats else out
