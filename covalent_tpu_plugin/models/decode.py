@@ -123,6 +123,7 @@ def generate(
     top_p: float | None = None,
     eos_token_id: int | None = None,
     pad_token_id: int | None = None,
+    prefill_chunk: int | None = None,
 ) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt`` ((B, P) int32).
 
@@ -132,7 +133,11 @@ def generate(
     order, the HF/transformers convention).  ``eos_token_id`` stops a row
     once it emits EOS: its remaining slots fill with ``pad_token_id``
     (default: the EOS id), and the loop exits early when every row has
-    finished.  Returns the full (B, P+N) token buffer.  Wrap in
+    finished.  ``prefill_chunk`` streams the prompt into the caches in
+    fixed-size slabs instead of one pass — the decode cache attends a
+    chunk's queries against everything already cached, so the result is
+    exact while prefill activation memory is bounded O(chunk·S) for long
+    prompts.  Returns the full (B, P+N) token buffer.  Wrap in
     ``jax.jit`` for repeated use — everything inside is a single compiled
     loop.
     """
@@ -168,6 +173,8 @@ def generate(
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if pad_token_id is not None and eos_token_id is None:
         raise ValueError("pad_token_id requires eos_token_id")
+    if prefill_chunk is not None and prefill_chunk < 1:
+        raise ValueError(f"prefill_chunk must be >= 1, got {prefill_chunk}")
     if max_new_tokens <= 0:
         return prompt.astype(jnp.int32)
     if temperature > 0 and rng is None:
@@ -201,12 +208,23 @@ def generate(
         chosen = jnp.where(done, jnp.int32(pad), chosen)
         return chosen, done | (chosen == eos_token_id)
 
-    # Prefill: one batched pass pushes the whole prompt into the caches and
-    # yields the first generated token from the prompt's last logits.
-    prefill_logits, mutated = decoder.apply(
-        {"params": params, "cache": cache}, prompt, mutable=["cache"]
-    )
-    cache = mutated["cache"]
+    # Prefill: batched pass(es) push the whole prompt into the caches and
+    # yield the first generated token from the prompt's last logits.
+    # Chunked prefill is exact (each slab attends the cached prefix with
+    # per-row causal visibility); the chunk count is static so this is a
+    # plain Python loop of at most two compiled shapes.
+    if prefill_chunk is None or prefill_chunk >= prompt_len:
+        chunks = [prompt]
+    else:
+        chunks = [
+            prompt[:, start:start + prefill_chunk]
+            for start in range(0, prompt_len, prefill_chunk)
+        ]
+    for slab in chunks:
+        prefill_logits, mutated = decoder.apply(
+            {"params": params, "cache": cache}, slab, mutable=["cache"]
+        )
+        cache = mutated["cache"]
     first, rng = choose(prefill_logits[:, -1], rng)
     done = jnp.zeros((batch,), bool)
     first, done = finish(first, done)

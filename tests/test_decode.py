@@ -303,3 +303,37 @@ def test_rope_base_changes_positions_but_keeps_cache_consistency():
     got = generate(model, params, prompt, max_new_tokens=6)
     want = naive_greedy(model, params, prompt, 6)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_generate_prefill_chunk_exact():
+    """Chunked prefill through generate(): identical tokens to the
+    single-slab prefill for standard AND rolling(+sinks) caches, at chunk
+    sizes that divide the prompt, don't, and exceed it."""
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+        max_seq=48, dtype=jnp.float32, attention="reference",
+    )
+    model = TransformerLM(cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(11), (2, 13), 0, 64)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    want = np.asarray(generate(model, params, prompt, 8))
+    for chunk in (1, 4, 5, 13, 64):
+        got = np.asarray(
+            generate(model, params, prompt, 8, prefill_chunk=chunk)
+        )
+        np.testing.assert_array_equal(got, want, err_msg=f"chunk={chunk}")
+
+    rolling_cfg = dataclasses.replace(
+        cfg, sliding_window=16, attention_sinks=2, rolling_cache=True
+    )
+    rolling = TransformerLM(rolling_cfg)
+    ref_cfg = dataclasses.replace(rolling_cfg, rolling_cache=False)
+    want = np.asarray(generate(TransformerLM(ref_cfg), params, prompt, 8))
+    for chunk in (4, 7):
+        got = np.asarray(
+            generate(rolling, params, prompt, 8, prefill_chunk=chunk)
+        )
+        np.testing.assert_array_equal(got, want, err_msg=f"rolling chunk={chunk}")
+
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        generate(model, params, prompt, 4, prefill_chunk=0)
