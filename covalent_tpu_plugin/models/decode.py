@@ -146,15 +146,21 @@ def generate(
     batch, prompt_len = prompt.shape
     total = prompt_len + max(max_new_tokens, 0)
     if config.rolling_cache:
-        # The circular cache frees generation from max_seq: only the
-        # prompt (one prefill slab at position 0) must fit the ring
-        # (pinned sink slots + circular band region).
+        # The circular cache frees generation from max_seq: a prefill
+        # slab must fit the ring (pinned sink slots + band region).  A
+        # LONGER prompt still streams in exactly with prefill_chunk=1 —
+        # token-by-token writes evict only the position just outside each
+        # query's band.  Wider chunks cannot cross capacity exactly: a
+        # multi-token slab that wraps the ring erases band-edge entries
+        # its own earlier rows should still see (the documented-lossy
+        # case), so they keep the strict check.
         capacity = config.sliding_window + config.attention_sinks
-        if prompt_len > capacity:
+        if prompt_len > capacity and prefill_chunk != 1:
             raise ValueError(
                 f"rolling_cache prefill of {prompt_len} tokens exceeds "
                 f"the cache capacity ({capacity} = sliding_window + "
-                "attention_sinks); chunk or truncate the prompt"
+                "attention_sinks); stream it with prefill_chunk=1 or "
+                "truncate the prompt"
             )
     elif total > config.max_seq:
         raise ValueError(

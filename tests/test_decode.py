@@ -337,3 +337,30 @@ def test_generate_prefill_chunk_exact():
 
     with pytest.raises(ValueError, match="prefill_chunk"):
         generate(model, params, prompt, 4, prefill_chunk=0)
+
+
+def test_rolling_prefill_chunk1_streams_past_capacity():
+    """prefill_chunk=1 streams a prompt LONGER than the rolling cache's
+    capacity, exactly: token-by-token writes evict only the position just
+    outside each query's band.  Oracle: the standard (full-length) cache
+    with the same window+sinks mask — old positions are masked identically,
+    just not physically evicted."""
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+        max_seq=48, dtype=jnp.float32, attention="reference",
+        sliding_window=6, attention_sinks=2,
+    )
+    model = TransformerLM(cfg)
+    rolling = TransformerLM(dataclasses.replace(cfg, rolling_cache=True))
+    prompt = jax.random.randint(jax.random.PRNGKey(13), (2, 20), 0, 64)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    want = np.asarray(generate(model, params, prompt, 8))
+    got = np.asarray(
+        generate(rolling, params, prompt, 8, prefill_chunk=1)
+    )
+    np.testing.assert_array_equal(got, want)
+    # Wider chunks past capacity stay refused (documented-lossy).
+    with pytest.raises(ValueError, match="prefill_chunk=1"):
+        generate(rolling, params, prompt, 8, prefill_chunk=4)
+    with pytest.raises(ValueError, match="prefill_chunk=1"):
+        generate(rolling, params, prompt, 8)
