@@ -1,0 +1,92 @@
+"""Windowed flash kernel sweep: banded-grid win vs full flash across
+(S, window, block) configs, on whatever backend is present.
+
+Run on the TPU VM:  python benchmarks/sweep_window.py
+Prints one JSON line per config (resumable under a driver timeout) —
+median-of-N delta timing, same method as bench.py.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from covalent_tpu_plugin.ops.attention import flash_attention  # noqa: E402
+
+
+def unit_seconds(dispatch, fetch, target_s=2.0, cap=8, trials=5):
+    dispatch()
+    fetch()
+    t0 = time.monotonic()
+    dispatch()
+    fetch()
+    once = time.monotonic() - t0
+    k = max(2, min(cap, int(target_s / max(once, 1e-6)) + 1))
+    deltas = []
+    for _ in range(trials):
+        t0 = time.monotonic()
+        dispatch()
+        fetch()
+        e1 = time.monotonic() - t0
+        t0 = time.monotonic()
+        for _ in range(k):
+            dispatch()
+        fetch()
+        ek = time.monotonic() - t0
+        if ek > e1:
+            deltas.append((ek - e1) / (k - 1))
+    return statistics.median(deltas) if deltas else once
+
+
+def time_fwd_bwd(q, k, v, window, block_q=None, block_k=None):
+    grad_fn = jax.jit(
+        jax.grad(
+            lambda q, k, v: flash_attention(
+                q, k, v, causal=True, window=window,
+                block_q=block_q, block_k=block_k,
+            ).astype(jnp.float32).sum(),
+            argnums=(0, 1, 2),
+        )
+    )
+    holder = {}
+
+    def dispatch():
+        holder["g"] = grad_fn(q, k, v)
+
+    def fetch():
+        jax.device_get(holder["g"][0][0, 0, 0, 0])
+
+    return unit_seconds(dispatch, fetch)
+
+
+def main() -> None:
+    print(json.dumps({"devices": str(jax.devices())}), flush=True)
+    b, h, d = 1, 8, 64
+    for s in (8192, 16384):
+        q, k, v = (
+            jax.random.normal(jax.random.PRNGKey(i), (b, h, s, d), jnp.bfloat16)
+            for i in range(3)
+        )
+        full = time_fwd_bwd(q, k, v, None)
+        print(json.dumps({"s": s, "window": None,
+                          "fwd_bwd_ms": round(full * 1e3, 2)}), flush=True)
+        for window in (512, 1024, 2048):
+            for blocks in (None, (256, 256), (512, 512), (512, 256)):
+                bq, bk = blocks if blocks else (None, None)
+                unit = time_fwd_bwd(q, k, v, window, bq, bk)
+                print(json.dumps({
+                    "s": s, "window": window, "block_q": bq, "block_k": bk,
+                    "fwd_bwd_ms": round(unit * 1e3, 2),
+                    "speedup_vs_full": round(full / unit, 2),
+                }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
