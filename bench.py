@@ -669,12 +669,32 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
                     report("lm_decode_int8", error=repr(error))
                     qgen = None
 
+            # int8 KV cache: halves the per-step CACHE reads (the other
+            # bandwidth half); also its own try.
+            kvq_gen = None
+            if remaining() > 30:
+                try:
+                    import dataclasses as _dc
+
+                    kvq_model = TransformerLM(
+                        _dc.replace(gen_config, quantized_kv_cache=True)
+                    )
+                    kvq_gen = jax.jit(
+                        lambda p, t: generate(
+                            kvq_model, p, t, max_new_tokens=new_tokens
+                        )
+                    )
+                    jax.device_get(kvq_gen(params, prompt)[0, -1])  # warm
+                except Exception as error:  # noqa: BLE001
+                    report("lm_decode_kvq", error=repr(error))
+                    kvq_gen = None
+
             # Like-for-like A/B: alternate bf16/int8 measurements inside
             # one phase so tunnel drift hits both arms equally (BENCH_r02's
             # int8 delta was within cross-session variance).  The int8 arm
             # keeps its own try at measurement time too — a quant-side
             # failure mid-loop must not void the bf16 numbers.
-            bf16_times, int8_times = [], []
+            bf16_times, int8_times, kvq_times = [], [], []
             for _ in range(3):
                 bf16_times.append(time_gen(gen, params))
                 if qgen is not None:
@@ -683,6 +703,12 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
                     except Exception as error:  # noqa: BLE001
                         report("lm_decode_int8", error=repr(error))
                         qgen, int8_times = None, []
+                if kvq_gen is not None:
+                    try:
+                        kvq_times.append(time_gen(kvq_gen, params))
+                    except Exception as error:  # noqa: BLE001
+                        report("lm_decode_kvq", error=repr(error))
+                        kvq_gen, kvq_times = None, []
             elapsed = stats_mod.median(bf16_times)
             # One batched prefill + (new_tokens - 1) decode steps share the
             # wall; metrics are labelled end-to-end, not per decode step.
@@ -707,6 +733,17 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
                 )
             elif qgen is None and remaining() <= 30:
                 report("lm_decode_int8", skipped="budget")
+            if kvq_times:
+                kv_elapsed = stats_mod.median(kvq_times)
+                report(
+                    "lm_decode_kvq",
+                    batch=bsz,
+                    tokens_per_s=round(bsz * new_tokens / kv_elapsed),
+                    speedup_vs_bf16_same_phase=round(
+                        elapsed / kv_elapsed, 3
+                    ),
+                    e2e_s_spread=[round(t, 3) for t in sorted(kvq_times)],
+                )
         except Exception as error:  # noqa: BLE001
             report("lm_decode", error=repr(error))
     else:
@@ -1079,6 +1116,10 @@ async def main() -> None:
         "lm125m_decode_int8_tokens_per_s": sub("lm_decode_int8", "tokens_per_s"),
         "lm125m_decode_int8_speedup_ab": sub(
             "lm_decode_int8", "speedup_vs_bf16_same_phase"
+        ),
+        "lm125m_decode_kvq_tokens_per_s": sub("lm_decode_kvq", "tokens_per_s"),
+        "lm125m_decode_kvq_speedup_ab": sub(
+            "lm_decode_kvq", "speedup_vs_bf16_same_phase"
         ),
         "spec_accept_rate": sub("lm_spec", "accept_rate"),
         "spec_tokens_per_s": sub("lm_spec", "spec_tokens_per_s"),
