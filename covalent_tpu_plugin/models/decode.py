@@ -84,6 +84,37 @@ def _filter_top_k(logits: jax.Array, top_k: int) -> jax.Array:
     return jnp.where(logits < kth, NEG_INF, logits)
 
 
+def _filter_min_p(logits: jax.Array, min_p: float) -> jax.Array:
+    """min-p filter: keep tokens whose probability is at least ``min_p``
+    times the most likely token's — a relative floor that adapts to the
+    distribution's confidence (tight on peaked steps, permissive on flat
+    ones), unlike top-k/top-p's absolute budgets."""
+    from ..ops.attention import NEG_INF
+
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    floor = jnp.max(logprobs, axis=-1, keepdims=True) + jnp.log(min_p)
+    return jnp.where(logprobs < floor, NEG_INF, logits)
+
+
+def _apply_repetition_penalty(
+    logits: jax.Array, seen: jax.Array, penalty: float
+) -> jax.Array:
+    """CTRL-style repetition penalty over the ``seen`` token multiset:
+    logits of already-emitted tokens divide by ``penalty`` when positive
+    and multiply when negative (the HF convention), making repeats
+    uniformly less likely.  ``seen`` is (B, L) int32 with -1 padding for
+    not-yet-written slots."""
+    batch, vocab = logits.shape
+    safe = jnp.where(seen >= 0, seen, vocab)  # -1 pads -> overflow column
+    appeared = jnp.zeros((batch, vocab + 1), bool).at[
+        jnp.arange(batch)[:, None], safe
+    ].set(True)[:, :vocab]
+    penalised = jnp.where(
+        logits > 0, logits / penalty, logits * penalty
+    )
+    return jnp.where(appeared, penalised, logits)
+
+
 def _filter_top_p(logits: jax.Array, top_p: float) -> jax.Array:
     """Nucleus filter: keep the smallest prefix of the sorted distribution
     whose cumulative probability reaches ``top_p``; mask the rest.
@@ -124,13 +155,19 @@ def generate(
     eos_token_id: int | None = None,
     pad_token_id: int | None = None,
     prefill_chunk: int | None = None,
+    min_p: float | None = None,
+    repetition_penalty: float | None = None,
 ) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt`` ((B, P) int32).
 
     ``temperature=0`` is greedy argmax; otherwise softmax sampling at the
     given temperature (requires ``rng``), optionally restricted to the
-    ``top_k`` highest logits and/or the ``top_p`` nucleus (applied in that
-    order, the HF/transformers convention).  ``eos_token_id`` stops a row
+    ``top_k`` highest logits, the ``top_p`` nucleus, and/or the ``min_p``
+    relative-probability floor (applied in that order, the
+    HF/transformers convention).  ``repetition_penalty`` (CTRL-style,
+    works for greedy AND sampling) divides positive / multiplies
+    negative logits of every token already in the row's buffer before
+    the other filters.  ``eos_token_id`` stops a row
     once it emits EOS: its remaining slots fill with ``pad_token_id``
     (default: the EOS id), and the loop exits early when every row has
     finished.  ``prefill_chunk`` streams the prompt into the caches in
@@ -171,12 +208,22 @@ def generate(
     # combination is a caller bug worth surfacing); the rng requirement
     # only applies when sampling will actually happen, preserving the
     # original "zero new tokens is identity" contract.
-    if temperature <= 0 and (top_k is not None or top_p is not None):
-        raise ValueError("top_k/top_p require sampling (temperature > 0)")
+    if temperature <= 0 and (
+        top_k is not None or top_p is not None or min_p is not None
+    ):
+        raise ValueError(
+            "top_k/top_p/min_p require sampling (temperature > 0)"
+        )
     if top_k is not None and not 1 <= top_k <= config.vocab_size:
         raise ValueError(f"top_k must be in [1, {config.vocab_size}], got {top_k}")
     if top_p is not None and not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if min_p is not None and not 0.0 < min_p <= 1.0:
+        raise ValueError(f"min_p must be in (0, 1], got {min_p}")
+    if repetition_penalty is not None and repetition_penalty <= 0:
+        raise ValueError(
+            f"repetition_penalty must be > 0, got {repetition_penalty}"
+        )
     if pad_token_id is not None and eos_token_id is None:
         raise ValueError("pad_token_id requires eos_token_id")
     if prefill_chunk is not None and prefill_chunk < 1:
@@ -192,17 +239,28 @@ def generate(
     buffer = jnp.zeros((batch, total), jnp.int32)
     buffer = jax.lax.dynamic_update_slice(buffer, prompt, (0, 0))
 
-    def choose(step_logits, rng):
+    def choose(step_logits, rng, buffer, written):
         rng, sample_key = jax.random.split(rng)
+        step_logits = step_logits.astype(jnp.float32)
+        if repetition_penalty is not None:
+            # Unwritten buffer slots hold token 0 — mask them to -1 so a
+            # legitimate token id 0 is only penalised once it appears.
+            cols = jnp.arange(buffer.shape[1])[None, :]
+            seen = jnp.where(cols < written, buffer, -1)
+            step_logits = _apply_repetition_penalty(
+                step_logits, seen, repetition_penalty
+            )
         if temperature > 0:
-            scaled = step_logits.astype(jnp.float32) / temperature
+            scaled = step_logits / temperature
             if top_k is not None:
                 scaled = _filter_top_k(scaled, top_k)
             if top_p is not None:
                 scaled = _filter_top_p(scaled, top_p)
+            if min_p is not None:
+                scaled = _filter_min_p(scaled, min_p)
             chosen = jax.random.categorical(sample_key, scaled, axis=-1)
         else:
-            chosen = jnp.argmax(step_logits.astype(jnp.float32), axis=-1)
+            chosen = jnp.argmax(step_logits, axis=-1)
         return chosen.astype(jnp.int32), rng
 
     pad = eos_token_id if pad_token_id is None else pad_token_id
@@ -231,7 +289,9 @@ def generate(
             {"params": params, "cache": cache}, slab, mutable=["cache"]
         )
         cache = mutated["cache"]
-    first, rng = choose(prefill_logits[:, -1], rng)
+    first, rng = choose(
+        prefill_logits[:, -1], rng, buffer, jnp.asarray(prompt_len)
+    )
     done = jnp.zeros((batch,), bool)
     first, done = finish(first, done)
     buffer = jax.lax.dynamic_update_slice(
@@ -245,7 +305,7 @@ def generate(
             {"params": params, "cache": cache}, token, mutable=["cache"]
         )
         cache = mutated["cache"]
-        chosen, rng = choose(logits[:, 0], rng)
+        chosen, rng = choose(logits[:, 0], rng, buffer, t + 1)
         chosen, done = finish(chosen, done)
         buffer = jax.lax.dynamic_update_slice(
             buffer, chosen[:, None], (0, t + 1)

@@ -202,7 +202,7 @@ def test_top_filters_are_jittable_and_validated():
     out = jitted(params, prompt, jax.random.PRNGKey(0))
     assert out.shape == (1, 8)
     assert 0 <= int(jnp.min(out)) and int(jnp.max(out)) < BASE.vocab_size
-    with pytest.raises(ValueError, match="top_k/top_p require"):
+    with pytest.raises(ValueError, match="top_k/top_p/min_p require"):
         generate(model, params, prompt, 2, top_k=4)
     with pytest.raises(ValueError, match="top_k must be"):
         generate(model, params, prompt, 2, temperature=1.0,
@@ -364,3 +364,77 @@ def test_rolling_prefill_chunk1_streams_past_capacity():
         generate(rolling, params, prompt, 8, prefill_chunk=4)
     with pytest.raises(ValueError, match="prefill_chunk=1"):
         generate(rolling, params, prompt, 8)
+
+
+def test_min_p_filter_semantics():
+    """Keep tokens with prob >= min_p * max prob; mask the rest."""
+    from covalent_tpu_plugin.models.decode import _filter_min_p
+
+    logits = jnp.log(jnp.asarray([[0.5, 0.25, 0.2, 0.05]]))
+    # Floor = min_p * max_prob: 0.3 * 0.5 = 0.15 keeps 0.5/0.25/0.2.
+    kept = np.asarray(_filter_min_p(logits, 0.3)) > -1e29
+    np.testing.assert_array_equal(kept[0], [True, True, True, False])
+    # 0.4999 * 0.5 ~ 0.25 keeps the top two (0.2 falls below).
+    kept = np.asarray(_filter_min_p(logits, 0.4999)) > -1e29
+    np.testing.assert_array_equal(kept[0], [True, True, False, False])
+    # 0.05 * 0.5 = 0.025 keeps everything.
+    kept = np.asarray(_filter_min_p(logits, 0.05)) > -1e29
+    np.testing.assert_array_equal(kept[0], [True, True, True, True])
+    # A peaked distribution tightens the floor adaptively.
+    peaked = jnp.log(jnp.asarray([[0.9, 0.05, 0.03, 0.02]]))
+    kept = np.asarray(_filter_min_p(peaked, 0.3)) > -1e29
+    np.testing.assert_array_equal(kept[0], [True, False, False, False])
+
+
+def test_repetition_penalty_semantics():
+    """HF/CTRL convention: appeared tokens' positive logits divide by the
+    penalty, negative multiply; pads (-1) and unseen tokens untouched;
+    token id 0 is only penalised when genuinely present."""
+    from covalent_tpu_plugin.models.decode import _apply_repetition_penalty
+
+    logits = jnp.asarray([[2.0, -2.0, 1.0, -1.0]])
+    seen = jnp.asarray([[1, 2, -1, -1]])  # tokens 1 and 2 appeared
+    out = np.asarray(_apply_repetition_penalty(logits, seen, 2.0))
+    np.testing.assert_allclose(out[0], [2.0, -4.0, 0.5, -1.0])
+    # Buffer pads masked to -1 must NOT penalise token 0.
+    seen = jnp.asarray([[-1, -1, -1, -1]])
+    out = np.asarray(_apply_repetition_penalty(logits, seen, 2.0))
+    np.testing.assert_allclose(out[0], np.asarray(logits)[0])
+    seen = jnp.asarray([[0, -1, -1, -1]])
+    out = np.asarray(_apply_repetition_penalty(logits, seen, 2.0))
+    np.testing.assert_allclose(out[0], [1.0, -2.0, 1.0, -1.0])
+
+
+def test_generate_with_penalty_and_min_p():
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+        max_seq=48, dtype=jnp.float32, attention="reference",
+    )
+    model = TransformerLM(cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(21), (2, 5), 0, 64)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    plain = np.asarray(generate(model, params, prompt, 10))
+    # Greedy + repetition penalty: jittable, valid, and actually biting
+    # (the untrained model's greedy continuation revisits tokens).
+    pen = np.asarray(
+        jax.jit(
+            lambda p, t: generate(
+                model, p, t, 10, repetition_penalty=5.0
+            )
+        )(params, prompt)
+    )
+    assert pen.shape == plain.shape
+    assert (pen >= 0).all() and (pen < 64).all()
+    assert not np.array_equal(pen, plain)
+    # Sampling with min_p runs and stays in range.
+    sampled = np.asarray(
+        generate(
+            model, params, prompt, 10, temperature=0.8, min_p=0.1,
+            rng=jax.random.PRNGKey(5),
+        )
+    )
+    assert (sampled >= 0).all() and (sampled < 64).all()
+    with pytest.raises(ValueError, match="min_p"):
+        generate(model, params, prompt, 4, min_p=0.1)
+    with pytest.raises(ValueError, match="repetition_penalty"):
+        generate(model, params, prompt, 4, repetition_penalty=0.0)
