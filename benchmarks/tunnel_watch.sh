@@ -1,0 +1,26 @@
+#!/bin/bash
+# Probe the axon TPU tunnel on a cadence; append one status line per attempt.
+# Usage: tunnel_watch.sh [interval_s] [logfile]
+# Each probe is a fresh subprocess with a hard timeout, so a hung backend
+# init can never wedge the watcher itself.
+INTERVAL="${1:-180}"
+LOG="${2:-/tmp/tpu_tunnel_watch.log}"
+while true; do
+  TS=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+  OUT=$(timeout 75 python -c "
+import time, jax
+t0 = time.monotonic()
+d = jax.devices()
+import jax.numpy as jnp
+x = jnp.ones((256, 256), jnp.bfloat16)
+v = float((x @ x)[0, 0])
+print(f'UP init={time.monotonic()-t0:.1f}s dev={d[0].device_kind} check={v}')
+" 2>/dev/null | tail -1)
+  RC=$?
+  if [ $RC -eq 0 ] && [ -n "$OUT" ]; then
+    echo "$TS $OUT" >> "$LOG"
+  else
+    echo "$TS DOWN rc=$RC" >> "$LOG"
+  fi
+  sleep "$INTERVAL"
+done
