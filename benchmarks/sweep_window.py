@@ -2,8 +2,16 @@
 (S, window, block) configs, on whatever backend is present.
 
 Run on the TPU VM:  python benchmarks/sweep_window.py
-Prints one JSON line per config (resumable under a driver timeout) —
-median-of-N delta timing, same method as bench.py.
+Prints one JSON line per config (resumable under a driver timeout).
+
+Timing method: data-dependent chained iterations inside ONE jit (each
+fwd+bwd's dq feeds the next iteration's q), so the measurement is pure
+device time — per-dispatch host/tunnel overhead appears in neither arm.
+The r4 sweep found the original two-batch delta method mis-ranked
+sub-10ms configs by up to 5x on the tunneled backend (a 2.6 ms read for
+a kernel whose true device time was 2.7 ms next to a 17.9 ms read for a
+12.7 ms one); chained timing reproduced within a few percent across
+reruns where the delta method flipped winners run to run.
 """
 
 from __future__ import annotations
@@ -21,69 +29,62 @@ sys.path.insert(0, __file__.rsplit("/", 2)[0])
 from covalent_tpu_plugin.ops.attention import flash_attention  # noqa: E402
 
 
-def unit_seconds(dispatch, fetch, target_s=2.0, cap=8, trials=5):
-    dispatch()
-    fetch()
-    t0 = time.monotonic()
-    dispatch()
-    fetch()
-    once = time.monotonic() - t0
-    k = max(2, min(cap, int(target_s / max(once, 1e-6)) + 1))
-    deltas = []
+def chain_ms(q, k, v, window, block_q=None, block_k=None, iters=16,
+             trials=3):
+    """Pure on-device ms per fwd+bwd: (iters-chain − 1-chain)/(iters−1)."""
+
+    def one(q_in):
+        dq = jax.grad(
+            lambda q_: flash_attention(
+                q_, k, v, causal=True, window=window,
+                block_q=block_q, block_k=block_k,
+            ).astype(jnp.float32).sum()
+        )(q_in)
+        # Data dependency serialises iterations on device; the axpy is
+        # noise next to the attention FLOPs.
+        return q_in + (1e-6 * dq).astype(q_in.dtype)
+
+    @jax.jit
+    def chain(q0, n):
+        return jax.lax.fori_loop(0, n, lambda i, q_: one(q_), q0)
+
+    jax.device_get(chain(q, iters)[0, 0, 0, 0])  # compile both shapes
+    jax.device_get(chain(q, 1)[0, 0, 0, 0])
+    samples = []
     for _ in range(trials):
         t0 = time.monotonic()
-        dispatch()
-        fetch()
-        e1 = time.monotonic() - t0
+        jax.device_get(chain(q, 1)[0, 0, 0, 0])
+        t1 = time.monotonic() - t0
         t0 = time.monotonic()
-        for _ in range(k):
-            dispatch()
-        fetch()
-        ek = time.monotonic() - t0
-        if ek > e1:
-            deltas.append((ek - e1) / (k - 1))
-    return statistics.median(deltas) if deltas else once
-
-
-def time_fwd_bwd(q, k, v, window, block_q=None, block_k=None):
-    grad_fn = jax.jit(
-        jax.grad(
-            lambda q, k, v: flash_attention(
-                q, k, v, causal=True, window=window,
-                block_q=block_q, block_k=block_k,
-            ).astype(jnp.float32).sum(),
-            argnums=(0, 1, 2),
-        )
-    )
-    holder = {}
-
-    def dispatch():
-        holder["g"] = grad_fn(q, k, v)
-
-    def fetch():
-        jax.device_get(holder["g"][0][0, 0, 0, 0])
-
-    return unit_seconds(dispatch, fetch)
+        jax.device_get(chain(q, iters)[0, 0, 0, 0])
+        tn = time.monotonic() - t0
+        if tn > t1:
+            samples.append((tn - t1) / (iters - 1))
+    return statistics.median(samples) * 1e3 if samples else float("nan")
 
 
 def main() -> None:
     print(json.dumps({"devices": str(jax.devices())}), flush=True)
     b, h, d = 1, 8, 64
-    for s in (8192, 16384):
+    for s in (4096, 8192, 16384):
         q, k, v = (
             jax.random.normal(jax.random.PRNGKey(i), (b, h, s, d), jnp.bfloat16)
             for i in range(3)
         )
-        full = time_fwd_bwd(q, k, v, None)
+        iters = max(8, 16384 * 16 // s)
+        full = chain_ms(q, k, v, None, iters=iters)
         print(json.dumps({"s": s, "window": None,
-                          "fwd_bwd_ms": round(full * 1e3, 2)}), flush=True)
-        for window in (512, 1024, 2048):
-            for blocks in (None, (256, 256), (512, 512), (512, 256)):
+                          "fwd_bwd_ms": round(full, 3)}), flush=True)
+        for window in (512, 1024, 2048, 4096):
+            if window >= s:
+                continue
+            for blocks in (None, (512, 512), (512, 1024), (1024, 1024),
+                           (512, 256)):
                 bq, bk = blocks if blocks else (None, None)
-                unit = time_fwd_bwd(q, k, v, window, bq, bk)
+                unit = chain_ms(q, k, v, window, bq, bk, iters=iters)
                 print(json.dumps({
                     "s": s, "window": window, "block_q": bq, "block_k": bk,
-                    "fwd_bwd_ms": round(unit * 1e3, 2),
+                    "fwd_bwd_ms": round(unit, 3),
                     "speedup_vs_full": round(full / unit, 2),
                 }), flush=True)
 

@@ -43,6 +43,25 @@ _DEFAULT_BLOCK_Q = 512
 _DEFAULT_BLOCK_K = 1024
 
 
+def _pick_windowed_blocks(seq_len_q: int, seq_len_k: int,
+                          window: int) -> tuple[int, int]:
+    """Forward-tile winners for the BANDED (windowed) grids, from the v5e
+    r4 hardware sweep (benchmarks/WINDOW_SWEEP.md).
+
+    The band run is quantised to whole key tiles, so tile choice trades
+    band tightness (smaller BK wastes fewer out-of-band columns) against
+    MXU/overhead efficiency (larger tiles amortise better).  On-device
+    chained timing (dispatch-noise-free; see WINDOW_SWEEP.md's method
+    note) shows (512, 512) winning for w <= 512 and (1024, 1024) for
+    wider bands, consistently across S = 4k..16k; the full-attention
+    default (512, 1024) gives up 4-15% on banded shapes.  Explicit
+    ``block_q``/``block_k`` args always override.
+    """
+    if window <= 512:
+        return 512, 512
+    return 1024, 1024
+
+
 def _gqa_group(q: jax.Array, k: jax.Array) -> int:
     """Query-heads-per-kv-head ratio; validates the GQA head contract."""
     h_q, h_kv = q.shape[1], k.shape[1]
@@ -425,12 +444,19 @@ def _flash_forward(
     # shrink by halving until they divide seq_len, so any even-ish length
     # works out of the box.  EXPLICIT blocks stay strict — a user-chosen
     # tile that doesn't divide is an error, not a silent re-tile.
+    # Windowed (banded-grid) calls get their own per-shape winners: the
+    # full-attention tiles are measurably wrong for a band (see
+    # _pick_windowed_blocks).
+    if window is not None and causal:
+        win_bq, win_bk = _pick_windowed_blocks(seq_len, seq_len_k, window)
+    else:
+        win_bq, win_bk = _DEFAULT_BLOCK_Q, _DEFAULT_BLOCK_K
     if block_q is None:
-        block_q = _fit_block(_DEFAULT_BLOCK_Q, seq_len)
+        block_q = _fit_block(win_bq, seq_len)
     else:
         block_q = min(block_q, seq_len)
     if block_k is None:
-        block_k = _fit_block(_DEFAULT_BLOCK_K, seq_len_k)
+        block_k = _fit_block(win_bk, seq_len_k)
     else:
         block_k = min(block_k, seq_len_k)
     if seq_len % block_q or seq_len_k % block_k:

@@ -188,6 +188,37 @@ def test_banded_backward_gqa_exact():
         )
 
 
+def test_windowed_block_picker():
+    """Windowed defaults follow the r4 hardware sweep winners
+    (benchmarks/WINDOW_SWEEP.md): (512, 512) for w <= 512, (1024, 1024)
+    wider; full-attention calls keep the full-attention defaults; fitted
+    down for short sequences; explicit blocks always win."""
+    from covalent_tpu_plugin.ops.attention import (
+        _DEFAULT_BLOCK_K,
+        _DEFAULT_BLOCK_Q,
+        _fit_block,
+        _pick_windowed_blocks,
+    )
+
+    assert _pick_windowed_blocks(16384, 16384, 512) == (512, 512)
+    assert _pick_windowed_blocks(16384, 16384, 1024) == (1024, 1024)
+    assert _pick_windowed_blocks(4096, 4096, 2048) == (1024, 1024)
+    # The picker feeds _fit_block, so short sequences still tile.
+    bq, bk = _pick_windowed_blocks(256, 256, 1024)
+    assert _fit_block(bq, 256) == 256 and _fit_block(bk, 256) == 256
+    # Full attention unaffected by the windowed table.
+    assert (_DEFAULT_BLOCK_Q, _DEFAULT_BLOCK_K) == (512, 1024)
+    # End to end: a windowed call with default blocks stays exact.
+    q, k, v = qkv(s=1024)
+    np.testing.assert_allclose(
+        np.asarray(flash_attention(q, k, v, causal=True, window=600),
+                   np.float32),
+        np.asarray(mha_reference(q, k, v, causal=True, window=600),
+                   np.float32),
+        atol=2e-5, rtol=2e-5,
+    )
+
+
 def test_window_equals_full_causal_when_wider_than_sequence():
     q, k, v = qkv(s=128)
     full = np.asarray(flash_attention(q, k, v, causal=True), np.float32)
