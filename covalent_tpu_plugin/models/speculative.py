@@ -392,7 +392,11 @@ def speculative_sample(
 
         # Acceptance: u_i < p_i(d_i) / q_i(d_i), vectorised over the k
         # drafted positions.
-        rng, akey, bkey = jax.random.split(rng, 3)
+        # Distinct keys for the three draws: res_tok and bonus_tok are
+        # mutually exclusive today (scalar run == k selects exactly one),
+        # but sharing a key would silently correlate them if boundary
+        # selection ever became per-row.
+        rng, akey, bkey, ckey = jax.random.split(rng, 4)
         logp_d = jnp.take_along_axis(
             logp[:, :k, :], drafted[:, :, None], axis=2
         )[..., 0]  # (B, k)
@@ -429,7 +433,7 @@ def speculative_sample(
             bkey, jnp.log(jnp.maximum(residual, 1e-37)), axis=-1
         ).astype(jnp.int32)
         bonus_tok = jax.random.categorical(
-            bkey, jnp.log(jnp.maximum(p_bnd, 1e-37)), axis=-1
+            ckey, jnp.log(jnp.maximum(p_bnd, 1e-37)), axis=-1
         ).astype(jnp.int32)
         accept_bnd = jnp.take_along_axis(
             accept, jnp.full((batch, 1), jnp.minimum(run, k - 1)), axis=1

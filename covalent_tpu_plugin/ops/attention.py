@@ -533,7 +533,8 @@ def _flash_bwd_dkdv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qpos_ref, kpos_ref,
     dk_ref, dv_ref, dk_acc, dv_acc,
     *, causal: bool, scale: float, window: int | None = None,
-    sinks: int = 0, band: tuple[int, int, int] | None = None
+    sinks: int = 0, band: tuple[int, int, int] | None = None,
+    kt_offset: int = 0
 ):
     """One (kv head, key tile, group member, query tile) cell of the dk/dv
     sweep, grid (B, H_kv, KT, G, QT).
@@ -563,11 +564,13 @@ def _flash_bwd_dkdv_kernel(
     if band is not None:
         # Banded grid: liveness from grid ids + static geometry (clamped
         # duplicate tiles must not double-count) — see forward kernel.
+        # kt_offset maps this call's local key-tile ids to global ones
+        # (the sinks split runs the banded call on the post-sink tiles).
         block_q, block_k, qt_full = band
         needed = jnp.logical_and(
             needed,
-            _band_qt_live(pl.program_id(2), qt, block_q, block_k, window,
-                          qt_full),
+            _band_qt_live(pl.program_id(2) + kt_offset, qt, block_q,
+                          block_k, window, qt_full),
         )
 
     @pl.when(needed)
@@ -722,50 +725,90 @@ def _flash_backward(
     # dk/dv sweep — grid (B, H_kv, KT, G, QT): group member + query tile are
     # innermost so one (kv head, key tile) output block accumulates across
     # every query head in its group (see kernel docstring).  With a window
-    # the QT sweep shrinks to the band's query-tile run (see forward) —
-    # except with sinks: a sink KEY tile is read by every later query
-    # tile, so this sweep stays full-grid + tile-skip (the forward and dq
-    # sweeps band their sink run instead; splitting dk/dv into a sink
-    # call + band call is the remaining follow-up).
-    n_inner_qt, _qi, band_kv = _banded_sweep_qt(
-        seq_len, seq_len_k, block_q, block_k, window, banded and not sinks
-    )
+    # the QT sweep shrinks to the band's query-tile run (see forward).
+    # With sinks the sweep SPLITS: a sink KEY tile is read by every later
+    # query tile (no band run exists for it), so the leading sink tiles
+    # get their own full-QT-sweep call and the remaining tiles run the
+    # banded grid with a key-tile offset — both calls write disjoint
+    # dk/dv slabs that concatenate back to (B, H_kv, S, D).
+    def run_dkdv(kt_offset, kt_n, qi, band, n_inner):
+        """One dk/dv pallas_call over key tiles [kt_offset, kt_offset+kt_n)."""
+        qo_spec_q = pl.BlockSpec(
+            (1, 1, block_q, head_dim),
+            lambda b, h, i, gi, j: (b, h * group + gi, qi(i, j), 0),
+        )
+        kv_spec_in = pl.BlockSpec(
+            (1, 1, block_k, head_dim),
+            lambda b, h, i, gi, j: (b, h, i + kt_offset, 0),
+        )
+        kv_spec_out = pl.BlockSpec(
+            (1, 1, block_k, head_dim), lambda b, h, i, gi, j: (b, h, i, 0)
+        )
+        stat_spec_q = pl.BlockSpec(
+            (1, 1, block_q, 1),
+            lambda b, h, i, gi, j: (b, h * group + gi, qi(i, j), 0),
+        )
+        qpos_spec_q = pl.BlockSpec(
+            (block_q, 1), lambda b, h, i, gi, j: (qi(i, j), 0)
+        )
+        kpos_spec_k = pl.BlockSpec(
+            (1, block_k), lambda b, h, i, gi, j: (0, i + kt_offset)
+        )
+        return pl.pallas_call(
+            functools.partial(
+                _flash_bwd_dkdv_kernel, causal=causal, scale=scale,
+                window=window, sinks=sinks, band=band, kt_offset=kt_offset,
+            ),
+            grid=(batch, kv_heads, kt_n, group, n_inner),
+            in_specs=[qo_spec_q, kv_spec_in, kv_spec_in, qo_spec_q,
+                      stat_spec_q, stat_spec_q, qpos_spec_q, kpos_spec_k],
+            out_specs=[kv_spec_out, kv_spec_out],
+            out_shape=[
+                # grad_dtype=f32: ring callers sum one partial per hop and
+                # must not pay a bf16 rounding at every hop
+                jax.ShapeDtypeStruct(
+                    (batch, kv_heads, kt_n * block_k, head_dim),
+                    grad_dtype or k.dtype,
+                ),
+                jax.ShapeDtypeStruct(
+                    (batch, kv_heads, kt_n * block_k, head_dim),
+                    grad_dtype or v.dtype,
+                ),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_k, head_dim), jnp.float32),  # dk acc
+                pltpu.VMEM((block_k, head_dim), jnp.float32),  # dv acc
+            ],
+            interpret=interpret,
+            cost_estimate=cost,
+        )(q, k, v, g, lse, delta, qpos, kpos)
 
-    qo_spec_q = pl.BlockSpec(
-        (1, 1, block_q, head_dim),
-        lambda b, h, i, gi, j: (b, h * group + gi, _qi(i, j), 0),
+    nst_bwd = _sink_tiles(sinks, block_k) if (banded and sinks) else 0
+    n_inner_rem = (
+        _banded_n_inner_qt(seq_len, seq_len_k, block_q, block_k, window)
+        if 0 < nst_bwd < kt_full else None
     )
-    kv_spec_k = pl.BlockSpec(
-        (1, 1, block_k, head_dim), lambda b, h, i, gi, j: (b, h, i, 0)
-    )
-    stat_spec_q = pl.BlockSpec(
-        (1, 1, block_q, 1),
-        lambda b, h, i, gi, j: (b, h * group + gi, _qi(i, j), 0),
-    )
-    qpos_spec_q = pl.BlockSpec((block_q, 1), lambda b, h, i, gi, j: (_qi(i, j), 0))
-    kpos_spec_k = pl.BlockSpec((1, block_k), lambda b, h, i, gi, j: (0, i))
-    dk, dv = pl.pallas_call(
-        functools.partial(
-            _flash_bwd_dkdv_kernel, causal=causal, scale=scale, window=window,
-            sinks=sinks, band=band_kv,
-        ),
-        grid=(batch, kv_heads, kt_full, group, n_inner_qt),
-        in_specs=[qo_spec_q, kv_spec_k, kv_spec_k, qo_spec_q, stat_spec_q,
-                  stat_spec_q, qpos_spec_q, kpos_spec_k],
-        out_specs=[kv_spec_k, kv_spec_k],
-        out_shape=[
-            # grad_dtype=f32: ring callers sum one partial per hop and must
-            # not pay a bf16 rounding at every hop
-            jax.ShapeDtypeStruct(k.shape, grad_dtype or k.dtype),
-            jax.ShapeDtypeStruct(v.shape, grad_dtype or v.dtype),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((block_k, head_dim), jnp.float32),  # dk accumulator
-            pltpu.VMEM((block_k, head_dim), jnp.float32),  # dv accumulator
-        ],
-        interpret=interpret,
-        cost_estimate=cost,
-    )(q, k, v, g, lse, delta, qpos, kpos)
+    if n_inner_rem is not None:
+        # Sinks split: full sweep over the few sink tiles, banded sweep
+        # (global geometry via kt_offset) over everything after them.
+        def qi_rem(i, j):
+            return jnp.minimum(
+                _band_qt_lo(i + nst_bwd, block_q, block_k) + j, qt_full - 1
+            )
+
+        dk_s, dv_s = run_dkdv(0, nst_bwd, lambda i, j: j, None, qt_full)
+        dk_r, dv_r = run_dkdv(
+            nst_bwd, kt_full - nst_bwd, qi_rem,
+            (block_q, block_k, qt_full), n_inner_rem,
+        )
+        dk = jnp.concatenate([dk_s, dk_r], axis=2)
+        dv = jnp.concatenate([dv_s, dv_r], axis=2)
+    else:
+        n_inner_qt, _qi, band_kv = _banded_sweep_qt(
+            seq_len, seq_len_k, block_q, block_k, window,
+            banded and not sinks,
+        )
+        dk, dv = run_dkdv(0, kt_full, _qi, band_kv, n_inner_qt)
 
     # dq sweep — banded exactly like the forward (key tiles innermost).
     n_inner_kt, _kj, band_q = _banded_sweep_kt(
@@ -883,9 +926,9 @@ def flash_attention(
     band's tiles (compute and DMA scale O(S·w) instead of O(S²)).
     ``sinks=k`` (StreamingLLM attention sinks) keeps columns ``< k``
     visible to every row alongside the band; the forward and dq sweeps
-    band as a sink-tile run + band run, while the dk/dv sweep (whose
-    sink key tiles are read by every query tile) keeps the full grid
-    with the tile-level skip.
+    band as a sink-tile run + band run, and the dk/dv sweep splits into
+    a full-sweep call over the sink key tiles plus a banded call over
+    the rest, so all three sweeps stay O(S·w) with sinks on.
     """
     _check_window(window, causal, sinks)
     if interpret is None:

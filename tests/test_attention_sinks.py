@@ -97,6 +97,49 @@ def test_flash_backward_matches_reference():
         )
 
 
+@pytest.mark.parametrize("sinks", [6, 140])
+def test_banded_dkdv_sink_split_exact(monkeypatch, sinks):
+    """The dk/dv sinks SPLIT (sink-tile full-sweep call + banded
+    remainder call, r4) must match the dense oracle.  The default
+    backward tile (1024) covers s=512 in one tile, so shrink it to 128:
+    kt_full=4, the sink run is 1 tile (sinks=6) or 2 tiles (sinks=140,
+    non-tile-aligned so the second sink tile mixes sink and band
+    columns), and the remainder call runs the offset banded grid."""
+    import covalent_tpu_plugin.ops.attention as att
+
+    monkeypatch.setattr(att, "_DEFAULT_BWD_BLOCK", 128)
+    # Split preconditions really hold at this geometry.
+    nst = att._sink_tiles(sinks, 128)
+    assert 0 < nst < 512 // 128
+    assert att._banded_n_inner_qt(512, 512, 128, 128, 100) is not None
+
+    q, k, v = qkv(s=512)
+
+    def loss(fn):
+        return lambda q, k, v: (
+            fn(q, k, v).astype(jnp.float32) * jnp.cos(jnp.arange(64.0))
+        ).sum()
+
+    g_ref = jax.grad(
+        loss(lambda q, k, v: mha_reference(
+            q, k, v, causal=True, window=100, sinks=sinks
+        )),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    g_flash = jax.grad(
+        loss(lambda q, k, v: flash_attention(
+            q, k, v, causal=True, window=100, sinks=sinks,
+            block_q=128, block_k=128,
+        )),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            atol=5e-5, rtol=5e-5,
+        )
+
+
 def test_sinks_change_long_range_behavior():
     """Position 0's value must influence rows past the band with sinks on,
     and must NOT without them — the defining sink property."""
