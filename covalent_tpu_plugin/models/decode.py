@@ -174,7 +174,11 @@ def generate(
     fixed-size slabs instead of one pass — the decode cache attends a
     chunk's queries against everything already cached, so the result is
     exact while prefill activation memory is bounded O(chunk·S) for long
-    prompts.  Returns the full (B, P+N) token buffer.  Wrap in
+    prompts.  With ``rolling_cache``, prompts past the ring capacity
+    stream in chunks of at most ``sliding_window`` tokens (the default
+    when unset) — exact at any such width, ~window× fewer prefill steps
+    than the old forced token-by-token stream.  Returns the full
+    (B, P+N) token buffer.  Wrap in
     ``jax.jit`` for repeated use — everything inside is a single compiled
     loop.
     """
@@ -183,22 +187,26 @@ def generate(
     batch, prompt_len = prompt.shape
     total = prompt_len + max(max_new_tokens, 0)
     if config.rolling_cache:
-        # The circular cache frees generation from max_seq: a prefill
-        # slab must fit the ring (pinned sink slots + band region).  A
-        # LONGER prompt still streams in exactly with prefill_chunk=1 —
-        # token-by-token writes evict only the position just outside each
-        # query's band.  Wider chunks cannot cross capacity exactly: a
-        # multi-token slab that wraps the ring erases band-edge entries
-        # its own earlier rows should still see (the documented-lossy
-        # case), so they keep the strict check.
+        # The circular cache frees generation from max_seq: prompts past
+        # capacity stream in as chunks of at most ``sliding_window``
+        # tokens.  Any such chunk is EXACT — the decode step attends the
+        # pre-write ring snapshot plus the slab itself, so a wrapping
+        # scatter can no longer erase band-edge entries earlier slab rows
+        # need (the r3 lossy case that forced prefill_chunk=1 and made
+        # long-prompt prefill O(P) sequential steps).  Wider-than-window
+        # chunks would land two slab tokens in one ring slot (an
+        # order-undefined scatter), so they stay rejected.
         capacity = config.sliding_window + config.attention_sinks
-        if prompt_len > capacity and prefill_chunk != 1:
-            raise ValueError(
-                f"rolling_cache prefill of {prompt_len} tokens exceeds "
-                f"the cache capacity ({capacity} = sliding_window + "
-                "attention_sinks); stream it with prefill_chunk=1 or "
-                "truncate the prompt"
-            )
+        if prompt_len > capacity:
+            if prefill_chunk is None:
+                prefill_chunk = config.sliding_window
+            if prefill_chunk > config.sliding_window:
+                raise ValueError(
+                    f"rolling_cache prefill chunks of {prefill_chunk} "
+                    f"exceed sliding_window ({config.sliding_window}): "
+                    "two slab tokens would scatter into the same ring "
+                    "slot; use prefill_chunk <= sliding_window"
+                )
     elif total > config.max_seq:
         raise ValueError(
             f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "

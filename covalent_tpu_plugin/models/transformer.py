@@ -87,10 +87,11 @@ class TransformerConfig:
     #: StreamingLLM-style circular KV cache for decode: cache length is
     #: `sliding_window + attention_sinks` instead of `max_seq` and
     #: generation can run past max_seq at O(window) memory.  Requires
-    #: sliding_window; exact for the generate() flow (one prefill at
-    #: position 0 + single-token steps); a multi-token slab written at
-    #: pos > 0 that wraps the ring erases band-edge entries its earlier
-    #: rows should still see.
+    #: sliding_window; exact for the generate() flow at ANY chunking —
+    #: multi-token slabs attend the pre-write ring snapshot plus the slab
+    #: itself, so a wrapping write cannot erase entries earlier slab rows
+    #: still need (slabs stay <= sliding_window so the scatter never
+    #: lands two slab tokens in one slot).
     rolling_cache: bool = False
     #: attention sinks (StreamingLLM): the first `attention_sinks`
     #: positions stay visible to every query alongside the sliding band,
@@ -386,6 +387,21 @@ class Attention(nn.Module):
             v_store, v_s = quantize(v)
         else:
             k_store, v_store = k.astype(cfg.dtype), v.astype(cfg.dtype)
+        # Rolling multi-token slabs attend the PRE-write cache plus the
+        # slab itself (concatenated): the scatter below may overwrite ring
+        # slots that earlier slab rows still need (slot p+j-W dies when
+        # slab token j lands), so post-write attention would silently drop
+        # band-edge entries for every row but the last — the r3
+        # "documented-lossy" case that forced prefill_chunk=1.  With the
+        # pre-write snapshot every chunk <= sliding_window is EXACT: in-
+        # slab context comes from the slab branch, pre-slab context from
+        # slots the scatter has not yet touched (row i's oldest band need
+        # is p+i-W+1 > p-W-1+L-W ... all alive pre-write).
+        pre_k, pre_v = cached_k.value, cached_v.value
+        if quant_kv:
+            pre_ks, pre_vs = k_scale.value, v_scale.value
+        if rolling:
+            pre_sp = slot_pos.value
         if rolling:
             # Circular write: token at absolute position p lands in slot
             # p (pinned) while p < sinks, else sinks + (p - sinks) % W —
@@ -422,52 +438,63 @@ class Attention(nn.Module):
         cursor.value = pos + slab
 
         # One path for prefill slabs AND single-token steps: the slab's
-        # queries attend the whole cache with per-row causal visibility
-        # (query at absolute position pos+i sees cache slots <= pos+i), so
+        # queries attend the attend-set with per-row causal visibility
+        # (query at absolute position pos+i sees columns <= pos+i), so
         # chunked prefill at a non-zero cursor keeps its cached context.
+        # The attend-set is the post-write cache except for rolling
+        # multi-token slabs, which use the pre-write snapshot + the slab
+        # itself (the exact-chunked-prefill path; see the snapshot note).
+        # Column-position vector: the mask reads each column's recorded
+        # absolute position (-1 = never written), which is exact across
+        # ring wraps with no modular reconstruction; non-rolling slots ARE
+        # their positions.
+        if rolling and slab > 1:
+            attend_k = jnp.concatenate([pre_k, k_store], axis=1)
+            attend_v = jnp.concatenate([pre_v, v_store], axis=1)
+            if quant_kv:
+                attend_ks = jnp.concatenate([pre_ks, k_s], axis=1)
+                attend_vs = jnp.concatenate([pre_vs, v_s], axis=1)
+            col_pos = jnp.concatenate([pre_sp, q_positions])
+        else:
+            attend_k, attend_v = cached_k.value, cached_v.value
+            if quant_kv:
+                attend_ks, attend_vs = k_scale.value, v_scale.value
+            col_pos = (
+                slot_pos.value if rolling else jnp.arange(cache_len)
+            )
         group = cfg.n_heads // kv_heads
         qg = q.reshape(batch, slab, kv_heads, group, cfg.head_dim)
         scores = jnp.einsum(
-            "bqhgd,bshd->bhgqs", qg, cached_k.value.astype(cfg.dtype),
+            "bqhgd,bshd->bhgqs", qg, attend_k.astype(cfg.dtype),
             preferred_element_type=jnp.float32,
         ) * (cfg.head_dim**-0.5)
         if quant_kv:
             # The scale is constant over D, so it factors out of the dot:
             # apply per-(b, s, h) AFTER the matmul — HBM reads stay int8.
             scores = scores * jnp.transpose(
-                k_scale.value[..., 0], (0, 2, 1)
+                attend_ks[..., 0], (0, 2, 1)
             )[:, :, None, None, :]
-        if rolling:
-            # Mask by each slot's recorded absolute position: the band is
-            # exact whether or not the cache has wrapped, and a query in
-            # this slab can see same-slab earlier tokens (their slots were
-            # just written) but not slots later tokens will overwrite.
-            # Sink positions stay visible at any distance (their slots are
-            # pinned, so they are always present to see).
-            sp = slot_pos.value[None, :]
-            visible = (sp >= 0) & (sp <= q_positions[:, None])
+        # Band mask by column position: a query sees a column iff it is
+        # written, causal-past, and in the band — sink positions stay
+        # visible at any distance (their slots are pinned in the rolling
+        # ring, so they are always present to see).
+        sp = col_pos[None, :]
+        visible = (sp >= 0) & (sp <= q_positions[:, None])
+        if cfg.sliding_window is not None:
             in_band = sp > q_positions[:, None] - cfg.sliding_window
             if sinks:
                 in_band |= sp < sinks
             visible &= in_band
-        else:
-            slots = jnp.arange(cache_len)[None, :]
-            visible = slots <= q_positions[:, None]
-            if cfg.sliding_window is not None:
-                in_band = slots > q_positions[:, None] - cfg.sliding_window
-                if sinks:
-                    in_band |= slots < sinks
-                visible &= in_band
         scores = jnp.where(visible[None, None, None, :, :], scores, NEG_INF)
         probs = jax.nn.softmax(scores, axis=-1)
         if quant_kv:
             # Fold the V scale into the probabilities (constant over D).
             probs = probs * jnp.transpose(
-                v_scale.value[..., 0], (0, 2, 1)
+                attend_vs[..., 0], (0, 2, 1)
             )[:, :, None, None, :]
         probs = probs.astype(cfg.dtype)
         out = jnp.einsum(
-            "bhgqs,bshd->bqhgd", probs, cached_v.value.astype(cfg.dtype),
+            "bhgqs,bshd->bqhgd", probs, attend_v.astype(cfg.dtype),
             preferred_element_type=jnp.float32,
         )
         out = out.reshape(batch, slab, cfg.n_heads, cfg.head_dim)

@@ -359,11 +359,20 @@ def test_rolling_prefill_chunk1_streams_past_capacity():
         generate(rolling, params, prompt, 8, prefill_chunk=1)
     )
     np.testing.assert_array_equal(got, want)
-    # Wider chunks past capacity stay refused (documented-lossy).
-    with pytest.raises(ValueError, match="prefill_chunk=1"):
-        generate(rolling, params, prompt, 8, prefill_chunk=4)
-    with pytest.raises(ValueError, match="prefill_chunk=1"):
-        generate(rolling, params, prompt, 8)
+    # Wider chunks (<= window) are exact too since r4: multi-token slabs
+    # attend the pre-write ring snapshot + the slab, so a wrapping write
+    # can no longer erase band-edge entries (chunk 4 does not divide 20,
+    # exercising the ragged last slab; unset = auto window-wide chunks).
+    np.testing.assert_array_equal(
+        np.asarray(generate(rolling, params, prompt, 8, prefill_chunk=4)),
+        want,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(generate(rolling, params, prompt, 8)), want
+    )
+    # Wider-than-window chunks would double-book ring slots: refused.
+    with pytest.raises(ValueError, match="exceed sliding_window"):
+        generate(rolling, params, prompt, 8, prefill_chunk=7)
 
 
 def test_min_p_filter_semantics():

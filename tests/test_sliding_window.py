@@ -381,8 +381,13 @@ def test_rolling_cache_validation():
     params = TransformerLM(BASE).init(
         jax.random.PRNGKey(0), long_prompt[:, :4]
     )["params"]
-    with pytest.raises(ValueError, match="exceeds"):
-        generate(model, params, long_prompt, 4)
+    # Past-capacity prompts stream by default (auto chunk = window, r4);
+    # only wider-than-window chunks stay rejected (two slab tokens would
+    # scatter into one ring slot).
+    out = generate(model, params, long_prompt, 4)
+    assert out.shape == (1, 14)
+    with pytest.raises(ValueError, match="exceed sliding_window"):
+        generate(model, params, long_prompt, 4, prefill_chunk=7)
     # Speculative decoding refuses rolling models outright.
     from covalent_tpu_plugin.models import speculative_generate
 
@@ -390,3 +395,70 @@ def test_rolling_cache_validation():
         speculative_generate(
             model, params, model, params, long_prompt[:, :4], 4
         )
+
+
+def test_rolling_chunked_prefill_exact_past_capacity():
+    """The r4 exact chunked prefill: a past-capacity prompt streamed in
+    chunks of ANY width <= sliding_window must reproduce the
+    prefill_chunk=1 stream (the long-established exact path) bit for
+    bit — logits at the boundary and every generated token.  Chunk 5
+    does not divide P=24, so the last slab is ragged; chunk 6 == window
+    is the new auto-default."""
+    from covalent_tpu_plugin.models.decode import _decode_model, init_cache
+
+    model = TransformerLM(ROLLING)
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(5), (2, 24), 0, BASE.vocab_size  # 4x capacity
+    )
+    params = TransformerLM(BASE).init(
+        jax.random.PRNGKey(0), prompt[:, :4]
+    )["params"]
+
+    def stream_logits(chunk):
+        """Last-position logits after prefilling the prompt in chunks."""
+        decoder = _decode_model(model)
+        cache = init_cache(model, 2)
+        for start in range(0, prompt.shape[1], chunk):
+            logits, mutated = decoder.apply(
+                {"params": params, "cache": cache},
+                prompt[:, start:start + chunk], mutable=["cache"],
+            )
+            cache = mutated["cache"]
+        return np.asarray(logits[:, -1])
+
+    want_logits = stream_logits(1)
+    want_tokens = np.asarray(
+        generate(model, params, prompt, 8, prefill_chunk=1)
+    )
+    for chunk in (2, 3, 5, 6):
+        np.testing.assert_allclose(
+            stream_logits(chunk), want_logits, atol=1e-5, rtol=1e-5,
+            err_msg=f"chunk={chunk}",
+        )
+        np.testing.assert_array_equal(
+            np.asarray(
+                generate(model, params, prompt, 8, prefill_chunk=chunk)
+            ),
+            want_tokens, err_msg=f"chunk={chunk}",
+        )
+    # The auto default (prefill_chunk unset) matches too.
+    np.testing.assert_array_equal(
+        np.asarray(generate(model, params, prompt, 8)), want_tokens
+    )
+
+
+def test_rolling_chunked_prefill_exact_with_quantized_kv():
+    """Chunked past-capacity prefill composes with the int8 KV cache:
+    the slab branch must quantise/dequantise exactly like the cache
+    branch, so chunk=window reproduces the chunk=1 token stream."""
+    cfg = dataclasses.replace(ROLLING, quantized_kv_cache=True)
+    model = TransformerLM(cfg)
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(6), (2, 24), 0, BASE.vocab_size
+    )
+    params = TransformerLM(BASE).init(
+        jax.random.PRNGKey(0), prompt[:, :4]
+    )["params"]
+    want = np.asarray(generate(model, params, prompt, 8, prefill_chunk=1))
+    got = np.asarray(generate(model, params, prompt, 8))
+    np.testing.assert_array_equal(got, want)
