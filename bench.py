@@ -983,6 +983,78 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
                 plain_s_spread=[round(t, 3) for t in sorted(plain_t)],
                 spec_s_spread=[round(t, 3) for t in sorted(spec_t)],
             )
+
+            # Composed serving stack: the SAME spec machinery over an
+            # int8-weight + int8-KV target (tests prove the composition
+            # bit-exact vs the quantized target's own decode; this arm
+            # measures it).  Own try: a quant failure must not void the
+            # float lm_spec numbers above.
+            if remaining() > 60:
+                try:
+                    import dataclasses as _dc
+
+                    from covalent_tpu_plugin.models import quantize_lm
+
+                    qt_model, qt_params = quantize_lm(
+                        target_model, target_params
+                    )
+                    qt_model = TransformerLM(
+                        _dc.replace(
+                            qt_model.config, quantized_kv_cache=True
+                        )
+                    )
+                    qplain = jax.jit(
+                        lambda p, t: generate(
+                            qt_model, p, t, max_new_tokens=spec_new
+                        )
+                    )
+                    qspec = jax.jit(
+                        lambda tp, dp, t: speculative_generate(
+                            qt_model, tp, draft_model, dp, t, spec_new,
+                            draft_len=draft_len, return_stats=True,
+                        )
+                    )
+                    out_qp = qplain(qt_params, prompt)
+                    out_qs, qstats = qspec(qt_params, draft_params, prompt)
+                    jax.device_get(out_qp[0, -1])  # compile + warm
+                    jax.device_get(out_qs[0, -1])
+                    q_exact = bool(
+                        jax.device_get((out_qp == out_qs).all())
+                    )
+                    q_rounds = int(jax.device_get(qstats["rounds"]))
+                    q_accept = (spec_new - 1 - q_rounds) / max(
+                        q_rounds * draft_len, 1
+                    )
+                    qp_t, qs_t = [], []
+                    for _ in range(3):  # alternating A/B, median
+                        t0 = time.monotonic()
+                        jax.device_get(qplain(qt_params, prompt)[0, -1])
+                        qp_t.append(time.monotonic() - t0)
+                        t0 = time.monotonic()
+                        out, _ = qspec(qt_params, draft_params, prompt)
+                        jax.device_get(out[0, -1])
+                        qs_t.append(time.monotonic() - t0)
+                    qp_s = stats_mod.median(qp_t)
+                    qs_s = stats_mod.median(qs_t)
+                    report(
+                        "lm_spec_quant",
+                        exact=q_exact,
+                        rounds=q_rounds,
+                        accept_rate=round(q_accept, 3),
+                        plain_tokens_per_s=round(
+                            spec_bsz * spec_new / qp_s
+                        ),
+                        spec_tokens_per_s=round(
+                            spec_bsz * spec_new / qs_s
+                        ),
+                        speedup=round(qp_s / qs_s, 3),
+                        plain_s_spread=[round(t, 3) for t in sorted(qp_t)],
+                        spec_s_spread=[round(t, 3) for t in sorted(qs_t)],
+                    )
+                except Exception as error:  # noqa: BLE001
+                    report("lm_spec_quant", error=repr(error))
+            else:
+                report("lm_spec_quant", skipped="budget")
         except Exception as error:  # noqa: BLE001
             report("lm_spec", error=repr(error))
     else:
@@ -1260,6 +1332,9 @@ async def main() -> None:
         "spec_plain_tokens_per_s": sub("lm_spec", "plain_tokens_per_s"),
         "spec_speedup": sub("lm_spec", "speedup"),
         "spec_exact": sub("lm_spec", "exact"),
+        "spec_quant_speedup": sub("lm_spec_quant", "speedup"),
+        "spec_quant_tokens_per_s": sub("lm_spec_quant", "spec_tokens_per_s"),
+        "spec_quant_exact": sub("lm_spec_quant", "exact"),
     }
     emit(final)
 

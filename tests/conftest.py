@@ -32,6 +32,37 @@ os.environ.setdefault("COVALENT_TPU_CONFIG", "/tmp/covalent-tpu-test-config.toml
 
 import pytest
 
+#: jax-heavy modules (interpret-mode Pallas kernels, model forwards,
+#: virtual-mesh shard_map) — minutes each on one core.  The fast tier
+#: (``pytest -m "not slow"``) is the executor/transport/workflow/config
+#: stack, mirroring the reference's seconds-fast mocked unit tier
+#: (reference tests/ssh_test.py); CI runs both tiers.
+SLOW_MODULES = {
+    "test_attention",
+    "test_attention_sinks",
+    "test_distributed_pod",
+    "test_beam",
+    "test_decode",
+    "test_kv_cache_quant",
+    "test_lora",
+    "test_models",
+    "test_moe",
+    "test_parallel",
+    "test_pipeline",
+    "test_quant",
+    "test_ring_attention",
+    "test_serving_sharded",
+    "test_sliding_window",
+    "test_speculative",
+}
+
+
+def pytest_collection_modifyitems(items):
+    for item in items:
+        module = item.module.__name__.rsplit(".", 1)[-1]
+        if module in SLOW_MODULES:
+            item.add_marker(pytest.mark.slow)
+
 
 @pytest.fixture()
 def run_async():
