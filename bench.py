@@ -782,12 +782,35 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
                     report("lm_decode_kvq", error=repr(error))
                     kvq_gen = None
 
+            # The FULL quantized serving stack: int8 weights AND int8 KV
+            # in one model — both bandwidth halves cut together.
+            full_q_gen = None
+            if qgen is not None and remaining() > 30:
+                try:
+                    import dataclasses as _dc
+
+                    fullq_model = TransformerLM(
+                        _dc.replace(
+                            qmodel.config, quantized_kv_cache=True
+                        )
+                    )
+                    full_q_gen = jax.jit(
+                        lambda p, t: generate(
+                            fullq_model, p, t, max_new_tokens=new_tokens
+                        )
+                    )
+                    jax.device_get(full_q_gen(qparams, prompt)[0, -1])
+                except Exception as error:  # noqa: BLE001
+                    report("lm_decode_fullq", error=repr(error))
+                    full_q_gen = None
+
             # Like-for-like A/B: alternate bf16/int8 measurements inside
             # one phase so tunnel drift hits both arms equally (BENCH_r02's
             # int8 delta was within cross-session variance).  The int8 arm
             # keeps its own try at measurement time too — a quant-side
             # failure mid-loop must not void the bf16 numbers.
             bf16_times, int8_times, kvq_times = [], [], []
+            fullq_times = []
             for _ in range(3):
                 bf16_times.append(time_gen(gen, params))
                 if qgen is not None:
@@ -802,6 +825,12 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
                     except Exception as error:  # noqa: BLE001
                         report("lm_decode_kvq", error=repr(error))
                         kvq_gen, kvq_times = None, []
+                if full_q_gen is not None:
+                    try:
+                        fullq_times.append(time_gen(full_q_gen, qparams))
+                    except Exception as error:  # noqa: BLE001
+                        report("lm_decode_fullq", error=repr(error))
+                        full_q_gen, fullq_times = None, []
             elapsed = stats_mod.median(bf16_times)
             # One batched prefill + (new_tokens - 1) decode steps share the
             # wall; metrics are labelled end-to-end, not per decode step.
@@ -836,6 +865,17 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
                         elapsed / kv_elapsed, 3
                     ),
                     e2e_s_spread=[round(t, 3) for t in sorted(kvq_times)],
+                )
+            if fullq_times:
+                fq_elapsed = stats_mod.median(fullq_times)
+                report(
+                    "lm_decode_fullq",
+                    batch=bsz,
+                    tokens_per_s=round(bsz * new_tokens / fq_elapsed),
+                    speedup_vs_bf16_same_phase=round(
+                        elapsed / fq_elapsed, 3
+                    ),
+                    e2e_s_spread=[round(t, 3) for t in sorted(fullq_times)],
                 )
         except Exception as error:  # noqa: BLE001
             report("lm_decode", error=repr(error))
@@ -1345,6 +1385,12 @@ async def main() -> None:
         "lm125m_decode_kvq_tokens_per_s": sub("lm_decode_kvq", "tokens_per_s"),
         "lm125m_decode_kvq_speedup_ab": sub(
             "lm_decode_kvq", "speedup_vs_bf16_same_phase"
+        ),
+        "lm125m_decode_fullq_tokens_per_s": sub(
+            "lm_decode_fullq", "tokens_per_s"
+        ),
+        "lm125m_decode_fullq_speedup_ab": sub(
+            "lm_decode_fullq", "speedup_vs_bf16_same_phase"
         ),
         "spec_accept_rate": sub("lm_spec", "accept_rate"),
         "spec_tokens_per_s": sub("lm_spec", "spec_tokens_per_s"),
