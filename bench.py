@@ -610,6 +610,20 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
                     banded_max_err=round(probe_err, 5),
                     **win_spread,
                 )
+                # Second band width: w=512's tighter band has a higher
+                # tile-geometry ceiling (the w=1k multiple saturates its
+                # own ceiling — see benchmarks/WINDOW_SWEEP.md).
+                if not small and remaining() > 25:
+                    w2 = 512
+                    w2_unit, w2_spread = bwd_unit(w2)
+                    report(
+                        "flash_window_512",
+                        seq_len=s,
+                        window=w2,
+                        fwd_bwd_ms=round(w2_unit * 1e3, 2),
+                        speedup_vs_full=round(unit / w2_unit, 2),
+                        **w2_spread,
+                    )
             else:
                 report("flash_window", skipped="budget")
         except Exception as error:  # noqa: BLE001
@@ -1372,6 +1386,9 @@ async def main() -> None:
         "flash_16k_attn_tflops": sub("flash_long", "attn_tflops"),
         "flash_16k_window1k_ms": sub("flash_window", "fwd_bwd_ms"),
         "flash_16k_window1k_speedup": sub("flash_window", "speedup_vs_full"),
+        "flash_16k_window512_speedup": sub(
+            "flash_window_512", "speedup_vs_full"
+        ),
         "banded_max_err": sub("flash_window", "banded_max_err"),
         "lm125m_step_ms": sub("lm_step", "step_ms"),
         "lm125m_tokens_per_s": sub("lm_step", "tokens_per_s"),
