@@ -64,6 +64,20 @@ def pytest_collection_modifyitems(items):
             item.add_marker(pytest.mark.slow)
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _bound_jax_compile_state():
+    """Clear jax's in-process caches after each test module.
+
+    A full-suite run accumulates hundreds of compiled executables in one
+    process; at ~85% through, XLA:CPU's compiler segfaulted inside
+    backend_compile (reproduced twice at the same test, while the same
+    test passes in isolation and in whole-file runs).  Per-module
+    clearing bounds the growth; cross-module cache reuse is ~nil anyway
+    (modules compile their own model/kernel shapes)."""
+    yield
+    jax.clear_caches()
+
+
 @pytest.fixture()
 def run_async():
     """Drive a coroutine to completion (no pytest-asyncio in this image)."""
