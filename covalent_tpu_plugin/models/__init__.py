@@ -25,6 +25,7 @@ from .lora import (
     quantize_then_lora,
 )
 from .quant import QuantDenseGeneral, quantize_lm
+from .serve import continuous_generate
 from .speculative import speculative_generate, speculative_sample
 from .mlp import MLP, MnistCNN, synthetic_mnist
 from .transformer import TransformerConfig, TransformerLM, lm_125m_config
@@ -46,6 +47,7 @@ __all__ = [
     "synthetic_lm_batches",
     "beam_search",
     "generate",
+    "continuous_generate",
     "inference_params",
     "init_cache",
     "MoEMlp",

@@ -40,6 +40,7 @@ import pytest
 SLOW_MODULES = {
     "test_attention",
     "test_attention_sinks",
+    "test_continuous",
     "test_distributed_pod",
     "test_beam",
     "test_decode",
