@@ -1,0 +1,12 @@
+"""Shared benchmark-script bootstrap: honour JAX_PLATFORMS=cpu.
+
+The sandbox's axon site-hook re-pins the TPU platform after interpreter
+start, so the env var alone does not protect a bare script — only the
+config update really forces CPU.  Import this before any other jax use.
+"""
+import os
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

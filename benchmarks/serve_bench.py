@@ -1,0 +1,183 @@
+"""Continuous batching vs static wave batching on a mixed-length workload.
+
+Run:  python benchmarks/serve_bench.py          (TPU or CPU)
+
+Workload: N requests whose token budgets are spread 4..100 (a serving
+mix). Static batching serves them in waves of ``max_batch`` through
+plain ``generate()`` — every wave runs until its LONGEST member's
+budget.  Continuous batching refills a slot the moment its request
+finishes.  Static step accounting is exact; continuous is reported
+both as the idealized packing bound AND sync-quantized (admission only
+happens at ``sync_steps`` boundaries, so each finished request strands
+up to ``sync_steps - 1`` frozen steps).  Wall clock is measured with
+every shape pre-compiled for BOTH arms.
+
+Correctness accounting: each arm's outputs are compared token-wise to
+batch-1 ``generate()`` per prompt.  On CPU (f32 or bf16) both match bit
+for bit.  On the TPU MXU, *batched* matmul tiling can round bf16
+logits differently than the batch-1 shape, occasionally flipping a
+near-tie argmax — so the static arm drifts from the batch-1 oracle in
+exactly the same way the continuous arm does; both agreement rates are
+reported to make that attribution visible.
+"""
+from __future__ import annotations
+
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+import _bootstrap  # noqa: F401  (honours JAX_PLATFORMS=cpu)
+
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from covalent_tpu_plugin.models import (  # noqa: E402
+    TransformerConfig,
+    TransformerLM,
+    continuous_generate,
+    generate,
+    inference_params,
+)
+
+
+def agreement(outs, oracle):
+    """Fraction of requests whose full token sequence matches."""
+    return sum(
+        1 for o, w in zip(outs, oracle)
+        if o.size == w.size and (o == w).all()
+    ) / len(oracle)
+
+
+def main() -> None:
+    n_req, max_batch = 24, 8
+    from covalent_tpu_plugin.ops.attention import on_tpu
+
+    # bf16 is the serving dtype on TPU; on CPU it is software-emulated
+    # (and f32 is also the bit-exactness regime worth recording there).
+    dtype = jnp.bfloat16 if on_tpu() else jnp.float32
+    cfg = TransformerConfig(
+        vocab_size=512, d_model=256, n_layers=4, n_heads=4, d_ff=1024,
+        max_seq=128, dtype=dtype, scan_layers=False,
+    )
+    model = TransformerLM(cfg)
+    rngs = jax.random.split(jax.random.PRNGKey(0), n_req)
+    plen = 8
+    prompts = [
+        np.asarray(
+            jax.random.randint(rngs[i], (plen,), 0, cfg.vocab_size),
+            np.int32,
+        )
+        for i in range(n_req)
+    ]
+    # Five budget tiers keep the compile count tunnel-sane (each distinct
+    # plen+cap is one generate() compile) while spreading 4..100.
+    tiers = (4, 16, 40, 64, 100)
+    caps = [tiers[(i * 7919) % len(tiers)] for i in range(n_req)]
+    params = model.init(jax.random.PRNGKey(1), prompts[0][None])["params"]
+    if dtype == jnp.bfloat16:
+        params = inference_params(params)
+
+    # All generate() calls go through jitted wrappers (unjitted decode
+    # runs the while_loop eagerly — hundreds of op dispatches per token).
+    # One compile per distinct (batch, cap); prompts share one length.
+    jit_gen = {}
+
+    def gen(batch_tokens, cap):
+        key = (batch_tokens.shape[0], cap)
+        if key not in jit_gen:
+            jit_gen[key] = jax.jit(
+                lambda pp, tt, c=cap: generate(model, pp, tt, c)
+            )
+        return np.asarray(jit_gen[key](params, jnp.asarray(batch_tokens)))
+
+    # Batch-1 oracle per request.
+    oracle = []
+    for i, (p, c) in enumerate(zip(prompts, caps)):
+        oracle.append(gen(p[None], c)[0])
+        print(f"oracle {i+1}/{len(prompts)}", file=sys.stderr, flush=True)
+
+    order = list(range(n_req))
+    waves = [order[i:i + max_batch] for i in range(0, n_req, max_batch)]
+
+    def run_static():
+        outs = [None] * n_req
+        for w in waves:
+            wave_cap = max(caps[i] for i in w)
+            batch = np.stack([prompts[i] for i in w])
+            res = gen(batch, wave_cap)
+            for r, i in enumerate(w):
+                outs[i] = res[r][: plen + caps[i]]
+        return outs
+
+    def run_continuous():
+        return continuous_generate(
+            model, params, prompts, caps, max_batch=max_batch,
+            sync_steps=8,
+        )
+
+    print("static warm-up...", file=sys.stderr, flush=True)
+    static_outs = run_static()      # compile + warm
+    print("continuous warm-up...", file=sys.stderr, flush=True)
+    cont_outs = run_continuous()    # compile + warm
+
+    # Device-step accounting (the cost driver).  Static is exact.
+    # Continuous: the ideal packing bound, plus a simulation of the real
+    # loop where a freed slot re-admits only at the next sync boundary.
+    static_steps = sum(
+        plen + max(caps[i] for i in w) for w in waves
+    )
+    sync = 8
+    ideal = [0] * max_batch
+    for i in order:
+        k = min(range(max_batch), key=lambda j: ideal[j])
+        ideal[k] += plen + caps[i]
+    continuous_steps_ideal = max(ideal)
+    free_at = [0] * max_batch   # next admission boundary per slot
+    finish = [0] * max_batch    # actual completion step per slot
+    for i in order:
+        k = min(range(max_batch), key=lambda j: free_at[j])
+        finish[k] = free_at[k] + plen + caps[i]
+        free_at[k] = -(-finish[k] // sync) * sync
+    continuous_steps = max(finish)
+
+    t0 = time.monotonic()
+    run_continuous()
+    t_cont = time.monotonic() - t0
+    t0 = time.monotonic()
+    run_static()
+    t_static = time.monotonic() - t0
+
+    print(json.dumps({
+        "n_requests": n_req,
+        "max_batch": max_batch,
+        "dtype": str(dtype.__name__ if hasattr(dtype, "__name__") else dtype),
+        "static_wave_steps": static_steps,
+        "continuous_steps_ideal": continuous_steps_ideal,
+        "continuous_steps_sync_quantized": continuous_steps,
+        "step_reduction": round(static_steps / continuous_steps, 2),
+        "wall_s_static_waves": round(t_static, 2),
+        "wall_s_continuous": round(t_cont, 2),
+        "wall_speedup": round(t_static / t_cont, 2),
+        "agreement_continuous_vs_b1": round(
+            agreement(cont_outs, oracle), 3
+        ),
+        "agreement_static_vs_b1": round(
+            agreement(static_outs, oracle), 3
+        ),
+        "note": "both arms pre-compiled before timing; agreement < 1 on "
+                "TPU bf16 reflects batched-matmul rounding vs the "
+                "batch-1 oracle and applies to BOTH arms equally; at "
+                "this toy scale per-step loop overhead can eat the "
+                "step-count win on CPU - step counts are the "
+                "structural metric",
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

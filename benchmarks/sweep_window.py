@@ -16,6 +16,11 @@ reruns where the delta method flipped winners run to run.
 
 from __future__ import annotations
 
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+import _bootstrap  # noqa: F401  (honours JAX_PLATFORMS=cpu)
+
 import json
 import statistics
 import sys

@@ -19,7 +19,12 @@ TPU-native design — the pieces map to the compilation model:
   ``jax.vmap`` over the single-row decode step — no scalar-cursor
   surgery in the model.  A lane's numerics are exactly a batch-1
   ``generate()``'s (no cross-batch reductions anywhere), which is what
-  makes the bit-equality oracle in the tests possible.
+  makes the bit-equality oracle in the tests possible.  Caveat shared
+  with plain batched ``generate()``: on backends whose batched-matmul
+  tiling rounds differently than the batch-1 shape (TPU MXU at bf16),
+  near-tie argmaxes can flip vs the batch-1 oracle — benchmarks/
+  serve_bench.py reports both arms' agreement to make the attribution
+  visible; on CPU (f32 and bf16) equality is bit-exact.
 * **Admission at scan boundaries.**  The device runs ``sync_steps``
   decode steps per jitted call (``lax.scan``); the host only looks at
   the tiny (B,) state vectors between calls, harvests finished rows,
@@ -52,7 +57,7 @@ from .transformer import TransformerLM
 
 @functools.lru_cache(maxsize=32)
 def _make_run_steps(decoder, temperature, top_k, eos_token_id,
-                    max_new_tokens, length, sync_steps, batch):
+                    length, sync_steps, batch):
     """Jitted ``sync_steps``-long serving scan, cached on its statics.
 
     A per-call ``@jax.jit`` over a closure would retrace and recompile
@@ -77,7 +82,7 @@ def _make_run_steps(decoder, temperature, top_k, eos_token_id,
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
     def one_step(params, state, _):
-        caches, buffer, pos, plen, n_gen, done, rng = state
+        caches, buffer, pos, plen, row_cap, n_gen, done, rng = state
 
         def row_step(cache, token):
             logits, mutated = decoder.apply(
@@ -102,12 +107,12 @@ def _make_run_steps(decoder, temperature, top_k, eos_token_id,
         n_gen = n_gen + gen_now.astype(jnp.int32)
         if eos_token_id is not None:
             done = done | (gen_now & (nxt == eos_token_id))
-        done = done | (n_gen >= max_new_tokens)
+        done = done | (n_gen >= row_cap)
         # Frozen rows hold position (their lane keeps stepping on the
         # same token; logits are ignored, cache writes past the row's
         # used region are reset at admission).
         pos = jnp.where(done, pos, pos + 1)
-        return (caches, buffer, pos, plen, n_gen, done, rng), None
+        return (caches, buffer, pos, plen, row_cap, n_gen, done, rng), None
 
     @jax.jit
     def run_steps(params, state):
@@ -124,7 +129,7 @@ def continuous_generate(
     model: TransformerLM,
     params: Any,
     prompts: Sequence[np.ndarray],
-    max_new_tokens: int,
+    max_new_tokens: int | Sequence[int],
     *,
     max_batch: int = 4,
     temperature: float = 0.0,
@@ -139,10 +144,15 @@ def continuous_generate(
     prompt, in the input order.
 
     Each output is ``prompt + generated`` where generation stops at
-    ``max_new_tokens`` or the row's EOS (the EOS token is included).
-    Greedy rows are bit-identical to ``generate(model, params,
-    prompt[None], max_new_tokens)`` — admission order cannot change
-    tokens, only latency.
+    the request's token budget or its EOS (the EOS token is included).
+    ``max_new_tokens`` is one shared budget (int) or one per request —
+    mixed-length workloads are continuous batching's home turf: a slot
+    whose request hits its own budget is refilled immediately instead of
+    idling until the longest request in a static batch finishes.  Greedy
+    rows are bit-identical to ``generate(model, params, prompt[None],
+    cap_i)`` on batch-rounding-invariant backends (CPU f32/bf16; see the
+    module docstring for the TPU-bf16 caveat shared with plain batched
+    decode) — admission order cannot change tokens, only latency.
     """
     config = _decode_model(model).config
     if config.rolling_cache:
@@ -150,7 +160,19 @@ def continuous_generate(
             "continuous_generate does not support rolling_cache models "
             "(slot reset assumes the plain cache layout)"
         )
-    if max_new_tokens < 1:
+    caps = None
+    if isinstance(max_new_tokens, (float, np.floating)):
+        max_new_tokens = int(max_new_tokens)  # old int-like float contract
+    if not isinstance(max_new_tokens, (int, np.integer)):
+        caps = [int(c) for c in max_new_tokens]
+        if len(caps) != len(prompts):
+            raise ValueError(
+                f"per-request max_new_tokens has {len(caps)} entries for "
+                f"{len(prompts)} prompts"
+            )
+        if any(c < 1 for c in caps):
+            raise ValueError("every per-request max_new_tokens must be >= 1")
+    elif max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
     if max_batch < 1:
         raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -169,12 +191,13 @@ def continuous_generate(
         return []
     if any(p.size < 1 for p in prompts):
         raise ValueError("every prompt needs at least one token")
-    max_plen = max(p.size for p in prompts)
-    length = max_plen + max_new_tokens
+    if caps is None:
+        caps = [int(max_new_tokens)] * len(prompts)
+    length = max(p.size + c for p, c in zip(prompts, caps))
     if length > config.max_seq:
         raise ValueError(
-            f"longest prompt ({max_plen}) + max_new_tokens "
-            f"({max_new_tokens}) exceeds config.max_seq ({config.max_seq})"
+            f"worst-case prompt + budget ({length}) exceeds "
+            f"config.max_seq ({config.max_seq})"
         )
     batch = min(max_batch, len(prompts))
     decoder = _decode_model(model)
@@ -198,37 +221,41 @@ def continuous_generate(
 
     run_steps = _make_run_steps(
         decoder, float(temperature), top_k, eos_token_id,
-        int(max_new_tokens), int(length), int(sync_steps), int(batch),
+        int(length), int(sync_steps), int(batch),
     )
 
     # --- host-side slot management ---------------------------------------
-    queue = list(enumerate(prompts))  # (original index, tokens)
+    queue = [
+        (i, p, c) for i, (p, c) in enumerate(zip(prompts, caps))
+    ]  # (original index, tokens, budget)
     outputs: list[np.ndarray | None] = [None] * len(prompts)
     buffer = np.full((batch, length), pad, np.int32)
     pos = np.zeros(batch, np.int32)
     plen = np.ones(batch, np.int32)
+    row_cap = np.ones(batch, np.int32)
     n_gen = np.zeros(batch, np.int32)
     done = np.ones(batch, bool)  # empty slots are "done" until admitted
     slot_req = [-1] * batch  # original request index per slot
 
     def admit(state, slot):
-        caches, buffer, pos, plen, n_gen, done, rng = state
-        req_idx, tokens = queue.pop(0)
+        caches, buffer, pos, plen, row_cap, n_gen, done, rng = state
+        req_idx, tokens, cap = queue.pop(0)
         slot_req[slot] = req_idx
         row = np.full((length,), pad, np.int32)
         row[: tokens.size] = tokens
         buffer = buffer.at[slot].set(jnp.asarray(row))
         pos = pos.at[slot].set(0)
         plen = plen.at[slot].set(tokens.size)
+        row_cap = row_cap.at[slot].set(cap)
         n_gen = n_gen.at[slot].set(0)
         done = done.at[slot].set(False)
         caches = jax.tree_util.tree_map(
             lambda c, z: c.at[slot].set(z), caches, lane_zero
         )
-        return caches, buffer, pos, plen, n_gen, done, rng
+        return caches, buffer, pos, plen, row_cap, n_gen, done, rng
 
     def harvest(state, slot):
-        _, buffer, _, plen_d, n_gen_d, _, _ = state
+        _, buffer, _, plen_d, _, n_gen_d, _, _ = state
         row = np.asarray(buffer[slot])
         keep = int(plen_d[slot]) + int(n_gen_d[slot])
         outputs[slot_req[slot]] = row[:keep]
@@ -236,7 +263,7 @@ def continuous_generate(
 
     state = (
         caches, jnp.asarray(buffer), jnp.asarray(pos), jnp.asarray(plen),
-        jnp.asarray(n_gen), jnp.asarray(done), rng,
+        jnp.asarray(row_cap), jnp.asarray(n_gen), jnp.asarray(done), rng,
     )
     for slot in range(batch):
         if queue:
@@ -244,7 +271,7 @@ def continuous_generate(
 
     while True:
         state = run_steps(params, state)
-        done_h = np.asarray(state[5])
+        done_h = np.asarray(state[6])
         for slot in range(batch):
             if done_h[slot] and slot_req[slot] >= 0:
                 harvest(state, slot)

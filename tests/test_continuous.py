@@ -101,6 +101,25 @@ def test_eos_frees_slots_early():
         np.testing.assert_array_equal(o, want_full[: o.size])
 
 
+def test_per_request_token_budgets():
+    """Each request can carry its own max_new_tokens; row i must equal
+    generate(prompt_i, cap_i) bit-for-bit, and a slot freed by a small
+    budget serves later queue entries (5 requests, 2 slots)."""
+    model, params = build()
+    prompts = ragged_prompts(5, base_seed=60)
+    caps = [3, 12, 5, 8, 1]
+    outs = continuous_generate(
+        model, params, prompts, caps, max_batch=2, sync_steps=4
+    )
+    for p, c, o in zip(prompts, caps, outs):
+        want = np.asarray(generate(model, params, p[None], c))[0]
+        np.testing.assert_array_equal(o, want)
+    with pytest.raises(ValueError, match="entries for"):
+        continuous_generate(model, params, prompts, [4, 4], max_batch=2)
+    with pytest.raises(ValueError, match=">= 1"):
+        continuous_generate(model, params, prompts, [4, 4, 0, 4, 4])
+
+
 def test_sampling_deterministic_per_rng():
     model, params = build()
     prompts = ragged_prompts(3, base_seed=40)
