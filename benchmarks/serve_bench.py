@@ -115,10 +115,10 @@ def main() -> None:
                 outs[i] = res[r][: plen + caps[i]]
         return outs
 
-    def run_continuous():
+    def run_continuous(mode="batched"):
         return continuous_generate(
             model, params, prompts, caps, max_batch=max_batch,
-            sync_steps=8,
+            sync_steps=8, prefill=mode,
         )
 
     print("static warm-up...", file=sys.stderr, flush=True)
@@ -130,25 +130,33 @@ def main() -> None:
     # Continuous: the ideal packing bound, plus a simulation of the real
     # loop where a freed slot re-admits only at the next sync boundary.
     static_steps = sum(
-        plen + max(caps[i] for i in w) for w in waves
+        max(caps[i] for i in w) - 1 for w in waves
     )
     sync = 8
+    # Batched-prefill admission: each request costs 1 prefill pass (done
+    # host-side between scans) + cap-1 decode loop steps.
     ideal = [0] * max_batch
     for i in order:
         k = min(range(max_batch), key=lambda j: ideal[j])
-        ideal[k] += plen + caps[i]
+        ideal[k] += caps[i] - 1
     continuous_steps_ideal = max(ideal)
     free_at = [0] * max_batch   # next admission boundary per slot
     finish = [0] * max_batch    # actual completion step per slot
     for i in order:
         k = min(range(max_batch), key=lambda j: free_at[j])
-        finish[k] = free_at[k] + plen + caps[i]
+        finish[k] = free_at[k] + caps[i] - 1
         free_at[k] = -(-finish[k] // sync) * sync
     continuous_steps = max(finish)
+    continuous_prefill_passes = n_req
+    static_prefill_passes = len(waves)
 
+    run_continuous("stream")  # warm the streaming variant too
     t0 = time.monotonic()
     run_continuous()
     t_cont = time.monotonic() - t0
+    t0 = time.monotonic()
+    run_continuous("stream")
+    t_cont_stream = time.monotonic() - t0
     t0 = time.monotonic()
     run_static()
     t_static = time.monotonic() - t0
@@ -158,11 +166,14 @@ def main() -> None:
         "max_batch": max_batch,
         "dtype": str(dtype.__name__ if hasattr(dtype, "__name__") else dtype),
         "static_wave_steps": static_steps,
+        "static_prefill_passes": static_prefill_passes,
+        "continuous_prefill_passes": continuous_prefill_passes,
         "continuous_steps_ideal": continuous_steps_ideal,
         "continuous_steps_sync_quantized": continuous_steps,
         "step_reduction": round(static_steps / continuous_steps, 2),
         "wall_s_static_waves": round(t_static, 2),
         "wall_s_continuous": round(t_cont, 2),
+        "wall_s_continuous_stream_prefill": round(t_cont_stream, 2),
         "wall_speedup": round(t_static / t_cont, 2),
         "agreement_continuous_vs_b1": round(
             agreement(cont_outs, oracle), 3
@@ -170,6 +181,10 @@ def main() -> None:
         "agreement_static_vs_b1": round(
             agreement(static_outs, oracle), 3
         ),
+        "accounting": "step fields count DECODE steps only (changed "
+                      "from the earlier plen+cap accounting); prefill "
+                      "passes are reported separately per arm - the one "
+                      "axis where continuous is strictly costlier",
         "note": "both arms pre-compiled before timing; agreement < 1 on "
                 "TPU bf16 reflects batched-matmul rounding vs the "
                 "batch-1 oracle and applies to BOTH arms equally; at "

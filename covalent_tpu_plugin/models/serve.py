@@ -32,14 +32,27 @@ TPU-native design — the pieces map to the compilation model:
   slot.  One host round-trip per ``sync_steps`` tokens instead of one
   per token — the knob trades admission latency against host chatter
   (tunnelled TPUs want it large).
-* **Chunk-1 prompt streaming.**  An admitted prompt streams through the
-  shared step loop one token per step (classic interleaved chunked
-  prefill), so prefill and decode share one compiled program and new
-  admissions never recompile.
+* **Bucketed batched prefill at admission** (``prefill="batched"``, the
+  default).  An admitted prompt runs ONE single-lane prefill pass padded
+  to a power-of-two bucket, then enters the shared decode loop — time to
+  first token is one pass, not ``len(prompt)`` interleaved steps.  The
+  padding trick is exact: pad K/V land at slots ``>= len(prompt)``, the
+  cursor is rewound to ``len(prompt)``, and the causal mask only ever
+  exposes slot ``k`` to queries at positions ``>= k`` — by which step
+  the decode loop has overwritten it with the real token's K/V.
+  Compiles one prefill per bucket size (a handful for a whole serving
+  mix).  ``prefill="stream"`` keeps the zero-extra-compiles chunk-1
+  interleave: the prompt streams through the shared step loop one token
+  per step.
 
 Greedy and temperature/top-k sampling are supported; EOS finishes a slot
-early.  ``rolling_cache`` models are refused (slot reset assumes the
-plain cache layout).
+early.  Sampling note: greedy outputs are identical across prefill
+modes, but SAMPLED outputs are not reproducible across them — batched
+admission draws each first token from a dedicated admission key chain
+(``fold_in(rng, 0x5E1)``) while streaming draws it from the shared loop
+stream; pin ``prefill`` as well as ``rng`` for reproducible sampling.
+``rolling_cache`` models are refused (slot reset assumes the plain
+cache layout).
 """
 
 from __future__ import annotations
@@ -52,7 +65,46 @@ import jax.numpy as jnp
 import numpy as np
 
 from .decode import _decode_model, _filter_top_k, init_cache
+from .speculative import _set_cursor
 from .transformer import TransformerLM
+
+
+def _choose_tokens(logits, key, temperature, top_k):
+    """Shared greedy/sampling rule for the loop and the prefill."""
+    logits = logits.astype(jnp.float32)
+    if temperature > 0:
+        scaled = logits / temperature
+        if top_k is not None:
+            scaled = _filter_top_k(scaled, top_k)
+        return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+@functools.lru_cache(maxsize=32)
+def _make_prefill(decoder, temperature, top_k, bucket):
+    """Jitted single-lane bucketed prefill: padded (1, bucket) tokens ->
+    (lane cache at cursor=plen, first generated token).
+
+    Pad positions' K/V land at slots >= plen; with the cursor rewound to
+    ``plen`` they are dead until the decode loop overwrites them (the
+    causal mask shows slot k only to queries at positions >= k, and the
+    loop writes slot k right before the first such query runs), so the
+    padded pass is exact — same trick as speculative decoding's cache
+    rewind (models/speculative.py)."""
+
+    @jax.jit
+    def prefill(params, cache, tokens, plen, key):
+        logits, mutated = decoder.apply(
+            {"params": params, "cache": cache}, tokens, mutable=["cache"]
+        )
+        cache = _set_cursor(mutated["cache"], plen)
+        last = jnp.take_along_axis(
+            logits, (plen - 1)[None, None, None], axis=1
+        )[0, 0]  # (V,)
+        first = _choose_tokens(last[None, :], key, temperature, top_k)[0]
+        return cache, first
+
+    return prefill
 
 
 @functools.lru_cache(maxsize=32)
@@ -71,15 +123,7 @@ def _make_run_steps(decoder, temperature, top_k, eos_token_id,
     rows = jnp.arange(batch)
 
     def choose(logits, key):
-        logits = logits.astype(jnp.float32)
-        if temperature > 0:
-            scaled = logits / temperature
-            if top_k is not None:
-                scaled = _filter_top_k(scaled, top_k)
-            return jax.random.categorical(key, scaled, axis=-1).astype(
-                jnp.int32
-            )
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return _choose_tokens(logits, key, temperature, top_k)
 
     def one_step(params, state, _):
         caches, buffer, pos, plen, row_cap, n_gen, done, rng = state
@@ -138,6 +182,7 @@ def continuous_generate(
     eos_token_id: int | None = None,
     pad_token_id: int | None = None,
     sync_steps: int = 8,
+    prefill: str = "batched",
 ) -> list[np.ndarray]:
     """Serve ``prompts`` (each a 1-D int32 array) through ``max_batch``
     continuously-refilled slots; returns one trimmed output sequence per
@@ -178,6 +223,10 @@ def continuous_generate(
         raise ValueError(f"max_batch must be >= 1, got {max_batch}")
     if sync_steps < 1:
         raise ValueError(f"sync_steps must be >= 1, got {sync_steps}")
+    if prefill not in ("batched", "stream"):
+        raise ValueError(
+            f'prefill must be "batched" or "stream", got {prefill!r}'
+        )
     if temperature > 0 and rng is None:
         raise ValueError("sampling (temperature > 0) requires rng")
     if temperature <= 0 and top_k is not None:
@@ -237,6 +286,8 @@ def continuous_generate(
     done = np.ones(batch, bool)  # empty slots are "done" until admitted
     slot_req = [-1] * batch  # original request index per slot
 
+    adm_rng = {"key": jax.random.fold_in(rng, 0x5E1)}
+
     def admit(state, slot):
         caches, buffer, pos, plen, row_cap, n_gen, done, rng = state
         req_idx, tokens, cap = queue.pop(0)
@@ -244,14 +295,41 @@ def continuous_generate(
         row = np.full((length,), pad, np.int32)
         row[: tokens.size] = tokens
         buffer = buffer.at[slot].set(jnp.asarray(row))
-        pos = pos.at[slot].set(0)
         plen = plen.at[slot].set(tokens.size)
         row_cap = row_cap.at[slot].set(cap)
-        n_gen = n_gen.at[slot].set(0)
-        done = done.at[slot].set(False)
-        caches = jax.tree_util.tree_map(
-            lambda c, z: c.at[slot].set(z), caches, lane_zero
-        )
+        if prefill == "batched":
+            # One padded prefill pass; the slot enters the loop already
+            # holding its first generated token (see module docstring).
+            bucket = min(
+                1 << (int(tokens.size) - 1).bit_length(), config.max_seq
+            )
+            pf = _make_prefill(
+                decoder, float(temperature), top_k, int(bucket)
+            )
+            padded = np.full((1, bucket), pad, np.int32)
+            padded[0, : tokens.size] = tokens
+            adm_rng["key"], key = jax.random.split(adm_rng["key"])
+            new_lane, first = pf(
+                params, lane_zero, jnp.asarray(padded),
+                jnp.asarray(tokens.size, jnp.int32), key,
+            )
+            caches = jax.tree_util.tree_map(
+                lambda c, nl: c.at[slot].set(nl), caches, new_lane
+            )
+            buffer = buffer.at[slot, tokens.size].set(first)
+            pos = pos.at[slot].set(tokens.size)
+            n_gen = n_gen.at[slot].set(1)
+            fin = jnp.asarray(cap <= 1)
+            if eos_token_id is not None:
+                fin = fin | (first == eos_token_id)
+            done = done.at[slot].set(fin)
+        else:
+            pos = pos.at[slot].set(0)
+            n_gen = n_gen.at[slot].set(0)
+            done = done.at[slot].set(False)
+            caches = jax.tree_util.tree_map(
+                lambda c, z: c.at[slot].set(z), caches, lane_zero
+            )
         return caches, buffer, pos, plen, row_cap, n_gen, done, rng
 
     def harvest(state, slot):

@@ -53,16 +53,18 @@ def ragged_prompts(n, base_seed=0):
     ]
 
 
+@pytest.mark.parametrize("prefill", ["batched", "stream"])
 @pytest.mark.parametrize("max_batch,sync_steps", [(1, 1), (2, 4), (3, 8)])
-def test_greedy_bit_equal_to_generate(max_batch, sync_steps):
+def test_greedy_bit_equal_to_generate(max_batch, sync_steps, prefill):
     """Every served output == the standalone greedy continuation, across
-    slot counts (1 = fully serial), sync granularities, and ragged
-    prompt lengths that force multiple admission waves."""
+    slot counts (1 = fully serial), sync granularities, both admission
+    prefill modes (one padded batched pass vs chunk-1 streaming), and
+    ragged prompt lengths that force multiple admission waves."""
     model, params = build()
     prompts = ragged_prompts(5)
     outs = continuous_generate(
         model, params, prompts, 8, max_batch=max_batch,
-        sync_steps=sync_steps,
+        sync_steps=sync_steps, prefill=prefill,
     )
     assert len(outs) == len(prompts)
     for p, o in zip(prompts, outs):
@@ -70,10 +72,12 @@ def test_greedy_bit_equal_to_generate(max_batch, sync_steps):
         np.testing.assert_array_equal(o, want)
 
 
-def test_eos_frees_slots_early():
+@pytest.mark.parametrize("prefill", ["batched", "stream"])
+def test_eos_frees_slots_early(prefill):
     """Rows stop at their own EOS (token included, output trimmed), and
     the freed slot serves later queue entries — outputs still match the
-    per-prompt oracle up to and including EOS."""
+    per-prompt oracle up to and including EOS.  Covers both admission
+    modes: batched admission has its own first-token EOS check."""
     model, params = build()
     prompts = ragged_prompts(6, base_seed=20)
     # Pick an eos id that actually occurs in some greedy continuations:
@@ -88,7 +92,7 @@ def test_eos_frees_slots_early():
     eos = max(hits, key=hits.get)
     outs = continuous_generate(
         model, params, prompts, 10, max_batch=2, eos_token_id=eos,
-        sync_steps=3,
+        sync_steps=3, prefill=prefill,
     )
     for p, o in zip(prompts, outs):
         want_full = np.asarray(
@@ -120,12 +124,13 @@ def test_per_request_token_budgets():
         continuous_generate(model, params, prompts, [4, 4, 0, 4, 4])
 
 
-def test_sampling_deterministic_per_rng():
+@pytest.mark.parametrize("prefill", ["batched", "stream"])
+def test_sampling_deterministic_per_rng(prefill):
     model, params = build()
     prompts = ragged_prompts(3, base_seed=40)
     kwargs = dict(
         max_batch=2, temperature=0.8, top_k=16,
-        rng=jax.random.PRNGKey(7), sync_steps=4,
+        rng=jax.random.PRNGKey(7), sync_steps=4, prefill=prefill,
     )
     a = continuous_generate(model, params, prompts, 6, **kwargs)
     b = continuous_generate(model, params, prompts, 6, **kwargs)
@@ -153,4 +158,6 @@ def test_validation():
         continuous_generate(model, params, prompts, 4, top_k=4)
     with pytest.raises(ValueError, match="at least one token"):
         continuous_generate(model, params, [np.zeros(0, np.int32)], 4)
+    with pytest.raises(ValueError, match="prefill must be"):
+        continuous_generate(model, params, prompts, 4, prefill="turbo")
     assert continuous_generate(model, params, [], 4) == []
