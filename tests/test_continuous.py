@@ -142,6 +142,29 @@ def test_sampling_deterministic_per_rng(prefill):
         assert (x >= 0).all() and (x < CFG.vocab_size).all()
 
 
+def test_composes_with_quantized_serving_stack():
+    """The serving matrix closes: continuous batching over an int8-weight
+    + int8-KV model is bit-identical to that quantized model's own
+    plain decode per prompt."""
+    from covalent_tpu_plugin.models import quantize_lm
+
+    model = TransformerLM(dataclasses.replace(CFG, scan_layers=False))
+    prompts = ragged_prompts(4, base_seed=80)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+    )["params"]
+    qmodel, qparams = quantize_lm(model, params)
+    qmodel = TransformerLM(
+        dataclasses.replace(qmodel.config, quantized_kv_cache=True)
+    )
+    outs = continuous_generate(
+        qmodel, qparams, prompts, 8, max_batch=2, sync_steps=4
+    )
+    for p, o in zip(prompts, outs):
+        want = np.asarray(generate(qmodel, qparams, p[None], 8))[0]
+        np.testing.assert_array_equal(o, want)
+
+
 def test_validation():
     model, params = build()
     prompts = ragged_prompts(2)
