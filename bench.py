@@ -96,7 +96,17 @@ def tpu_preflight(timeout_s: float) -> tuple[bool, float, str]:
     import subprocess
 
     code = (
-        "import jax, jax.numpy as jnp\n"
+        # Pin the platform from the env like the electron harness does —
+        # site hooks (e.g. the axon TPU plugin) re-pin after interpreter
+        # start, so a JAX_PLATFORMS=cpu validation run would otherwise
+        # probe the TPU tunnel it was explicitly avoiding.
+        "import os, jax, jax.numpy as jnp\n"
+        "plat = os.environ.get('JAX_PLATFORMS')\n"
+        "if plat:\n"
+        "    try:\n"
+        "        jax.config.update('jax_platforms', plat)\n"
+        "    except RuntimeError:\n"
+        "        pass  # backend already initialized by a site hook\n"
         "x = jnp.ones((256, 256), jnp.bfloat16)\n"
         "out = jax.jit(lambda a: a @ a)(x)\n"
         "print('PREFLIGHT_OK', float(out[0, 0]), jax.devices()[0].platform)\n"
