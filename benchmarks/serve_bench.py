@@ -43,6 +43,7 @@ from covalent_tpu_plugin.models import (  # noqa: E402
     continuous_generate,
     generate,
     inference_params,
+    step_accounting,
 )
 
 
@@ -126,27 +127,16 @@ def main() -> None:
     print("continuous warm-up...", file=sys.stderr, flush=True)
     cont_outs = run_continuous()    # compile + warm
 
-    # Device-step accounting (the cost driver).  Static is exact.
-    # Continuous: the ideal packing bound, plus a simulation of the real
-    # loop where a freed slot re-admits only at the next sync boundary.
-    static_steps = sum(
-        max(caps[i] for i in w) - 1 for w in waves
-    )
-    sync = 8
-    # Batched-prefill admission: each request costs 1 prefill pass (done
-    # host-side between scans) + cap-1 decode loop steps.
-    ideal = [0] * max_batch
-    for i in order:
-        k = min(range(max_batch), key=lambda j: ideal[j])
-        ideal[k] += caps[i] - 1
-    continuous_steps_ideal = max(ideal)
-    free_at = [0] * max_batch   # next admission boundary per slot
-    finish = [0] * max_batch    # actual completion step per slot
-    for i in order:
-        k = min(range(max_batch), key=lambda j: free_at[j])
-        finish[k] = free_at[k] + caps[i] - 1
-        free_at[k] = -(-finish[k] // sync) * sync
-    continuous_steps = max(finish)
+    # Device-step accounting (the cost driver) via the package's shared
+    # structural model (models/serve.py:step_accounting) — static exact
+    # waves, the ideal packing bound, and the sync-quantized simulation
+    # of the real admission loop.  Batched-prefill admission: each
+    # request costs 1 prefill pass (done host-side between scans) +
+    # cap-1 decode loop steps.
+    steps = step_accounting(caps, max_batch, 8)
+    static_steps = steps["static_wave_steps"]
+    continuous_steps_ideal = steps["continuous_steps_ideal"]
+    continuous_steps = steps["continuous_steps_sync"]
     continuous_prefill_passes = n_req
     static_prefill_passes = len(waves)
 

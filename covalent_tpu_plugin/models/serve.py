@@ -169,6 +169,46 @@ def _make_run_steps(decoder, temperature, top_k, eos_token_id,
     return run_steps
 
 
+def step_accounting(
+    caps: Sequence[int], max_batch: int, sync_steps: int
+) -> dict[str, int]:
+    """Structural decode-step accounting for a serving workload: the
+    device-step counts that static wave batching and this module's
+    continuous loop pay for per-request budgets ``caps``, independent of
+    model size or transport.  One shared model for every artifact
+    (``bench.py`` ``lm_serve`` and ``benchmarks/serve_bench.py``) so the
+    accounting cannot drift from the admission rule implemented above.
+
+    Per-request cost is ``cap - 1`` decode steps (admission prefill
+    yields the first token; prefill passes are counted separately by the
+    callers).  Static: requests run in arrival-order waves of
+    ``max_batch``, each wave to its LONGEST member's budget.
+    Continuous: greedy slot packing in arrival order; a freed slot
+    re-admits only at the next ``sync_steps`` boundary (the
+    quantization ``continuous_generate``'s host loop actually pays),
+    with ``continuous_steps_ideal`` the unquantized packing bound.
+    """
+    caps = [int(c) for c in caps]
+    waves = [
+        caps[i:i + max_batch] for i in range(0, len(caps), max_batch)
+    ]
+    static = sum(max(w) - 1 for w in waves)
+    ideal = [0] * max_batch
+    free_at = [0] * max_batch
+    finish = [0] * max_batch
+    for cap in caps:
+        k = min(range(max_batch), key=lambda j: ideal[j])
+        ideal[k] += cap - 1
+        k = min(range(max_batch), key=lambda j: free_at[j])
+        finish[k] = free_at[k] + cap - 1
+        free_at[k] = -(-finish[k] // sync_steps) * sync_steps
+    return {
+        "static_wave_steps": static,
+        "continuous_steps_ideal": max(ideal),
+        "continuous_steps_sync": max(finish),
+    }
+
+
 def continuous_generate(
     model: TransformerLM,
     params: Any,
@@ -332,13 +372,6 @@ def continuous_generate(
             )
         return caches, buffer, pos, plen, row_cap, n_gen, done, rng
 
-    def harvest(state, slot):
-        _, buffer, _, plen_d, _, n_gen_d, _, _ = state
-        row = np.asarray(buffer[slot])
-        keep = int(plen_d[slot]) + int(n_gen_d[slot])
-        outputs[slot_req[slot]] = row[:keep]
-        slot_req[slot] = -1
-
     state = (
         caches, jnp.asarray(buffer), jnp.asarray(pos), jnp.asarray(plen),
         jnp.asarray(row_cap), jnp.asarray(n_gen), jnp.asarray(done), rng,
@@ -350,9 +383,23 @@ def continuous_generate(
     while True:
         state = run_steps(params, state)
         done_h = np.asarray(state[6])
-        for slot in range(batch):
-            if done_h[slot] and slot_req[slot] >= 0:
-                harvest(state, slot)
+        finished = [
+            s for s in range(batch) if done_h[s] and slot_req[s] >= 0
+        ]
+        if finished:
+            # Bulk-harvest: ONE fetch each of buffer/plen/n_gen per sync
+            # boundary instead of three per finished slot — on tunneled
+            # backends every fetch is a full host round trip, and this
+            # loop's host chatter is the serving throughput floor.
+            # Admissions below only mutate the admitted slot, so the
+            # pre-admission snapshot stays valid for the other rows.
+            buffer_h = np.asarray(state[1])
+            plen_h = np.asarray(state[3])
+            n_gen_h = np.asarray(state[5])
+            for slot in finished:
+                keep = int(plen_h[slot]) + int(n_gen_h[slot])
+                outputs[slot_req[slot]] = buffer_h[slot, :keep].copy()
+                slot_req[slot] = -1
                 if queue:
                     state = admit(state, slot)
         if not queue and all(r < 0 for r in slot_req):
