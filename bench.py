@@ -82,6 +82,70 @@ def spread_stats(values, prefix: str) -> dict:
     return out
 
 
+def load_last_known_good() -> dict | None:
+    """Newest committed self-run combined line, stamped with provenance.
+
+    Two rounds in a row the driver's bench window hit a tunnel outage and
+    the official BENCH_r{N}.json carried ~30 silent nulls (VERDICT r4
+    "what's weak" #1).  When the live preflight never passes, the final
+    combined line now attaches this sub-object — the TPU phase values
+    from the newest ``benchmarks/BENCH_SELF_r*.jsonl`` artifact, plus the
+    artifact path and its git commit date — under the explicitly-stale
+    key ``last_known_good``.  The live fields are NEVER backfilled: a
+    reader always sees which numbers were measured in this run (null on
+    outage) and which are carried evidence with a timestamp.
+    """
+    import glob
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    paths = sorted(glob.glob(os.path.join(here, "benchmarks",
+                                          "BENCH_SELF_r*.jsonl")))
+    for path in reversed(paths):
+        try:
+            combined = None
+            with open(path, encoding="utf-8") as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    obj = json.loads(line)
+                    if "metric" in obj:
+                        combined = obj
+            if combined is None:
+                continue
+            stamp = None
+            try:
+                out = subprocess.run(
+                    ["git", "log", "-1", "--format=%cI", "--",
+                     os.path.relpath(path, here)],
+                    cwd=here, capture_output=True, text=True, timeout=10,
+                )
+                stamp = out.stdout.strip() or None
+            except Exception:  # noqa: BLE001
+                pass
+            if stamp is None:
+                stamp = time.strftime(
+                    "%Y-%m-%dT%H:%M:%SZ", time.gmtime(os.path.getmtime(path))
+                )
+            # Only the accelerator-measured fields travel; the host-side
+            # dispatch numbers are re-measured live every run.
+            skip = {"metric", "value", "unit", "vs_baseline"}
+            return {
+                "provenance": "stale builder self-run artifact; live "
+                              "preflight failed this run",
+                "source": os.path.relpath(path, here),
+                "captured_at": stamp,
+                **{k: v for k, v in combined.items()
+                   if k not in skip and not k.startswith("fanout")
+                   and not k.startswith("dispatch_overhead")
+                   and not k.startswith("electron_wall")},
+            }
+        except Exception:  # noqa: BLE001
+            continue
+    return None
+
+
 def tpu_preflight(timeout_s: float) -> tuple[bool, float, str]:
     """Cheap tunnel-health probe in a throwaway subprocess.
 
@@ -561,8 +625,8 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
                     if tn > t1:
                         samples.append((tn - t1) / (iters - 1))
                 if not samples:
-                    return t1 / max(iters, 1), {"n_deltas": 0,
-                                                "note": "chain bound"}
+                    return tn / iters, {"n_deltas": 0,
+                                        "note": "chain bound"}
                 unit = stats_mod.median(samples)
                 spread = {
                     "n_deltas": len(samples),
@@ -1562,6 +1626,14 @@ async def main() -> None:
             ),
             "serve_complete": sub("lm_serve", "complete"),
         })
+    if sub("init", "backend") is None:
+        # Outage path: every accelerator field above is null.  Attach the
+        # newest committed self-run under an explicitly-stale key (never
+        # backfilled into the live fields) so the artifact self-describes
+        # instead of reading as "no evidence exists".
+        lkg = load_last_known_good()
+        if lkg is not None:
+            final["last_known_good"] = lkg
     emit(final)
 
 

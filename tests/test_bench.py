@@ -74,3 +74,19 @@ def test_tpu_preflight_timeout_reports_false():
     ok, took, err = bench.tpu_preflight(0.01)
     assert not ok
     assert "timeout" in err
+
+
+def test_last_known_good_is_stamped_and_never_live_shaped():
+    # VERDICT r4: an end-of-round outage must yield a self-describing
+    # artifact, not silent nulls.  The sub-object must carry provenance
+    # and must NOT look like live host-side measurements.
+    lkg = bench.load_last_known_good()
+    assert lkg is not None  # benchmarks/BENCH_SELF_r*.jsonl is committed
+    assert lkg["source"].startswith("benchmarks/BENCH_SELF_r")
+    assert "captured_at" in lkg and lkg["captured_at"]
+    assert "stale" in lkg["provenance"]
+    # Host-side fields are re-measured every run and excluded here.
+    assert "dispatch_overhead_s" not in lkg
+    assert not any(k.startswith("fanout") for k in lkg)
+    # At least the headline accelerator fields travel.
+    assert lkg.get("matmul4k_mfu") is not None
