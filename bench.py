@@ -1262,10 +1262,12 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
             ]
             caps = [short_cap if i % 2 else long_cap for i in range(n_req)]
 
+            serve_stats: dict = {}
+
             def run_serve():
                 return continuous_generate(
                     s_model, s_params, s_prompts, caps,
-                    max_batch=slots, sync_steps=sync,
+                    max_batch=slots, sync_steps=sync, stats=serve_stats,
                 )
 
             t0 = time.monotonic()
@@ -1284,15 +1286,17 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
             cont_steps = steps["continuous_steps_sync"]
             n_waves = -(-n_req // slots)
             static_wall = n_waves * serve_ctx["static_batch_s"]
-            # Host chatter estimate: one round trip per sync boundary
-            # plus one per harvested request — the tunnel-dominated cost
-            # the wall ratio carries that a host-attached TPU would not.
-            est_round_trips = -(-cont_steps // sync) + n_req
             structural = {
                 "n_requests": n_req,
                 "max_batch": slots,
                 "sync_steps": sync,
-                "est_host_round_trips": est_round_trips,
+                # Counters measured by the host loop itself
+                # (models/serve.py `stats`): fused admission waves and
+                # blocking fetches — the tunnel-dominated costs the wall
+                # ratio carries that a host-attached TPU would not.
+                "prefill_passes": serve_stats.get("prefill_passes"),
+                "sync_fetches": serve_stats.get("sync_fetches"),
+                "device_chunks": serve_stats.get("device_chunks"),
                 "caps_short_long": [short_cap, long_cap],
                 "complete": complete,
                 "compile_wall_s": round(compile_wall, 2),

@@ -80,31 +80,73 @@ def _choose_tokens(logits, key, temperature, top_k):
     return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
 
-@functools.lru_cache(maxsize=32)
-def _make_prefill(decoder, temperature, top_k, bucket):
-    """Jitted single-lane bucketed prefill: padded (1, bucket) tokens ->
-    (lane cache at cursor=plen, first generated token).
+@functools.lru_cache(maxsize=64)
+def _make_admit(decoder, temperature, top_k, eos_token_id, batch, bucket, g):
+    """One fused, donated admission wave: batch-prefill ``g`` prompts and
+    scatter their cache lanes, buffer rows, and cursors in a SINGLE
+    compiled call.
 
-    Pad positions' K/V land at slots >= plen; with the cursor rewound to
-    ``plen`` they are dead until the decode loop overwrites them (the
-    causal mask shows slot k only to queries at positions >= k, and the
-    loop writes slot k right before the first such query runs), so the
-    padded pass is exact — same trick as speculative decoding's cache
-    rewind (models/speculative.py)."""
+    Round 4's serving wall loss traced to admission overhead: every
+    admitted request paid its own single-lane prefill dispatch plus one
+    eager ``.at[slot].set`` per cache leaf (each a full-tree device
+    copy).  Here the whole wave is one executable with the serving state
+    donated, so XLA updates the caches in place and the prefill runs as
+    ONE (g, bucket) batched pass — admission cost scales with waves, not
+    requests.
 
-    @jax.jit
-    def prefill(params, cache, tokens, plen, key):
-        logits, mutated = decoder.apply(
-            {"params": params, "cache": cache}, tokens, mutable=["cache"]
+    Exactness of the padded pass: pad positions' K/V land at slots
+    >= plen; with the cursor rewound to ``plen`` they are dead until the
+    decode loop overwrites them (the causal mask shows slot k only to
+    queries at positions >= k) — same trick as speculative decoding's
+    cache rewind (models/speculative.py).  Rows whose ``slots`` entry is
+    out of range (the group padded up to a power of two) are dropped by
+    the scatters (``mode="drop"``), so padding never touches live state.
+    """
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def admit_wave(params, state, rows, padded, plens, slots, caps_in,
+                   keys):
+        # rows (g, length) full buffer rows; padded (g, bucket) prompt
+        # tokens; plens/caps_in/slots (g,); keys (g, 2) admission keys.
+        caches, buffer, pos, plen, row_cap, n_gen, done, rng = state
+
+        def lane_prefill(tokens, pl, key):
+            zero = jax.tree_util.tree_map(
+                lambda c: jnp.zeros(c.shape[1:], c.dtype), caches
+            )
+            logits, mutated = decoder.apply(
+                {"params": params, "cache": zero}, tokens[None],
+                mutable=["cache"],
+            )
+            cache = _set_cursor(mutated["cache"], pl)
+            last = jnp.take_along_axis(
+                logits, (pl - 1)[None, None, None], axis=1
+            )[0, 0]  # (V,)
+            first = _choose_tokens(
+                last[None, :], key, temperature, top_k
+            )[0]
+            return cache, first
+
+        new_lanes, firsts = jax.vmap(lane_prefill)(padded, plens, keys)
+        caches = jax.tree_util.tree_map(
+            lambda c, nl: c.at[slots].set(nl, mode="drop"),
+            caches, new_lanes,
         )
-        cache = _set_cursor(mutated["cache"], plen)
-        last = jnp.take_along_axis(
-            logits, (plen - 1)[None, None, None], axis=1
-        )[0, 0]  # (V,)
-        first = _choose_tokens(last[None, :], key, temperature, top_k)[0]
-        return cache, first
+        rows = rows.at[jnp.arange(g), plens].set(firsts)
+        buffer = buffer.at[slots].set(rows, mode="drop")
+        pos = pos.at[slots].set(plens, mode="drop")
+        plen = plen.at[slots].set(plens, mode="drop")
+        row_cap = row_cap.at[slots].set(caps_in, mode="drop")
+        n_gen = n_gen.at[slots].set(
+            jnp.ones((g,), jnp.int32), mode="drop"
+        )
+        fin = caps_in <= 1
+        if eos_token_id is not None:
+            fin = fin | (firsts == eos_token_id)
+        done = done.at[slots].set(fin, mode="drop")
+        return caches, buffer, pos, plen, row_cap, n_gen, done, rng
 
-    return prefill
+    return admit_wave
 
 
 @functools.lru_cache(maxsize=32)
@@ -158,8 +200,12 @@ def _make_run_steps(decoder, temperature, top_k, eos_token_id,
         pos = jnp.where(done, pos, pos + 1)
         return (caches, buffer, pos, plen, row_cap, n_gen, done, rng), None
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(1,))
     def run_steps(params, state):
+        # State donation lets XLA update the (B, layers, S, ...) caches in
+        # place: without it every sync chunk copies the full serving
+        # state tree host-visibly, which round 4's wall numbers showed
+        # dominating the toy-scale loop.
         state, _ = jax.lax.scan(
             functools.partial(one_step, params), state, None,
             length=sync_steps,
@@ -223,6 +269,7 @@ def continuous_generate(
     pad_token_id: int | None = None,
     sync_steps: int = 8,
     prefill: str = "batched",
+    stats: dict | None = None,
 ) -> list[np.ndarray]:
     """Serve ``prompts`` (each a 1-D int32 array) through ``max_batch``
     continuously-refilled slots; returns one trimmed output sequence per
@@ -238,6 +285,12 @@ def continuous_generate(
     cap_i)`` on batch-rounding-invariant backends (CPU f32/bf16; see the
     module docstring for the TPU-bf16 caveat shared with plain batched
     decode) — admission order cannot change tokens, only latency.
+
+    ``stats``, when given, is filled with host-loop counters:
+    ``prefill_passes`` (fused admission waves dispatched — the cost that
+    was one pass PER REQUEST before round 5), ``sync_fetches`` (blocking
+    host round-trips), and ``device_chunks`` (``sync_steps``-long scans
+    dispatched).
     """
     config = _decode_model(model).config
     if config.rolling_cache:
@@ -295,6 +348,10 @@ def continuous_generate(
         pad = eos_token_id if eos_token_id is not None else 0
     if rng is None:
         rng = jax.random.PRNGKey(0)
+    # The serving state (rng included) is donated to the jitted chunk and
+    # admission calls; a private copy keeps the CALLER's key buffer alive
+    # for their next call with the same array.
+    rng = jnp.array(rng, copy=True)
 
     # One cache lane per slot: stack B single-row caches.  Lane shape
     # keeps the model's own batch dim of 1, so the vmapped step calls the
@@ -327,62 +384,121 @@ def continuous_generate(
     slot_req = [-1] * batch  # original request index per slot
 
     adm_rng = {"key": jax.random.fold_in(rng, 0x5E1)}
+    # Host-side lower bound on decode steps until each slot can finish
+    # (exact without EOS; with EOS a slot may finish earlier, which only
+    # delays its harvest, never corrupts it — frozen rows hold position).
+    min_left = [0] * batch
+    if stats is not None:
+        stats.update(prefill_passes=0, sync_fetches=0, device_chunks=0)
 
-    def admit(state, slot):
+    def _count(key, by=1):
+        if stats is not None:
+            stats[key] += by
+
+    def admit_stream(state, slot):
+        """Streaming admission: the prompt replays through the shared
+        step loop one token per step (zero extra compiles)."""
         caches, buffer, pos, plen, row_cap, n_gen, done, rng = state
         req_idx, tokens, cap = queue.pop(0)
         slot_req[slot] = req_idx
+        min_left[slot] = tokens.size - 1 + cap
         row = np.full((length,), pad, np.int32)
         row[: tokens.size] = tokens
         buffer = buffer.at[slot].set(jnp.asarray(row))
         plen = plen.at[slot].set(tokens.size)
         row_cap = row_cap.at[slot].set(cap)
-        if prefill == "batched":
-            # One padded prefill pass; the slot enters the loop already
-            # holding its first generated token (see module docstring).
+        pos = pos.at[slot].set(0)
+        n_gen = n_gen.at[slot].set(0)
+        done = done.at[slot].set(False)
+        caches = jax.tree_util.tree_map(
+            lambda c, z: c.at[slot].set(z), caches, lane_zero
+        )
+        return caches, buffer, pos, plen, row_cap, n_gen, done, rng
+
+    def admit_group(state, free_slots):
+        """Admit up to ``len(free_slots)`` queued requests in fused
+        waves: one `_make_admit` call per prompt bucket, each group
+        padded to a power of two to bound the compile count at
+        O(buckets x log2(batch))."""
+        if prefill == "stream":
+            for slot in free_slots:
+                if queue:
+                    state = admit_stream(state, slot)
+            return state
+        picked = []  # (slot, req_idx, tokens, cap, key, bucket)
+        for slot in free_slots:
+            if not queue:
+                break
+            req_idx, tokens, cap = queue.pop(0)
+            slot_req[slot] = req_idx
+            min_left[slot] = cap - 1
             bucket = min(
                 1 << (int(tokens.size) - 1).bit_length(), config.max_seq
             )
-            pf = _make_prefill(
-                decoder, float(temperature), top_k, int(bucket)
-            )
-            padded = np.full((1, bucket), pad, np.int32)
-            padded[0, : tokens.size] = tokens
+            # The documented per-admission key chain: one split per
+            # admitted request, in admission order, regardless of how
+            # admissions group into waves.
             adm_rng["key"], key = jax.random.split(adm_rng["key"])
-            new_lane, first = pf(
-                params, lane_zero, jnp.asarray(padded),
-                jnp.asarray(tokens.size, jnp.int32), key,
+            picked.append((slot, req_idx, tokens, cap, key, bucket))
+        for bucket in sorted({p[5] for p in picked}):
+            group = [p for p in picked if p[5] == bucket]
+            g = 1 << (len(group) - 1).bit_length()  # pad to power of two
+            rows = np.full((g, length), pad, np.int32)
+            padded = np.full((g, bucket), pad, np.int32)
+            plens = np.ones(g, np.int32)
+            slots = np.full(g, batch, np.int32)  # OOB rows are dropped
+            caps_in = np.ones(g, np.int32)
+            keys = [jax.random.PRNGKey(0)] * g
+            for r, (slot, _, tokens, cap, key, _) in enumerate(group):
+                rows[r, : tokens.size] = tokens
+                padded[r, : tokens.size] = tokens
+                plens[r] = tokens.size
+                slots[r] = slot
+                caps_in[r] = cap
+                keys[r] = key
+            wave = _make_admit(
+                decoder, float(temperature), top_k, eos_token_id,
+                int(batch), int(bucket), int(g),
             )
-            caches = jax.tree_util.tree_map(
-                lambda c, nl: c.at[slot].set(nl), caches, new_lane
+            state = wave(
+                params, state, jnp.asarray(rows), jnp.asarray(padded),
+                jnp.asarray(plens), jnp.asarray(slots),
+                jnp.asarray(caps_in), jnp.stack(keys),
             )
-            buffer = buffer.at[slot, tokens.size].set(first)
-            pos = pos.at[slot].set(tokens.size)
-            n_gen = n_gen.at[slot].set(1)
-            fin = jnp.asarray(cap <= 1)
-            if eos_token_id is not None:
-                fin = fin | (first == eos_token_id)
-            done = done.at[slot].set(fin)
-        else:
-            pos = pos.at[slot].set(0)
-            n_gen = n_gen.at[slot].set(0)
-            done = done.at[slot].set(False)
-            caches = jax.tree_util.tree_map(
-                lambda c, z: c.at[slot].set(z), caches, lane_zero
-            )
-        return caches, buffer, pos, plen, row_cap, n_gen, done, rng
+            _count("prefill_passes")
+        return state
 
     state = (
         caches, jnp.asarray(buffer), jnp.asarray(pos), jnp.asarray(plen),
         jnp.asarray(row_cap), jnp.asarray(n_gen), jnp.asarray(done), rng,
     )
-    for slot in range(batch):
-        if queue:
-            state = admit(state, slot)
+    state = admit_group(state, list(range(batch)))
 
     while True:
-        state = run_steps(params, state)
+        # Run as many sync chunks as the host can PROVE are finish-free
+        # before paying a blocking fetch: with no EOS the per-slot budget
+        # bound is exact, so fetches happen only at boundaries where a
+        # request can actually complete.  With EOS the loop always stays
+        # at one chunk per fetch — a slot can finish any step, and
+        # multi-chunking would keep stepping frozen rows for up to the
+        # residual cap after every live row has stopped.
+        active = [s for s in range(batch) if slot_req[s] >= 0]
+        chunks = 1
+        if eos_token_id is None:
+            # Without EOS the budget bound is exact, so this skips only
+            # provably finish-free fetches.  With EOS a slot can finish
+            # any step, and multi-chunking would keep stepping frozen
+            # rows for up to the residual cap after every live row has
+            # stopped — one chunk per fetch stays the honest choice.
+            bound = min((min_left[s] for s in active), default=1)
+            chunks = max(1, -(-bound // sync_steps))
+        for _ in range(chunks):
+            state = run_steps(params, state)
+        _count("device_chunks", chunks)
+        for s in active:
+            min_left[s] = max(min_left[s] - chunks * sync_steps, 0)
         done_h = np.asarray(state[6])
+        _count("sync_fetches")
         finished = [
             s for s in range(batch) if done_h[s] and slot_req[s] >= 0
         ]
@@ -391,7 +507,7 @@ def continuous_generate(
             # boundary instead of three per finished slot — on tunneled
             # backends every fetch is a full host round trip, and this
             # loop's host chatter is the serving throughput floor.
-            # Admissions below only mutate the admitted slot, so the
+            # Admissions below only mutate freed slots, so the
             # pre-admission snapshot stays valid for the other rows.
             buffer_h = np.asarray(state[1])
             plen_h = np.asarray(state[3])
@@ -400,8 +516,8 @@ def continuous_generate(
                 keep = int(plen_h[slot]) + int(n_gen_h[slot])
                 outputs[slot_req[slot]] = buffer_h[slot, :keep].copy()
                 slot_req[slot] = -1
-                if queue:
-                    state = admit(state, slot)
+            if queue:
+                state = admit_group(state, finished)
         if not queue and all(r < 0 for r in slot_req):
             break
     return outputs  # type: ignore[return-value]

@@ -75,7 +75,13 @@ _EXECUTOR_PLUGIN_DEFAULTS = {
     "project": "",
     "use_internal_ips": False,
     "ssh_key_file": os.path.join("~", ".ssh", "id_rsa"),
+    # "ssh" auto-picks an SSH backend (asyncssh > OpenSSH binaries >
+    # vendored minissh); "minissh" pins the vendored pure-python stack
+    # (transport/minissh.py); "local" runs workers in-place.
     "transport": "ssh",
+    # minissh/asyncssh-pinning extras: path to the server's public host
+    # key for strict checking (empty = rely on strict_host_keys=False).
+    "known_host_key_file": "",
     "cache_dir": os.path.join("~", ".cache", "covalent-tpu"),
     "python_path": "python3",
     "conda_env": "",
@@ -170,6 +176,7 @@ class TPUExecutor(RemoteExecutor):
         use_internal_ips: bool | None = None,
         ssh_key_file: str | None = None,
         transport: str | None = None,
+        known_host_key_file: str | None = None,
         cache_dir: str | None = None,
         python_path: str | None = None,
         conda_env: str | None = None,
@@ -209,6 +216,14 @@ class TPUExecutor(RemoteExecutor):
         #: discovery cache: [(external_ip, internal_ip)] per worker.
         self._discovered_endpoints: list[tuple[str, str]] | None = None
         self.transport_kind = resolve(transport, "transport")
+        if self.transport_kind not in ("local", "ssh", "minissh"):
+            raise ValueError(
+                f'transport must be "local", "ssh" or "minissh", '
+                f"got {self.transport_kind!r}"
+            )
+        self.known_host_key_file = str(
+            resolve(known_host_key_file, "known_host_key_file") or ""
+        )
         self.ssh_key_file = str(
             Path(resolve(ssh_key_file, "ssh_key_file")).expanduser().resolve()
         )
@@ -379,6 +394,8 @@ class TPUExecutor(RemoteExecutor):
             ssh_key_file=self.ssh_key_file,
             port=port or 22,
             strict_host_keys=self.strict_host_keys,
+            backend="minissh" if self.transport_kind == "minissh" else "auto",
+            known_host_key=self.known_host_key_file or None,
         )
 
     async def _client_connect(self, address: str) -> Transport:

@@ -8,8 +8,11 @@ quirks":
 * host-key verification is ON by default (the reference passes
   ``known_hosts=None``, disabling it — ``ssh.py:267``);
 * the backend degrades gracefully: asyncssh when importable, otherwise the
-  OpenSSH client binaries (``ssh``/``scp``) driven over subprocess, so the
-  control plane works on minimal TPU-VM images where asyncssh may be absent.
+  OpenSSH client binaries (``ssh``/``scp``) driven over subprocess, and as
+  the last rung the vendored pure-python SSH2 stack (:mod:`.minissh`,
+  built on ``cryptography``), so the control plane works on minimal
+  TPU-VM images where asyncssh — or ANY ssh stack — may be absent.
+  ``backend=`` pins one explicitly ("asyncssh" / "openssh" / "minissh").
 
 Retry semantics match the reference exactly: up to ``max_attempts`` tries
 (default 5, ``ssh.py:90``) sleeping ``retry_wait_time`` between them (default
@@ -62,7 +65,15 @@ class SSHTransport(Transport):
         port: int = 22,
         strict_host_keys: bool = True,
         connect_timeout: float = 30.0,
+        backend: str = "auto",
+        password: str = "",
+        known_host_key=None,
     ) -> None:
+        if backend not in ("auto", "asyncssh", "openssh", "minissh"):
+            raise ValueError(
+                f'backend must be "auto"/"asyncssh"/"openssh"/"minissh", '
+                f"got {backend!r}"
+            )
         self.hostname = hostname
         self.username = username
         self.ssh_key_file = ssh_key_file
@@ -70,13 +81,50 @@ class SSHTransport(Transport):
         self.strict_host_keys = strict_host_keys
         self.connect_timeout = connect_timeout
         self.address = f"{username}@{hostname}" if username else hostname
-        self._conn = None  # asyncssh connection when that backend is active
-        self._use_asyncssh = _HAVE_ASYNCSSH
+        self._conn = None  # asyncssh/minissh connection when active
+        self.password = password
+        self.known_host_key = known_host_key
+        if backend == "auto":
+            # Degradation ladder: asyncssh > OpenSSH binaries > vendored
+            # pure-python stack.  Resolved here (not per-call) so one
+            # transport never straddles two backends.
+            if _HAVE_ASYNCSSH:
+                backend = "asyncssh"
+            elif shutil.which("ssh") is not None:
+                backend = "openssh"
+            else:
+                backend = "minissh"
+        self.backend = backend
+        self._use_asyncssh = backend == "asyncssh"
         self._closed = False
 
     # -- handshake -----------------------------------------------------------
 
     async def _open(self) -> None:
+        if self.backend == "minissh":
+            from . import minissh
+
+            known = self.known_host_key
+            if isinstance(known, (str, bytes)) and known:
+                from cryptography.hazmat.primitives import serialization
+
+                with open(known, "rb") as fh:
+                    known = serialization.load_ssh_public_key(fh.read())
+            if self.strict_host_keys and known is None:
+                raise TransportError(
+                    "minissh backend with strict_host_keys=True needs "
+                    "known_host_key (a key object or public-key file path)"
+                )
+            self._conn = await minissh.connect(
+                self.hostname,
+                self.port,
+                self.username or "root",
+                password=self.password or None,
+                client_key=self.ssh_key_file or None,
+                known_host_key=known if self.strict_host_keys else None,
+                connect_timeout=self.connect_timeout,
+            )
+            return
         if self._use_asyncssh:
             kwargs = dict(
                 username=self.username or None,
@@ -147,6 +195,11 @@ class SSHTransport(Transport):
         if self._closed:
             raise TransportError("transport is closed")
         describe = describe or f"{self.address}:{command.split()[0]}"
+        if self.backend == "minissh":
+            from .process import TransportProcess
+
+            proc = await self._conn.open_exec(command)
+            return TransportProcess(proc.stdout, proc.stdin, proc, describe)
         if self._use_asyncssh:
             from .process import TransportProcess
 
@@ -159,6 +212,11 @@ class SSHTransport(Transport):
     async def run(self, command: str, timeout: float | None = None) -> CommandResult:
         if self._closed:
             raise TransportError("transport is closed")
+        if self.backend == "minissh":
+            res = await asyncio.wait_for(self._conn.run(command), timeout)
+            return CommandResult(
+                exit_status=res.exit_status, stdout=res.stdout, stderr=res.stderr
+            )
         if self._use_asyncssh:
             proc = await asyncio.wait_for(self._conn.run(command), timeout)
             return CommandResult(
@@ -169,6 +227,9 @@ class SSHTransport(Transport):
         return await self._exec_openssh(command, timeout)
 
     async def put(self, local_path: str, remote_path: str) -> None:
+        if self.backend == "minissh":
+            await self._conn.put(local_path, remote_path)
+            return
         if self._use_asyncssh:
             await asyncssh.scp(local_path, (self._conn, remote_path))
             return
@@ -180,6 +241,9 @@ class SSHTransport(Transport):
             raise TransportError(f"scp upload failed: {result.stderr.strip()}")
 
     async def get(self, remote_path: str, local_path: str) -> None:
+        if self.backend == "minissh":
+            await self._conn.get(remote_path, local_path)
+            return
         if self._use_asyncssh:
             await asyncssh.scp((self._conn, remote_path), local_path)
             return
@@ -194,7 +258,10 @@ class SSHTransport(Transport):
         if self._closed:
             return
         self._closed = True
-        if self._use_asyncssh and self._conn is not None:
+        if (
+            (self._use_asyncssh or self.backend == "minissh")
+            and self._conn is not None
+        ):
             self._conn.close()
             await self._conn.wait_closed()
 

@@ -1,0 +1,131 @@
+"""Cross-interop: the vendored SSH2 stack against asyncssh, both roles.
+
+The point of these tests is to prove ``transport/minissh.py`` speaks the
+actual SSH protocol rather than a self-consistent private dialect: an
+independent implementation (asyncssh) must kex, authenticate, and run
+exec channels against it in BOTH directions.  The build sandbox has no
+asyncssh (that absence is why minissh exists), so these skip there and
+run in CI's interop job, which installs asyncssh
+(``.github/workflows/tests.yml`` interop step).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+asyncssh = pytest.importorskip("asyncssh")
+
+from cryptography.hazmat.primitives import serialization  # noqa: E402
+from cryptography.hazmat.primitives.asymmetric import ed25519  # noqa: E402
+
+from covalent_tpu_plugin.transport import minissh  # noqa: E402
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_asyncssh_client_against_minissh_server(tmp_path):
+    """asyncssh (independent implementation) connects TO our server."""
+
+    async def flow():
+        server = await minissh.serve(users={"u": "pw"})
+        try:
+            conn = await asyncssh.connect(
+                "127.0.0.1",
+                port=server.port,
+                username="u",
+                password="pw",
+                known_hosts=None,
+                client_keys=None,
+            )
+            result = await conn.run("echo interop; exit 5")
+            assert result.stdout == "interop\n"
+            assert result.exit_status == 5
+            conn.close()
+            await conn.wait_closed()
+        finally:
+            server.close()
+            await server.wait_closed()
+
+    run(flow())
+
+
+def test_minissh_client_against_asyncssh_server(tmp_path):
+    """Our client connects TO an asyncssh-served sshd."""
+
+    class Server(asyncssh.SSHServer):
+        def begin_auth(self, username):
+            return True
+
+        def password_auth_supported(self):
+            return True
+
+        def validate_password(self, username, password):
+            return username == "u" and password == "pw"
+
+    def session_factory(process):
+        process.stdout.write("from-asyncssh\n")
+        process.exit(9)
+
+    async def flow():
+        host_key = asyncssh.generate_private_key("ssh-ed25519")
+        server = await asyncssh.create_server(
+            Server,
+            "127.0.0.1",
+            0,
+            server_host_keys=[host_key],
+            process_factory=session_factory,
+        )
+        port = server.sockets[0].getsockname()[1]
+        try:
+            conn = await minissh.connect(
+                "127.0.0.1", port, "u", password="pw"
+            )
+            res = await conn.run("anything")
+            assert res.stdout == "from-asyncssh\n"
+            assert res.exit_status == 9
+            conn.close()
+            await conn.wait_closed()
+        finally:
+            server.close()
+            await server.wait_closed()
+
+    run(flow())
+
+
+def test_publickey_interop_asyncssh_client(tmp_path):
+    """asyncssh authenticates to our server with an ed25519 key written by
+    the cryptography library — the full key-file format chain."""
+
+    async def flow():
+        key = ed25519.Ed25519PrivateKey.generate()
+        key_path = tmp_path / "id_ed25519"
+        key_path.write_bytes(
+            key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.OpenSSH,
+                serialization.NoEncryption(),
+            )
+        )
+        server = await minissh.serve(authorized_keys=[key])
+        try:
+            conn = await asyncssh.connect(
+                "127.0.0.1",
+                port=server.port,
+                username="bob",
+                client_keys=[str(key_path)],
+                known_hosts=None,
+            )
+            result = await conn.run("printf pk-interop")
+            assert result.stdout == "pk-interop"
+            assert result.exit_status == 0
+            conn.close()
+            await conn.wait_closed()
+        finally:
+            server.close()
+            await server.wait_closed()
+
+    run(flow())

@@ -209,11 +209,35 @@ def test_cli_argv_shapes():
     assert "StrictHostKeyChecking=no" not in " ".join(strict)
 
 
-def test_no_backend_at_all(fake_ssh_bin, monkeypatch, run_async):
+def test_auto_falls_through_to_minissh(monkeypatch):
+    """With no asyncssh and no ssh binary on PATH, auto resolves to the
+    vendored pure-python stack instead of failing — an image with NO ssh
+    stack at all still gets a working control plane (round 5)."""
     monkeypatch.setenv("PATH", "/nonexistent")
-    t = make_cli_transport()
+    t = SSHTransport(hostname="127.0.0.1")
+    assert t.backend == "minissh"
+    assert not t._use_asyncssh
+
+
+def test_pinned_openssh_without_binary_fails(fake_ssh_bin, monkeypatch,
+                                             run_async):
+    t = make_cli_transport(backend="openssh")
+    monkeypatch.setenv("PATH", "/nonexistent")
     with pytest.raises(TransportError, match="no SSH backend"):
         run_async(t._open())
+
+
+def test_minissh_strict_without_known_key_fails(run_async):
+    t = SSHTransport(
+        hostname="127.0.0.1", backend="minissh", strict_host_keys=True
+    )
+    with pytest.raises(TransportError, match="known_host_key"):
+        run_async(t._open())
+
+
+def test_invalid_backend_rejected():
+    with pytest.raises(ValueError, match="backend"):
+        SSHTransport(hostname="h", backend="telnet")
 
 
 # --------------------------------------------------------------------- #
