@@ -787,6 +787,59 @@ def accelerator_electron(progress_path: str, budget_s: float) -> dict:
                 final_loss=round(final_loss, 4),
                 **spread,
             )
+
+            # Fused-xent arm (VERDICT r4 #7): the same step with the
+            # vocab-chunked loss (ops/xent.py) — the lm_head matmul runs
+            # bf16-native and the (B,S,V) logits tensor never reaches
+            # HBM.  A/B against the standard arm above; own try so a
+            # fused failure can't void the standard number.
+            if remaining() > 40:
+                try:
+                    v_chunk = min(8192, config.vocab_size)
+
+                    @jax.jit
+                    def step_fused(state, tokens):
+                        loss, grads = jax.value_and_grad(
+                            lambda p: lm_loss(
+                                p, state.apply_fn, {"tokens": tokens},
+                                vocab_chunk=v_chunk,
+                            )
+                        )(state.params)
+                        return state.apply_gradients(grads=grads), loss
+
+                    holder_f = {"state": holder["state"]}
+
+                    def dispatch_f():
+                        holder_f["state"], holder_f["loss"] = step_fused(
+                            holder_f["state"], tokens
+                        )
+
+                    def fetch_f():
+                        holder_f["final"] = float(
+                            jax.device_get(holder_f["loss"])
+                        )
+
+                    fused_s, fspread = unit_seconds(
+                        dispatch_f, fetch_f, target_s=4.0, cap=10
+                    )
+                    f_tflops = 6 * n_params * bsz * seq / fused_s / 1e12
+                    f_mfu, f_warn = mfu(f_tflops)
+                    report(
+                        "lm_step_fused",
+                        vocab_chunk=v_chunk,
+                        step_ms=round(fused_s * 1e3, 1),
+                        tokens_per_s=round(bsz * seq / fused_s),
+                        tflops_6nd=round(f_tflops, 2),
+                        mfu=f_mfu,
+                        **({"mfu_warning": f_warn} if f_warn else {}),
+                        speedup_vs_std_step=round(step_s / fused_s, 3),
+                        final_loss=round(holder_f["final"], 4),
+                        **fspread,
+                    )
+                except Exception as error:  # noqa: BLE001
+                    report("lm_step_fused", error=repr(error))
+            else:
+                report("lm_step_fused", skipped="budget")
         except Exception as error:  # noqa: BLE001
             report("lm_step", error=repr(error))
     else:
@@ -1619,6 +1672,15 @@ async def main() -> None:
     # The serving phase is a beyond-parity bonus that self-skips on tight
     # budgets; merge its fields only when it actually measured, so a
     # skipped run does not re-introduce null TPU fields.
+    # Measured-only merges (no new nullable keys on outage/skip paths).
+    if sub("lm_step_fused", "step_ms") is not None:
+        final.update({
+            "lm125m_fused_step_ms": sub("lm_step_fused", "step_ms"),
+            "lm125m_fused_mfu": sub("lm_step_fused", "mfu"),
+            "lm125m_fused_speedup": sub(
+                "lm_step_fused", "speedup_vs_std_step"
+            ),
+        })
     if sub("lm_serve", "tokens_per_s") is not None:
         final.update({
             "serve_tokens_per_s": sub("lm_serve", "tokens_per_s"),

@@ -1,0 +1,38 @@
+#!/bin/bash
+# Round-5 TPU evidence harvest — run the moment the tunnel is up.
+# Priority order mirrors what the round still owes hardware numbers for:
+#   1. bench.py full TPU phases  -> benchmarks/BENCH_SELF_r05.jsonl
+#      (includes lm_step_fused A/B and the lm_serve wall)
+#   2. windowed chained sweep    -> benchmarks/WINDOW_SWEEP_CHAIN_r05.jsonl
+#      (interior-tile fast path: does w=1k now clear 5x? 512^2 vs 1024^2)
+#   3. serving bench             -> benchmarks/SERVE_BENCH_TPU_r05.json
+#   4. spec realism curve        -> benchmarks/SPEC_REALISM_TPU_r05.json
+# Each step is its own process with a hard timeout: a mid-harvest tunnel
+# death loses one artifact, not the run.  Compile cache is shared at
+# /tmp/covalent-tpu-jax-cache-$UID (r4 protocol).
+set -u
+cd "$(dirname "$0")/.."
+STAMP=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+echo "harvest start $STAMP"
+
+echo "== 1/4 bench.py (TPU phases) =="
+BENCH_TPU_BUDGET_S=${BENCH_TPU_BUDGET_S:-540} timeout 1500 \
+  python bench.py > benchmarks/BENCH_SELF_r05.jsonl 2>benchmarks/harvest_bench.err
+echo "bench rc=$? lines=$(wc -l < benchmarks/BENCH_SELF_r05.jsonl)"
+
+echo "== 2/4 windowed chain sweep =="
+timeout 1800 python benchmarks/sweep_window.py \
+  > benchmarks/WINDOW_SWEEP_CHAIN_r05.jsonl 2>benchmarks/harvest_sweep.err
+echo "sweep rc=$?"
+
+echo "== 3/4 serve bench =="
+timeout 900 python benchmarks/serve_bench.py \
+  > benchmarks/SERVE_BENCH_TPU_r05.json 2>benchmarks/harvest_serve.err
+echo "serve rc=$?"
+
+echo "== 4/4 spec realism =="
+timeout 1800 python benchmarks/spec_realism.py \
+  > benchmarks/SPEC_REALISM_TPU_r05.json 2>benchmarks/harvest_spec.err
+echo "spec rc=$?"
+
+echo "harvest done $(date -u +%Y-%m-%dT%H:%M:%SZ)"

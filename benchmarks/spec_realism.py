@@ -1,0 +1,285 @@
+"""Speculative decoding under draft-quality degradation (VERDICT r4 #5).
+
+Every prior acceptance number (0.97) came from the easiest possible
+drafting task: a draft trained on the SAME affine-bigram stream as the
+target.  This experiment measures the acceptance → speedup curve as the
+draft degrades, so the headline is anchored to a curve rather than one
+easy-mode point:
+
+* ``trained``   — draft trained on the target's stream (the easy mode);
+* ``half``      — draft trained 1/8 as long (undertrained);
+* ``shifted``   — draft trained on a DIFFERENT affine map (A,B swapped
+  for other constants): systematically wrong next-token rule, the
+  synthetic analog of a draft from another domain;
+* ``untrained`` — randomly initialized draft (worst case, acceptance
+  ≈ top-1 agreement of two unrelated models);
+* ``sampled``   — the trained pair at temperature 0.8 / top_k 40 through
+  ``speculative_sample`` (rejection-sampling acceptance — the
+  distribution-exact regime, where acceptance is probabilistic even for
+  a perfect draft).
+
+For each arm: acceptance rate, rounds, wall tokens/s for speculative vs
+plain decode of the SAME target (A/B alternated, median of 3), and the
+structural tokens-per-target-pass.  Output: one JSON line per arm plus a
+combined summary line, committed as ``SPEC_REALISM_{backend}_rNN.json``.
+
+Run: ``python benchmarks/spec_realism.py`` (TPU when the tunnel is up;
+``JAX_PLATFORMS=cpu`` otherwise — acceptance and structure are
+backend-independent, wall ratios are per-backend).
+"""
+from __future__ import annotations
+
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+import _bootstrap  # noqa: F401
+
+import json
+import statistics
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+
+from covalent_tpu_plugin.models import (  # noqa: E402
+    TransformerLM,
+    generate,
+    inference_params,
+    lm_125m_config,
+    speculative_generate,
+    speculative_sample,
+)
+from covalent_tpu_plugin.models.data import synthetic_lm_batch  # noqa: E402
+from covalent_tpu_plugin.models.train import TrainState, lm_loss  # noqa: E402
+from covalent_tpu_plugin.ops.attention import on_tpu  # noqa: E402
+
+
+def main() -> None:
+    small = not on_tpu()
+    if small:
+        vocab, seq, sbsz = 512, 128, 16
+        t_steps, d_steps = 30, 64
+        spec_new, spec_prompt, spec_bsz = 48, 16, 2
+        t_dims = dict(d_model=256, n_layers=6, n_heads=4, d_ff=1024)
+        draft_len = 4
+    else:
+        vocab, seq, sbsz = 512, 128, 32
+        t_steps, d_steps = 120, 300
+        spec_new, spec_prompt, spec_bsz = 192, 32, 8
+        t_dims = {}  # 125M-class (768 x 12)
+        draft_len = 6
+    cap = spec_prompt + spec_new + draft_len + 1
+    t_cfg = lm_125m_config(
+        vocab_size=vocab, max_seq=max(seq, cap), scan_layers=False, **t_dims
+    )
+    d_cfg = lm_125m_config(
+        vocab_size=vocab, d_model=128, n_layers=2, n_heads=4, d_ff=512,
+        max_seq=max(seq, cap), scan_layers=False,
+    )
+
+    import numpy as np
+
+    def corrupted_lm_batch(batch_size, seq_len, seed, wrong_frac):
+        """The affine stream, except token VALUES below ``wrong_frac *
+        vocab`` follow a different successor rule.  A draft trained on
+        this learns the wrong next-token for ~that fraction of values, so
+        its greedy top-1 agreement with the target is ≈ (1-wrong_frac)
+        per position — a SMOOTH acceptance knob, unlike whole-batch
+        mixtures (the deterministic stream makes batch-level mixing
+        bimodal: the draft's top-1 either matches the true rule or
+        doesn't, so measured acceptance snaps to ~0 or ~0.9)."""
+        rng = np.random.default_rng(seed)
+        tokens = np.empty((batch_size, seq_len), np.int64)
+        tokens[:, 0] = rng.integers(0, vocab, batch_size)
+        resets = rng.random((batch_size, seq_len)) < 0.05
+        randoms = rng.integers(0, vocab, (batch_size, seq_len))
+        cut = int(wrong_frac * vocab)
+        for t in range(1, seq_len):
+            prev = tokens[:, t - 1]
+            follow = np.where(
+                prev < cut, (prev * 11 + 5) % vocab, (prev * 7 + 3) % vocab
+            )
+            tokens[:, t] = np.where(resets[:, t], randoms[:, t], follow)
+        return tokens.astype(np.int32)
+
+    def train_lm(cfg, model_seed, train_steps, affine=None, wrong_frac=None):
+        """``affine``: (A, B) override for the stream's next-token rule —
+        the 'shifted distribution' arm trains its draft on a different
+        map than the one the target (and the eval prompts) follow.
+        ``wrong_frac``: train on the value-conditionally corrupted stream
+        instead (the mid-range acceptance knob)."""
+        from covalent_tpu_plugin.models import data as data_mod
+
+        model = TransformerLM(cfg)
+        tokens0 = jnp.asarray(
+            synthetic_lm_batch(sbsz, seq + 1, vocab, seed=0)["tokens"]
+        )
+        params = model.init(
+            jax.random.PRNGKey(model_seed), tokens0[:, :-1]
+        )["params"]
+        if train_steps == 0:
+            return model, inference_params(params), float("nan")
+        state = TrainState.create(
+            apply_fn=model.apply, params=params, tx=optax.adamw(1e-3)
+        )
+
+        @jax.jit
+        def step(state, tokens):
+            loss, grads = jax.value_and_grad(
+                lambda p: lm_loss(p, state.apply_fn, {"tokens": tokens})
+            )(state.params)
+            return state.apply_gradients(grads=grads), loss
+
+        loss = None
+        saved = (data_mod._A, data_mod._B)
+        try:
+            if affine is not None:
+                data_mod._A, data_mod._B = affine
+            for i in range(train_steps):
+                if wrong_frac is not None:
+                    tokens = jnp.asarray(
+                        corrupted_lm_batch(sbsz, seq + 1, 1 + i, wrong_frac)
+                    )
+                else:
+                    tokens = jnp.asarray(
+                        synthetic_lm_batch(sbsz, seq + 1, vocab, seed=1 + i)[
+                            "tokens"
+                        ]
+                    )
+                state, loss = step(state, tokens)
+        finally:
+            data_mod._A, data_mod._B = saved
+        return model, inference_params(state.params), float(
+            jax.device_get(loss)
+        )
+
+    print("training target...", file=sys.stderr, flush=True)
+    target_model, target_params, t_loss = train_lm(t_cfg, 1, t_steps)
+    drafts = {
+        "trained": train_lm(d_cfg, 2, d_steps),
+        "half": train_lm(d_cfg, 2, max(d_steps // 8, 4)),
+        # Value-corruption arms: the draft learns the WRONG successor for
+        # a fraction of token values — the knob that lands acceptance in
+        # the mid-range the curve needs (VERDICT r4 asked for
+        # ~{0.5, 0.7, 0.97} points).
+        "wrong-5pct": train_lm(d_cfg, 2, d_steps, wrong_frac=0.05),
+        "wrong-15pct": train_lm(d_cfg, 2, d_steps, wrong_frac=0.15),
+        "wrong-30pct": train_lm(d_cfg, 2, d_steps, wrong_frac=0.30),
+        # A=11, B=5: a different affine cycle over the same vocab (7,3 is
+        # the real stream's rule — models/data.py:19).
+        "shifted": train_lm(d_cfg, 2, d_steps, affine=(11, 5)),
+        "untrained": train_lm(d_cfg, 3, 0),
+    }
+
+    prompt = jnp.asarray(
+        synthetic_lm_batch(spec_bsz, spec_prompt, vocab, seed=999)["tokens"]
+    )
+    plain = jax.jit(
+        lambda p, t: generate(target_model, p, t, max_new_tokens=spec_new)
+    )
+    jax.device_get(plain(target_params, prompt)[0, -1])  # compile once
+
+    def time_arm(fn, *args):
+        walls = []
+        for _ in range(3):
+            t0 = time.monotonic()
+            out = fn(*args)
+            out = out[0] if isinstance(out, tuple) else out
+            jax.device_get(out[0, -1])
+            walls.append(time.monotonic() - t0)
+        return statistics.median(walls), walls
+
+    plain_s, plain_walls = time_arm(plain, target_params, prompt)
+
+    rows = []
+    for name, (d_model_, d_params_, d_loss_) in drafts.items():
+        spec = jax.jit(
+            lambda tp, dp, t, dm=d_model_: speculative_generate(
+                target_model, tp, dm, dp, t, spec_new,
+                draft_len=draft_len, return_stats=True,
+            )
+        )
+        out_spec, stats = spec(target_params, d_params_, prompt)
+        out_plain = plain(target_params, prompt)
+        exact = bool(jax.device_get((out_plain == out_spec).all()))
+        rounds = int(jax.device_get(stats["rounds"]))
+        accept = (spec_new - 1 - rounds) / max(rounds * draft_len, 1)
+        spec_s, spec_walls = time_arm(spec, target_params, d_params_, prompt)
+        row = {
+            "arm": name,
+            "draft_loss": round(d_loss_, 3),
+            "accept_rate": round(accept, 3),
+            "rounds": rounds,
+            "tokens_per_target_pass": round((spec_new - 1) / rounds, 2),
+            "spec_tokens_per_s": round(spec_bsz * spec_new / spec_s),
+            "speedup_vs_plain": round(plain_s / spec_s, 3),
+            "exact": exact,
+            "spec_s_spread": [round(t, 3) for t in sorted(spec_walls)],
+        }
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    # Sampled regime: rejection-sampling acceptance on the trained pair.
+    d_model_, d_params_, d_loss_ = drafts["trained"]
+    samp = jax.jit(
+        lambda tp, dp, t, key: speculative_sample(
+            target_model, tp, d_model_, dp, t, spec_new,
+            draft_len=draft_len, temperature=0.8, top_k=40, rng=key,
+            return_stats=True,
+        )
+    )
+    key = jax.random.PRNGKey(17)
+    out_s, stats = samp(target_params, d_params_, prompt, key)
+    rounds = int(jax.device_get(stats["rounds"]))
+    accept = (spec_new - 1 - rounds) / max(rounds * draft_len, 1)
+    samp_s, samp_walls = time_arm(samp, target_params, d_params_, prompt, key)
+    plain_samp = jax.jit(
+        lambda p, t, k: generate(
+            target_model, p, t, max_new_tokens=spec_new,
+            temperature=0.8, top_k=40, rng=k,
+        )
+    )
+    plain_samp_s, _ = time_arm(plain_samp, target_params, prompt, key)
+    row = {
+        "arm": "sampled-t0.8",
+        "draft_loss": round(d_loss_, 3),
+        "accept_rate": round(accept, 3),
+        "rounds": rounds,
+        "tokens_per_target_pass": round((spec_new - 1) / rounds, 2),
+        "spec_tokens_per_s": round(spec_bsz * spec_new / samp_s),
+        "speedup_vs_plain": round(plain_samp_s / samp_s, 3),
+        "exact": None,  # distribution-exact, not token-exact, by design
+        "spec_s_spread": [round(t, 3) for t in sorted(samp_walls)],
+    }
+    rows.append(row)
+    print(json.dumps(row), flush=True)
+
+    print(json.dumps({
+        "experiment": "spec_realism",
+        "backend": jax.devices()[0].platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "target_loss": round(t_loss, 3),
+        "draft_len": draft_len,
+        "spec_new": spec_new,
+        "batch": spec_bsz,
+        "plain_tokens_per_s": round(spec_bsz * spec_new / plain_s),
+        "plain_s_spread": [round(t, 3) for t in sorted(plain_walls)],
+        "curve": {
+            r["arm"]: {
+                "accept": r["accept_rate"], "speedup": r["speedup_vs_plain"]
+            }
+            for r in rows
+        },
+        "note": "acceptance and tokens_per_target_pass are backend-"
+                "independent structure; wall speedups are this backend's. "
+                "greedy arms are bit-exact vs plain decode REGARDLESS of "
+                "draft quality (the exact field) - draft quality moves "
+                "only the speed, never the tokens",
+    }, ), flush=True)
+
+
+if __name__ == "__main__":
+    main()

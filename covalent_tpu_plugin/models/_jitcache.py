@@ -1,0 +1,35 @@
+"""One compiled-executable cache for the public decode entry points.
+
+``generate`` / ``beam_search`` / ``speculative_generate`` /
+``speculative_sample`` are fully traceable, but a bare call used to run
+their decode loops EAGERLY — hundreds of op dispatches per token —
+unless the caller remembered ``jax.jit`` (the round-4 slow-test
+post-mortem found most of the CPU tier's minutes there).  Each wrapper
+now asks this cache for a jitted executable keyed on its static knobs
+(the hashable flax module + every non-array argument); calls under an
+outer jit simply inline.  One cache, one eviction policy, instead of
+four copy-pasted ``lru_cache`` scaffolds.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable
+
+import jax
+
+_MAX = 512
+_cache: OrderedDict = OrderedDict()
+
+
+def cached_jit(key: tuple, make: Callable[[], Callable]) -> Callable:
+    """Return ``jax.jit(make())`` memoized on ``key`` (LRU, bounded)."""
+    fn = _cache.get(key)
+    if fn is None:
+        fn = jax.jit(make())
+        _cache[key] = fn
+        if len(_cache) > _MAX:
+            _cache.popitem(last=False)
+    else:
+        _cache.move_to_end(key)
+    return fn

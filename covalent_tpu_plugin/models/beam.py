@@ -32,6 +32,7 @@ import jax.numpy as jnp
 
 from ..ops.attention import NEG_INF
 from .decode import _decode_model, init_cache
+from ._jitcache import cached_jit
 from .transformer import TransformerLM
 
 
@@ -64,7 +65,7 @@ def rank_hypotheses(
     return scores / (lengths ** length_penalty)
 
 
-def beam_search(
+def _beam_search_traced(
     model: TransformerLM,
     params: Any,
     prompt: jax.Array,
@@ -306,3 +307,45 @@ def beam_search(
     tokens = jnp.take_along_axis(tokens, order[:, :, None], axis=1)
     scores = jnp.take_along_axis(scores, order, axis=1)
     return tokens, scores
+
+
+def _beam_jit(model, max_new_tokens, beam_width, eos_token_id,
+              length_penalty):
+    def make():
+        def run(params, prompt):
+            return _beam_search_traced(
+                model, params, prompt, max_new_tokens, beam_width,
+                eos_token_id, length_penalty,
+            )
+
+        return run
+
+    return cached_jit(
+        ("beam", model, max_new_tokens, beam_width, eos_token_id,
+         length_penalty),
+        make,
+    )
+
+
+def beam_search(
+    model: TransformerLM,
+    params: Any,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    beam_width: int = 4,
+    eos_token_id: int | None = None,
+    length_penalty: float = 1.0,
+) -> tuple[jax.Array, jax.Array]:
+    """Jit-cached wrapper; semantics in `_beam_search_traced` (a bare
+    call used to run the decode loop eagerly — see decode._generate_jit
+    for the rationale)."""
+    if max_new_tokens <= 0:
+        return _beam_search_traced(
+            model, params, prompt, max_new_tokens, beam_width,
+            eos_token_id, length_penalty,
+        )
+    fn = _beam_jit(
+        model, int(max_new_tokens), int(beam_width), eos_token_id,
+        float(length_penalty),
+    )
+    return fn(params, jnp.asarray(prompt))

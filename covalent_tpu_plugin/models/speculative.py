@@ -41,6 +41,7 @@ import jax
 import jax.numpy as jnp
 
 from .decode import _decode_model, init_cache
+from ._jitcache import cached_jit
 from .transformer import TransformerLM
 
 
@@ -60,7 +61,7 @@ def _set_cursor(cache: Any, value) -> Any:
     )
 
 
-def speculative_generate(
+def _speculative_generate_traced(
     target_model: TransformerLM,
     target_params: Any,
     draft_model: TransformerLM,
@@ -226,6 +227,50 @@ def speculative_generate(
     return (out, {"rounds": rounds}) if return_stats else out
 
 
+def _spec_gen_jit(target_model, draft_model, max_new_tokens, draft_len,
+                  return_stats):
+    """Compiled-executable cache for plain speculative_generate() calls
+    (shared cache + rationale: models/_jitcache.py)."""
+
+    def make():
+        def run(target_params, draft_params, prompt):
+            return _speculative_generate_traced(
+                target_model, target_params, draft_model, draft_params,
+                prompt, max_new_tokens, draft_len, return_stats,
+            )
+
+        return run
+
+    return cached_jit(
+        ("spec_gen", target_model, draft_model, max_new_tokens,
+         draft_len, return_stats),
+        make,
+    )
+
+
+def speculative_generate(
+    target_model: TransformerLM,
+    target_params: Any,
+    draft_model: TransformerLM,
+    draft_params: Any,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    draft_len: int = 4,
+    return_stats: bool = False,
+):
+    """Jit-cached wrapper; semantics in `_speculative_generate_traced`."""
+    if max_new_tokens <= 0:
+        return _speculative_generate_traced(
+            target_model, target_params, draft_model, draft_params,
+            prompt, max_new_tokens, draft_len, return_stats,
+        )
+    fn = _spec_gen_jit(
+        target_model, draft_model, int(max_new_tokens), int(draft_len),
+        bool(return_stats),
+    )
+    return fn(target_params, draft_params, jnp.asarray(prompt))
+
+
 def _filtered_logprobs(logits, temperature, top_k, top_p):
     """Temperature + top-k + top-p filtered log-probabilities (f32).
 
@@ -244,7 +289,7 @@ def _filtered_logprobs(logits, temperature, top_k, top_p):
     return jax.nn.log_softmax(scaled, axis=-1)
 
 
-def speculative_sample(
+def _speculative_sample_traced(
     target_model: TransformerLM,
     target_params: Any,
     draft_model: TransformerLM,
@@ -470,3 +515,52 @@ def speculative_sample(
     )
     out = jax.lax.dynamic_slice(buffer, (0, 0), (batch, total))
     return (out, {"rounds": rounds}) if return_stats else out
+
+
+def _spec_sample_jit(target_model, draft_model, max_new_tokens, draft_len,
+                     temperature, top_k, top_p, return_stats):
+    def make():
+        def run(target_params, draft_params, prompt, rng):
+            return _speculative_sample_traced(
+                target_model, target_params, draft_model, draft_params,
+                prompt, max_new_tokens, draft_len, temperature, rng,
+                top_k, top_p, return_stats,
+            )
+
+        return run
+
+    return cached_jit(
+        ("spec_sample", target_model, draft_model, max_new_tokens,
+         draft_len, temperature, top_k, top_p, return_stats),
+        make,
+    )
+
+
+def speculative_sample(
+    target_model: TransformerLM,
+    target_params: Any,
+    draft_model: TransformerLM,
+    draft_params: Any,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    draft_len: int = 4,
+    temperature: float = 1.0,
+    rng: jax.Array | None = None,
+    top_k: int | None = None,
+    top_p: float | None = None,
+    return_stats: bool = False,
+):
+    """Jit-cached wrapper; semantics in `_speculative_sample_traced`."""
+    if max_new_tokens <= 0 or rng is None:
+        # Identity path, and the traced body's own "sampling requires
+        # rng"-style validation, stay eager.
+        return _speculative_sample_traced(
+            target_model, target_params, draft_model, draft_params,
+            prompt, max_new_tokens, draft_len, temperature, rng,
+            top_k, top_p, return_stats,
+        )
+    fn = _spec_sample_jit(
+        target_model, draft_model, int(max_new_tokens), int(draft_len),
+        float(temperature), top_k, top_p, bool(return_stats),
+    )
+    return fn(target_params, draft_params, jnp.asarray(prompt), rng)

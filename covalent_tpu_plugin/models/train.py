@@ -159,11 +159,42 @@ def classifier_loss(params, apply_fn, batch):
     return cross_entropy_loss(logits, batch["label"])
 
 
-def lm_loss(params, apply_fn, batch):
-    """Next-token loss over a {"tokens": (B, S)} batch."""
+def lm_loss(params, apply_fn, batch, vocab_chunk: int | None = None):
+    """Next-token loss over a {"tokens": (B, S)} batch.
+
+    ``vocab_chunk`` switches to the fused vocab-chunked cross-entropy
+    (``ops/xent.py``): the model returns final FEATURES and the loss
+    streams over lm_head chunks, so the (B, S, vocab) logits tensor is
+    never materialised in HBM — the loss-side bandwidth lever the round-4
+    step sweep left on the table.  Requires a plain float lm_head kernel
+    (no lm_head LoRA, unquantized)."""
     tokens = batch["tokens"]
-    logits = apply_fn({"params": params}, tokens[:, :-1])
-    return cross_entropy_loss(logits, tokens[:, 1:])
+    if vocab_chunk is None:
+        logits = apply_fn({"params": params}, tokens[:, :-1])
+        return cross_entropy_loss(logits, tokens[:, 1:])
+    from ..ops.xent import fused_cross_entropy
+
+    feats = apply_fn(
+        {"params": params}, tokens[:, :-1], return_features=True
+    )
+    from flax.core import meta as flax_meta
+
+    # The kernel may ride in a flax Partitioned box (sharded init path).
+    head = params["lm_head"]
+    kernel = flax_meta.unbox(head["kernel"])
+    if "lora_a" in head or not jnp.issubdtype(
+        jnp.asarray(kernel).dtype, jnp.floating
+    ):
+        # A LoRA head's adapters would be silently dropped (and get zero
+        # grads); a quantized head's kernel is int8 + scales.  Both take
+        # the standard logits path.
+        raise ValueError(
+            "vocab_chunk needs a plain float lm_head kernel "
+            "(quantized/LoRA heads take the standard path)"
+        )
+    flat = feats.reshape(-1, feats.shape[-1])
+    labels = tokens[:, 1:].reshape(-1)
+    return fused_cross_entropy(flat, kernel, labels, vocab_chunk)
 
 
 def make_lm_train_step(mesh, state_shardings, rules=DEFAULT_RULES):

@@ -556,7 +556,7 @@ class TransformerLM(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, return_features: bool = False):
         cfg = self.config
         if tokens.shape[-1] > cfg.max_seq:
             raise ValueError(
@@ -597,6 +597,14 @@ class TransformerLM(nn.Module):
                 x = block_cls(cfg, name=f"layer_{i}")(x)
 
         x = RMSNorm(cfg.dtype, name="ln_final")(x)
+        if return_features:
+            # The fused-xent training path (ops/xent.py) consumes the
+            # final features and the lm_head kernel directly, so the
+            # (B, S, vocab) logits tensor is never materialised.  Safe to
+            # skip the head here: apply() with unused params is fine, and
+            # init() always runs the full path (return_features defaults
+            # False) so the lm_head params always exist.
+            return x
         logits = dense_general(
             cfg.quantized,
             features=cfg.vocab_size,

@@ -213,6 +213,58 @@ def _band_qt_lo(jk, block_q: int, block_k: int):
     return (jk * block_k) // block_q
 
 
+def _band_kt_global(i, jj, block_q: int, block_k: int, window: int,
+                    kt_full: int, sinks: int = 0):
+    """Global key-tile index of inner step ``jj`` of query tile ``i`` —
+    THE geometry both the sweep's index map and the interior test use, so
+    a clamp/sink-run change cannot desync them."""
+    nst = _sink_tiles(sinks, block_k)
+    band_j = jnp.minimum(
+        _band_kt_lo(i, block_q, block_k, window, sinks) + (jj - nst),
+        kt_full - 1,
+    )
+    return jnp.where(jj < nst, jj, band_j) if nst else band_j
+
+
+def _band_qt_global(jk, qq, block_q: int, block_k: int, qt_full: int):
+    """Global query-tile index of inner step ``qq`` of key tile ``jk``."""
+    return jnp.minimum(_band_qt_lo(jk, block_q, block_k) + qq, qt_full - 1)
+
+
+def _kt_interior(i, jj, block_q: int, block_k: int, window: int,
+                 kt_full: int, sinks: int = 0):
+    """Inner step ``jj`` of query tile ``i`` is an INTERIOR tile: every
+    (q, k) pair it holds is visible, so the kernel may skip the band mask
+    entirely (round-5 per-tile-overhead cut, WINDOW_SWEEP.md: at w=1k the
+    measured multiple sat on the 1024-tile geometry ceiling; tighter
+    tiles only win if the per-tile VPU work shrinks — interior tiles are
+    the dominant per-tile VPU cost once DMA is banded).  Exact only for
+    contiguous positions, which is the precondition of the banded grid
+    this is used with.  A tile is interior iff it is fully causal
+    (``max_k <= min_q``) and fully inside the band (``min_k > max_q -
+    window``) or fully inside the sink columns (``max_k < sinks``)."""
+    kt_g = _band_kt_global(i, jj, block_q, block_k, window, kt_full, sinks)
+    causal_full = (kt_g + 1) * block_k - 1 <= i * block_q
+    window_full = kt_g * block_k > (i + 1) * block_q - 1 - window
+    if sinks:
+        window_full = jnp.logical_or(
+            window_full, (kt_g + 1) * block_k <= sinks
+        )
+    return jnp.logical_and(causal_full, window_full)
+
+
+def _qt_interior(jk, qq, block_q: int, block_k: int, window: int,
+                 qt_full: int):
+    """Interior test for the dk/dv sweep (roles swapped: key tile ``jk``
+    fixed, inner step ``qq`` walks query tiles).  The banded dk/dv call
+    never covers sink columns (the sinks split handles those in a
+    separate full sweep), so only the causal and band bounds apply."""
+    qt_g = _band_qt_global(jk, qq, block_q, block_k, qt_full)
+    causal_full = qt_g * block_q >= (jk + 1) * block_k - 1
+    window_full = (qt_g + 1) * block_q - 1 - jk * block_k < window
+    return jnp.logical_and(causal_full, window_full)
+
+
 def _banded_sweep_kt(seq_q: int, seq_k: int, block_q: int, block_k: int,
                      window, enabled: bool, sinks: int = 0):
     """(steps, tile_index_fn, band) for a key-tile inner sweep.
@@ -229,14 +281,9 @@ def _banded_sweep_kt(seq_q: int, seq_k: int, block_q: int, block_k: int,
     )
     if n_inner is None:
         return kt_full, (lambda i, jj: jj), None
-    nst = _sink_tiles(sinks, block_k)
-
     def tile(i, jj):
-        band_j = jnp.minimum(
-            _band_kt_lo(i, block_q, block_k, window, sinks) + (jj - nst),
-            kt_full - 1,
-        )
-        return jnp.where(jj < nst, jj, band_j) if nst else band_j
+        return _band_kt_global(i, jj, block_q, block_k, window, kt_full,
+                               sinks)
 
     return n_inner, tile, (block_q, block_k, kt_full)
 
@@ -253,9 +300,7 @@ def _banded_sweep_qt(seq_q: int, seq_k: int, block_q: int, block_k: int,
         return qt_full, (lambda jk, qq: qq), None
 
     def tile(jk, qq):
-        return jnp.minimum(
-            _band_qt_lo(jk, block_q, block_k) + qq, qt_full - 1
-        )
+        return _band_qt_global(jk, qq, block_q, block_k, qt_full)
 
     return n_inner, tile, (block_q, block_k, qt_full)
 
@@ -351,8 +396,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, qpos_ref, kpos_ref, o_ref, lse_ref,
                           kt_full, sinks),
         )
 
-    @pl.when(needed)
-    def _tile():
+    def _tile_body(masked: bool):
         # Matmul inputs stay in the INPUT dtype (bf16 on TPU) with f32
         # accumulation — casting to f32 first would push the hot matmuls
         # off the MXU's native bf16 path (measured 3-4x slower end to end).
@@ -366,7 +410,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, qpos_ref, kpos_ref, o_ref, lse_ref,
             preferred_element_type=jnp.float32,
         ) * scale  # (BQ, BK) f32
 
-        if causal:
+        if causal and masked:
             # Masking reads GLOBAL positions — (BQ,1) against (1,BK) —
             # so striped/rotated layouts (ring attention) mask correctly;
             # contiguous arange positions reproduce the classic diagonal.
@@ -378,7 +422,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, qpos_ref, kpos_ref, o_ref, lse_ref,
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
-        if causal:
+        if causal and masked:
             p = jnp.where(mask, p, 0.0)
         m_ref[:] = m_new
         l_ref[:] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
@@ -388,6 +432,21 @@ def _flash_kernel(q_ref, k_ref, v_ref, qpos_ref, kpos_ref, o_ref, lse_ref,
             preferred_element_type=jnp.float32,
         )
         acc_ref[:] = acc_ref[:] * alpha + pv
+
+    if band is None:
+        pl.when(needed)(lambda: _tile_body(True))
+    else:
+        # Two-branch banded cell: INTERIOR tiles (statically fully
+        # visible — exact for the contiguous positions the banded grid
+        # requires) skip the mask compute and both (BQ, BK) selects; only
+        # band-edge tiles pay the masked path.
+        interior = jnp.logical_and(needed, _kt_interior(
+            pl.program_id(2), kt, block_q, block_k, window, kt_full, sinks
+        ))
+        pl.when(interior)(lambda: _tile_body(False))
+        pl.when(jnp.logical_and(needed, jnp.logical_not(interior)))(
+            lambda: _tile_body(True)
+        )
 
     @pl.when(kt == num_k_tiles - 1)
     def _finalise():
@@ -573,8 +632,7 @@ def _flash_bwd_dkdv_kernel(
                           block_k, window, qt_full),
         )
 
-    @pl.when(needed)
-    def _tile():
+    def _tile_body(masked: bool):
         q = q_ref[0, 0, :, :]
         k_tile = k_ref[0, 0, :, :]
         v_tile = v_ref[0, 0, :, :]
@@ -588,7 +646,7 @@ def _flash_bwd_dkdv_kernel(
             preferred_element_type=jnp.float32,
         ) * scale  # (BQ, BK) f32
         p = jnp.exp(s - lse)  # exactly the forward's normalised probabilities
-        if causal:
+        if causal and masked:
             p = jnp.where(
                 _band_visible(qpos_ref[:, :], kpos_ref[:, :], window, sinks),
                 p, 0.0,
@@ -610,6 +668,21 @@ def _flash_bwd_dkdv_kernel(
             ds.astype(q.dtype), q,
             dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
+        )
+
+    if band is None:
+        pl.when(needed)(lambda: _tile_body(True))
+    else:
+        # Interior tiles skip the band mask (see forward kernel); the
+        # banded dk/dv call never covers sink columns (the sinks split
+        # runs those separately), so _qt_interior needs no sinks case.
+        interior = jnp.logical_and(needed, _qt_interior(
+            pl.program_id(2) + kt_offset, qt, block_q, block_k, window,
+            qt_full,
+        ))
+        pl.when(interior)(lambda: _tile_body(False))
+        pl.when(jnp.logical_and(needed, jnp.logical_not(interior)))(
+            lambda: _tile_body(True)
         )
 
     @pl.when(jnp.logical_and(gi == last_group, qt == num_q_tiles - 1))
@@ -643,8 +716,7 @@ def _flash_bwd_dq_kernel(
                           kt_full, sinks),
         )
 
-    @pl.when(needed)
-    def _tile():
+    def _tile_body(masked: bool):
         q = q_ref[0, 0, :, :]
         k_tile = k_ref[0, 0, :, :]
         v_tile = v_ref[0, 0, :, :]
@@ -658,7 +730,7 @@ def _flash_bwd_dq_kernel(
             preferred_element_type=jnp.float32,
         ) * scale
         p = jnp.exp(s - lse)
-        if causal:
+        if causal and masked:
             p = jnp.where(
                 _band_visible(qpos_ref[:, :], kpos_ref[:, :], window, sinks),
                 p, 0.0,
@@ -674,6 +746,18 @@ def _flash_bwd_dq_kernel(
             ds.astype(k_tile.dtype), k_tile,
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
+        )
+
+    if band is None:
+        pl.when(needed)(lambda: _tile_body(True))
+    else:
+        # Interior tiles skip the band mask (see forward kernel).
+        interior = jnp.logical_and(needed, _kt_interior(
+            pl.program_id(2), kt, block_q, block_k, window, kt_full, sinks
+        ))
+        pl.when(interior)(lambda: _tile_body(False))
+        pl.when(jnp.logical_and(needed, jnp.logical_not(interior)))(
+            lambda: _tile_body(True)
         )
 
     @pl.when(kt == num_k_tiles - 1)

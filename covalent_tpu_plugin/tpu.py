@@ -79,8 +79,10 @@ _EXECUTOR_PLUGIN_DEFAULTS = {
     # vendored minissh); "minissh" pins the vendored pure-python stack
     # (transport/minissh.py); "local" runs workers in-place.
     "transport": "ssh",
-    # minissh/asyncssh-pinning extras: path to the server's public host
-    # key for strict checking (empty = rely on strict_host_keys=False).
+    # minissh-backend host-key pin: path to the server's public key for
+    # strict checking (asyncssh/openssh pin via ~/.ssh/known_hosts; the
+    # transport refuses the combination rather than silently ignoring an
+    # explicit pin).
     "known_host_key_file": "",
     "cache_dir": os.path.join("~", ".cache", "covalent-tpu"),
     "python_path": "python3",

@@ -349,8 +349,10 @@ class _Channel:
         self.eof_sent = False
         self.close_sent = False
         self._window_free = asyncio.Event()
-        # Server side: the local process this channel drives.
+        # Server side: the local process this channel drives, plus the
+        # stdin queue its pump drains (window replenished on consumption).
         self.proc: asyncio.subprocess.Process | None = None
+        self.stdin_q: asyncio.Queue | None = None
         self.pump_tasks: list[asyncio.Task] = []
 
     def grant(self, n: int) -> None:
@@ -559,7 +561,13 @@ class _Connection:
             ch = self.channels.get(r.u32())
             data = r.string()
             if ch:
-                await ch.consume(len(data))
+                # Window accounting is role-specific: the client consumes
+                # at receipt; the SERVER defers to its stdin pump so the
+                # peer's window only replenishes once the subprocess has
+                # actually taken the bytes (otherwise a stalled command
+                # would buffer unboundedly — and awaiting the pipe drain
+                # HERE would block the one packet loop, deadlocking
+                # against our own outbound flow control).
                 await self._channel_data(ch, data, None)
             return True
         if msg == MSG_CHANNEL_EXTENDED_DATA:
@@ -567,7 +575,6 @@ class _Connection:
             code = r.u32()
             data = r.string()
             if ch:
-                await ch.consume(len(data))
                 await self._channel_data(ch, data, code)
             return True
         if msg == MSG_CHANNEL_EOF:
@@ -792,6 +799,7 @@ class MiniSSHConnection(_Connection):
             self.lost.set()
 
     async def _channel_data(self, ch, data, ext):
+        await ch.consume(len(data))
         if ext == 1:
             ch.stderr_buf.extend(data)
         elif ext is None:
@@ -836,26 +844,51 @@ class MiniSSHConnection(_Connection):
         )
 
     async def put(self, local_path: str, remote_path: str) -> None:
-        """Upload over exec+cat: binary-safe, no SFTP subsystem needed."""
+        """Upload over exec+cat: binary-safe, no SFTP subsystem needed.
+        Streams in fixed chunks through the window-respecting data path —
+        peak memory is O(chunk), not O(file)."""
+        proc = await self.open_exec(f"cat > {shlex.quote(remote_path)}")
         with open(local_path, "rb") as fh:
-            data = fh.read()
-        res = await self.run(
-            f"cat > {shlex.quote(remote_path)}", stdin=data
-        )
-        if res.exit_status != 0:
-            raise MiniSSHError(f"upload failed: {res.stderr.strip()}")
+            while True:
+                chunk = fh.read(1 << 18)
+                if not chunk:
+                    break
+                proc.stdin.write(chunk)
+                await proc.stdin.drain()
+        proc.stdin.write_eof()
+        await proc.wait_closed()
+        if proc.exit_status != 0:
+            raise MiniSSHError(
+                "upload failed: "
+                + proc.stderr_bytes.decode(errors="replace").strip()
+            )
 
     async def get(self, remote_path: str, local_path: str) -> None:
         proc = await self.open_exec(f"cat {shlex.quote(remote_path)}")
         proc.stdin.write_eof()
-        data = await proc.stdout.read()
-        await proc.wait_closed()
-        if proc.exit_status != 0:
-            raise MiniSSHError(
-                f"download failed: {proc.stderr_bytes.decode(errors='replace').strip()}"
-            )
-        with open(local_path, "wb") as fh:
-            fh.write(data)
+        # Stream into a sibling temp file; only a SUCCESSFUL download
+        # claims local_path (a failed cat must not leave partial output).
+        tmp = f"{local_path}.minissh-part"
+        try:
+            with open(tmp, "wb") as fh:
+                while True:
+                    chunk = await proc.stdout.read(1 << 18)
+                    if not chunk:
+                        break
+                    fh.write(chunk)
+            await proc.wait_closed()
+            if proc.exit_status != 0:
+                raise MiniSSHError(
+                    "download failed: "
+                    + proc.stderr_bytes.decode(errors="replace").strip()
+                )
+            os.replace(tmp, local_path)
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
 
     def close(self) -> None:  # asyncssh-shaped: sync close + wait_closed
         if self.loop_task is not None:
@@ -1003,6 +1036,7 @@ class _ServerConnection(_Connection):
                     ch.remote_id = sender
                     ch.grant(window)
                     ch.max_packet = max_packet
+                    ch.stdin_q = asyncio.Queue()
                     await self.send(
                         _byte(MSG_CHANNEL_OPEN_CONFIRMATION) + _u32(sender)
                         + _u32(ch.local_id) + _u32(_WINDOW) + _u32(_MAX_PACKET)
@@ -1065,6 +1099,26 @@ class _ServerConnection(_Connection):
         if want_reply:
             await self.send(_byte(MSG_CHANNEL_SUCCESS) + _u32(ch.remote_id))
 
+        async def pump_in():
+            while True:
+                data = await ch.stdin_q.get()
+                if data is None:
+                    if ch.proc.stdin is not None:
+                        try:
+                            ch.proc.stdin.close()
+                        except Exception:
+                            pass
+                    break
+                try:
+                    if ch.proc.stdin is not None:
+                        ch.proc.stdin.write(data)
+                        await ch.proc.stdin.drain()
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+                # Only now is the peer's window replenished: backpressure
+                # reaches the client instead of buffering here.
+                await ch.consume(len(data))
+
         async def pump_out(stream, ext):
             while True:
                 chunk = await stream.read(16384)
@@ -1085,22 +1139,18 @@ class _ServerConnection(_Connection):
             )
             await ch.send_close()
 
+        ch.pump_tasks.append(asyncio.ensure_future(pump_in()))
         ch.pump_tasks.append(asyncio.ensure_future(finish()))
 
     async def _channel_data(self, ch, data, ext):
-        if ch.proc is not None and ch.proc.stdin is not None:
-            try:
-                ch.proc.stdin.write(data)
-                await ch.proc.stdin.drain()
-            except (BrokenPipeError, ConnectionResetError):
-                pass
+        if ch.stdin_q is not None:
+            # Never blocks: in-flight bytes are bounded by the window we
+            # granted, and we only re-grant from the pump below.
+            ch.stdin_q.put_nowait(data)
 
     async def _channel_eof(self, ch):
-        if ch.proc is not None and ch.proc.stdin is not None:
-            try:
-                ch.proc.stdin.close()
-            except Exception:
-                pass
+        if ch.stdin_q is not None:
+            ch.stdin_q.put_nowait(None)
 
     async def _channel_closed(self, ch):
         """Client closed the channel: the command must die with it (the

@@ -126,6 +126,15 @@ class SSHTransport(Transport):
             )
             return
         if self._use_asyncssh:
+            if self.known_host_key is not None:
+                # Silently ignoring an operator's explicit pin would be a
+                # MITM-protection downgrade; asyncssh users pin via
+                # ~/.ssh/known_hosts (its native mechanism) instead.
+                raise TransportError(
+                    "known_host_key pinning is implemented for the "
+                    "minissh backend; with asyncssh use a known_hosts "
+                    "entry (strict_host_keys=True already enables it)"
+                )
             kwargs = dict(
                 username=self.username or None,
                 client_keys=[self.ssh_key_file] if self.ssh_key_file else None,

@@ -40,6 +40,36 @@ def build(seed=0):
     return model, params
 
 
+#: One shared model/params for the whole module (round-5 test-tier
+#: speedup: this file alone ran 8+ minutes, dominated by per-test inits
+#: and UNJITTED oracle decodes — eager while_loops pay hundreds of op
+#: dispatches per token).
+MODEL, PARAMS = None, None
+
+
+def shared():
+    global MODEL, PARAMS
+    if MODEL is None:
+        MODEL, PARAMS = build()
+    return MODEL, PARAMS
+
+
+_jit_oracle = {}
+
+
+def oracle(model, params, prompt, cap, eos=None):
+    """Jitted per-shape batch-1 generate(), cached across tests: the
+    oracle for every bit-equality assertion here."""
+    key = (id(model), prompt.size, cap, eos)
+    if key not in _jit_oracle:
+        _jit_oracle[key] = jax.jit(
+            lambda pp, t: generate(
+                model, pp, t, cap, eos_token_id=eos
+            )
+        )
+    return np.asarray(_jit_oracle[key](params, jnp.asarray(prompt[None])))[0]
+
+
 def ragged_prompts(n, base_seed=0):
     return [
         np.asarray(
@@ -60,7 +90,7 @@ def test_greedy_bit_equal_to_generate(max_batch, sync_steps, prefill):
     slot counts (1 = fully serial), sync granularities, both admission
     prefill modes (one padded batched pass vs chunk-1 streaming), and
     ragged prompt lengths that force multiple admission waves."""
-    model, params = build()
+    model, params = shared()
     prompts = ragged_prompts(5)
     outs = continuous_generate(
         model, params, prompts, 8, max_batch=max_batch,
@@ -68,8 +98,7 @@ def test_greedy_bit_equal_to_generate(max_batch, sync_steps, prefill):
     )
     assert len(outs) == len(prompts)
     for p, o in zip(prompts, outs):
-        want = np.asarray(generate(model, params, p[None], 8))[0]
-        np.testing.assert_array_equal(o, want)
+        np.testing.assert_array_equal(o, oracle(model, params, p, 8))
 
 
 @pytest.mark.parametrize("prefill", ["batched", "stream"])
@@ -78,26 +107,22 @@ def test_eos_frees_slots_early(prefill):
     the freed slot serves later queue entries — outputs still match the
     per-prompt oracle up to and including EOS.  Covers both admission
     modes: batched admission has its own first-token EOS check."""
-    model, params = build()
+    model, params = shared()
     prompts = ragged_prompts(6, base_seed=20)
     # Pick an eos id that actually occurs in some greedy continuations:
-    # try a few ids and use the one hit most often.
-    hits = {}
-    for eos in range(8):
-        n = 0
-        for p in prompts:
-            cont = np.asarray(generate(model, params, p[None], 10))[0][p.size:]
-            n += int((cont == eos).any())
-        hits[eos] = n
+    # try a few ids and use the one hit most often.  One jitted decode
+    # per prompt length, shared across all eight candidate ids.
+    conts = [oracle(model, params, p, 10)[p.size:] for p in prompts]
+    hits = {
+        eos: sum(int((c == eos).any()) for c in conts) for eos in range(8)
+    }
     eos = max(hits, key=hits.get)
     outs = continuous_generate(
         model, params, prompts, 10, max_batch=2, eos_token_id=eos,
         sync_steps=3, prefill=prefill,
     )
     for p, o in zip(prompts, outs):
-        want_full = np.asarray(
-            generate(model, params, p[None], 10, eos_token_id=eos)
-        )[0]
+        want_full = oracle(model, params, p, 10, eos=eos)
         gen = o[p.size:]
         eos_pos = np.where(gen == eos)[0]
         if eos_pos.size:  # trimmed at (and including) the first EOS
@@ -109,15 +134,14 @@ def test_per_request_token_budgets():
     """Each request can carry its own max_new_tokens; row i must equal
     generate(prompt_i, cap_i) bit-for-bit, and a slot freed by a small
     budget serves later queue entries (5 requests, 2 slots)."""
-    model, params = build()
+    model, params = shared()
     prompts = ragged_prompts(5, base_seed=60)
     caps = [3, 12, 5, 8, 1]
     outs = continuous_generate(
         model, params, prompts, caps, max_batch=2, sync_steps=4
     )
     for p, c, o in zip(prompts, caps, outs):
-        want = np.asarray(generate(model, params, p[None], c))[0]
-        np.testing.assert_array_equal(o, want)
+        np.testing.assert_array_equal(o, oracle(model, params, p, c))
     with pytest.raises(ValueError, match="entries for"):
         continuous_generate(model, params, prompts, [4, 4], max_batch=2)
     with pytest.raises(ValueError, match=">= 1"):
@@ -126,7 +150,7 @@ def test_per_request_token_budgets():
 
 @pytest.mark.parametrize("prefill", ["batched", "stream"])
 def test_sampling_deterministic_per_rng(prefill):
-    model, params = build()
+    model, params = shared()
     prompts = ragged_prompts(3, base_seed=40)
     kwargs = dict(
         max_batch=2, temperature=0.8, top_k=16,
@@ -161,12 +185,13 @@ def test_composes_with_quantized_serving_stack():
         qmodel, qparams, prompts, 8, max_batch=2, sync_steps=4
     )
     for p, o in zip(prompts, outs):
-        want = np.asarray(generate(qmodel, qparams, p[None], 8))[0]
-        np.testing.assert_array_equal(o, want)
+        np.testing.assert_array_equal(
+            o, oracle(qmodel, qparams, p, 8)
+        )
 
 
 def test_validation():
-    model, params = build()
+    model, params = shared()
     prompts = ragged_prompts(2)
     with pytest.raises(ValueError, match="rolling_cache"):
         rolling = TransformerLM(dataclasses.replace(

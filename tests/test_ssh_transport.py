@@ -213,6 +213,7 @@ def test_auto_falls_through_to_minissh(monkeypatch):
     """With no asyncssh and no ssh binary on PATH, auto resolves to the
     vendored pure-python stack instead of failing — an image with NO ssh
     stack at all still gets a working control plane (round 5)."""
+    monkeypatch.setattr(ssh_mod, "_HAVE_ASYNCSSH", False)  # CI has asyncssh
     monkeypatch.setenv("PATH", "/nonexistent")
     t = SSHTransport(hostname="127.0.0.1")
     assert t.backend == "minissh"
