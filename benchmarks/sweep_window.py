@@ -83,8 +83,12 @@ def main() -> None:
         for window in (512, 1024, 2048, 4096):
             if window >= s:
                 continue
+            # (256, *) rows added in round 5: the interior-tile fast path
+            # cut per-tile VPU overhead, which is exactly what made
+            # tighter tiles lose before (WINDOW_SWEEP.md ceiling table:
+            # 512^2 has a 5.7x geometry ceiling at w=1k, 512x256 6.8x).
             for blocks in (None, (512, 512), (512, 1024), (1024, 1024),
-                           (512, 256)):
+                           (512, 256), (256, 256), (256, 512)):
                 bq, bk = blocks if blocks else (None, None)
                 unit = chain_ms(q, k, v, window, bq, bk, iters=iters)
                 print(json.dumps({
