@@ -1705,7 +1705,60 @@ async def main() -> None:
         lkg = load_last_known_good()
         if lkg is not None:
             final["last_known_good"] = lkg
+    final["stage_histograms"] = stage_histogram_summary()
+    final["metrics_totals"] = metrics_totals()
     emit(final)
+
+
+def metrics_totals() -> dict:
+    """Flat counter/gauge snapshot (the registry's scalar series)."""
+    from covalent_tpu_plugin.obs.metrics import REGISTRY
+
+    out: dict = {}
+    for name, metric in REGISTRY.snapshot()["metrics"].items():
+        if metric["kind"] == "histogram":
+            continue
+        for series in metric["series"]:
+            labels = ",".join(f"{k}={v}" for k, v in series["labels"].items())
+            key = f"{name}{{{labels}}}" if labels else name
+            out[key] = series["value"]
+    return out
+
+
+def stage_histogram_summary() -> dict:
+    """Per-stage dispatch latency distributions from the obs registry.
+
+    Every probe/fanout electron above ran through the instrumented
+    TPUExecutor lifecycle, so the span histograms hold the full per-stage
+    distribution — count/sum/p50/p95 per ``executor.<stage>`` plus the
+    overhead histogram — where the pre-obs bench reported one overhead
+    scalar.  Future BENCH_r*.json rounds carry this breakdown.
+    """
+    from covalent_tpu_plugin.obs.metrics import REGISTRY
+    from covalent_tpu_plugin.obs.trace import SPAN_HISTOGRAM
+
+    out: dict = {}
+    snap = REGISTRY.snapshot()["metrics"]
+    spans = snap.get(SPAN_HISTOGRAM, {}).get("series", [])
+    for series in spans:
+        name = series["labels"].get("span", "")
+        if not name.startswith(("executor.", "pool.", "agent.")):
+            continue
+        out[name] = {
+            "count": series["count"],
+            "sum_s": round(series["sum"], 4),
+            "p50_s": series["p50"],
+            "p95_s": series["p95"],
+        }
+    overhead = snap.get("covalent_tpu_dispatch_overhead_seconds", {})
+    for series in overhead.get("series", []):
+        out["dispatch_overhead"] = {
+            "count": series["count"],
+            "sum_s": round(series["sum"], 4),
+            "p50_s": series["p50"],
+            "p95_s": series["p95"],
+        }
+    return out
 
 
 if __name__ == "__main__":

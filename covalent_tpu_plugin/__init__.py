@@ -13,8 +13,9 @@ built-in minimal workflow layer (``electron``/``lattice``/``dispatch``/
 ``get_result``) so the framework runs standalone.
 """
 
+from . import obs
 from .tpu import EXECUTOR_PLUGIN_NAME, TPUExecutor
 
-__all__ = ["TPUExecutor", "EXECUTOR_PLUGIN_NAME"]
+__all__ = ["TPUExecutor", "EXECUTOR_PLUGIN_NAME", "obs"]
 
 __version__ = "0.1.0"

@@ -29,8 +29,22 @@ import uuid
 from functools import lru_cache
 from pathlib import Path
 
+from .obs import events as obs_events
+from .obs.metrics import REGISTRY
+from .obs.trace import Span
 from .transport.base import Transport, TransportError
 from .utils.log import app_log
+
+_AGENT_RPCS = REGISTRY.counter(
+    "covalent_tpu_agent_rpcs_total",
+    "Commands written to resident agent channels",
+    ("cmd",),
+)
+_AGENT_EVENTS = REGISTRY.counter(
+    "covalent_tpu_agent_events_total",
+    "Events pushed by resident agent channels",
+    ("event",),
+)
 
 AGENT_SOURCE = Path(__file__).parent / "native" / "agent.cc"
 
@@ -209,6 +223,7 @@ class AgentClient:
                 async with self._cond:
                     kind = event.get("event")
                     task_id = event.get("id", "")
+                    _AGENT_EVENTS.labels(event=str(kind)).inc()
                     if kind == "started":
                         self._started[task_id] = int(event["pid"])
                     elif kind == "exit":
@@ -231,6 +246,9 @@ class AgentClient:
             # wake waiters: an unnotified exception here would leave
             # wait_exit() blocked forever (e.g. asyncssh.ConnectionLost is
             # neither TransportError nor OSError).
+            obs_events.emit(
+                "agent.channel_died", address=self.address, error=repr(err)
+            )
             async with self._cond:
                 self._dead = err
                 self._cond.notify_all()
@@ -290,6 +308,13 @@ class AgentClient:
         if log:
             command["log"] = log
         sent = False
+        # The span times command-write -> `started` push: the agent-path
+        # analog of submit_task's round-trip, and the number that proves
+        # (or disproves) the resident runtime's launch-latency win.
+        submit_span = Span(
+            "agent.submit", {"address": self.address, "task_id": task_id}
+        )
+        submit_span.__enter__()
         try:
             await self._send(command)
             sent = True
@@ -319,7 +344,10 @@ class AgentClient:
             err.maybe_started = sent and not getattr(  # type: ignore[attr-defined]
                 err, "rejected", False
             )
+            submit_span.record_error(err)
             raise
+        finally:
+            submit_span.end()
 
     async def wait_exit(
         self, task_id: str, timeout: float | None = None
@@ -347,6 +375,7 @@ class AgentClient:
     async def _send(self, command: dict) -> None:
         if self._dead is not None:
             raise AgentError(f"agent@{self.address} channel died: {self._dead}")
+        _AGENT_RPCS.labels(cmd=str(command.get("cmd", "?"))).inc()
         try:
             await self._process.write_line(json.dumps(command))
         except TransportError as err:

@@ -30,6 +30,33 @@ from __future__ import annotations
 import json
 import os
 import sys
+import time
+
+
+def _emit_worker_event(spec: dict, type: str, **fields) -> None:
+    """Append one structured JSONL event from the worker side.
+
+    Mirrors the dispatcher's ``obs.events`` line format (ts/pid/type) but
+    stays stdlib-only — this file runs on workers where the plugin is not
+    installed.  The sink path comes from the spec's ``events_file`` (set by
+    the stager when the dispatcher has events enabled) or the worker's own
+    ``COVALENT_TPU_EVENTS_PATH``; unset means no-op, and write failures
+    never fail the task they were observing.
+    """
+    path = spec.get("events_file") or os.environ.get("COVALENT_TPU_EVENTS_PATH")
+    if not path:
+        return
+    try:
+        with open(path, "a", encoding="utf-8") as f:
+            f.write(json.dumps({
+                "ts": round(time.time(), 6),
+                "pid": os.getpid(),
+                "type": type,
+                "operation_id": spec.get("operation_id"),
+                **fields,
+            }) + "\n")
+    except OSError:
+        pass
 
 
 def install_pip_deps(pip_deps: list) -> None:
@@ -132,6 +159,7 @@ def run_task(spec: dict) -> int:
 
     distributed = spec.get("distributed")
     process_id = int(distributed["process_id"]) if distributed else 0
+    _emit_worker_event(spec, "worker.task_started", process_id=process_id)
 
     pip_deps = spec.get("pip_deps") or []
     if pip_deps:
@@ -144,6 +172,10 @@ def run_task(spec: dict) -> int:
         try:
             install_pip_deps(pip_deps)
         except RuntimeError as pip_error:
+            _emit_worker_event(
+                spec, "worker.task_finished", process_id=process_id,
+                ok=False, error=repr(pip_error),
+            )
             if process_id == 0:
                 _fallback_result(result_file, pip_error)
             return 1
@@ -216,6 +248,11 @@ def run_task(spec: dict) -> int:
         with open(done, "w") as f:
             f.write("done\n")
 
+    _emit_worker_event(
+        spec, "worker.task_finished", process_id=process_id,
+        ok=exception is None,
+        **({"error": repr(exception)} if exception is not None else {}),
+    )
     return 0
 
 

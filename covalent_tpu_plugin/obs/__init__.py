@@ -1,0 +1,85 @@
+"""Observability subsystem: metrics registry, lifecycle spans, event stream.
+
+Three cooperating layers, zero hard third-party dependencies:
+
+* :mod:`.metrics` — process-wide counters/gauges/histograms with JSON
+  snapshot and Prometheus text exposition (``REGISTRY``);
+* :mod:`.trace` — ``Span`` context managers with trace/span/parent ids
+  that instrument every executor lifecycle stage, workflow node, agent
+  RPC, and pool acquire;
+* :mod:`.events` — a structured JSONL event stream
+  (``COVALENT_TPU_EVENTS_PATH``) carrying task-state transitions,
+  failures with remote log tails, pool/agent health, and finished spans.
+
+Environment:
+
+``COVALENT_TPU_EVENTS_PATH``
+    Path of the JSONL event log; unset disables the stream.
+``COVALENT_TPU_METRICS``
+    Path to dump the metrics registry to at interpreter exit — JSON
+    snapshot by default, Prometheus text when the path ends in ``.prom``.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+
+from .events import EventSink, configure as configure_events, emit as emit_event
+from .events import get_sink
+from .metrics import (
+    DEFAULT_LATENCY_BUCKETS,
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+)
+from .trace import SPAN_HISTOGRAM, Span, current_span, span
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "REGISTRY",
+    "DEFAULT_LATENCY_BUCKETS",
+    "Span",
+    "span",
+    "current_span",
+    "SPAN_HISTOGRAM",
+    "EventSink",
+    "get_sink",
+    "configure_events",
+    "emit_event",
+    "dump_metrics",
+]
+
+_METRICS_ENV = "COVALENT_TPU_METRICS"
+
+
+def dump_metrics(path: str, registry: Registry = REGISTRY) -> None:
+    """Write the registry to ``path``: Prometheus text for ``*.prom``,
+    JSON snapshot otherwise."""
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    if path.endswith(".prom"):
+        payload = registry.prometheus_text()
+    else:
+        payload = registry.snapshot_json(indent=2) + "\n"
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(payload)
+
+
+def _dump_at_exit() -> None:  # pragma: no cover - exercised via subprocess test
+    path = os.environ.get(_METRICS_ENV)
+    if not path:
+        return
+    try:
+        dump_metrics(path)
+    except OSError:
+        pass  # exit hooks must never fail the interpreter
+
+
+atexit.register(_dump_at_exit)

@@ -47,6 +47,9 @@ from .agent import (
     start_pool_server,
 )
 from .executor_base import RemoteExecutor
+from .obs import events as obs_events
+from .obs.metrics import REGISTRY
+from .obs.trace import Span
 from .parallel.distributed import coordinator_spec
 from .transport import (
     LocalTransport,
@@ -59,7 +62,6 @@ from .transport import (
 from .utils.config import get_config, update_config
 from .utils.log import app_log
 from .utils.serialize import dump_task, load_result
-from .utils.timing import StageTimer
 
 # Plugin identity — the hook Covalent's loader keys on (pattern: ssh.py:34).
 EXECUTOR_PLUGIN_NAME = "TPUExecutor"
@@ -111,6 +113,25 @@ _EXECUTOR_PLUGIN_DEFAULTS = {
     "pool_preload": "cloudpickle",
     "profile_dir": "",
 }
+
+
+# Process-wide series every executor instance records to (obs/metrics.py).
+# Per-stage latency distributions ride the span histogram
+# (covalent_tpu_span_duration_seconds{span="executor.<stage>"}) emitted by
+# obs.trace automatically; these three are the executor-level aggregates.
+_TASKS_TOTAL = REGISTRY.counter(
+    "covalent_tpu_tasks_total",
+    "Electron outcomes by terminal state",
+    ("outcome",),
+)
+_ACTIVE_ELECTRONS = REGISTRY.gauge(
+    "covalent_tpu_active_electrons",
+    "Electrons currently inside TPUExecutor.run()",
+)
+_OVERHEAD_HIST = REGISTRY.histogram(
+    "covalent_tpu_dispatch_overhead_seconds",
+    "Per-electron dispatch overhead (lifecycle stages minus execute)",
+)
 
 
 def _split_host_port(hostport: str) -> tuple[str, int | None]:
@@ -443,6 +464,11 @@ class TPUExecutor(RemoteExecutor):
     async def _discard_workers(self) -> None:
         """Drop pooled transports after a mid-run control-plane error so the
         next electron redials instead of reusing a dead channel."""
+        obs_events.emit(
+            "pool.workers_discarded",
+            addresses=self._worker_addresses(),
+            transport=self.transport_kind,
+        )
         # Deferred-cleanup tasks from earlier electrons hold these same
         # pooled transports; closing the channels mid-rm would fail their
         # cleanup and leak the staged files — let them finish first.
@@ -490,11 +516,24 @@ class TPUExecutor(RemoteExecutor):
         raise RuntimeError(message)
 
     async def _on_dispatch_fail_async(
-        self, fn: Callable, args: tuple, kwargs: dict, message: str
+        self,
+        fn: Callable,
+        args: tuple,
+        kwargs: dict,
+        message: str,
+        operation_id: str | None = None,
+        log_tail: str = "",
     ) -> Any:
         """Async wrapper: the fallback body runs on a worker thread so a
         long CPU electron cannot stall the (shared) dispatcher event loop —
         every concurrent dispatch and agent channel lives there."""
+        obs_events.emit(
+            "task.dispatch_failed",
+            operation_id=operation_id,
+            message=message,
+            fallback_local=self.run_local_on_dispatch_fail,
+            **({"log_tail": log_tail} if log_tail else {}),
+        )
         if self.run_local_on_dispatch_fail:
             app_log.warning(
                 "TPU dispatch failed (%s); running electron locally on the "
@@ -536,13 +575,25 @@ class TPUExecutor(RemoteExecutor):
             if num_processes > 1
             else None
         )
+        # Worker-side events join the dispatcher's JSONL only when the two
+        # share a filesystem (local transport); remote workers honor their
+        # own COVALENT_TPU_EVENTS_PATH instead of scribbling a dispatcher
+        # path onto a foreign fs.
+        events_file = (
+            obs_events.get_sink().path
+            if self.transport_kind == "local" and obs_events.get_sink().enabled
+            else None
+        )
         for process_id in range(num_processes):
             spec: dict[str, Any] = {
+                "operation_id": operation_id,
                 "function_file": staged.remote_function_file,
                 "result_file": staged.remote_result_file,
                 "workdir": current_remote_workdir,
                 "pid_file": f"{staged.remote_pid_file}.{process_id}",
             }
+            if events_file:
+                spec["events_file"] = events_file
             if self.task_env:
                 spec["env"] = self.task_env
             if self.profile_dir:
@@ -708,10 +759,16 @@ class TPUExecutor(RemoteExecutor):
                     )
                     continue
                 self._agents[conn.address] = client
+                obs_events.emit(
+                    "agent.started", address=conn.address, mode=client.mode
+                )
                 return client
             app_log.info(
                 "worker %s: no resident runtime; using nohup+poll protocol",
                 conn.address,
+            )
+            obs_events.emit(
+                "agent.unavailable", address=conn.address, tried=modes
             )
             self._agents[conn.address] = None
             return None
@@ -1046,6 +1103,9 @@ class TPUExecutor(RemoteExecutor):
             # DEAD and must classify it as cancelled, not failed (a failure
             # with run_local_on_dispatch_fail would re-run the body).
             self._cancelled_ops.add(op_id)
+            obs_events.emit(
+                "task.cancel_requested", operation_id=op_id, pids=pids
+            )
             for address, pid in pids.items():
                 try:
                     conn = await self._client_connect(address)
@@ -1211,8 +1271,12 @@ class TPUExecutor(RemoteExecutor):
     ) -> Any:
         """Full electron lifecycle (reference orchestrator: ssh.py:466-591).
 
-        Stage timings land in ``self.last_timings`` (the reference captured
-        none — SURVEY §5 tracing gap).
+        Every stage runs in its own span (``executor.<stage>``) under one
+        ``executor.run`` root, so each electron leaves a full trace in the
+        event stream and per-stage histograms in the metrics registry
+        (the reference captured none — SURVEY §5 tracing gap).  Stage
+        timings still land in ``self.last_timings`` — now on every exit
+        path, success or not — for callers of the pre-obs API.
         """
         args = tuple(args or ())
         kwargs = dict(kwargs or {})
@@ -1228,17 +1292,34 @@ class TPUExecutor(RemoteExecutor):
 
         self._guard_event_loop()
 
-        timer = StageTimer()
+        root = Span(
+            "executor.run",
+            {
+                "operation_id": operation_id,
+                "dispatch_id": dispatch_id,
+                "node_id": node_id,
+                "transport": self.transport_kind,
+            },
+        )
+        root.__enter__()
+        _ACTIVE_ELECTRONS.inc()
+        obs_events.emit(
+            "task.state",
+            operation_id=operation_id,
+            state="starting",
+            trace_id=root.trace_id,
+        )
+        outcome = "failed"
         staged: StagedTask | None = None
         conns: list[Transport] = []
         try:
-            with timer.stage("validate"):
+            with Span("executor.validate"):
                 await self._validate_credentials()
 
             try:
-                with timer.stage("connect"):
+                with Span("executor.connect"):
                     conns = await self._connect_all()
-                with timer.stage("preflight"):
+                with Span("executor.preflight"):
                     # Agent warm-up (upload + compile on first use) rides the
                     # same gather as the env checks: independent round-trips,
                     # so the first electron hides the one-time compile cost.
@@ -1247,11 +1328,17 @@ class TPUExecutor(RemoteExecutor):
                         *(self._agent_for(c) for c in conns),
                     )
             except (TransportError, OSError, ValueError) as err:
-                return await self._on_dispatch_fail_async(
-                    function, args, kwargs, f"could not reach TPU workers: {err}"
+                result = await self._on_dispatch_fail_async(
+                    function,
+                    args,
+                    kwargs,
+                    f"could not reach TPU workers: {err}",
+                    operation_id=operation_id,
                 )
+                outcome = "fallback_local"
+                return result
 
-            with timer.stage("stage"):
+            with Span("executor.stage"):
                 staged = self._write_function_files(
                     operation_id,
                     function,
@@ -1260,13 +1347,13 @@ class TPUExecutor(RemoteExecutor):
                     current_remote_workdir,
                     pip_deps=task_metadata.get("pip_deps", ()),
                 )
-            with timer.stage("upload"):
+            with Span("executor.upload"):
                 await asyncio.gather(
                     *(self._upload_task(c, staged, i) for i, c in enumerate(conns))
                 )
 
             try:
-                with timer.stage("submit"):
+                with Span("executor.submit"):
                     pids = await self._launch_all(conns, staged)
             except TransportError as err:
                 if operation_id in self._cancelled_ops:
@@ -1274,13 +1361,26 @@ class TPUExecutor(RemoteExecutor):
                         f"task {operation_id} cancelled during launch"
                     ) from err
                 # Nonzero-submit routing mirrors ssh.py:553-557.
-                return await self._on_dispatch_fail_async(
-                    function, args, kwargs, f"task launch failed: {err}"
+                result = await self._on_dispatch_fail_async(
+                    function,
+                    args,
+                    kwargs,
+                    f"task launch failed: {err}",
+                    operation_id=operation_id,
                 )
+                outcome = "fallback_local"
+                return result
 
+            obs_events.emit(
+                "task.state",
+                operation_id=operation_id,
+                state="submitted",
+                trace_id=root.trace_id,
+                pids=pids,
+            )
             addresses = self._worker_addresses()
             try:
-                with timer.stage("execute"):
+                with Span("executor.execute"):
                     agents = self._op_agents.get(operation_id, [])
                     if agents and all(c is not None and c.alive for c in agents):
                         # Every worker launched through its agent: completion
@@ -1298,20 +1398,32 @@ class TPUExecutor(RemoteExecutor):
                             f"task {operation_id} cancelled"
                         )
                     log_tail = await self._remote_log_tail(conns[blamed], staged)
+                    obs_events.emit(
+                        "task.failed",
+                        operation_id=operation_id,
+                        trace_id=root.trace_id,
+                        worker=addresses[blamed],
+                        status=status.value,
+                        log_tail=log_tail,
+                    )
                     await self.cancel(operation_id)
-                    return await self._on_dispatch_fail_async(
+                    result = await self._on_dispatch_fail_async(
                         function,
                         args,
                         kwargs,
                         f"remote task {operation_id} failed on {addresses[blamed]} "
                         f"({status.value}); log tail:\n{log_tail}",
+                        operation_id=operation_id,
+                        log_tail=log_tail,
                     )
+                    outcome = "fallback_local"
+                    return result
 
                 if len(conns) > 1:
-                    with timer.stage("reap"):
+                    with Span("executor.reap"):
                         await self._await_stragglers(conns, staged, pids)
 
-                with timer.stage("fetch"):
+                with Span("executor.fetch"):
                     result, exception = await self.query_result(conns[0], staged)
             except (TransportError, OSError):
                 # A control-plane channel died mid-task: drop the pooled
@@ -1324,7 +1436,7 @@ class TPUExecutor(RemoteExecutor):
             self._active.pop(operation_id, None)
 
             if self.do_cleanup:
-                with timer.stage("cleanup"):
+                with Span("executor.cleanup"):
                     if self.defer_cleanup and not self._closing:
                         # Result is in hand; the rm round-trips happen off
                         # the critical path.  close() drains stragglers
@@ -1341,10 +1453,33 @@ class TPUExecutor(RemoteExecutor):
             if exception is not None:
                 # Re-raise the remote exception locally (ssh.py:581-583);
                 # the finally below still runs, unlike the reference's leak.
+                outcome = "remote_exception"
                 raise exception
+            outcome = "completed"
             return result
+        except asyncio.CancelledError:
+            outcome = "cancelled"
+            raise
         finally:
-            self.last_timings = timer.summary()
+            # Terminal accounting runs on EVERY exit path — success,
+            # failure, fallback, cancel — so overhead attribution and the
+            # outcome counter survive failed runs.
+            root.set_attribute("outcome", outcome)
+            if outcome not in ("completed", "fallback_local"):
+                root.record_error(outcome)
+            root.end()
+            self.last_timings = root.summary()
+            _ACTIVE_ELECTRONS.dec()
+            _TASKS_TOTAL.labels(outcome=outcome).inc()
+            _OVERHEAD_HIST.observe(root.overhead())
+            obs_events.emit(
+                "task.state",
+                operation_id=operation_id,
+                state=outcome,
+                trace_id=root.trace_id,
+                overhead_s=round(root.overhead(), 6),
+                total_s=round(root.total(), 6),
+            )
             self._active.pop(operation_id, None)
             self._cancelled_ops.discard(operation_id)
             # Release per-task state retained by resident agent channels

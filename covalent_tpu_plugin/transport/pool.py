@@ -14,7 +14,21 @@ from __future__ import annotations
 import asyncio
 from typing import Awaitable, Callable
 
+from ..obs import events as obs_events
+from ..obs.metrics import REGISTRY
+from ..obs.trace import Span
 from .base import Transport
+
+_POOL_ACQUIRES = REGISTRY.counter(
+    "covalent_tpu_pool_acquires_total",
+    "Transport pool lookups by result (hit = reused channel, "
+    "miss = fresh dial)",
+    ("result",),
+)
+_POOL_SIZE = REGISTRY.gauge(
+    "covalent_tpu_pool_size",
+    "Live transports currently held by pools in this process",
+)
 
 
 class TransportPool:
@@ -35,20 +49,29 @@ class TransportPool:
         async with lock:
             transport = self._transports.get(key)
             if transport is not None:
+                _POOL_ACQUIRES.labels(result="hit").inc()
                 return transport
-            transport = await factory()
+            _POOL_ACQUIRES.labels(result="miss").inc()
+            # The span surfaces what pooling saves: its histogram is the
+            # per-dial handshake cost that hits only on a miss.
+            with Span("pool.connect", {"key": key}):
+                transport = await factory()
             self._transports[key] = transport
+            _POOL_SIZE.inc()
             return transport
 
     async def discard(self, key: str) -> None:
         """Drop (and close) a broken transport so the next acquire redials."""
         transport = self._transports.pop(key, None)
         if transport is not None:
+            _POOL_SIZE.dec()
+            obs_events.emit("pool.discard", key=key)
             await transport.close()
 
     async def close_all(self) -> None:
         transports = list(self._transports.values())
         self._transports.clear()
+        _POOL_SIZE.dec(len(transports))
         await asyncio.gather(*(t.close() for t in transports), return_exceptions=True)
 
     def __len__(self) -> int:

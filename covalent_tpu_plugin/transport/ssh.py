@@ -28,6 +28,7 @@ import shlex
 import shutil
 from typing import Sequence
 
+from ..obs import events as obs_events
 from ..utils.log import app_log
 from .base import CommandResult, Transport, TransportError
 
@@ -306,6 +307,13 @@ async def connect_with_retries(
                 attempt,
                 max_attempts,
                 err,
+            )
+            obs_events.emit(
+                "transport.retry",
+                address=transport.address,
+                attempt=attempt,
+                max_attempts=max_attempts,
+                error=repr(err),
             )
             if attempt < max_attempts:
                 await asyncio.sleep(retry_wait_time)

@@ -24,6 +24,16 @@ except Exception:
             logging.Formatter("[%(asctime)s] [%(levelname)s] %(name)s: %(message)s")
         )
         app_log.addHandler(_handler)
-    app_log.setLevel(os.environ.get("COVALENT_TPU_LOG_LEVEL", "WARNING").upper())
+    # Validate before setLevel: an invalid value would raise ValueError at
+    # import time and take down every `import covalent_tpu_plugin` with it.
+    _raw = os.environ.get("COVALENT_TPU_LOG_LEVEL", "WARNING").strip().upper()
+    _level = int(_raw) if _raw.isdigit() else logging.getLevelName(_raw)
+    if not isinstance(_level, int):
+        app_log.setLevel(logging.WARNING)
+        app_log.warning(
+            "invalid COVALENT_TPU_LOG_LEVEL %r; falling back to WARNING", _raw
+        )
+    else:
+        app_log.setLevel(_level)
 
 __all__ = ["app_log"]

@@ -1,44 +1,56 @@
-"""Per-stage wall-clock timing for dispatch-overhead accounting.
+"""Per-stage wall-clock timing — backward-compatible shim over obs.trace.
 
-The reference has no timing capture at all (SURVEY §5: only ``app_log.debug``
-breadcrumbs at ``covalent_ssh_plugin/ssh.py:158,382,424,...``).  The TPU
-build's north star is <2 s dispatch overhead per electron, so every
-``TPUExecutor.run()`` records how long each lifecycle stage took; the bench
-harness and tests read these numbers back.
+``StageTimer`` predates the observability subsystem: it recorded a flat
+``{stage: seconds}`` dict per ``TPUExecutor.run()`` that died with the
+executor instance.  The span tracer (``covalent_tpu_plugin/obs/trace.py``)
+subsumes it — trace/span/parent ids, status, event-stream export, and
+per-stage histograms in the metrics registry — so this class is kept only
+for existing callers of the old API: each ``stage()`` opens a real span
+(named ``timer.<stage>``), and ``summary()``/``total()``/``overhead()``
+read back the identical numbers the old implementation produced.
 """
 
 from __future__ import annotations
 
-import time
 from contextlib import contextmanager
+
+from ..obs.trace import Span
+
+__all__ = ["StageTimer"]
 
 
 class StageTimer:
-    """Accumulates named stage durations for one executor run."""
+    """Accumulates named stage durations for one executor run.
 
-    def __init__(self) -> None:
-        self.stages: dict[str, float] = {}
-        self._t0 = time.perf_counter()
+    Deprecated in favour of :mod:`covalent_tpu_plugin.obs.trace`; each
+    timed stage is now a real span so existing callers feed the metrics
+    registry and event stream without code changes.
+    """
+
+    def __init__(self, root_name: str = "timer") -> None:
+        self._root_name = root_name
+        # The root is entered immediately (matching the old perf_counter
+        # capture in __init__) and closed implicitly by summary()/total()
+        # reads — the old API had no explicit end, so the root must not
+        # capture the ambient span context (activate=False).
+        self._root = Span(root_name, emit=False, activate=False)
+        self._root.__enter__()
+
+    @property
+    def stages(self) -> dict[str, float]:
+        return self._root.stage_durations
 
     @contextmanager
     def stage(self, name: str):
-        start = time.perf_counter()
-        try:
+        with Span(f"{self._root_name}.{name}", parent=self._root):
             yield
-        finally:
-            self.stages[name] = self.stages.get(name, 0.0) + (
-                time.perf_counter() - start
-            )
 
     def total(self) -> float:
-        return time.perf_counter() - self._t0
+        return self._root.total()
 
     def overhead(self, exclude: tuple[str, ...] = ("execute",)) -> float:
         """Dispatch overhead = everything except the task's own runtime."""
-        return sum(v for k, v in self.stages.items() if k not in exclude)
+        return self._root.overhead(exclude)
 
     def summary(self) -> dict[str, float]:
-        out = dict(self.stages)
-        out["total"] = self.total()
-        out["overhead"] = self.overhead()
-        return out
+        return self._root.summary()

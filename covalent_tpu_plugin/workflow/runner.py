@@ -24,10 +24,24 @@ from dataclasses import dataclass, field
 from enum import Enum
 from typing import Any, Callable
 
+from ..obs import events as obs_events
+from ..obs.metrics import REGISTRY
+from ..obs.trace import Span
 from ..utils.log import app_log
 from .dag import Graph, Lattice, Node
 from .deps import wrap_task
 from .executors import resolve_executor
+
+_NODES_TOTAL = REGISTRY.counter(
+    "covalent_tpu_workflow_nodes_total",
+    "Workflow node terminal states",
+    ("status",),
+)
+_DISPATCHES_TOTAL = REGISTRY.counter(
+    "covalent_tpu_dispatches_total",
+    "Workflow dispatch terminal states",
+    ("status",),
+)
 
 
 class Status(str, Enum):
@@ -100,6 +114,16 @@ async def _execute_graph(graph: Graph, result: Result) -> None:
                 created.append(instance)
         return executors[key]
 
+    def node_event(spec, state: str, **fields) -> None:
+        obs_events.emit(
+            "node.state",
+            dispatch_id=dispatch_id,
+            node_id=spec.node_id,
+            node=getattr(spec.fn, "__name__", str(spec.fn)),
+            state=state,
+            **fields,
+        )
+
     async def run_node(spec) -> Any:
         deps = spec.dependencies()
         if deps:
@@ -108,6 +132,8 @@ async def _execute_graph(graph: Graph, result: Result) -> None:
             )
             failed = [d for d, r in zip(deps, dep_results) if isinstance(r, BaseException)]
             if failed:
+                _NODES_TOTAL.labels(status="skipped").inc()
+                node_event(spec, "skipped", upstream_failed=sorted(failed))
                 raise _DependencyFailed(f"upstream node(s) {sorted(failed)} failed")
         args = _resolve_value(list(spec.args), result.node_outputs)
         kwargs = _resolve_value(dict(spec.kwargs), result.node_outputs)
@@ -120,10 +146,42 @@ async def _execute_graph(graph: Graph, result: Result) -> None:
             # usage at svm_workflow.py:19.
             task_metadata["pip_deps"] = list(spec.deps_pip.packages)
         fn = wrap_task(spec.fn, spec.call_before, spec.call_after)
-        output = await executor.run(fn, args, kwargs, task_metadata)
+        node_event(spec, "running")
+        try:
+            with Span(
+                "workflow.node",
+                {"dispatch_id": dispatch_id, "node_id": spec.node_id,
+                 "node": getattr(spec.fn, "__name__", str(spec.fn))},
+            ):
+                output = await executor.run(fn, args, kwargs, task_metadata)
+        except asyncio.CancelledError:
+            _NODES_TOTAL.labels(status="cancelled").inc()
+            node_event(spec, "cancelled")
+            raise
+        except BaseException as err:
+            _NODES_TOTAL.labels(status="failed").inc()
+            node_event(spec, "failed", error=repr(err))
+            raise
+        _NODES_TOTAL.labels(status="completed").inc()
+        node_event(spec, "completed")
         result.node_outputs[spec.node_id] = output
         return output
 
+    # Dispatch root span: node tasks are created below with this span
+    # active, so their context copies parent every workflow.node (and the
+    # executor.run trees under them) to one trace per dispatch.
+    dispatch_span = Span(
+        "workflow.dispatch",
+        {"dispatch_id": dispatch_id, "num_nodes": len(graph.nodes)},
+    )
+    dispatch_span.__enter__()
+    obs_events.emit(
+        "dispatch.state",
+        dispatch_id=dispatch_id,
+        state="running",
+        num_nodes=len(graph.nodes),
+        trace_id=dispatch_span.trace_id,
+    )
     try:
         loop = asyncio.get_running_loop()
         result._loop = loop
@@ -168,6 +226,19 @@ async def _execute_graph(graph: Graph, result: Result) -> None:
                     await closer()
                 except Exception:  # noqa: BLE001
                     pass
+        status = result.status.value
+        dispatch_span.set_attribute("status", status)
+        if result.status not in (Status.COMPLETED, Status.NEW):
+            dispatch_span.record_error(status)
+        dispatch_span.end()
+        _DISPATCHES_TOTAL.labels(status=status.lower()).inc()
+        obs_events.emit(
+            "dispatch.state",
+            dispatch_id=dispatch_id,
+            state=status,
+            trace_id=dispatch_span.trace_id,
+            **({"error": result.error} if result.error else {}),
+        )
         result._done.set()
 
 

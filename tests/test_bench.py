@@ -90,3 +90,32 @@ def test_last_known_good_is_stamped_and_never_live_shaped():
     assert not any(k.startswith("fanout") for k in lkg)
     # At least the headline accelerator fields travel.
     assert lkg.get("matmul4k_mfu") is not None
+
+
+def test_stage_histogram_summary_reads_span_registry():
+    # The bench report embeds per-stage latency distributions from the obs
+    # registry (ISSUE 1: real histograms instead of one overhead scalar).
+    from covalent_tpu_plugin.obs.trace import Span
+
+    with Span("executor.bench_probe_stage", emit=False):
+        pass
+    out = bench.stage_histogram_summary()
+    entry = out["executor.bench_probe_stage"]
+    assert entry["count"] >= 1
+    assert {"count", "sum_s", "p50_s", "p95_s"} <= set(entry)
+    # Unprefixed spans (models, workflow internals) stay out of the report.
+    assert all(k.startswith(("executor.", "pool.", "agent.", "dispatch_"))
+               for k in out)
+
+
+def test_metrics_totals_flat_and_json_safe():
+    import json
+
+    from covalent_tpu_plugin.obs.metrics import REGISTRY
+
+    REGISTRY.counter("bench_probe_total", "", ("kind",)).labels(
+        kind="x"
+    ).inc(2)
+    totals = bench.metrics_totals()
+    assert totals["bench_probe_total{kind=x}"] == 2
+    json.dumps(totals)
