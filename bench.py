@@ -38,6 +38,15 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from covalent_tpu_plugin import TPUExecutor  # noqa: E402
 
 OVERHEAD_PROBES = 5
+#: Phase selection (CI smoke runs pick a subset: the full TPU phase needs
+#: an accelerator + minutes of budget, the dispatch phases need neither).
+BENCH_PHASES = {
+    phase.strip()
+    for phase in os.environ.get(
+        "BENCH_PHASES", "overhead,fanout,cached_fanout,tpu"
+    ).split(",")
+    if phase.strip()
+}
 # Per-phase wall budgets (s).  The accelerator phase dominates: it absorbs
 # one cold TPU backend init (minutes on some PJRT plugins) plus the compute
 # sub-phases, each of which self-skips as the electron's deadline nears.
@@ -61,6 +70,10 @@ JAX_CACHE_DIR = os.environ.get(
     "JAX_COMPILATION_CACHE_DIR",
     f"/tmp/covalent-tpu-jax-cache-{os.getuid()}",
 )
+
+
+class _PhaseSkipped(Exception):
+    """Raised inside a phase body when BENCH_PHASES deselects it."""
 
 
 def emit(obj: dict) -> None:
@@ -1447,6 +1460,9 @@ async def main() -> None:
     # ---- phase 1: dispatch overhead (the headline metric) ----------------
     overhead = None
     try:
+        if "overhead" not in BENCH_PHASES:
+            raise _PhaseSkipped
+
         async def overhead_phase():
             # Warm the pooled transport + agent; steady state is what an
             # N-electron lattice pays per electron.
@@ -1478,6 +1494,8 @@ async def main() -> None:
             "electron_wall_s": summary["electron_wall_s"],
             **spread_stats(overheads, "overhead"),
             **spread_stats(singles, "electron_wall")})
+    except _PhaseSkipped:
+        emit({"phase": "overhead", "skipped": "BENCH_PHASES"})
     except Exception as error:  # noqa: BLE001
         emit({"phase": "overhead", "error": repr(error)})
 
@@ -1496,6 +1514,9 @@ async def main() -> None:
         return time.perf_counter() - t0
 
     try:
+        if "fanout" not in BENCH_PHASES:
+            raise _PhaseSkipped
+
         async def fanout_trials():
             # 3 trials -> median + spread (r3 verdict: honest statistics
             # on every phase, not just the TPU ones).
@@ -1512,12 +1533,16 @@ async def main() -> None:
             "fanout8_wall_s", "fanout8_per_electron_s",
             "fanout8_speedup_vs_serial")},
             **spread_stats(fanout_walls, "fanout8_wall")})
+    except _PhaseSkipped:
+        emit({"phase": "fanout8", "skipped": "BENCH_PHASES"})
     except Exception as error:  # noqa: BLE001
         emit({"phase": "fanout8", "error": repr(error)})
 
     # Same fan-out with 300 ms of real work per electron: serial would
     # take >= 2.4 s, so the wall directly exposes task concurrency.
     try:
+        if "fanout" not in BENCH_PHASES:
+            raise _PhaseSkipped
         task_s = 0.3
 
         async def busy_trials():
@@ -1531,8 +1556,91 @@ async def main() -> None:
         emit({"phase": "fanout8_busy", "task_s": task_s, **{k: summary[k] for k in (
             "fanout8_busy_wall_s", "fanout8_busy_speedup")},
             **spread_stats(busy_walls, "fanout8_busy_wall")})
+    except _PhaseSkipped:
+        emit({"phase": "fanout8_busy", "skipped": "BENCH_PHASES"})
     except Exception as error:  # noqa: BLE001
         emit({"phase": "fanout8_busy", "error": repr(error)})
+
+    # ---- phase 2b: two-level cache, same electron N times ----------------
+    # Warm vs cold through a cache_results executor: the cold first run
+    # pays connect + CAS-miss uploads + launch + execute; the warm repeats
+    # memoize (level 2) and, where they do dispatch, skip repeat payloads
+    # (level 1).  The trajectory JSON carries the measured speedup plus the
+    # hit/miss counter deltas so the win is attributable, not inferred.
+    try:
+        if "cached_fanout" not in BENCH_PHASES:
+            raise _PhaseSkipped
+
+        def cache_counters() -> dict:
+            # Same public snapshot path as the final line's metrics_totals.
+            return {
+                key: value
+                for key, value in metrics_totals().items()
+                if key.startswith(("covalent_tpu_result_cache_total",
+                                   "covalent_tpu_cas_uploads_total"))
+            }
+
+        async def cached_phase():
+            cache_ex = TPUExecutor(
+                transport="local",
+                cache_dir=f"{workdir}/cache_memo",
+                remote_cache=f"{workdir}/remote_memo",
+                python_path=sys.executable,
+                poll_freq=0.2,
+                pool_preload="cloudpickle",
+                cache_results=True,
+                task_env={
+                    "PYTHONPATH": repo_root + os.pathsep
+                    + os.environ.get("PYTHONPATH", ""),
+                },
+            )
+            try:
+                t0 = time.perf_counter()
+                await cache_ex.run(
+                    trivial_electron, [7], {},
+                    {"dispatch_id": "cache_cold", "node_id": 0},
+                )
+                cold = time.perf_counter() - t0
+                warm = []
+                for i in range(4):
+                    t0 = time.perf_counter()
+                    await cache_ex.run(
+                        trivial_electron, [7], {},
+                        {"dispatch_id": "cache_warm", "node_id": i},
+                    )
+                    warm.append(time.perf_counter() - t0)
+            finally:
+                await cache_ex.close()
+            return cold, warm
+
+        counters_before = cache_counters()
+        cold_s, warm_list = await asyncio.wait_for(
+            cached_phase(), FANOUT_BUDGET_S
+        )
+        warm_s = statistics.median(warm_list)
+        counters_delta = {
+            key: round(value - counters_before.get(key, 0.0), 1)
+            for key, value in cache_counters().items()
+            if value != counters_before.get(key, 0.0)
+        }
+        summary["cached_fanout_cold_s"] = round(cold_s, 4)
+        summary["cached_fanout_warm_s"] = round(warm_s, 4)
+        summary["cached_fanout_speedup"] = round(cold_s / max(warm_s, 1e-9), 2)
+        summary["cached_fanout_warm_below_cold"] = bool(warm_s < cold_s)
+        emit({
+            "phase": "cached_fanout",
+            "cold_s": summary["cached_fanout_cold_s"],
+            "warm_s_median": summary["cached_fanout_warm_s"],
+            "warm_per_run_s": [round(w, 4) for w in warm_list],
+            "speedup": summary["cached_fanout_speedup"],
+            "warm_below_cold": summary["cached_fanout_warm_below_cold"],
+            "cache_counters_delta": counters_delta,
+            **spread_stats(warm_list, "warm"),
+        })
+    except _PhaseSkipped:
+        emit({"phase": "cached_fanout", "skipped": "BENCH_PHASES"})
+    except Exception as error:  # noqa: BLE001
+        emit({"phase": "cached_fanout", "error": repr(error)})
 
     # ---- phase 3: all accelerator work, ONE electron, ONE backend init ---
     # The whole phase lives under ONE wall-clock deadline (the old
@@ -1554,7 +1662,8 @@ async def main() -> None:
 
     try:
         healthy = False
-        for attempt in range(64):
+        skipped_tpu = "tpu" not in BENCH_PHASES
+        for attempt in range(0 if skipped_tpu else 64):
             ok, took, err = await asyncio.get_event_loop().run_in_executor(
                 None, tpu_preflight, min(45.0, max(phase3_left() - 5, 5.0))
             )
@@ -1567,7 +1676,9 @@ async def main() -> None:
             if phase3_left() < 90:
                 break
             await asyncio.sleep(min(15.0, max(phase3_left() - 60, 1.0)))
-        if not healthy:
+        if skipped_tpu:
+            emit({"phase": "tpu", "skipped": "BENCH_PHASES"})
+        elif not healthy:
             emit({"phase": "tpu", "error": "preflight never passed; "
                   "electron skipped (tunnel down)"})
         attempt = 0
@@ -1697,11 +1808,12 @@ async def main() -> None:
             ),
             "serve_complete": sub("lm_serve", "complete"),
         })
-    if sub("init", "backend") is None:
+    if sub("init", "backend") is None and "tpu" in BENCH_PHASES:
         # Outage path: every accelerator field above is null.  Attach the
         # newest committed self-run under an explicitly-stale key (never
         # backfilled into the live fields) so the artifact self-describes
-        # instead of reading as "no evidence exists".
+        # instead of reading as "no evidence exists".  A deliberate
+        # BENCH_PHASES deselect (CI smoke) is not an outage: no stale data.
         lkg = load_last_known_good()
         if lkg is not None:
             final["last_known_good"] = lkg

@@ -14,8 +14,15 @@ built-in minimal workflow layer (``electron``/``lattice``/``dispatch``/
 """
 
 from . import obs
+from .cache import CASIndex, ResultCache
 from .tpu import EXECUTOR_PLUGIN_NAME, TPUExecutor
 
-__all__ = ["TPUExecutor", "EXECUTOR_PLUGIN_NAME", "obs"]
+__all__ = [
+    "TPUExecutor",
+    "EXECUTOR_PLUGIN_NAME",
+    "obs",
+    "CASIndex",
+    "ResultCache",
+]
 
 __version__ = "0.1.0"

@@ -1,0 +1,334 @@
+"""Two-level dispatch cache: content-addressed staging + result memoization.
+
+Level 1 — **content-addressed artifact store (CAS)**.  Every file the
+executor stages (harness module, function pickle, per-worker spec JSON)
+is named by its sha256 digest under ``{remote_cache}/cas/``, and a
+per-connection :class:`CASIndex` remembers which digests each worker
+already holds.  The index is seeded by ONE batched existence probe per
+connection lifetime (``Transport.exists_batch``) and maintained locally
+after that, so repeat uploads collapse to set lookups: the harness ships
+once per connection instead of once per electron × worker, and identical
+function pickles across a map-style fan-out ship once total.  This is the
+Podracer amortize-the-setup pattern (PAPERS): keep workers hot, ship work
+*descriptions*, not payloads.
+
+Level 2 — **electron result memoization** (:class:`ResultCache`).  An
+opt-in, disk-backed LRU keyed by (function digest, call digest, executor
+environment fingerprint): a repeat dispatch of an identical electron
+returns the completed result without touching the transport at all.
+Bounded by entry count and total bytes; only *successful* results are
+stored (failures and fallbacks always re-run), and memoization is only
+safe for side-effect-free electrons — it is off unless ``cache_results``
+/ ``COVALENT_TPU_RESULT_CACHE`` asks for it.
+
+Both levels record into the PR-1 obs layer:
+``covalent_tpu_cas_uploads_total{result=hit|miss}`` and
+``covalent_tpu_result_cache_total{result=...}`` counters, plus an
+``executor.cas_put`` span per *actual* upload so the span histogram shows
+the put traffic falling off after warm-up.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import os
+import pickle
+import threading
+import uuid
+from functools import lru_cache
+from typing import Any
+
+import cloudpickle
+
+from .obs import events as obs_events
+from .obs.metrics import REGISTRY
+from .obs.trace import Span
+from .transport.base import Transport
+from .utils.log import app_log
+
+__all__ = [
+    "CAS_DIR",
+    "CASIndex",
+    "ResultCache",
+    "bytes_digest",
+    "cas_path",
+    "file_digest",
+    "harness_digest",
+    "CAS_UPLOADS_TOTAL",
+    "RESULT_CACHE_TOTAL",
+]
+
+#: Subdirectory of ``remote_cache`` holding digest-addressed artifacts.
+CAS_DIR = "cas"
+
+CAS_UPLOADS_TOTAL = REGISTRY.counter(
+    "covalent_tpu_cas_uploads_total",
+    "CAS artifact upload decisions (hit = worker already holds the digest, "
+    "put skipped; miss = payload shipped)",
+    ("result",),
+)
+RESULT_CACHE_TOTAL = REGISTRY.counter(
+    "covalent_tpu_result_cache_total",
+    "Electron result-memoization events by result",
+    ("result",),
+)
+
+
+def bytes_digest(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def file_digest(path: str) -> str:
+    """Streaming sha256 of a file's content."""
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+@lru_cache(maxsize=1)
+def harness_digest() -> str:
+    """Digest of the (static) worker harness module — one hash per process.
+
+    The harness is copied verbatim to workers (harness.py module docstring),
+    so its digest is constant for an installed package version; memoizing it
+    keeps the stage path at one sha256 of the function pickle + specs.
+    """
+    from . import harness as _harness_module
+
+    return file_digest(_harness_module.__file__)
+
+
+def cas_path(remote_cache: str, digest: str, suffix: str = "") -> str:
+    """Digest-addressed remote path under ``{remote_cache}/cas/``."""
+    return f"{remote_cache}/{CAS_DIR}/{digest}{suffix}"
+
+
+class CASIndex:
+    """Per-connection "already present" digest sets with single-flight puts.
+
+    Keys are the executor's pool keys (``transport:address``) — the same
+    identity the transport pool and pre-flight cache use — so a discarded
+    connection evicts its CAS knowledge with it (:meth:`forget`) and a
+    recreated worker re-probes instead of trusting stale state.
+    """
+
+    def __init__(self) -> None:
+        self._present: dict[str, set[str]] = {}
+        self._probed: set[str] = set()
+        #: (key, digest) -> future resolved when the winning put settles;
+        #: losers re-check the present set and retry if the put failed.
+        self._inflight: dict[tuple[str, str], asyncio.Future] = {}
+        self._probe_locks: dict[str, asyncio.Lock] = {}
+
+    def known(self, key: str, digest: str) -> bool:
+        return digest in self._present.get(key, ())
+
+    async def ensure_probed(
+        self, key: str, conn: Transport, entries: list[tuple[str, str]]
+    ) -> None:
+        """Seed ``key``'s present set with ONE batched existence probe.
+
+        ``entries`` is ``[(digest, remote_path), ...]`` for the artifacts
+        about to upload.  Runs at most once per key: later electrons trust
+        the locally-maintained set instead of re-probing (a fresh digest
+        they introduce is simply treated as absent and uploaded).
+        """
+        if key in self._probed:
+            return
+        lock = self._probe_locks.setdefault(key, asyncio.Lock())
+        async with lock:
+            if key in self._probed:
+                return
+            present = self._present.setdefault(key, set())
+            flags = await conn.exists_batch([path for _, path in entries])
+            for (digest, _), held in zip(entries, flags):
+                if held:
+                    present.add(digest)
+            self._probed.add(key)
+            obs_events.emit(
+                "cas.probed",
+                key=key,
+                probed=len(entries),
+                already_present=sum(flags),
+            )
+
+    async def ensure(
+        self,
+        key: str,
+        conn: Transport,
+        digest: str,
+        local_path: str,
+        remote_path: str,
+    ) -> None:
+        """Upload ``local_path`` unless ``key`` already holds ``digest``.
+
+        Single-flight per (key, digest): concurrent electrons of a fan-out
+        sharing one function pickle trigger exactly one put; the rest await
+        it and count as hits.
+        """
+        while True:
+            present = self._present.setdefault(key, set())
+            if digest in present:
+                CAS_UPLOADS_TOTAL.labels(result="hit").inc()
+                return
+            pending = self._inflight.get((key, digest))
+            if pending is None:
+                break
+            await pending  # winner settles (never raises: result-only)
+        future: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._inflight[(key, digest)] = future
+        try:
+            with Span(
+                "executor.cas_put",
+                {"key": key, "digest": digest[:12]},
+            ):
+                # Temp name + atomic rename: CAS paths are shared across
+                # executors (each workflow dispatch builds its own index),
+                # so another dispatcher's existence probe must never see a
+                # half-written artifact at the digest path.  Orphaned .tmp
+                # files from a crashed put are swept by the pre-flight TTL
+                # prune.
+                tmp = f"{remote_path}.tmp-{uuid.uuid4().hex[:8]}"
+                await conn.put(local_path, tmp)
+                await conn.rename(tmp, remote_path)
+            present.add(digest)
+            CAS_UPLOADS_TOTAL.labels(result="miss").inc()
+        finally:
+            self._inflight.pop((key, digest), None)
+            if not future.done():
+                future.set_result(None)
+
+    def forget(self, key: str) -> None:
+        """Evict one connection's CAS knowledge (channel discarded: the
+        worker may have been preempted/recreated with an empty cache)."""
+        self._present.pop(key, None)
+        self._probed.discard(key)
+        self._probe_locks.pop(key, None)
+
+    def forget_digest(self, digest: str) -> None:
+        """Drop one digest from every present set (its remote file was
+        deleted, e.g. a per-operation spec removed by cleanup)."""
+        for present in self._present.values():
+            present.discard(digest)
+
+
+class ResultCache:
+    """Disk-backed LRU of completed electron results.
+
+    One file per entry (``{key}.pkl`` under ``root``); recency is the
+    file's mtime, touched on every hit, so the store survives process
+    restarts and is shared by every executor instance pointing at the same
+    ``cache_dir`` — including the fresh executor each workflow dispatch
+    resolves from a string alias.  Bounded by ``max_entries`` and
+    ``max_bytes`` with oldest-first eviction.
+    """
+
+    def __init__(
+        self,
+        root: str,
+        max_entries: int = 512,
+        max_bytes: int = 256 * 1024 * 1024,
+    ) -> None:
+        self.root = root
+        self.max_entries = max(1, int(max_entries))
+        self.max_bytes = max(1, int(max_bytes))
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def make_key(*parts: str) -> str:
+        return bytes_digest("\x00".join(parts).encode())
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, f"{key}.pkl")
+
+    def get(self, key: str) -> tuple[bool, Any]:
+        """``(hit, value)`` — a corrupt/missing entry is a miss, never an
+        error in the dispatch it was accelerating."""
+        path = self._path(key)
+        with self._lock:
+            try:
+                with open(path, "rb") as f:
+                    value = pickle.load(f)
+                os.utime(path)  # LRU touch
+            except Exception:  # noqa: BLE001 - any corrupt entry is a miss
+                RESULT_CACHE_TOTAL.labels(result="miss").inc()
+                return False, None
+        RESULT_CACHE_TOTAL.labels(result="hit").inc()
+        return True, value
+
+    def put(self, key: str, value: Any) -> bool:
+        """Best-effort store; returns True when the entry landed."""
+        try:
+            data = cloudpickle.dumps(value)
+        except Exception as err:  # noqa: BLE001 - arbitrary user objects
+            RESULT_CACHE_TOTAL.labels(result="unpicklable").inc()
+            app_log.debug("result cache: value not picklable (%s)", err)
+            return False
+        if len(data) > self.max_bytes:
+            RESULT_CACHE_TOTAL.labels(result="oversize").inc()
+            return False
+        path = self._path(key)
+        with self._lock:
+            try:
+                os.makedirs(self.root, exist_ok=True)
+                tmp = f"{path}.tmp.{os.getpid()}"
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                os.replace(tmp, path)
+            except OSError as err:
+                RESULT_CACHE_TOTAL.labels(result="error").inc()
+                app_log.warning("result cache write failed: %s", err)
+                return False
+            RESULT_CACHE_TOTAL.labels(result="store").inc()
+            self._evict_locked()
+        return True
+
+    def _entries(self) -> list[tuple[float, int, str]]:
+        out = []
+        try:
+            with os.scandir(self.root) as it:
+                for entry in it:
+                    if not entry.name.endswith(".pkl"):
+                        continue
+                    try:
+                        stat = entry.stat()
+                    except OSError:
+                        continue
+                    out.append((stat.st_mtime, stat.st_size, entry.path))
+        except OSError:
+            return []
+        return sorted(out)
+
+    def _evict_locked(self) -> None:
+        entries = self._entries()
+        total = sum(size for _, size, _ in entries)
+        evicted = 0
+        while entries and (
+            len(entries) > self.max_entries or total > self.max_bytes
+        ):
+            _, size, path = entries.pop(0)
+            try:
+                os.remove(path)
+            except OSError:
+                continue
+            total -= size
+            evicted += 1
+            RESULT_CACHE_TOTAL.labels(result="evict").inc()
+        if evicted:
+            obs_events.emit(
+                "result_cache.evicted", count=evicted, root=self.root
+            )
+
+    def clear(self) -> None:
+        with self._lock:
+            for _, _, path in self._entries():
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+
+    def __len__(self) -> int:
+        return len(self._entries())

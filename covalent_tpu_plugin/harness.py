@@ -187,6 +187,33 @@ def run_task(spec: dict) -> int:
             _fallback_result(result_file, import_error)
         return 1
 
+    expected_digest = spec.get("function_digest")
+    if expected_digest:
+        # The function file is a content-addressed (CAS) artifact: verify
+        # its bytes against the digest the dispatcher staged before
+        # unpickling, so a torn upload or stale cache entry fails loud
+        # instead of executing the wrong payload.  Runs BEFORE the
+        # distributed barrier so a bad artifact on any worker fails fast
+        # with correct blame instead of hanging process 0 in initialize.
+        import hashlib
+
+        sha = hashlib.sha256()
+        with open(spec["function_file"], "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                sha.update(chunk)
+        if sha.hexdigest() != expected_digest:
+            digest_error = RuntimeError(
+                f"staged function {spec['function_file']} does not match "
+                f"its content digest (torn or stale CAS artifact)"
+            )
+            _emit_worker_event(
+                spec, "worker.task_finished", process_id=process_id,
+                ok=False, error=repr(digest_error),
+            )
+            if process_id == 0:
+                _fallback_result(result_file, digest_error)
+            return 1
+
     if distributed:
         # Data-plane bootstrap: after this, in-electron jax code sees every
         # chip in the slice and XLA collectives ride ICI/DCN (SURVEY §2.4).

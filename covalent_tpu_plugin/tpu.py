@@ -38,13 +38,23 @@ from enum import Enum
 from pathlib import Path
 from typing import Any, Callable, Sequence
 
+import cloudpickle
+
 from . import harness as _harness_module
 from .agent import (
-    HARNESS_BASENAME,
     AgentClient,
     AgentError,
     ensure_agent_binary,
     start_pool_server,
+)
+from .cache import (
+    RESULT_CACHE_TOTAL,
+    CASIndex,
+    ResultCache,
+    bytes_digest,
+    cas_path,
+    file_digest,
+    harness_digest,
 )
 from .executor_base import RemoteExecutor
 from .obs import events as obs_events
@@ -107,6 +117,18 @@ _EXECUTOR_PLUGIN_DEFAULTS = {
     "task_timeout": 0.0,
     "task_env": {},
     "use_agent": True,
+    # Level-2 cache (cache.py): memoize completed electron results locally,
+    # keyed by (function digest, args digest, executor env fingerprint).
+    # Only sound for side-effect-free electrons, hence opt-in; the env var
+    # COVALENT_TPU_RESULT_CACHE=1 flips it on process-wide.
+    "cache_results": False,
+    "result_cache_max_entries": 512,
+    "result_cache_max_bytes": 256 * 1024 * 1024,
+    # Age bound on remote_cache/cas/ contents, pruned once per connection
+    # during pre-flight: dedupable artifacts (harness, repeated fn pickles)
+    # stay hot, while one-off payloads from long-gone electrons cannot fill
+    # the worker disk.  0 disables pruning.
+    "cas_ttl_hours": 168.0,
     # NOT jax by default: forking a parent that already imported jax (PJRT
     # plugins register at import) measurably slows TPU backend init in the
     # children; interpreter+sitecustomize startup is the big win anyway.
@@ -159,7 +181,12 @@ class StagedTask:
     """Paths produced by staging one task for one worker set.
 
     Extends the reference's 5-tuple of staged paths (``ssh.py:173-179``) with
-    per-worker spec files and the shared harness script.
+    per-worker spec files and the shared harness script.  Immutable staged
+    payloads (harness, function pickle, specs) are *content-addressed*:
+    their remote paths are ``{remote_cache}/cas/{sha256}{ext}``, which is
+    what lets the CAS layer (cache.py) skip re-uploads of bytes a worker
+    already holds.  Mutable per-operation files (result, log, pid) keep
+    their operation-scoped names.
     """
 
     def __init__(self, operation_id: str, cache_dir: Path, remote_cache: str):
@@ -168,14 +195,39 @@ class StagedTask:
         self.local_result_file = str(cache_dir / f"result_{operation_id}.pkl")
         self.local_spec_files: list[str] = []
         self.remote_cache = remote_cache
-        self.remote_function_file = f"{remote_cache}/function_{operation_id}.pkl"
-        self.remote_harness_file = f"{remote_cache}/{HARNESS_BASENAME}"
+        #: content digests, assigned during staging (_write_function_files)
+        self.function_digest: str = ""
+        self.harness_digest: str = ""
+        self.spec_digests: list[str] = []
         self.remote_result_file = f"{remote_cache}/result_{operation_id}.pkl"
         self.remote_log_file = f"{remote_cache}/log_{operation_id}.txt"
         self.remote_pid_file = f"{remote_cache}/pid_{operation_id}"
 
+    @property
+    def remote_function_file(self) -> str:
+        return cas_path(self.remote_cache, self.function_digest, ".pkl")
+
+    @property
+    def remote_harness_file(self) -> str:
+        return cas_path(self.remote_cache, self.harness_digest, ".py")
+
     def remote_spec_file(self, process_id: int) -> str:
-        return f"{self.remote_cache}/spec_{self.operation_id}_{process_id}.json"
+        return cas_path(
+            self.remote_cache, self.spec_digests[process_id], ".json"
+        )
+
+    def artifacts(self, process_id: int) -> list[tuple[str, str, str]]:
+        """``(local_path, remote_path, digest)`` per staged file for one
+        worker — the unit the CAS upload path works in."""
+        return [
+            (self.function_file, self.remote_function_file,
+             self.function_digest),
+            (_harness_module.__file__, self.remote_harness_file,
+             self.harness_digest),
+            (self.local_spec_files[process_id],
+             self.remote_spec_file(process_id),
+             self.spec_digests[process_id]),
+        ]
 
 
 class TPUExecutor(RemoteExecutor):
@@ -220,6 +272,10 @@ class TPUExecutor(RemoteExecutor):
         use_agent: bool | str | None = None,
         pool_preload: str | None = None,
         profile_dir: str | None = None,
+        cache_results: bool | None = None,
+        result_cache_max_entries: int | None = None,
+        result_cache_max_bytes: int | None = None,
+        cas_ttl_hours: float | None = None,
         pool: TransportPool | None = None,
     ) -> None:
         def resolve(value, key):
@@ -294,6 +350,17 @@ class TPUExecutor(RemoteExecutor):
             self.use_agent = False
         #: comma-separated modules the pool server imports once at start.
         self.pool_preload = str(resolve(pool_preload, "pool_preload"))
+        #: result memoization (cache.py level 2): explicit arg > env var >
+        #: config > default-off.  Env is the workflow-layer switch — each
+        #: dispatch resolves a fresh alias executor, and the disk-backed
+        #: store under cache_dir is what repeat dispatches share.
+        env_cache = os.environ.get("COVALENT_TPU_RESULT_CACHE")
+        if cache_results is None and env_cache is not None:
+            cache_results = env_cache.strip().lower() not in (
+                "", "0", "false", "no", "off"
+            )
+        self.cache_results = bool(resolve(cache_results, "cache_results"))
+        self.cas_ttl_hours = float(resolve(cas_ttl_hours, "cas_ttl_hours"))
 
         resolved_poll_freq = float(resolve(poll_freq, "poll_freq"))
         resolved_remote_cache = resolve(remote_cache, "remote_cache")
@@ -305,9 +372,29 @@ class TPUExecutor(RemoteExecutor):
         os.makedirs(self.cache_dir, exist_ok=True)
         self._pool = pool or TransportPool()
         self._owns_pool = pool is None
-        #: transports that already passed pre-flight — one check per host
-        #: per executor lifetime, not per electron (overhead budget).
-        self._preflighted: set[int] = set()
+        #: hosts (by pool key) that already passed pre-flight — one check
+        #: per host per executor lifetime, not per electron (overhead
+        #: budget).  Keyed by pool key, NOT id(conn): a GC'd transport's id
+        #: can be reused by a fresh connection, which would falsely skip
+        #: pre-flight; _discard_workers evicts per-key entries instead.
+        self._preflighted: set[str] = set()
+        #: level-1 cache: per-connection CAS digest sets (cache.py).
+        self._cas = CASIndex()
+        #: level-2 cache: opt-in electron result memoization.
+        self._result_cache: ResultCache | None = (
+            ResultCache(
+                os.path.join(self.cache_dir, "results"),
+                max_entries=int(
+                    resolve(result_cache_max_entries,
+                            "result_cache_max_entries")
+                ),
+                max_bytes=int(
+                    resolve(result_cache_max_bytes, "result_cache_max_bytes")
+                ),
+            )
+            if self.cache_results
+            else None
+        )
         #: operation_id -> {worker address -> pid}; backs cancel().
         self._active: dict[str, dict[str, int]] = {}
         #: operations killed by cancel(): their DEAD status must surface as
@@ -474,11 +561,17 @@ class TPUExecutor(RemoteExecutor):
         # cleanup and leak the staged files — let them finish first.
         await self._drain_cleanup_tasks()
         for address in self._worker_addresses():
-            await self._pool.discard(self._pool_key(address))
+            key = self._pool_key(address)
+            await self._pool.discard(key)
             client = self._agents.pop(address, None)
             if client is not None:
                 await client.close()
-        self._preflighted.clear()
+            # Per-key eviction (not clear()): other hosts' pre-flight and
+            # CAS knowledge stays valid; only the discarded channels must
+            # re-prove their environment and re-probe their artifact cache
+            # (the worker may have been recreated with an empty disk).
+            self._preflighted.discard(key)
+            self._cas.forget(key)
         # A mid-run control-plane failure may mean the TPU itself was
         # preempted/recreated with new IPs: re-discover on the next electron
         # instead of dialing stale addresses forever.
@@ -555,6 +648,7 @@ class TPUExecutor(RemoteExecutor):
         kwargs: dict,
         current_remote_workdir: str,
         pip_deps: Sequence[str] = (),
+        payload: bytes | None = None,
     ) -> StagedTask:
         """Stage the function pickle + per-worker task specs locally.
 
@@ -562,9 +656,22 @@ class TPUExecutor(RemoteExecutor):
         ``.format()``-ing the harness per task (ssh.py:160-171), per-task
         parameters go into small JSON spec files — one per worker process so
         each gets its own ``process_id`` for ``jax.distributed``.
+        ``payload`` carries pre-serialized ``(fn, args, kwargs)`` bytes when
+        the result-cache lookup already pickled them, so a cold cached
+        dispatch never serializes a large argument set twice.
         """
         staged = StagedTask(operation_id, Path(self.cache_dir), self.remote_cache)
-        dump_task(fn, args, kwargs, staged.function_file)
+        if payload is None:
+            dump_task(fn, args, kwargs, staged.function_file)
+            staged.function_digest = file_digest(staged.function_file)
+        else:
+            with open(staged.function_file, "wb") as f:
+                f.write(payload)
+            staged.function_digest = bytes_digest(payload)
+        # Content addressing: remote artifact paths derive from the digests
+        # above, which therefore must exist before the specs (embedding the
+        # remote function path) are written.
+        staged.harness_digest = harness_digest()
 
         num_processes = self._num_processes()
         dist_blocks = (
@@ -588,6 +695,9 @@ class TPUExecutor(RemoteExecutor):
             spec: dict[str, Any] = {
                 "operation_id": operation_id,
                 "function_file": staged.remote_function_file,
+                # The harness verifies the CAS artifact against this before
+                # unpickling: a torn/stale digest-addressed file fails loud.
+                "function_digest": staged.function_digest,
                 "result_file": staged.remote_result_file,
                 "workdir": current_remote_workdir,
                 "pid_file": f"{staged.remote_pid_file}.{process_id}",
@@ -609,7 +719,84 @@ class TPUExecutor(RemoteExecutor):
             with open(local_spec, "w") as f:
                 json.dump(spec, f)
             staged.local_spec_files.append(local_spec)
+            staged.spec_digests.append(file_digest(local_spec))
         return staged
+
+    @staticmethod
+    def _fn_code_digest(fn: Callable) -> str:
+        """Digest of the electron's own bytecode, or "" when unavailable.
+
+        cloudpickle serializes module-importable functions BY REFERENCE
+        (module + qualname), so the staged payload bytes alone would not
+        change when the user edits such a function's body — and the
+        disk-persistent result cache would serve the stale result.  The
+        marshalled code object closes that hole for the electron itself
+        (edits to transitively imported helpers remain invisible — see the
+        README's cache-hit semantics).
+        """
+        import marshal
+
+        code = getattr(fn, "__code__", None)
+        if code is None:
+            code = getattr(getattr(fn, "__call__", None), "__code__", None)
+        if code is None:
+            return ""
+        try:
+            return bytes_digest(marshal.dumps(code))
+        except (TypeError, ValueError):
+            return ""
+
+    def _result_cache_key(
+        self,
+        fn: Callable,
+        args: tuple,
+        kwargs: dict,
+        task_metadata: dict,
+        payload: bytes | None = None,
+    ) -> str | None:
+        """Memoization key for one electron, or None when uncacheable.
+
+        (payload digest, function code digest, executor env fingerprint):
+        the payload is the staged ``(fn, args, kwargs)`` pickle — passed in
+        when run() already serialized it, so key computation and staging
+        share ONE cloudpickle pass — the code digest covers by-reference
+        pickled functions whose payload bytes don't change with their body,
+        and the fingerprint covers everything that could change the remote
+        computation's meaning: transport/interpreter/conda environment,
+        task env, pip deps, and worker topology, so a config change never
+        serves a stale result.  Unpicklable callables/arguments are simply
+        uncacheable (counted, never fatal).
+        """
+        if payload is None:
+            try:
+                payload = cloudpickle.dumps(
+                    (fn, tuple(args), dict(kwargs))
+                )
+            except Exception as err:  # noqa: BLE001 - arbitrary payloads
+                RESULT_CACHE_TOTAL.labels(result="unpicklable").inc()
+                app_log.debug(
+                    "result cache: electron not picklable (%s)", err
+                )
+                return None
+        fingerprint = json.dumps(
+            {
+                "transport": self.transport_kind,
+                "python_path": self.python_path,
+                "conda_env": self.conda_env,
+                "task_env": self.task_env,
+                "pip_deps": list(task_metadata.get("pip_deps", ()) or ()),
+                "workers": self.workers
+                or [self.tpu_name or self.hostname or "local"],
+                "workdir": self.remote_workdir,
+            },
+            sort_keys=True,
+            default=str,
+        )
+        return ResultCache.make_key(
+            bytes_digest(payload),
+            self._fn_code_digest(fn),
+            bytes_digest(fingerprint.encode()),
+        )
 
     def _preflight_command(self) -> str:
         """One compound pre-flight command.
@@ -618,7 +805,19 @@ class TPUExecutor(RemoteExecutor):
         (ssh.py:508-519), python3 check (ssh.py:521-524), cache mkdir
         (ssh.py:528-532) — into a single exec.
         """
-        checks = [f"mkdir -p {shlex.quote(self.remote_cache)}"]
+        cas_dir = shlex.quote(cas_path(self.remote_cache, "").rstrip("/"))
+        checks = [
+            f"mkdir -p {shlex.quote(self.remote_cache)} {cas_dir}"
+        ]
+        prune = self._cas_prune_clause()
+        if prune:
+            # Connection-start CAS prune (pre-flight runs before the first
+            # existence probe, so the present set can never reference a
+            # pruned file): bounds worker-disk growth from one-off payloads
+            # and sweeps .tmp orphans of crashed uploads.  Cleanup re-runs
+            # the same clause per electron, so growth stays bounded on
+            # long-lived connections too.
+            checks.append(f"({prune} || true)")
         if self.conda_env:
             checks.append(
                 f'eval "$(conda shell.bash hook)" && conda activate '
@@ -635,15 +834,51 @@ class TPUExecutor(RemoteExecutor):
         )
         return " && ".join(checks)
 
-    async def _preflight(self, conn: Transport) -> None:
+    def _cas_prune_clause(self) -> str | None:
+        """Age-prune shell clause for the CAS dir; None when disabled."""
+        if self.cas_ttl_hours <= 0:
+            return None
+        cas_dir = shlex.quote(cas_path(self.remote_cache, "").rstrip("/"))
+        minutes = max(1, int(self.cas_ttl_hours * 60))
+        return (
+            f"find {cas_dir} -type f -mmin +{minutes} "
+            "-exec rm -f {} + 2>/dev/null"
+        )
+
+    def _cas_maintenance_command(self, staged: StagedTask) -> str:
+        """Per-electron CAS upkeep, one round-trip, run during cleanup.
+
+        ``touch`` refreshes the dedupable artifacts' mtimes so the TTL
+        prune treats in-use files as hot — without it, a sibling executor's
+        prune could delete a week-old harness a live present set still
+        references, making the next upload skip launch against a missing
+        file.  The prune clause then ages out one-off payloads (unique-args
+        function pickles) continuously, not just at connection start, so a
+        long-lived connection cannot fill the worker disk.
+        """
+        hot = " ".join(
+            shlex.quote(p)
+            for p in (staged.remote_function_file, staged.remote_harness_file)
+        )
+        parts = [f"touch -c {hot} 2>/dev/null"]
+        prune = self._cas_prune_clause()
+        if prune:
+            parts.append(prune)
+        return "; ".join(parts) + "; true"
+
+    async def _preflight(self, conn: Transport, key: str | None = None) -> None:
         """Run the environment checks once per pooled connection.
 
         The reference re-validates the remote environment on every electron
         (3 round-trips each time, ssh.py:508-532); with pooled transports the
         environment cannot change under us, so the (already batched) check
         runs once per host and subsequent electrons skip straight to staging.
+        Keyed by the pool key (the connection's durable identity), which
+        _discard_workers evicts when the channel is dropped — an id(conn)
+        key could be silently reused by a fresh connection after GC.
         """
-        if id(conn) in self._preflighted:
+        key = key or self._pool_key(conn.address)
+        if key in self._preflighted:
             return
         result = await conn.run(self._preflight_command())
         if result.exit_status != 0:
@@ -655,17 +890,31 @@ class TPUExecutor(RemoteExecutor):
                 f"{self.python_path} on {conn.address} is not python3 "
                 f"(reported major version {result.stdout.strip()!r})"
             )
-        self._preflighted.add(id(conn))
+        self._preflighted.add(key)
 
     async def _upload_task(
-        self, conn: Transport, staged: StagedTask, process_id: int
+        self,
+        conn: Transport,
+        staged: StagedTask,
+        process_id: int,
+        key: str | None = None,
     ) -> None:
-        """Ship the staged files to one worker (reference: ssh.py:337-361)."""
-        await conn.put(staged.function_file, staged.remote_function_file)
-        await conn.put(_harness_module.__file__, staged.remote_harness_file)
-        await conn.put(
-            staged.local_spec_files[process_id], staged.remote_spec_file(process_id)
+        """Ship the staged files to one worker (reference: ssh.py:337-361).
+
+        Every artifact goes through the CAS layer: digests the worker is
+        known to hold are skipped outright, unknown state is resolved by
+        ONE batched existence probe per connection lifetime, and identical
+        payloads racing from concurrent electrons upload single-flight.
+        The harness (digest constant per package version) therefore ships
+        once per connection, not once per electron × worker.
+        """
+        key = key or self._pool_key(conn.address)
+        artifacts = staged.artifacts(process_id)
+        await self._cas.ensure_probed(
+            key, conn, [(digest, remote) for _, remote, digest in artifacts]
         )
+        for local, remote, digest in artifacts:
+            await self._cas.ensure(key, conn, digest, local, remote)
 
     # ------------------------------------------------------------------ #
     # Submit / status / poll / fetch / cancel / cleanup                  #
@@ -1129,7 +1378,20 @@ class TPUExecutor(RemoteExecutor):
     async def cleanup(
         self, conns: list[Transport], staged: StagedTask
     ) -> None:
-        """Delete staged files locally and on every worker (ref: ssh.py:284-315)."""
+        """Delete per-operation staged files locally and on every worker
+        (ref: ssh.py:284-315).
+
+        Dedupable CAS artifacts (function pickle, harness) deliberately
+        survive cleanup: they ARE the remote cache — deleting them would
+        invalidate the per-connection present sets mid-flight for
+        concurrent electrons and force every repeat dispatch to re-upload
+        (the pre-flight TTL prune bounds their long-tail growth instead).
+        Spec files, though CAS-named, embed the operation id and so can
+        never dedupe across electrons — they are removed with the other
+        per-operation files (result, done markers, log, pid), and their
+        digests evicted from the CAS index so a retried operation
+        re-uploads instead of launching against a missing spec.
+        """
         for path in [
             staged.function_file,
             staged.local_result_file,
@@ -1139,10 +1401,11 @@ class TPUExecutor(RemoteExecutor):
                 os.remove(path)
             except FileNotFoundError:
                 pass
+        for digest in staged.spec_digests:
+            self._cas.forget_digest(digest)
 
         async def clean_worker(process_id: int, conn: Transport) -> None:
             files = [
-                staged.remote_function_file,
                 staged.remote_spec_file(process_id),
                 staged.remote_log_file,
                 f"{staged.remote_pid_file}.{process_id}",
@@ -1155,6 +1418,15 @@ class TPUExecutor(RemoteExecutor):
             if result.exit_status != 0:
                 app_log.warning(
                     "cleanup on %s: %s", conn.address, result.stderr.strip()
+                )
+            # Keep the op's dedupable artifacts hot + age out stale CAS
+            # entries (best-effort: the clause ends in `true`, and a failed
+            # round-trip must not fail a cleanup that already succeeded).
+            try:
+                await conn.run(self._cas_maintenance_command(staged))
+            except (TransportError, OSError) as err:
+                app_log.debug(
+                    "CAS maintenance on %s skipped: %s", conn.address, err
                 )
 
         await asyncio.gather(
@@ -1230,6 +1502,9 @@ class TPUExecutor(RemoteExecutor):
             )
         self._cleanup_tasks = set()
         self._preflighted.clear()
+        # CASIndex holds loop-bound locks/futures; present-set knowledge is
+        # cheap to rebuild via one probe per redialed connection.
+        self._cas = CASIndex()
         self._bound_loop = loop
 
     async def close(self) -> None:
@@ -1312,7 +1587,44 @@ class TPUExecutor(RemoteExecutor):
         outcome = "failed"
         staged: StagedTask | None = None
         conns: list[Transport] = []
+        result_cache_key: str | None = None
+        staged_payload: bytes | None = None
         try:
+            if self.cache_results:
+                # Level-2 memoization sits AHEAD of connect: a hit returns
+                # the completed result without touching the transport.  The
+                # pickled payload is kept for staging so a cold run pays
+                # ONE serialization pass, not two.
+                with Span("executor.cache_lookup"):
+                    try:
+                        staged_payload = await asyncio.to_thread(
+                            cloudpickle.dumps, (function, args, kwargs)
+                        )
+                    except Exception as err:  # noqa: BLE001 - user payloads
+                        RESULT_CACHE_TOTAL.labels(
+                            result="unpicklable"
+                        ).inc()
+                        app_log.debug(
+                            "result cache: electron not picklable (%s)", err
+                        )
+                    else:
+                        result_cache_key = self._result_cache_key(
+                            function, args, kwargs, task_metadata,
+                            payload=staged_payload,
+                        )
+                    if result_cache_key is not None:
+                        hit, cached = await asyncio.to_thread(
+                            self._result_cache.get, result_cache_key
+                        )
+                        if hit:
+                            obs_events.emit(
+                                "task.result_cached",
+                                operation_id=operation_id,
+                                trace_id=root.trace_id,
+                            )
+                            outcome = "cached"
+                            return cached
+
             with Span("executor.validate"):
                 await self._validate_credentials()
 
@@ -1323,8 +1635,12 @@ class TPUExecutor(RemoteExecutor):
                     # Agent warm-up (upload + compile on first use) rides the
                     # same gather as the env checks: independent round-trips,
                     # so the first electron hides the one-time compile cost.
+                    addresses = self._worker_addresses()
                     await asyncio.gather(
-                        *(self._preflight(c) for c in conns),
+                        *(
+                            self._preflight(c, key=self._pool_key(a))
+                            for a, c in zip(addresses, conns)
+                        ),
                         *(self._agent_for(c) for c in conns),
                     )
             except (TransportError, OSError, ValueError) as err:
@@ -1346,10 +1662,16 @@ class TPUExecutor(RemoteExecutor):
                     kwargs,
                     current_remote_workdir,
                     pip_deps=task_metadata.get("pip_deps", ()),
+                    payload=staged_payload,
                 )
             with Span("executor.upload"):
                 await asyncio.gather(
-                    *(self._upload_task(c, staged, i) for i, c in enumerate(conns))
+                    *(
+                        self._upload_task(
+                            c, staged, i, key=self._pool_key(addresses[i])
+                        )
+                        for i, c in enumerate(conns)
+                    )
                 )
 
             try:
@@ -1455,6 +1777,13 @@ class TPUExecutor(RemoteExecutor):
                 # the finally below still runs, unlike the reference's leak.
                 outcome = "remote_exception"
                 raise exception
+            if result_cache_key is not None:
+                # Only a clean remote completion is memoized: failures,
+                # fallbacks, and remote exceptions always re-run.
+                with Span("executor.cache_store"):
+                    await asyncio.to_thread(
+                        self._result_cache.put, result_cache_key, result
+                    )
             outcome = "completed"
             return result
         except asyncio.CancelledError:
@@ -1465,7 +1794,7 @@ class TPUExecutor(RemoteExecutor):
             # failure, fallback, cancel — so overhead attribution and the
             # outcome counter survive failed runs.
             root.set_attribute("outcome", outcome)
-            if outcome not in ("completed", "fallback_local"):
+            if outcome not in ("completed", "fallback_local", "cached"):
                 root.record_error(outcome)
             root.end()
             self.last_timings = root.summary()

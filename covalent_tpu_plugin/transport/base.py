@@ -56,6 +56,50 @@ class Transport(ABC):
     async def close(self) -> None:
         """Release the channel (idempotent)."""
 
+    async def exists_batch(self, paths: list[str]) -> list[bool]:
+        """Existence flags for ``paths`` in ONE control-plane round-trip.
+
+        Seeds the content-addressed staging cache (cache.py): probing N
+        digest paths individually would cost N round-trips — the exact
+        per-electron overhead the CAS exists to remove.  Default rides one
+        compound ``test -e`` command; backends with direct filesystem
+        access override it.  Unparseable probe output degrades to
+        all-absent (a spurious re-upload, never a spurious skip).
+        """
+        import shlex
+
+        if not paths:
+            return []
+        probe = "; ".join(
+            f"test -e {shlex.quote(p)} && echo 1 || echo 0" for p in paths
+        )
+        result = await self.run(probe)
+        tokens = [
+            line.strip()
+            for line in result.stdout.splitlines()
+            if line.strip() in ("0", "1")
+        ]
+        if result.exit_status != 0 or len(tokens) != len(paths):
+            return [False] * len(paths)
+        return [token == "1" for token in tokens]
+
+    async def rename(self, src: str, dst: str) -> None:
+        """Atomically move a worker-side file (CAS publish step).
+
+        Content-addressed uploads land under a temp name first, then rename
+        into the digest path — readers (including other executors' batched
+        existence probes) can never observe a half-written artifact.
+        """
+        import shlex
+
+        result = await self.run(
+            f"mv -f {shlex.quote(src)} {shlex.quote(dst)}"
+        )
+        if result.exit_status != 0:
+            raise TransportError(
+                f"rename {src} -> {dst} failed: {result.stderr.strip()}"
+            )
+
     async def remove(self, paths: list[str]) -> CommandResult:
         """Best-effort delete of worker-side files (cleanup hot path).
 

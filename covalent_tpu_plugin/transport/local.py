@@ -57,6 +57,19 @@ class LocalTransport(Transport):
             describe or f"local:{command.split()[0]}",
         )
 
+    async def exists_batch(self, paths: list[str]) -> list[bool]:
+        """Direct stat batch — no shell spawn on the CAS probe path."""
+        return await asyncio.to_thread(
+            lambda: [os.path.exists(p) for p in paths]
+        )
+
+    async def rename(self, src: str, dst: str) -> None:
+        """Direct atomic replace — no shell spawn on the CAS publish path."""
+        try:
+            await asyncio.to_thread(os.replace, src, dst)
+        except OSError as err:
+            raise TransportError(f"rename {src} -> {dst} failed: {err}")
+
     async def remove(self, paths: list[str]) -> CommandResult:
         """Direct unlink — no shell spawn on the cleanup hot path.
 

@@ -17,6 +17,7 @@ pre-flight amortise across the whole lattice (the <2 s overhead budget).
 from __future__ import annotations
 
 import asyncio
+import os
 import threading
 import traceback
 import uuid
@@ -76,7 +77,45 @@ class Result:
         return self._done.wait(timeout)
 
 
+#: dispatch_id -> Result.  Bounded: under sustained traffic an unbounded
+#: store leaks one Result (with node outputs) per dispatch forever, so
+#: only the newest ``COVALENT_TPU_RESULT_RETENTION`` *terminal* results
+#: are retained (insertion order = dispatch order); running dispatches are
+#: never evicted.  ``get_result`` on an evicted id raises the same
+#: ValueError an unknown id does.
 _RESULTS: dict[str, Result] = {}
+_RESULTS_LOCK = threading.Lock()
+_RESULT_RETENTION_ENV = "COVALENT_TPU_RESULT_RETENTION"
+_DEFAULT_RESULT_RETENTION = 256
+
+_RESULTS_EVICTED = REGISTRY.counter(
+    "covalent_tpu_results_evicted_total",
+    "Terminal dispatch Results evicted from the in-memory store",
+)
+
+
+def _result_retention() -> int:
+    """Read at eviction time so embedders/tests can retune a live process."""
+    try:
+        return max(1, int(
+            os.environ.get(_RESULT_RETENTION_ENV, _DEFAULT_RESULT_RETENTION)
+        ))
+    except ValueError:
+        return _DEFAULT_RESULT_RETENTION
+
+
+def _retain_terminal_results() -> None:
+    """Evict oldest terminal Results beyond the retention bound."""
+    limit = _result_retention()
+    with _RESULTS_LOCK:
+        terminal = [
+            dispatch_id
+            for dispatch_id, result in _RESULTS.items()
+            if result._done.is_set()
+        ]
+        for dispatch_id in terminal[: max(0, len(terminal) - limit)]:
+            del _RESULTS[dispatch_id]
+            _RESULTS_EVICTED.inc()
 
 
 class _DependencyFailed(Exception):
@@ -240,6 +279,7 @@ async def _execute_graph(graph: Graph, result: Result) -> None:
             **({"error": result.error} if result.error else {}),
         )
         result._done.set()
+        _retain_terminal_results()
 
 
 _LOOP_LOCK = threading.Lock()
@@ -275,7 +315,8 @@ def dispatch(lattice: Lattice) -> Callable[..., str]:
         dispatch_id = str(uuid.uuid4())
         graph = lattice.build_graph(*args, **kwargs)
         result = Result(dispatch_id=dispatch_id, status=Status.RUNNING)
-        _RESULTS[dispatch_id] = result
+        with _RESULTS_LOCK:
+            _RESULTS[dispatch_id] = result
         asyncio.run_coroutine_threadsafe(
             _execute_graph(graph, result), _dispatcher_loop()
         )
@@ -357,7 +398,8 @@ def get_result(
     """Fetch a dispatch's Result; with ``wait=True`` block until final
     (``ct.get_result(dispatch_id, wait=True)``, basic_workflow_test.py:24)."""
     try:
-        result = _RESULTS[dispatch_id]
+        with _RESULTS_LOCK:
+            result = _RESULTS[dispatch_id]
     except KeyError:
         raise ValueError(f"unknown dispatch_id {dispatch_id!r}") from None
     if wait:

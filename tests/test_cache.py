@@ -1,0 +1,663 @@
+"""Two-level dispatch cache tests (cache.py + executor/transport wiring).
+
+Level 1: content-addressed staging — digest helpers, the per-connection
+CAS index (probe seeding, single-flight puts, eviction), and the
+executor-level guarantee the PR exists for: the harness pickle is put at
+most once per connection across a multi-electron run.
+
+Level 2: electron result memoization — disk LRU bounds, the opt-in
+switches, and a full run() short-circuit that never touches the transport.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from covalent_tpu_plugin.cache import (
+    CAS_UPLOADS_TOTAL,
+    RESULT_CACHE_TOTAL,
+    CASIndex,
+    ResultCache,
+    bytes_digest,
+    cas_path,
+    file_digest,
+    harness_digest,
+)
+from covalent_tpu_plugin.obs.metrics import REGISTRY
+from covalent_tpu_plugin.transport.base import CommandResult
+from covalent_tpu_plugin.transport.local import LocalTransport
+
+from .helpers import FakeTransport, scripted_ok_responses
+from .test_tpu_executor import METADATA, make_executor
+
+
+def counter_value(counter, **labels) -> float:
+    return counter.labels(**labels).value
+
+
+# --------------------------------------------------------------------- #
+# Digest helpers
+# --------------------------------------------------------------------- #
+
+
+def test_file_digest_matches_bytes_digest(tmp_path):
+    path = tmp_path / "payload.bin"
+    path.write_bytes(b"covalent" * 1000)
+    assert file_digest(str(path)) == bytes_digest(b"covalent" * 1000)
+
+
+def test_harness_digest_is_stable_and_matches_file():
+    from covalent_tpu_plugin import harness
+
+    assert harness_digest() == file_digest(harness.__file__)
+    assert harness_digest() == harness_digest()  # memoized
+
+
+def test_cas_path_layout():
+    assert cas_path("/rc", "abc123", ".pkl") == "/rc/cas/abc123.pkl"
+
+
+# --------------------------------------------------------------------- #
+# CASIndex
+# --------------------------------------------------------------------- #
+
+
+def test_cas_ensure_uploads_once_per_key(tmp_path, run_async):
+    fake = FakeTransport()
+    index = CASIndex()
+    local = tmp_path / "artifact"
+    local.write_bytes(b"payload")
+    digest = file_digest(str(local))
+    hits0 = counter_value(CAS_UPLOADS_TOTAL, result="hit")
+    misses0 = counter_value(CAS_UPLOADS_TOTAL, result="miss")
+
+    async def flow():
+        await index.ensure("k", fake, digest, str(local), "/rc/cas/x")
+        await index.ensure("k", fake, digest, str(local), "/rc/cas/x")
+        # A different connection key has its own present set.
+        await index.ensure("k2", fake, digest, str(local), "/rc/cas/x")
+
+    run_async(flow())
+    assert len(fake.puts) == 2  # once per key, not per call
+    assert counter_value(CAS_UPLOADS_TOTAL, result="hit") - hits0 == 1
+    assert counter_value(CAS_UPLOADS_TOTAL, result="miss") - misses0 == 2
+
+
+def test_cas_concurrent_ensures_single_flight(tmp_path, run_async):
+    """Concurrent electrons sharing one digest trigger exactly one put."""
+
+    class SlowPutTransport(FakeTransport):
+        async def put(self, local_path, remote_path):
+            await asyncio.sleep(0.02)
+            await super().put(local_path, remote_path)
+
+    fake = SlowPutTransport()
+    index = CASIndex()
+    local = tmp_path / "artifact"
+    local.write_bytes(b"shared")
+    digest = file_digest(str(local))
+
+    async def flow():
+        await asyncio.gather(
+            *(
+                index.ensure("k", fake, digest, str(local), "/rc/cas/x")
+                for _ in range(5)
+            )
+        )
+
+    run_async(flow())
+    assert len(fake.puts) == 1
+
+
+def test_cas_probe_seeds_present_set(tmp_path, run_async):
+    """Artifacts the worker already holds are never re-uploaded: the ONE
+    batched existence probe seeds the present set."""
+    fake = FakeTransport({"test -e": CommandResult(0, "1\n1\n", "")})
+    index = CASIndex()
+    a = tmp_path / "a"
+    a.write_bytes(b"a")
+    b = tmp_path / "b"
+    b.write_bytes(b"b")
+    da, db = file_digest(str(a)), file_digest(str(b))
+
+    async def flow():
+        await index.ensure_probed(
+            "k", fake, [(da, "/rc/cas/a"), (db, "/rc/cas/b")]
+        )
+        await index.ensure("k", fake, da, str(a), "/rc/cas/a")
+        await index.ensure("k", fake, db, str(b), "/rc/cas/b")
+        # Probe ran once; re-asking is a no-op round-trip-wise.
+        await index.ensure_probed("k", fake, [(da, "/rc/cas/a")])
+
+    run_async(flow())
+    assert len(fake.puts) == 0
+    assert len([c for c in fake.commands if "test -e" in c]) == 1
+
+
+def test_cas_forget_evicts_key(tmp_path, run_async):
+    fake = FakeTransport()
+    index = CASIndex()
+    local = tmp_path / "artifact"
+    local.write_bytes(b"payload")
+    digest = file_digest(str(local))
+
+    async def flow():
+        await index.ensure("k", fake, digest, str(local), "/rc/cas/x")
+        index.forget("k")
+        await index.ensure("k", fake, digest, str(local), "/rc/cas/x")
+
+    run_async(flow())
+    assert len(fake.puts) == 2  # re-uploaded after eviction
+
+
+def test_exists_batch_shell_default_and_local_override(tmp_path, run_async):
+    present = tmp_path / "present"
+    present.write_text("x")
+    absent = str(tmp_path / "absent")
+
+    conn = LocalTransport()
+    assert run_async(conn.exists_batch([str(present), absent])) == [True, False]
+
+    # The ABC default: one compound shell round-trip through run().
+    from covalent_tpu_plugin.transport.base import Transport
+
+    shell = LocalTransport()
+    flags = run_async(Transport.exists_batch(shell, [str(present), absent]))
+    assert flags == [True, False]
+    assert run_async(Transport.exists_batch(shell, [])) == []
+
+
+# --------------------------------------------------------------------- #
+# Executor-level CAS (the acceptance-criteria test)
+# --------------------------------------------------------------------- #
+
+
+def test_harness_put_at_most_once_per_connection_two_electrons(
+    tmp_path, run_async
+):
+    """Across a 2-electron run on one pooled connection, the harness (and
+    the identical function pickle) upload once; the second electron ships
+    only its spec.  CAS hit counter >= 1 and the per-put span count drops
+    on the second electron."""
+    fake = FakeTransport(scripted_ok_responses())
+    fake.result_payload = (1, None)
+    ex = make_executor(tmp_path, fake)
+    fn = lambda: 1  # noqa: E731 - identical pickle across both electrons
+    hits0 = counter_value(CAS_UPLOADS_TOTAL, result="hit")
+
+    def put_span_count() -> int:
+        hist = REGISTRY.get("covalent_tpu_span_duration_seconds")
+        if hist is None:
+            return 0
+        for labels, child in hist._series():
+            if labels.get("span") == "executor.cas_put":
+                return child.count
+        return 0
+
+    spans0 = put_span_count()
+    state = {}
+
+    async def flow():
+        # One dispatcher loop for both electrons, like the workflow runner:
+        # a fresh loop per run() would (correctly) abandon the CAS index
+        # with the pooled transports it describes.
+        await ex.run(fn, [], {}, {"dispatch_id": "d", "node_id": 0})
+        state["first_puts"] = list(fake.puts)
+        state["spans_first"] = put_span_count() - spans0
+        await ex.run(fn, [], {}, {"dispatch_id": "d", "node_id": 1})
+
+    run_async(flow())
+    first_puts = state["first_puts"]
+    spans_first = state["spans_first"]
+    second_puts = fake.puts[len(first_puts):]
+    spans_second = put_span_count() - spans0 - spans_first
+
+    # Puts land under temp names and are atomically renamed into the
+    # digest path, so match on the artifact suffix inside the temp name.
+    harness_remote = [p for _, p in fake.puts if ".py.tmp-" in p]
+    assert len(harness_remote) == 1  # harness put at most once
+    assert len(first_puts) == 3  # function + harness + spec
+    assert len(second_puts) == 1  # only the new spec (fn + harness hit)
+    assert ".json.tmp-" in second_puts[0][1]
+    assert counter_value(CAS_UPLOADS_TOTAL, result="hit") - hits0 >= 2
+    assert spans_second < spans_first  # upload span count drops
+
+
+def test_discarded_connection_reprobes_and_reuploads(tmp_path, run_async):
+    """_discard_workers evicts CAS knowledge: a recreated worker gets the
+    artifacts again instead of a dangling 'already present' assumption."""
+    fake = FakeTransport(scripted_ok_responses(), address="localhost")
+    fake.result_payload = (1, None)
+    ex = make_executor(tmp_path, fake)
+    fn = lambda: 1  # noqa: E731
+
+    async def flow():
+        await ex.run(fn, [], {}, {"dispatch_id": "d", "node_id": 0})
+        await ex._discard_workers()
+        await ex.run(fn, [], {}, {"dispatch_id": "d", "node_id": 1})
+
+    run_async(flow())
+    harness_puts = [p for _, p in fake.puts if ".py.tmp-" in p]
+    assert len(harness_puts) == 2  # re-uploaded after discard
+
+
+# --------------------------------------------------------------------- #
+# ResultCache (level 2)
+# --------------------------------------------------------------------- #
+
+
+def test_result_cache_roundtrip_and_miss(tmp_path):
+    cache = ResultCache(str(tmp_path / "rc"))
+    key = ResultCache.make_key("fn", "args", "env")
+    hit, value = cache.get(key)
+    assert (hit, value) == (False, None)
+    assert cache.put(key, {"loss": 0.25})
+    hit, value = cache.get(key)
+    assert hit and value == {"loss": 0.25}
+
+
+def test_result_cache_entry_bound_evicts_oldest(tmp_path):
+    import os
+    import time
+
+    cache = ResultCache(str(tmp_path / "rc"), max_entries=2)
+    evicted0 = counter_value(RESULT_CACHE_TOTAL, result="evict")
+    keys = [ResultCache.make_key("fn", str(i), "env") for i in range(3)]
+    for i, key in enumerate(keys):
+        cache.put(key, i)
+        # mtime is the LRU clock; backdate each entry (oldest first) so
+        # the ordering is deterministic under sub-second mtime resolution.
+        path = cache._path(key)
+        if os.path.exists(path):
+            stamp = time.time() - 10 + i
+            os.utime(path, (stamp, stamp))
+    assert len(cache) == 2
+    assert cache.get(keys[0])[0] is False  # oldest gone
+    assert cache.get(keys[2]) == (True, 2)
+    assert counter_value(RESULT_CACHE_TOTAL, result="evict") - evicted0 >= 1
+
+
+def test_result_cache_byte_bound(tmp_path):
+    cache = ResultCache(str(tmp_path / "rc"), max_entries=100, max_bytes=64)
+    key = ResultCache.make_key("fn", "big", "env")
+    assert cache.put(key, "x" * 10_000) is False  # oversize, never stored
+    assert cache.get(key)[0] is False
+
+
+def test_result_cache_unpicklable_value_is_counted_not_fatal(tmp_path):
+    cache = ResultCache(str(tmp_path / "rc"))
+    before = counter_value(RESULT_CACHE_TOTAL, result="unpicklable")
+    assert cache.put("k", lambda: (yield)) in (True, False)  # never raises
+    # generator-function results pickle via cloudpickle; use a socket to
+    # guarantee failure
+    import socket
+
+    sock = socket.socket()
+    try:
+        assert cache.put("k2", sock) is False
+    finally:
+        sock.close()
+    assert counter_value(RESULT_CACHE_TOTAL, result="unpicklable") > before
+
+
+def test_result_cache_corrupt_entry_is_a_miss(tmp_path):
+    cache = ResultCache(str(tmp_path / "rc"))
+    key = ResultCache.make_key("fn", "args", "env")
+    cache.put(key, 42)
+    with open(cache._path(key), "wb") as f:
+        f.write(b"\x80garbage")
+    hit, value = cache.get(key)
+    assert (hit, value) == (False, None)
+
+
+# --------------------------------------------------------------------- #
+# Executor-level memoization
+# --------------------------------------------------------------------- #
+
+
+def test_run_result_cache_hit_skips_transport(tmp_path, run_async):
+    fake = FakeTransport(scripted_ok_responses())
+    fake.result_payload = ({"acc": 0.9}, None)
+    ex = make_executor(tmp_path, fake, cache_results=True)
+    fn = lambda: {"acc": 0.9}  # noqa: E731
+    hits0 = counter_value(RESULT_CACHE_TOTAL, result="hit")
+
+    out1 = run_async(ex.run(fn, [], {}, {"dispatch_id": "d", "node_id": 0}))
+    commands_after_first = len(fake.commands)
+    puts_after_first = len(fake.puts)
+    out2 = run_async(ex.run(fn, [], {}, {"dispatch_id": "d2", "node_id": 0}))
+
+    assert out1 == out2 == {"acc": 0.9}
+    # The hit returned before connect: zero new control-plane traffic.
+    assert len(fake.commands) == commands_after_first
+    assert len(fake.puts) == puts_after_first
+    assert counter_value(RESULT_CACHE_TOTAL, result="hit") - hits0 == 1
+    assert ex.last_timings["overhead"] >= 0.0
+
+
+def test_run_result_cache_distinguishes_args(tmp_path, run_async):
+    fake = FakeTransport(scripted_ok_responses())
+    fake.result_payload = (1, None)
+    ex = make_executor(tmp_path, fake, cache_results=True)
+    fn = lambda x: x  # noqa: E731
+
+    run_async(ex.run(fn, [1], {}, {"dispatch_id": "d", "node_id": 0}))
+    commands_after_first = len(fake.commands)
+    run_async(ex.run(fn, [2], {}, {"dispatch_id": "d", "node_id": 1}))
+    # Different args -> different key -> full dispatch again.
+    assert len(fake.commands) > commands_after_first
+
+
+def test_run_remote_exception_not_memoized(tmp_path, run_async):
+    fake = FakeTransport(scripted_ok_responses())
+    fake.result_payload = (None, KeyError("boom"))
+    ex = make_executor(tmp_path, fake, cache_results=True)
+    fn = lambda: 1  # noqa: E731
+
+    with pytest.raises(KeyError):
+        run_async(ex.run(fn, [], {}, {"dispatch_id": "d", "node_id": 0}))
+    commands_after_first = len(fake.commands)
+    with pytest.raises(KeyError):
+        run_async(ex.run(fn, [], {}, {"dispatch_id": "d", "node_id": 1}))
+    assert len(fake.commands) > commands_after_first  # re-ran, no hit
+
+
+def test_cache_results_env_var_opt_in(tmp_path, monkeypatch):
+    monkeypatch.setenv("COVALENT_TPU_RESULT_CACHE", "1")
+    ex = make_executor(tmp_path)
+    assert ex.cache_results is True
+    assert ex._result_cache is not None
+    monkeypatch.setenv("COVALENT_TPU_RESULT_CACHE", "0")
+    ex = make_executor(tmp_path)
+    assert ex.cache_results is False
+    assert ex._result_cache is None
+
+
+def test_cache_results_default_off(tmp_path):
+    ex = make_executor(tmp_path)
+    assert ex.cache_results is False
+    assert ex._result_cache is None
+
+
+def test_result_cache_key_covers_env_fingerprint(tmp_path):
+    ex1 = make_executor(tmp_path, cache_results=True)
+    ex2 = make_executor(
+        tmp_path, cache_results=True, task_env={"LIBTPU_INIT_ARGS": "x"}
+    )
+    fn = lambda: 1  # noqa: E731
+    k1 = ex1._result_cache_key(fn, (), {}, dict(METADATA))
+    k2 = ex2._result_cache_key(fn, (), {}, dict(METADATA))
+    k1_again = ex1._result_cache_key(fn, (), {}, dict(METADATA))
+    assert k1 == k1_again
+    assert k1 != k2  # different task_env must not share results
+    with_pip = ex1._result_cache_key(
+        fn, (), {}, {**METADATA, "pip_deps": ["scikit-learn"]}
+    )
+    assert with_pip != k1
+
+
+def test_result_cache_shared_across_executor_instances(tmp_path, run_async):
+    """Alias executors are rebuilt per workflow dispatch; the disk store
+    under cache_dir is what lets repeated dispatches of the same lattice
+    hit the cache."""
+    fake1 = FakeTransport(scripted_ok_responses())
+    fake1.result_payload = (7, None)
+    ex1 = make_executor(tmp_path, fake1, cache_results=True)
+    fn = lambda: 7  # noqa: E731
+    assert run_async(ex1.run(fn, [], {}, dict(METADATA))) == 7
+
+    fake2 = FakeTransport(scripted_ok_responses())
+    ex2 = make_executor(tmp_path, fake2, cache_results=True)
+    assert run_async(ex2.run(fn, [], {}, dict(METADATA))) == 7
+    assert fake2.commands == []  # pure cache hit, no transport traffic
+
+
+# --------------------------------------------------------------------- #
+# Harness-side CAS integrity
+# --------------------------------------------------------------------- #
+
+
+def test_harness_rejects_digest_mismatch(tmp_path):
+    """A torn/stale CAS artifact fails loud before unpickling."""
+    import cloudpickle
+
+    from covalent_tpu_plugin import harness
+
+    fn_file = tmp_path / "fn.pkl"
+    with open(fn_file, "wb") as f:
+        cloudpickle.dump((lambda: 1, (), {}), f)
+    result_file = tmp_path / "result.pkl"
+    spec = {
+        "operation_id": "op",
+        "function_file": str(fn_file),
+        "function_digest": "0" * 64,  # wrong on purpose
+        "result_file": str(result_file),
+    }
+    rc = harness.run_task(spec)
+    assert rc == 1
+    import pickle
+
+    with open(result_file, "rb") as f:
+        result, error = pickle.load(f)
+    assert result is None
+    assert "digest" in str(error)
+
+
+def test_harness_accepts_matching_digest(tmp_path):
+    import cloudpickle
+
+    from covalent_tpu_plugin import harness
+
+    fn_file = tmp_path / "fn.pkl"
+    with open(fn_file, "wb") as f:
+        cloudpickle.dump((lambda: 41 + 1, (), {}), f)
+    result_file = tmp_path / "result.pkl"
+    spec = {
+        "operation_id": "op",
+        "function_file": str(fn_file),
+        "function_digest": file_digest(str(fn_file)),
+        "result_file": str(result_file),
+    }
+    assert harness.run_task(spec) == 0
+    import pickle
+
+    with open(result_file, "rb") as f:
+        result, error = pickle.load(f)
+    assert (result, error) == (42, None)
+
+
+# --------------------------------------------------------------------- #
+# Pre-flight keying (satellite: id(conn) reuse bug)
+# --------------------------------------------------------------------- #
+
+
+def test_preflight_keyed_by_pool_key_not_id(tmp_path, run_async):
+    fake = FakeTransport(
+        {"mkdir -p": CommandResult(0, "3\n", "")}, address="localhost"
+    )
+    ex = make_executor(tmp_path)
+    run_async(ex._preflight(fake, key=ex._pool_key("localhost")))
+    assert ex._preflighted == {ex._pool_key("localhost")}
+    assert not any(isinstance(k, int) for k in ex._preflighted)
+
+
+def test_discard_workers_evicts_preflight_entry(tmp_path, run_async):
+    fake = FakeTransport(
+        {"mkdir -p": CommandResult(0, "3\n", "")}, address="localhost"
+    )
+    ex = make_executor(tmp_path)
+
+    async def flow():
+        await ex._preflight(fake, key=ex._pool_key("localhost"))
+        assert ex._pool_key("localhost") in ex._preflighted
+        await ex._discard_workers()
+
+    run_async(flow())
+    assert ex._pool_key("localhost") not in ex._preflighted
+    # A fresh connection must re-run pre-flight.
+    fresh = FakeTransport(
+        {"mkdir -p": CommandResult(0, "3\n", "")}, address="localhost"
+    )
+    run_async(ex._preflight(fresh, key=ex._pool_key("localhost")))
+    assert len(fresh.commands) == 1
+
+
+def test_spec_content_distinguishes_workers(tmp_path):
+    """Per-worker specs carry distinct process ids, so their digests (and
+    CAS paths) never collide across workers of one electron."""
+    ex = make_executor(tmp_path, workers=["w0", "w1"])
+    staged = ex._write_function_files("op", lambda: 1, (), {}, "/wd")
+    assert len(set(staged.spec_digests)) == 2
+    assert staged.remote_spec_file(0) != staged.remote_spec_file(1)
+    for process_id in (0, 1):
+        spec = json.load(open(staged.local_spec_files[process_id]))
+        assert spec["function_digest"] == staged.function_digest
+
+
+# --------------------------------------------------------------------- #
+# Review hardening: atomic publish, TTL prune, spec cleanup
+# --------------------------------------------------------------------- #
+
+
+def test_cas_put_is_atomic_publish(tmp_path, run_async):
+    """Uploads land under a temp name and are renamed into the digest path,
+    so a concurrent probe can never see a half-written artifact."""
+    fake = FakeTransport(scripted_ok_responses())
+    fake.result_payload = (1, None)
+    ex = make_executor(tmp_path, fake)
+    run_async(ex.run(lambda: 1, [], {}, dict(METADATA)))
+    # No put targets a bare digest path directly...
+    assert all(".tmp-" in remote for _, remote in fake.puts)
+    # ...and each tmp upload is published by an atomic mv to the CAS path.
+    renames = [c for c in fake.commands if c.startswith("mv -f")]
+    assert len(renames) == len(fake.puts)
+    assert all("/cas/" in c for c in renames)
+
+
+def test_local_transport_rename_is_atomic_replace(tmp_path, run_async):
+    conn = LocalTransport()
+    src = tmp_path / "a.tmp"
+    src.write_text("payload")
+    dst = tmp_path / "a"
+    run_async(conn.rename(str(src), str(dst)))
+    assert dst.read_text() == "payload"
+    assert not src.exists()
+    from covalent_tpu_plugin.transport import TransportError
+
+    with pytest.raises(TransportError):
+        run_async(conn.rename(str(tmp_path / "missing"), str(dst)))
+
+
+def test_preflight_command_prunes_cas_by_ttl(tmp_path):
+    ex = make_executor(tmp_path, cas_ttl_hours=2)
+    cmd = ex._preflight_command()
+    assert "find" in cmd and "-mmin +120" in cmd and "/cas" in cmd
+    # The prune can never fail pre-flight, and the python check stays last.
+    assert "|| true" in cmd
+    assert cmd.rstrip().endswith("sys.version_info[0])'")
+    no_prune = make_executor(tmp_path, cas_ttl_hours=0)
+    assert "find" not in no_prune._preflight_command()
+
+
+def test_cleanup_removes_spec_keeps_dedupable_artifacts(tmp_path, run_async):
+    """Per-operation specs (never dedupable) are cleaned and evicted from
+    the CAS index; the function pickle and harness stay cached."""
+    fake = FakeTransport(scripted_ok_responses())
+    fake.result_payload = (1, None)
+    ex = make_executor(tmp_path, fake)
+    state = {}
+    original_stage = ex._write_function_files
+
+    def spy(*args, **kwargs):
+        state["staged"] = original_stage(*args, **kwargs)
+        return state["staged"]
+
+    ex._write_function_files = spy
+    run_async(ex.run(lambda: 1, [], {}, {"dispatch_id": "d", "node_id": 0}))
+    staged = state["staged"]
+    rm_commands = [c for c in fake.commands if c.startswith("rm -f")]
+    assert rm_commands, "cleanup issued no removals"
+    removed = " ".join(rm_commands)
+    # The spec CAS file is removed; fn pickle and harness stay cached.
+    assert staged.remote_spec_file(0) in removed
+    assert f"{staged.harness_digest}.py" not in removed
+    assert f"{staged.function_digest}.pkl" not in removed
+    # run() keys the CAS by the configured worker address, not the fake's.
+    key = ex._pool_key("localhost")
+    assert ex._cas.known(key, staged.harness_digest)
+    assert ex._cas.known(key, staged.function_digest)
+    assert not ex._cas.known(key, staged.spec_digests[0])  # evicted
+
+
+def test_forget_digest_evicts_across_keys(tmp_path, run_async):
+    fake = FakeTransport()
+    index = CASIndex()
+    local = tmp_path / "spec.json"
+    local.write_bytes(b"{}")
+    digest = file_digest(str(local))
+
+    async def flow():
+        await index.ensure("k1", fake, digest, str(local), "/rc/cas/s.json")
+        await index.ensure("k2", fake, digest, str(local), "/rc/cas/s.json")
+
+    run_async(flow())
+    assert index.known("k1", digest) and index.known("k2", digest)
+    index.forget_digest(digest)
+    assert not index.known("k1", digest)
+    assert not index.known("k2", digest)
+
+
+def test_result_cache_key_includes_function_code(tmp_path):
+    """By-reference pickled functions keep the same payload bytes when
+    their body changes; the code digest must still split the keys."""
+    ex = make_executor(tmp_path, cache_results=True)
+
+    def f1():
+        return 1
+
+    def f2():
+        return 2
+
+    same_payload = b"identical-bytes"
+    k1 = ex._result_cache_key(f1, (), {}, {}, payload=same_payload)
+    k2 = ex._result_cache_key(f2, (), {}, {}, payload=same_payload)
+    assert k1 != k2
+    # Stable for the same function.
+    assert k1 == ex._result_cache_key(f1, (), {}, {}, payload=same_payload)
+    # Callables without __code__ still produce a key (no code component).
+    import functools
+
+    part = functools.partial(f1)
+    assert ex._result_cache_key(part, (), {}, {}, payload=b"x") is not None
+
+
+def test_cleanup_touches_hot_artifacts_and_prunes(tmp_path, run_async):
+    """Cleanup refreshes fn+harness mtimes (so sibling executors' TTL
+    prunes treat them as hot) and re-runs the age prune per electron."""
+    fake = FakeTransport(scripted_ok_responses())
+    fake.result_payload = (1, None)
+    ex = make_executor(tmp_path, fake, cas_ttl_hours=1)
+    state = {}
+    original_stage = ex._write_function_files
+
+    def spy(*args, **kwargs):
+        state["staged"] = original_stage(*args, **kwargs)
+        return state["staged"]
+
+    ex._write_function_files = spy
+    run_async(ex.run(lambda: 1, [], {}, dict(METADATA)))
+    staged = state["staged"]
+    maintenance = [c for c in fake.commands if c.startswith("touch -c")]
+    assert len(maintenance) == 1
+    assert staged.remote_function_file in maintenance[0]
+    assert staged.remote_harness_file in maintenance[0]
+    assert "-mmin +60" in maintenance[0]  # prune rides the same round-trip
+    assert maintenance[0].rstrip().endswith("true")  # can never fail cleanup
+
+
+def test_cleanup_maintenance_skips_prune_when_disabled(tmp_path):
+    ex = make_executor(tmp_path, cas_ttl_hours=0)
+    staged = ex._write_function_files("op", lambda: 1, (), {}, "/wd")
+    cmd = ex._cas_maintenance_command(staged)
+    assert "touch -c" in cmd and "find" not in cmd

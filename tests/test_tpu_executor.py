@@ -170,13 +170,21 @@ def test_file_writes_single_worker(tmp_path):
     ex = make_executor(tmp_path)
     staged = ex._write_function_files("d123_1", lambda: 1, (), {}, "/wd")
     assert staged.function_file.endswith("function_d123_1.pkl")
-    assert staged.remote_function_file.endswith("/function_d123_1.pkl")
+    # Immutable artifacts are content-addressed under remote_cache/cas/;
+    # mutable per-operation files keep their operation-scoped names.
+    assert f"/cas/{staged.function_digest}.pkl" in staged.remote_function_file
+    assert f"/cas/{staged.harness_digest}.py" in staged.remote_harness_file
     assert staged.remote_result_file.endswith("/result_d123_1.pkl")
     assert len(staged.local_spec_files) == 1
+    assert staged.remote_spec_file(0).endswith(
+        f"/cas/{staged.spec_digests[0]}.json"
+    )
     import json
 
     spec = json.load(open(staged.local_spec_files[0]))
     assert spec["workdir"] == "/wd"
+    assert spec["function_digest"] == staged.function_digest
+    assert spec["function_file"] == staged.remote_function_file
     assert "distributed" not in spec  # single process: no data plane
 
 
@@ -512,6 +520,51 @@ def test_launch_all_is_all_or_nothing(tmp_path, run_async):
 
     run_async(flow())
     assert any("kill" in c and "111" in c for c in good.commands)
+
+
+def test_mid_task_channel_death_discards_pool_and_redials(tmp_path, run_async):
+    """A TransportError during execute must discard the pooled transport and
+    the next electron must redial cleanly: pool miss counter increments
+    again and pre-flight re-runs on the fresh channel."""
+    from covalent_tpu_plugin.obs.metrics import REGISTRY
+
+    def dying_probe(command):
+        raise TransportError("channel died mid-task")
+
+    dying = FakeTransport(
+        {**scripted_ok_responses(), "if test -f": dying_probe},
+        address="localhost",
+    )
+    healthy = FakeTransport(scripted_ok_responses(), address="localhost")
+    healthy.result_payload = (5, None)
+    transports = iter([dying, healthy])
+
+    # Real TransportPool (no _client_connect patch): only _make_transport
+    # is swapped, so discard/redial exercises the production path.
+    ex = make_executor(tmp_path)
+    ex._make_transport = lambda address: next(transports)
+
+    def miss_count() -> float:
+        counter = REGISTRY.get("covalent_tpu_pool_acquires_total")
+        return counter.labels(result="miss").value if counter else 0.0
+
+    misses0 = miss_count()
+
+    async def flow():
+        with pytest.raises(TransportError):
+            await ex.run(lambda: 5, [], {}, {"dispatch_id": "d", "node_id": 0})
+        # The dead channel was discarded (closed), its pre-flight evicted.
+        assert dying.closed
+        assert ex._pool_key("localhost") not in ex._preflighted
+        return await ex.run(
+            lambda: 5, [], {}, {"dispatch_id": "d", "node_id": 1}
+        )
+
+    assert run_async(flow()) == 5
+    assert miss_count() - misses0 == 2  # fresh dial for each electron
+    # Pre-flight re-ran on the new channel instead of being skipped.
+    assert any("mkdir -p" in c for c in healthy.commands)
+    assert not dying.commands or dying.commands != healthy.commands
 
 
 def test_profile_dir_lands_in_spec_per_operation(tmp_path):

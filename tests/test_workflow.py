@@ -298,3 +298,50 @@ def test_cancel_racing_completion_returns_final_result():
     result = ct.cancel(dispatch_id)
     assert result._done.is_set()
     assert result.status in (ct.Status.CANCELLED, ct.Status.COMPLETED)
+
+
+def test_results_store_bounded_retention(monkeypatch):
+    """Terminal Results beyond the retention bound are evicted (with the
+    eviction counter ticking); newer dispatches stay fetchable."""
+    from covalent_tpu_plugin.obs.metrics import REGISTRY
+    from covalent_tpu_plugin.workflow import runner
+
+    monkeypatch.setenv("COVALENT_TPU_RESULT_RETENTION", "2")
+
+    @ct.electron
+    def ident(x):
+        return x
+
+    @ct.lattice
+    def flow(x):
+        return ident(x)
+
+    evicted = REGISTRY.get("covalent_tpu_results_evicted_total")
+    evicted0 = evicted.value if evicted else 0.0
+
+    ids = []
+    for i in range(5):
+        dispatch_id = ct.dispatch(flow)(i)
+        assert ct.get_result(dispatch_id, wait=True, timeout=30).result == i
+        ids.append(dispatch_id)
+
+    with runner._RESULTS_LOCK:
+        terminal = [
+            k for k, r in runner._RESULTS.items() if r._done.is_set()
+        ]
+    assert len(terminal) <= 2
+    # The oldest dispatch was evicted; the newest is still fetchable.
+    with pytest.raises(ValueError, match="unknown dispatch_id"):
+        ct.get_result(ids[0])
+    assert ct.get_result(ids[-1]).result == 4
+    evicted_now = REGISTRY.get("covalent_tpu_results_evicted_total").value
+    assert evicted_now - evicted0 >= 3
+
+
+def test_result_retention_invalid_env_falls_back(monkeypatch):
+    from covalent_tpu_plugin.workflow import runner
+
+    monkeypatch.setenv("COVALENT_TPU_RESULT_RETENTION", "not-a-number")
+    assert runner._result_retention() == runner._DEFAULT_RESULT_RETENTION
+    monkeypatch.setenv("COVALENT_TPU_RESULT_RETENTION", "0")
+    assert runner._result_retention() == 1  # never evict the only result
