@@ -43,7 +43,7 @@ OVERHEAD_PROBES = 5
 BENCH_PHASES = {
     phase.strip()
     for phase in os.environ.get(
-        "BENCH_PHASES", "overhead,fanout,cached_fanout,tpu"
+        "BENCH_PHASES", "overhead,fanout,cached_fanout,chaos_fanout,tpu"
     ).split(",")
     if phase.strip()
 }
@@ -1641,6 +1641,108 @@ async def main() -> None:
         emit({"phase": "cached_fanout", "skipped": "BENCH_PHASES"})
     except Exception as error:  # noqa: BLE001
         emit({"phase": "cached_fanout", "error": repr(error)})
+
+    # ---- phase 2c: recovery overhead under one injected channel death ----
+    # A 4-electron fan-out through a ChaosTransport that kills exactly ONE
+    # control-plane channel mid-poll, with 2 gang retries budgeted: the
+    # resilience layer must complete every electron with zero local
+    # fallbacks, and the wall-clock delta vs the clean fanout8 phase IS the
+    # measured recovery overhead (teardown + redial + CAS re-stage +
+    # relaunch + backoff).
+    try:
+        if "chaos_fanout" not in BENCH_PHASES:
+            raise _PhaseSkipped
+        from covalent_tpu_plugin.transport import ChaosPlan
+
+        def resilience_counters() -> dict:
+            return {
+                key: value
+                for key, value in metrics_totals().items()
+                if key.startswith(("covalent_tpu_task_retries_total",
+                                   "covalent_tpu_chaos_faults_total"))
+            }
+
+        def chaos_executor(plan):
+            return TPUExecutor(
+                transport="local",
+                cache_dir=f"{workdir}/cache_chaos",
+                remote_cache=f"{workdir}/remote_chaos",
+                python_path=sys.executable,
+                poll_freq=0.2,
+                pool_preload="cloudpickle",
+                use_agent=False,  # poll path: where the drop_match bites
+                max_task_retries=2,
+                retry_base_delay=0.05,
+                retry_max_delay=0.2,
+                chaos=plan,
+                task_env={
+                    "PYTHONPATH": repo_root + os.pathsep
+                    + os.environ.get("PYTHONPATH", ""),
+                },
+            )
+
+        async def fanout4(ex, dispatch_id):
+            t0 = time.perf_counter()
+            results = await asyncio.gather(
+                *(
+                    ex.run(
+                        trivial_electron, [i], {},
+                        {"dispatch_id": dispatch_id, "node_id": i},
+                    )
+                    for i in range(4)
+                )
+            )
+            return time.perf_counter() - t0, results
+
+        async def chaos_phase():
+            # Clean baseline FIRST, same shape and config (4 concurrent
+            # electrons do NOT cost half an 8-fan-out's wall — dispatch is
+            # parallel — so the overhead must be measured against an
+            # actual clean 4-fan-out, not a scaled fanout8 number).
+            clean_ex = chaos_executor(None)
+            try:
+                await fanout4(clean_ex, "chaoswarm")  # warm pool/CAS
+                clean_wall, _ = await fanout4(clean_ex, "chaosclean")
+            finally:
+                await clean_ex.close()
+            plan = ChaosPlan(drop_match="if test -f", max_faults=1)
+            chaos_ex = chaos_executor(plan)
+            try:
+                wall, results = await fanout4(chaos_ex, "chaosfan")
+            finally:
+                await chaos_ex.close()
+            return clean_wall, wall, results, plan.faults_injected
+
+        counters_before = resilience_counters()
+        clean_wall, chaos_wall, results, faults = await asyncio.wait_for(
+            chaos_phase(), FANOUT_BUDGET_S
+        )
+        assert results == [trivial_electron(i) for i in range(4)], results
+        counters_delta = {
+            key: round(value - counters_before.get(key, 0.0), 1)
+            for key, value in resilience_counters().items()
+            if value != counters_before.get(key, 0.0)
+        }
+        summary["chaos_fanout4_wall_s"] = round(chaos_wall, 3)
+        summary["chaos_fanout4_clean_wall_s"] = round(clean_wall, 3)
+        summary["chaos_fanout_faults_injected"] = faults
+        summary["chaos_fanout_recovery_overhead_s"] = round(
+            chaos_wall - clean_wall, 3
+        )
+        emit({
+            "phase": "chaos_fanout",
+            "wall_s": summary["chaos_fanout4_wall_s"],
+            "clean_wall_s": summary["chaos_fanout4_clean_wall_s"],
+            "faults_injected": faults,
+            "completed": len(results),
+            "resilience_counters_delta": counters_delta,
+            "recovery_overhead_s":
+                summary["chaos_fanout_recovery_overhead_s"],
+        })
+    except _PhaseSkipped:
+        emit({"phase": "chaos_fanout", "skipped": "BENCH_PHASES"})
+    except Exception as error:  # noqa: BLE001
+        emit({"phase": "chaos_fanout", "error": repr(error)})
 
     # ---- phase 3: all accelerator work, ONE electron, ONE backend init ---
     # The whole phase lives under ONE wall-clock deadline (the old

@@ -15,7 +15,9 @@ built-in minimal workflow layer (``electron``/``lattice``/``dispatch``/
 
 from . import obs
 from .cache import CASIndex, ResultCache
+from .resilience import CircuitBreaker, Deadline, RetryPolicy
 from .tpu import EXECUTOR_PLUGIN_NAME, TPUExecutor
+from .transport import ChaosPlan, ChaosTransport
 
 __all__ = [
     "TPUExecutor",
@@ -23,6 +25,11 @@ __all__ = [
     "obs",
     "CASIndex",
     "ResultCache",
+    "RetryPolicy",
+    "CircuitBreaker",
+    "Deadline",
+    "ChaosPlan",
+    "ChaosTransport",
 ]
 
 __version__ = "0.1.0"

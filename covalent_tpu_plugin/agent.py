@@ -45,6 +45,10 @@ _AGENT_EVENTS = REGISTRY.counter(
     "Events pushed by resident agent channels",
     ("event",),
 )
+AGENT_RESTARTS_TOTAL = REGISTRY.counter(
+    "covalent_tpu_agent_restarts_total",
+    "Cached agent channels discarded and restarted after a failed ping",
+)
 
 AGENT_SOURCE = Path(__file__).parent / "native" / "agent.cc"
 

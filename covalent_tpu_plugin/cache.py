@@ -44,7 +44,7 @@ import cloudpickle
 from .obs import events as obs_events
 from .obs.metrics import REGISTRY
 from .obs.trace import Span
-from .transport.base import Transport
+from .transport.base import Transport, TransportError
 from .utils.log import app_log
 
 __all__ = [
@@ -143,7 +143,23 @@ class CASIndex:
             if key in self._probed:
                 return
             present = self._present.setdefault(key, set())
-            flags = await conn.exists_batch([path for _, path in entries])
+            paths = [path for _, path in entries]
+            try:
+                flags = await conn.exists_batch(paths)
+            except (TransportError, OSError) as err:
+                # The batched probe is an optimization, never a
+                # correctness gate: degrade to per-artifact probes, and
+                # from there to all-absent — a spurious re-upload at worst,
+                # never a failed pre-flight.  (If the channel is truly
+                # dead, the uploads that follow will say so.)
+                app_log.warning(
+                    "CAS batched probe on %s failed (%s); "
+                    "falling back to per-artifact probes", key, err,
+                )
+                obs_events.emit(
+                    "cas.probe_fallback", key=key, error=repr(err)
+                )
+                flags = await self._probe_each(conn, paths)
             for (digest, _), held in zip(entries, flags):
                 if held:
                     present.add(digest)
@@ -154,6 +170,20 @@ class CASIndex:
                 probed=len(entries),
                 already_present=sum(flags),
             )
+
+    @staticmethod
+    async def _probe_each(conn: Transport, paths: list[str]) -> list[bool]:
+        """One ``test -e`` round-trip per artifact; failures read as absent."""
+        import shlex
+
+        flags = []
+        for path in paths:
+            try:
+                result = await conn.run(f"test -e {shlex.quote(path)}")
+                flags.append(result.exit_status == 0)
+            except (TransportError, OSError):
+                flags.append(False)
+        return flags
 
     async def ensure(
         self,

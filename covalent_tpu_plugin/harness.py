@@ -468,6 +468,14 @@ def main(argv: list[str]) -> int:
     if len(argv) != 2:
         print("usage: harness.py <task_spec.json> | --serve", file=sys.stderr)
         return 2
+    # Become a session/process-group leader (pool-mode children already do
+    # this in _spawn_task): the dispatcher's cancel and timeout-escalation
+    # paths kill `-- -pid`, and only a group leader pid makes that reach
+    # the user function's own subprocesses — no orphans on billed TPU time.
+    try:
+        os.setsid()
+    except OSError:
+        pass  # already a leader (or platform without sessions)
     with open(argv[1]) as f:
         spec = json.load(f)
     return run_task(spec)

@@ -61,9 +61,15 @@ def make_sharded_train_state(
 
     abstract = jax.eval_shape(init_fn, rng)
     logical_specs = nn.get_partition_spec(abstract)
-    with mesh:
-        shardings = nn.logical_to_mesh_sharding(logical_specs, mesh, list(rules))
-        state = jax.jit(init_fn, out_shardings=shardings)(rng)
+    shardings = nn.logical_to_mesh_sharding(logical_specs, mesh, list(rules))
+    # Deliberately NOT under `with mesh:`: the params are boxed with
+    # *logical* axis names via nn.with_partitioning, and flax's
+    # Partitioned.unbox applies those names verbatim as a sharding
+    # constraint whenever a global mesh is active — "vocab"/"embed" are not
+    # physical mesh axes, so tracing init (or apply) under an ambient mesh
+    # raises.  Placement comes entirely from the explicit out_shardings,
+    # which logical_to_mesh_sharding already translated through the rules.
+    state = jax.jit(init_fn, out_shardings=shardings)(rng)
     return state, shardings
 
 

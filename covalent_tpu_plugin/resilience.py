@@ -1,0 +1,349 @@
+"""Resilience layer: fault classification, retries, circuit breakers, deadlines.
+
+The reference plugin treats every dispatch failure the same way — one shot,
+then either a RuntimeError or a silent local-CPU fallback
+(``covalent_ssh_plugin/ssh.py:181-208``).  On a production TPU fleet that is
+exactly backwards: preemption, dropped SSH channels, and flaky preflights
+are *routine* (Podracer, arXiv:2104.06272, treats preemption-tolerant
+restart as table stakes), while a user-code exception must never be retried.
+This module gives every dispatch layer a shared vocabulary for that
+distinction:
+
+* :func:`classify_error` — transient (channel death, connect/preflight
+  failure, agent RPC loss, worker death without a result) vs permanent
+  (user-code exception, digest mismatch, cancellation, config errors).
+* :class:`RetryPolicy` — exponential backoff with full jitter under an
+  attempt + wall-clock budget (the AWS-style ``random(0, min(cap, base·2ⁿ))``
+  schedule, deterministic when seeded).
+* :class:`CircuitBreaker` / :class:`CircuitBreakerRegistry` — per-worker-
+  address quarantine: CLOSED → OPEN after N consecutive transient failures,
+  cooldown, HALF_OPEN probe, with a state gauge and transition events so a
+  quarantined host is visible, not silent.
+* :class:`Deadline` — wall-clock budget propagation, so ``task_timeout``
+  *escalates* (kill the gang, classify, retry) instead of abandoning
+  RUNNING remote processes.
+
+Everything here is transport-agnostic and imports only ``transport.base``
+and the obs layer, so the executor, the pool, and the workflow runner can
+all consult the same breaker/policy objects without import cycles.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Callable
+
+from .obs import events as obs_events
+from .obs.metrics import REGISTRY
+from .transport.base import TransportError
+
+__all__ = [
+    "CircuitBreaker",
+    "CircuitBreakerRegistry",
+    "CircuitOpenError",
+    "CircuitState",
+    "Deadline",
+    "FaultClass",
+    "RetryPolicy",
+    "classify_error",
+    "TASK_RETRIES_TOTAL",
+]
+
+
+TASK_RETRIES_TOTAL = REGISTRY.counter(
+    "covalent_tpu_task_retries_total",
+    "Electron dispatch retries by transient-failure reason",
+    ("reason",),
+)
+_CIRCUIT_STATE = REGISTRY.gauge(
+    "covalent_tpu_circuit_state",
+    "Per-worker circuit state (0=closed, 1=half_open, 2=open)",
+    ("address",),
+)
+_CIRCUIT_TRANSITIONS = REGISTRY.counter(
+    "covalent_tpu_circuit_transitions_total",
+    "Circuit-breaker state transitions by destination state",
+    ("to",),
+)
+
+
+# --------------------------------------------------------------------------
+# Fault classification
+# --------------------------------------------------------------------------
+
+
+class FaultClass(str, Enum):
+    """Whether a failure is worth retrying."""
+
+    TRANSIENT = "transient"   # infrastructure: retry may succeed
+    PERMANENT = "permanent"   # deterministic: retrying re-runs the failure
+
+
+def classify_error(error: BaseException) -> tuple[FaultClass, str]:
+    """``(fault class, reason label)`` for one dispatch-layer exception.
+
+    The reason label feeds ``covalent_tpu_task_retries_total{reason}`` and
+    retry events, so it stays low-cardinality.  Classification is by
+    exception *type*: the dispatch layers raise ``TransportError`` (and its
+    subclasses) for every control-plane fault, while user-code exceptions
+    arrive as arbitrary types re-raised from the remote result pickle — and
+    anything unrecognized is deliberately PERMANENT, because retrying an
+    unknown failure repeats work without evidence it can ever succeed.
+    """
+    if isinstance(error, asyncio.CancelledError):
+        return FaultClass.PERMANENT, "cancelled"
+    # Follow the cause chain: aggregation layers (e.g. _connect_all's
+    # "failed to connect to N workers" TransportError) wrap the breaker's
+    # fail-fast, and quarantine-driven failures must stay distinguishable.
+    cause: BaseException | None = error
+    for _ in range(8):
+        if cause is None:
+            break
+        if isinstance(cause, CircuitOpenError):
+            # Retrying (with backoff) is how a caller waits out the
+            # cooldown into the half-open probe.
+            return FaultClass.TRANSIENT, "circuit_open"
+        cause = cause.__cause__
+    if isinstance(error, TransportError):
+        # Covers AgentError (agent RPC loss) and chaos-injected faults too.
+        return FaultClass.TRANSIENT, "transport"
+    if isinstance(
+        error,
+        (FileNotFoundError, PermissionError, IsADirectoryError,
+         NotADirectoryError),
+    ):
+        # Deterministic filesystem errors (a staged artifact missing on
+        # the dispatcher, an unreadable key): retrying — with gang
+        # teardown, backoff, and redial — repeats the identical failure.
+        # Remote-side path problems never reach here raw; the transports
+        # wrap them in TransportError.
+        return FaultClass.PERMANENT, type(error).__name__
+    if isinstance(error, (ConnectionError, TimeoutError, OSError)):
+        return FaultClass.TRANSIENT, "connection"
+    return FaultClass.PERMANENT, type(error).__name__
+
+
+# --------------------------------------------------------------------------
+# Retry policy
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class RetryPolicy:
+    """Exponential backoff + full jitter under attempt/wall-clock budgets.
+
+    ``max_retries`` counts *re*-submissions (0 = single attempt, today's
+    behavior).  ``wall_budget`` is the elapsed time after which no NEW
+    attempt may start — backoff sleeps are capped to it, but an in-flight
+    attempt is never killed by it (0 disables).  ``seed`` pins the jitter
+    RNG so tests and chaos runs are deterministic.
+    """
+
+    max_retries: int = 0
+    base_delay: float = 0.25
+    max_delay: float = 10.0
+    wall_budget: float = 0.0
+    seed: int | None = None
+    _rng: random.Random = field(init=False, repr=False)
+
+    def __post_init__(self) -> None:
+        self._rng = random.Random(self.seed)
+
+    def delay(self, attempt: int) -> float:
+        """Full-jitter backoff for the sleep *before* attempt ``attempt+1``."""
+        ceiling = min(self.max_delay, self.base_delay * (2 ** attempt))
+        return self._rng.uniform(0.0, ceiling)
+
+    def should_retry(
+        self, attempt: int, fault: FaultClass, deadline: "Deadline"
+    ) -> bool:
+        """May attempt ``attempt`` (0-based) be followed by another?"""
+        if fault is not FaultClass.TRANSIENT:
+            return False
+        if attempt >= self.max_retries:
+            return False
+        return not deadline.expired
+
+
+# --------------------------------------------------------------------------
+# Deadlines
+# --------------------------------------------------------------------------
+
+
+class Deadline:
+    """A started wall-clock budget; ``budget <= 0`` means unbounded.
+
+    ``clock`` is injectable for deterministic tests.
+    """
+
+    def __init__(
+        self, budget: float = 0.0, clock: Callable[[], float] = time.monotonic
+    ) -> None:
+        self.budget = float(budget)
+        self._clock = clock
+        self._start = clock()
+
+    @property
+    def bounded(self) -> bool:
+        return self.budget > 0
+
+    def elapsed(self) -> float:
+        return self._clock() - self._start
+
+    def remaining(self) -> float | None:
+        """Seconds left, or None when unbounded.  Never negative."""
+        if not self.bounded:
+            return None
+        return max(0.0, self.budget - self.elapsed())
+
+    @property
+    def expired(self) -> bool:
+        return self.bounded and self.elapsed() >= self.budget
+
+
+# --------------------------------------------------------------------------
+# Circuit breakers
+# --------------------------------------------------------------------------
+
+
+class CircuitOpenError(TransportError):
+    """Fail-fast: the worker's circuit is open; no dial was attempted."""
+
+
+class CircuitState(str, Enum):
+    CLOSED = "closed"          # normal operation
+    OPEN = "open"              # quarantined: fail fast, no dialing
+    HALF_OPEN = "half_open"    # cooldown elapsed: one probe in flight
+
+    @property
+    def gauge_value(self) -> int:
+        return {"closed": 0, "half_open": 1, "open": 2}[self.value]
+
+
+class CircuitBreaker:
+    """Per-worker-address failure quarantine.
+
+    CLOSED → OPEN after ``failure_threshold`` *consecutive* transient
+    failures; OPEN → HALF_OPEN once ``cooldown`` elapses (the next
+    :meth:`check` lets exactly one probe through); HALF_OPEN → CLOSED on
+    success, back to OPEN on failure.  Not thread-safe by design: all
+    dispatch paths run on the one dispatcher event loop.
+    """
+
+    def __init__(
+        self,
+        address: str,
+        failure_threshold: int = 3,
+        cooldown: float = 30.0,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.address = address
+        self.failure_threshold = max(1, int(failure_threshold))
+        self.cooldown = float(cooldown)
+        self._clock = clock
+        self._state = CircuitState.CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._probe_in_flight = False
+        _CIRCUIT_STATE.labels(address=address).set(0)
+
+    @property
+    def state(self) -> CircuitState:
+        """Current state, promoting OPEN → HALF_OPEN after the cooldown."""
+        if (
+            self._state is CircuitState.OPEN
+            and self._clock() - self._opened_at >= self.cooldown
+        ):
+            self._transition(CircuitState.HALF_OPEN)
+        return self._state
+
+    def _transition(self, to: CircuitState) -> None:
+        if to is self._state:
+            return
+        obs_events.emit(
+            "circuit.state",
+            address=self.address,
+            from_state=self._state.value,
+            to_state=to.value,
+            consecutive_failures=self._consecutive_failures,
+        )
+        _CIRCUIT_TRANSITIONS.labels(to=to.value).inc()
+        _CIRCUIT_STATE.labels(address=self.address).set(to.gauge_value)
+        self._state = to
+        if to is CircuitState.OPEN:
+            self._opened_at = self._clock()
+
+    def check(self) -> None:
+        """Raise :class:`CircuitOpenError` when the host is quarantined.
+
+        In HALF_OPEN the first check passes (the probe) and the breaker
+        re-opens optimistically only on the probe's reported outcome — a
+        concurrent second caller during the probe window fails fast.
+        """
+        state = self.state
+        if state is CircuitState.OPEN:
+            raise CircuitOpenError(
+                f"circuit open for {self.address} "
+                f"({self._consecutive_failures} consecutive failures; "
+                f"retrying after {self.cooldown:.0f}s cooldown)"
+            )
+        if state is CircuitState.HALF_OPEN:
+            # One probe at a time: record_success/record_failure from the
+            # in-flight probe settles the real outcome; concurrent callers
+            # during the probe window fail fast.
+            if self._probe_in_flight:
+                raise CircuitOpenError(
+                    f"circuit half-open for {self.address}; probe in flight"
+                )
+            self._probe_in_flight = True
+
+    def record_success(self) -> None:
+        self._consecutive_failures = 0
+        self._probe_in_flight = False
+        self._transition(CircuitState.CLOSED)
+
+    def record_failure(self) -> None:
+        self._consecutive_failures += 1
+        self._probe_in_flight = False
+        if (
+            self._state is CircuitState.HALF_OPEN
+            or self._consecutive_failures >= self.failure_threshold
+        ):
+            # A failed half-open probe re-opens immediately; in CLOSED the
+            # threshold governs.
+            self._transition(CircuitState.OPEN)
+
+
+class CircuitBreakerRegistry:
+    """One :class:`CircuitBreaker` per worker address, created on demand."""
+
+    def __init__(
+        self,
+        failure_threshold: int = 3,
+        cooldown: float = 30.0,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.failure_threshold = failure_threshold
+        self.cooldown = cooldown
+        self._clock = clock
+        self._breakers: dict[str, CircuitBreaker] = {}
+
+    def get(self, address: str) -> CircuitBreaker:
+        breaker = self._breakers.get(address)
+        if breaker is None:
+            breaker = CircuitBreaker(
+                address,
+                failure_threshold=self.failure_threshold,
+                cooldown=self.cooldown,
+                clock=self._clock,
+            )
+            self._breakers[address] = breaker
+        return breaker
+
+    def states(self) -> dict[str, str]:
+        """address -> state snapshot (telemetry / debugging)."""
+        return {a: b.state.value for a, b in self._breakers.items()}

@@ -42,6 +42,7 @@ import cloudpickle
 
 from . import harness as _harness_module
 from .agent import (
+    AGENT_RESTARTS_TOTAL,
     AgentClient,
     AgentError,
     ensure_agent_binary,
@@ -61,7 +62,17 @@ from .obs import events as obs_events
 from .obs.metrics import REGISTRY
 from .obs.trace import Span
 from .parallel.distributed import coordinator_spec
+from .resilience import (
+    TASK_RETRIES_TOTAL,
+    CircuitBreakerRegistry,
+    Deadline,
+    FaultClass,
+    RetryPolicy,
+    classify_error,
+)
 from .transport import (
+    ChaosPlan,
+    ChaosTransport,
     LocalTransport,
     SSHTransport,
     Transport,
@@ -69,6 +80,7 @@ from .transport import (
     TransportPool,
     connect_with_retries,
 )
+from .transport.chaos import plan_from_spec
 from .utils.config import get_config, update_config
 from .utils.log import app_log
 from .utils.serialize import dump_task, load_result
@@ -134,6 +146,24 @@ _EXECUTOR_PLUGIN_DEFAULTS = {
     # children; interpreter+sitecustomize startup is the big win anyway.
     "pool_preload": "cloudpickle",
     "profile_dir": "",
+    # Resilience layer (resilience.py).  max_task_retries counts full-gang
+    # re-submissions after a *transient* failure (channel death, connect/
+    # preflight failure, worker death without a result, timeout); user-code
+    # exceptions and cancellations are never retried.  0 preserves the
+    # single-shot behavior; COVALENT_TPU_TASK_RETRIES overrides per process.
+    "max_task_retries": 0,
+    "retry_base_delay": 0.25,
+    "retry_max_delay": 10.0,
+    # Elapsed wall clock after which no NEW attempt starts (sleeps are
+    # capped to it; an in-flight attempt finishes); 0 = none.
+    "retry_wall_budget": 0.0,
+    # Per-worker circuit breaker: open after N consecutive dial/preflight
+    # failures, half-open probe after the cooldown.
+    "circuit_threshold": 3,
+    "circuit_cooldown": 30.0,
+    # Fault-injection spec (transport/chaos.py); also COVALENT_TPU_CHAOS.
+    # Empty = no chaos wrapper (the production default).
+    "chaos": "",
 }
 
 
@@ -175,6 +205,7 @@ class TaskStatus(str, Enum):
     RUNNING = "RUNNING"      # process alive, no result yet
     STARTING = "STARTING"    # no result, no pid file yet (launch window)
     DEAD = "DEAD"            # process gone and no result -> failure
+    TIMEOUT = "TIMEOUT"      # task_timeout expired with processes RUNNING
 
 
 class StagedTask:
@@ -230,6 +261,25 @@ class StagedTask:
         ]
 
 
+class _RetryDispatch(Exception):
+    """Internal control flow: this attempt failed transiently and the retry
+    budget allows another.  Raised by ``_run_attempt``'s failure sites and
+    caught only by the ``run()`` driver — never escapes the executor."""
+
+    def __init__(
+        self, reason: str, message: str, redial: bool, conns=None
+    ) -> None:
+        super().__init__(message)
+        self.reason = reason
+        self.message = message
+        #: drop pooled transports before the next attempt (degradation
+        #: order: retry -> redial/alternate connection -> local fallback).
+        self.redial = redial
+        #: the failed attempt's channels — the ONLY ones a redial may
+        #: discard (a concurrent electron's fresh channel must survive).
+        self.conns = list(conns or ())
+
+
 class TPUExecutor(RemoteExecutor):
     """Executor plugin: ``@ct.electron(executor="tpu")``.
 
@@ -276,6 +326,13 @@ class TPUExecutor(RemoteExecutor):
         result_cache_max_entries: int | None = None,
         result_cache_max_bytes: int | None = None,
         cas_ttl_hours: float | None = None,
+        max_task_retries: int | None = None,
+        retry_base_delay: float | None = None,
+        retry_max_delay: float | None = None,
+        retry_wall_budget: float | None = None,
+        circuit_threshold: int | None = None,
+        circuit_cooldown: float | None = None,
+        chaos: "str | ChaosPlan | None" = None,
         pool: TransportPool | None = None,
     ) -> None:
         def resolve(value, key):
@@ -361,6 +418,52 @@ class TPUExecutor(RemoteExecutor):
             )
         self.cache_results = bool(resolve(cache_results, "cache_results"))
         self.cas_ttl_hours = float(resolve(cas_ttl_hours, "cas_ttl_hours"))
+
+        #: gang-level retry budget (resilience.py): explicit arg > env >
+        #: config > default-off, the same chain as cache_results — the env
+        #: var is the workflow-layer switch for a whole dispatch.
+        env_retries = os.environ.get("COVALENT_TPU_TASK_RETRIES")
+        if max_task_retries is None and env_retries is not None:
+            try:
+                max_task_retries = int(env_retries)
+            except ValueError:
+                app_log.warning(
+                    "ignoring non-integer COVALENT_TPU_TASK_RETRIES=%r",
+                    env_retries,
+                )
+        self.max_task_retries = max(
+            0, int(resolve(max_task_retries, "max_task_retries"))
+        )
+        self._retry_policy = RetryPolicy(
+            max_retries=self.max_task_retries,
+            base_delay=float(resolve(retry_base_delay, "retry_base_delay")),
+            max_delay=float(resolve(retry_max_delay, "retry_max_delay")),
+            wall_budget=float(
+                resolve(retry_wall_budget, "retry_wall_budget")
+            ),
+        )
+        #: per-worker-address quarantine, consulted before every fresh dial.
+        self._breakers = CircuitBreakerRegistry(
+            failure_threshold=int(
+                resolve(circuit_threshold, "circuit_threshold")
+            ),
+            cooldown=float(resolve(circuit_cooldown, "circuit_cooldown")),
+        )
+        #: fault-injection plan shared by every transport this executor
+        #: dials (None = no chaos wrapper).  A ChaosPlan instance wins so
+        #: tests/bench can script faults and read injection counts back.
+        if isinstance(chaos, ChaosPlan):
+            self._chaos: ChaosPlan | None = chaos
+        else:
+            if chaos is None:
+                chaos = os.environ.get("COVALENT_TPU_CHAOS")
+            self._chaos = plan_from_spec(str(resolve(chaos, "chaos") or ""))
+        #: attempts consumed by the most recent run() (1 = no retries).
+        self.last_attempts = 0
+        #: base operation id -> attempts consumed; read (and popped) by the
+        #: workflow runner via attempts_of() so node events attribute
+        #: retries to the right node even under concurrent fan-out.
+        self._op_attempts: dict[str, int] = {}
 
         resolved_poll_freq = float(resolve(poll_freq, "poll_freq"))
         resolved_remote_cache = resolve(remote_cache, "remote_cache")
@@ -517,13 +620,23 @@ class TPUExecutor(RemoteExecutor):
         """
 
         async def factory() -> Transport:
+            transport = self._make_transport(address)
+            if self._chaos is not None:
+                # Chaos wraps UNDER the connect-retry envelope so injected
+                # connect faults exercise the same classified-retry path a
+                # real refused dial does.
+                transport = ChaosTransport(transport, self._chaos)
             return await connect_with_retries(
-                self._make_transport(address),
+                transport,
                 max_attempts=self.max_connection_attempts,
                 retry_wait_time=self.retry_wait_time,
             )
 
-        return await self._pool.acquire(self._pool_key(address), factory)
+        # The breaker gate makes a quarantined host fail fast instead of
+        # burning the full connect-retry envelope on every electron.
+        return await self._pool.acquire(
+            self._pool_key(address), factory, gate=self._breakers.get(address)
+        )
 
     def _pool_key(self, address: str) -> str:
         return f"{self.transport_kind}:{address}"
@@ -548,9 +661,19 @@ class TPUExecutor(RemoteExecutor):
             if not until_empty:
                 return
 
-    async def _discard_workers(self) -> None:
+    async def _discard_workers(
+        self, conns: list[Transport] | None = None
+    ) -> None:
         """Drop pooled transports after a mid-run control-plane error so the
-        next electron redials instead of reusing a dead channel."""
+        next electron redials instead of reusing a dead channel.
+
+        ``conns`` scopes the discard to the channels this caller actually
+        saw fail: a concurrent electron may already have redialed a FRESH
+        transport under the same pool key, and closing that one would turn
+        a single fault into a cascade of spurious launch failures across
+        the whole fan-out.  ``None`` (e.g. loop-guard teardown) discards
+        unconditionally.
+        """
         obs_events.emit(
             "pool.workers_discarded",
             addresses=self._worker_addresses(),
@@ -560,9 +683,17 @@ class TPUExecutor(RemoteExecutor):
         # pooled transports; closing the channels mid-rm would fail their
         # cleanup and leak the staged files — let them finish first.
         await self._drain_cleanup_tasks()
+        any_discarded = False
         for address in self._worker_addresses():
             key = self._pool_key(address)
-            await self._pool.discard(key)
+            discarded = await self._pool.discard(key, only=conns)
+            if not discarded and conns is not None and self._pool.has(key):
+                # A DIFFERENT (fresh) transport owns this key now — a
+                # concurrent electron already discarded the failed channel
+                # and redialed.  Its preflight/CAS/agent state is valid;
+                # leave it alone.
+                continue
+            any_discarded = any_discarded or discarded
             client = self._agents.pop(address, None)
             if client is not None:
                 await client.close()
@@ -575,7 +706,8 @@ class TPUExecutor(RemoteExecutor):
         # A mid-run control-plane failure may mean the TPU itself was
         # preempted/recreated with new IPs: re-discover on the next electron
         # instead of dialing stale addresses forever.
-        self._discovered_endpoints = None
+        if any_discarded or conns is None:
+            self._discovered_endpoints = None
 
     async def _connect_all(self) -> list[Transport]:
         """Open channels to every worker concurrently (all-or-nothing)."""
@@ -880,16 +1012,27 @@ class TPUExecutor(RemoteExecutor):
         key = key or self._pool_key(conn.address)
         if key in self._preflighted:
             return
-        result = await conn.run(self._preflight_command())
-        if result.exit_status != 0:
-            raise TransportError(
-                f"pre-flight failed on {conn.address}: {result.stderr.strip()}"
-            )
-        if result.stdout.strip().splitlines()[-1] != "3":
-            raise TransportError(
-                f"{self.python_path} on {conn.address} is not python3 "
-                f"(reported major version {result.stdout.strip()!r})"
-            )
+        # The breaker is keyed by the *configured* worker address (the pool
+        # key's tail), the same identity _client_connect gates on.
+        breaker = self._breakers.get(key.split(":", 1)[1])
+        try:
+            result = await conn.run(self._preflight_command())
+            if result.exit_status != 0:
+                raise TransportError(
+                    f"pre-flight failed on {conn.address}: "
+                    f"{result.stderr.strip()}"
+                )
+            if result.stdout.strip().splitlines()[-1] != "3":
+                raise TransportError(
+                    f"{self.python_path} on {conn.address} is not python3 "
+                    f"(reported major version {result.stdout.strip()!r})"
+                )
+        except (TransportError, OSError):
+            # A host that keeps failing preflight is as quarantine-worthy
+            # as one that refuses to dial.
+            breaker.record_failure()
+            raise
+        breaker.record_success()
         self._preflighted.add(key)
 
     async def _upload_task(
@@ -986,9 +1129,29 @@ class TPUExecutor(RemoteExecutor):
         async with lock:
             if conn.address in self._agents:
                 client = self._agents[conn.address]
-                if client is None or client.alive:
-                    return client
-                await client.close()  # stale channel; rebuild below
+                if client is None:
+                    return None
+                if client.alive:
+                    try:
+                        # One cheap RPC proves the cached channel end to
+                        # end before a task is entrusted to it: a server
+                        # that hung or lost its stdin looks `alive` from
+                        # here but would fail (or time out) the submit.
+                        await client.ping(self.AGENT_PING_TIMEOUT_S)
+                        return client
+                    except AgentError as err:
+                        app_log.warning(
+                            "worker %s: cached agent failed ping (%s); "
+                            "restarting it", conn.address, err,
+                        )
+                        AGENT_RESTARTS_TOTAL.inc()
+                        obs_events.emit(
+                            "agent.restarted",
+                            address=conn.address,
+                            error=repr(err),
+                        )
+                await client.close()  # dead/stale channel; rebuild below
+                self._agents.pop(conn.address, None)
             for mode in modes:
                 try:
                     if mode == "pool":
@@ -1079,12 +1242,12 @@ class TPUExecutor(RemoteExecutor):
                 if deadline is not None:
                     remaining = deadline - asyncio.get_running_loop().time()
                     if remaining <= 0:
-                        return TaskStatus.DEAD, 0  # timeout ≙ _poll_task's DEAD
+                        return TaskStatus.TIMEOUT, 0  # matches _poll_all
                 done, pending = await asyncio.wait(
                     pending, timeout=remaining, return_when=asyncio.FIRST_COMPLETED
                 )
                 if not done:
-                    return TaskStatus.DEAD, 0
+                    return TaskStatus.TIMEOUT, 0
                 # Worker 0 first: its successful completion outranks another
                 # worker's post-barrier teardown failure, matching
                 # _poll_all's statuses[0]-first precedence.
@@ -1174,6 +1337,13 @@ class TPUExecutor(RemoteExecutor):
     #: a silently-stuck electron is at least visible on billed TPU time.
     WATCHDOG_LOG_INTERVAL_S = 600.0
 
+    #: Liveness-probe budget for a cached agent channel (a healthy resident
+    #: runtime pongs in channel-RTT; a hung one must not stall dispatch).
+    AGENT_PING_TIMEOUT_S = 10.0
+
+    #: TERM-to-KILL grace when task_timeout escalation reaps the gang.
+    TIMEOUT_KILL_GRACE_S = 1.0
+
     async def _wait_while_running(
         self,
         probe: Callable,
@@ -1259,14 +1429,16 @@ class TPUExecutor(RemoteExecutor):
         pid: int | None = None,
         pid_file: str | None = None,
     ) -> TaskStatus:
-        """Wait for one worker's result; a timeout counts as DEAD."""
+        """Wait for one worker's result; ``task_timeout`` expiry reports
+        TIMEOUT so the caller can escalate (kill the gang, classify, retry)
+        instead of conflating it with a crashed harness."""
         tolerant = self._tolerant_status()
 
         async def probe() -> tuple[TaskStatus, int]:
             return await tolerant(0, conn, remote_result_file, pid, pid_file), 0
 
         status, _ = await self._wait_while_running(probe)
-        return TaskStatus.DEAD if status is TaskStatus.RUNNING else status
+        return TaskStatus.TIMEOUT if status is TaskStatus.RUNNING else status
 
     async def _poll_all(
         self, conns: list[Transport], staged: StagedTask, pids: dict[str, int]
@@ -1321,7 +1493,9 @@ class TPUExecutor(RemoteExecutor):
 
         status, blamed = await self._wait_while_running(probe)
         return (
-            (TaskStatus.DEAD, 0) if status is TaskStatus.RUNNING else (status, blamed)
+            (TaskStatus.TIMEOUT, 0)
+            if status is TaskStatus.RUNNING
+            else (status, blamed)
         )
 
     async def query_result(
@@ -1336,32 +1510,141 @@ class TPUExecutor(RemoteExecutor):
         result = await conn.run(f"tail -n 50 {shlex.quote(staged.remote_log_file)}")
         return result.stdout.strip()
 
-    async def cancel(self, operation_id: str | None = None) -> None:
+    def attempts_of(self, operation_id: str) -> int:
+        """Attempts the given (base) operation consumed; pops the record.
+
+        The workflow runner calls this right after ``run()`` settles to
+        stamp per-node retry counts onto node events.
+        """
+        return self._op_attempts.pop(operation_id, 1)
+
+    def _is_cancelled(self, operation_id: str) -> bool:
+        """Whether this operation — or its retry lineage — was cancelled.
+
+        Retry attempts run under ``{base}.r{n}`` operation ids; a caller
+        cancelling the base id (the only id the workflow layer knows) must
+        reach whichever attempt is currently in flight.
+        """
+        if operation_id in self._cancelled_ops:
+            return True
+        base = operation_id.split(".r", 1)[0]
+        return base != operation_id and base in self._cancelled_ops
+
+    async def cancel(
+        self, operation_id: str | None = None, mark: bool = True
+    ) -> None:
         """Kill the remote harness process on every worker.
 
         Implements what the reference stubs with ``NotImplementedError``
-        (ssh.py:460-464).
+        (ssh.py:460-464).  ``operation_id`` also matches retry attempts of
+        that operation (``{id}.r{n}``), so cancelling a dispatch reaches a
+        gang that is mid-retry.
+
+        ``mark=False`` is the executor's own gang teardown (a failed or
+        timed-out attempt being cleaned up for retry): the pids die but the
+        operation is NOT flagged as user-cancelled — a concurrent real
+        ``cancel()``'s mark must survive the teardown so the retry driver
+        still sees it.
         """
-        targets = (
-            {operation_id: self._active.get(operation_id, {})}
-            if operation_id
-            else dict(self._active)
-        )
+        if operation_id:
+            targets = {
+                op_id: pids
+                for op_id, pids in self._active.items()
+                if op_id == operation_id
+                or op_id.startswith(f"{operation_id}.r")
+            }
+            if not targets:
+                targets = {operation_id: {}}
+            # Mark the requested id too: an attempt not yet in _active (or
+            # the retry driver between attempts) must still see the cancel.
+            if mark:
+                self._cancelled_ops.add(operation_id)
+        else:
+            targets = dict(self._active)
         for op_id, pids in targets.items():
             # Flag FIRST: the moment a kill lands, the op's poller can see
             # DEAD and must classify it as cancelled, not failed (a failure
             # with run_local_on_dispatch_fail would re-run the body).
-            self._cancelled_ops.add(op_id)
+            if mark:
+                self._cancelled_ops.add(op_id)
             obs_events.emit(
                 "task.cancel_requested", operation_id=op_id, pids=pids
             )
             for address, pid in pids.items():
                 try:
                     conn = await self._client_connect(address)
-                    await conn.run(f"kill -TERM -- -{pid} 2>/dev/null || kill -TERM {pid}")
+                    # `-s TERM -- -pid` (not `-TERM -- -pid`): dash's kill
+                    # builtin rejects the latter, which silently reduced
+                    # this to a direct-pid kill on dash /bin/sh workers.
+                    await conn.run(
+                        f"kill -s TERM -- -{pid} 2>/dev/null "
+                        f"|| kill -s TERM {pid}"
+                    )
                 except Exception as err:  # noqa: BLE001 - best-effort teardown
                     app_log.warning("cancel: could not kill %s on %s: %s", pid, address, err)
             self._active.pop(op_id, None)
+
+    async def _escalate_timeout(
+        self,
+        operation_id: str,
+        conns: list[Transport],
+        addresses: list[str],
+        pids: dict[str, int],
+    ) -> None:
+        """Reap a timed-out gang: TERM every worker's process group, give
+        ``TIMEOUT_KILL_GRACE_S`` for cleanup handlers, then KILL survivors.
+
+        The harness calls ``setsid`` at startup, so ``kill -- -pid``
+        reaches the user function's own children too — no orphan pids left
+        accruing billed TPU time.  Deliberately does NOT go through
+        :meth:`cancel`: escalation is a *failure* being classified for
+        retry, and must never read as a user cancellation.
+        """
+        obs_events.emit(
+            "task.timeout_escalated",
+            operation_id=operation_id,
+            timeout_s=self.task_timeout,
+            pids=pids,
+        )
+        app_log.warning(
+            "task %s exceeded task_timeout=%.1fs; killing the gang (%s)",
+            operation_id, self.task_timeout, pids,
+        )
+
+        def group_kill(pid: int, sig: str) -> str:
+            # `kill -s SIG -- -pid`: the one group-kill spelling both bash
+            # and dash builtins accept (dash rejects `kill -SIG -- -pid`
+            # with "Illegal number").  Direct-pid kill rides along for the
+            # pre-setsid launch window.
+            return (
+                f"kill -s {sig} -- -{pid} 2>/dev/null; "
+                f"kill -s {sig} {pid} 2>/dev/null; true"
+            )
+
+        async def term_one(conn: Transport, address: str) -> None:
+            pid = pids.get(address)
+            if pid is not None:
+                await conn.run(group_kill(pid, "TERM"))
+
+        async def kill_survivor(conn: Transport, address: str) -> None:
+            pid = pids.get(address)
+            if pid is None:
+                return
+            await conn.run(
+                f"if kill -0 {pid} 2>/dev/null; "
+                f"then {group_kill(pid, 'KILL')}; fi; true"
+            )
+
+        await asyncio.gather(
+            *(term_one(c, a) for c, a in zip(conns, addresses)),
+            return_exceptions=True,
+        )
+        await asyncio.sleep(self.TIMEOUT_KILL_GRACE_S)
+        await asyncio.gather(
+            *(kill_survivor(c, a) for c, a in zip(conns, addresses)),
+            return_exceptions=True,
+        )
+        self._active.pop(operation_id, None)
 
     async def _logged_cleanup(
         self, conns: list[Transport], staged: StagedTask
@@ -1537,6 +1820,46 @@ class TPUExecutor(RemoteExecutor):
     # Orchestrator                                                       #
     # ------------------------------------------------------------------ #
 
+    def _plan_retry(
+        self,
+        attempt: int,
+        deadline: Deadline,
+        reason: str | None = None,
+        error: BaseException | None = None,
+        message: str = "",
+        conns: list[Transport] | None = None,
+    ) -> _RetryDispatch | None:
+        """A :class:`_RetryDispatch` when the budget allows one, else None.
+
+        ``error`` (when given) is classified first: a permanent fault (user
+        code, config errors, cancellation) never yields a retry regardless
+        of budget.  ``reason`` overrides the classified label for metrics.
+        """
+        fault = FaultClass.TRANSIENT
+        label = reason
+        if error is not None:
+            fault, classified = classify_error(error)
+            # The site's label (connect/launch/channel) names WHERE it
+            # failed; circuit_open is more specific — an operator alerting
+            # on quarantine-driven retries must be able to tell them from
+            # ordinary connect failures.
+            label = (
+                classified
+                if classified == "circuit_open"
+                else reason or classified
+            )
+        if not self._retry_policy.should_retry(attempt, fault, deadline):
+            return None
+        label = label or "transient"
+        # First retry reuses pooled channels (cheap, covers one-off blips);
+        # later retries — and channel-shaped failures — redial from scratch
+        # in case the worker was recreated behind the same address.
+        redial = attempt >= 1 or label == "channel"
+        return _RetryDispatch(
+            label, message or str(error or "transient failure"), redial,
+            conns=conns,
+        )
+
     async def run(
         self,
         function: Callable,
@@ -1544,20 +1867,126 @@ class TPUExecutor(RemoteExecutor):
         kwargs: dict,
         task_metadata: dict,
     ) -> Any:
-        """Full electron lifecycle (reference orchestrator: ssh.py:466-591).
+        """Full electron lifecycle with gang-level retry.
+
+        Drives :meth:`_run_attempt` under the resilience policy: a
+        transient failure (channel death, connect/preflight failure, worker
+        death without a result, timeout) tears the whole gang down and
+        re-submits the electron under a fresh operation id
+        (``{base}.r{n}``) after a jittered backoff — re-staging is nearly
+        free thanks to the CAS layer.  Permanent faults (user-code
+        exceptions, cancellation) and an exhausted budget fall through to
+        the pre-existing behavior: the fallback policy or the original
+        error.  Degradation order: retry -> redial/alternate connection ->
+        ``run_local_on_dispatch_fail``.
+        """
+        args = tuple(args or ())
+        kwargs = dict(kwargs or {})
+        dispatch_id = task_metadata.get("dispatch_id", "dispatch")
+        node_id = task_metadata.get("node_id", 0)
+        base_operation_id = f"{dispatch_id}_{node_id}"
+        policy = self._retry_policy
+        deadline = Deadline(policy.wall_budget)
+        try:
+            return await self._run_with_retries(
+                function, args, kwargs, task_metadata,
+                base_operation_id, policy, deadline,
+            )
+        finally:
+            # cancel(base_id) marks the base id so whichever attempt is in
+            # flight sees it; the per-attempt finally only clears attempt
+            # ids, so the base mark must die with the run (else a later
+            # dispatch reusing the id would read as pre-cancelled).
+            self._cancelled_ops.discard(base_operation_id)
+
+    async def _run_with_retries(
+        self,
+        function: Callable,
+        args: tuple,
+        kwargs: dict,
+        task_metadata: dict,
+        base_operation_id: str,
+        policy: RetryPolicy,
+        deadline: Deadline,
+    ) -> Any:
+        attempt = 0
+        while True:
+            operation_id = (
+                base_operation_id
+                if attempt == 0
+                else f"{base_operation_id}.r{attempt}"
+            )
+            self.last_attempts = attempt + 1
+            if len(self._op_attempts) > 1024:  # unread entries (direct API use)
+                self._op_attempts.pop(next(iter(self._op_attempts)))
+            self._op_attempts[base_operation_id] = attempt + 1
+            try:
+                return await self._run_attempt(
+                    function, args, kwargs, task_metadata,
+                    operation_id, attempt, deadline,
+                )
+            except _RetryDispatch as retry:
+                TASK_RETRIES_TOTAL.labels(reason=retry.reason).inc()
+                delay = policy.delay(attempt)
+                remaining = deadline.remaining()
+                if remaining is not None:
+                    # The wall budget bounds when new attempts may START
+                    # (an in-flight attempt is never killed by it): never
+                    # sleep past it, and the next failure's should_retry
+                    # sees the expired deadline and takes the terminal
+                    # path.
+                    delay = min(delay, remaining)
+                app_log.warning(
+                    "task %s attempt %d/%d failed (%s: %s); retrying in "
+                    "%.2fs%s",
+                    base_operation_id, attempt + 1, policy.max_retries + 1,
+                    retry.reason, retry.message, delay,
+                    " after redial" if retry.redial else "",
+                )
+                obs_events.emit(
+                    "task.retry",
+                    operation_id=operation_id,
+                    attempt=attempt + 1,
+                    max_retries=policy.max_retries,
+                    reason=retry.reason,
+                    delay_s=round(delay, 3),
+                    redial=retry.redial,
+                    error=retry.message,
+                )
+                if retry.redial and retry.conns:
+                    await self._discard_workers(retry.conns)
+                if delay:
+                    await asyncio.sleep(delay)
+                if self._is_cancelled(base_operation_id):
+                    raise asyncio.CancelledError(
+                        f"task {base_operation_id} cancelled between retries"
+                    )
+                attempt += 1
+
+    async def _run_attempt(
+        self,
+        function: Callable,
+        args: tuple,
+        kwargs: dict,
+        task_metadata: dict,
+        operation_id: str,
+        attempt: int,
+        deadline: Deadline,
+    ) -> Any:
+        """One full dispatch attempt (reference orchestrator: ssh.py:466-591).
 
         Every stage runs in its own span (``executor.<stage>``) under one
         ``executor.run`` root, so each electron leaves a full trace in the
         event stream and per-stage histograms in the metrics registry
         (the reference captured none — SURVEY §5 tracing gap).  Stage
         timings still land in ``self.last_timings`` — now on every exit
-        path, success or not — for callers of the pre-obs API.
+        path, success or not — for callers of the pre-obs API.  Transient
+        failures raise :class:`_RetryDispatch` (per-attempt outcome
+        ``retried``) when the budget allows; otherwise the single-shot
+        failure semantics are unchanged.
         """
-        args = tuple(args or ())
-        kwargs = dict(kwargs or {})
         dispatch_id = task_metadata.get("dispatch_id", "dispatch")
         node_id = task_metadata.get("node_id", 0)
-        operation_id = f"{dispatch_id}_{node_id}"  # per-task namespace (ssh.py:482-484)
 
         current_remote_workdir = self.remote_workdir
         if self.create_unique_workdir:  # ssh.py:486-491
@@ -1574,6 +2003,7 @@ class TPUExecutor(RemoteExecutor):
                 "dispatch_id": dispatch_id,
                 "node_id": node_id,
                 "transport": self.transport_kind,
+                "attempt": attempt,
             },
         )
         root.__enter__()
@@ -1644,6 +2074,14 @@ class TPUExecutor(RemoteExecutor):
                         *(self._agent_for(c) for c in conns),
                     )
             except (TransportError, OSError, ValueError) as err:
+                retry = self._plan_retry(
+                    attempt, deadline, reason="connect", error=err,
+                    message=f"could not reach TPU workers: {err}",
+                    conns=conns,
+                )
+                if retry is not None:
+                    outcome = "retried"
+                    raise retry from err
                 result = await self._on_dispatch_fail_async(
                     function,
                     args,
@@ -1664,24 +2102,47 @@ class TPUExecutor(RemoteExecutor):
                     pip_deps=task_metadata.get("pip_deps", ()),
                     payload=staged_payload,
                 )
-            with Span("executor.upload"):
-                await asyncio.gather(
-                    *(
-                        self._upload_task(
-                            c, staged, i, key=self._pool_key(addresses[i])
+            try:
+                with Span("executor.upload"):
+                    await asyncio.gather(
+                        *(
+                            self._upload_task(
+                                c, staged, i, key=self._pool_key(addresses[i])
+                            )
+                            for i, c in enumerate(conns)
                         )
-                        for i, c in enumerate(conns)
                     )
+            except (TransportError, OSError) as err:
+                # A channel that dies mid-upload is the same transient as
+                # one dying mid-poll: tear down, redial, re-stage (CAS
+                # makes the repeat cheap).  Without budget the error
+                # propagates as before — upload failures never fell back.
+                await self._discard_workers(conns)
+                retry = self._plan_retry(
+                    attempt, deadline, reason="channel", error=err,
+                    message=f"artifact upload failed: {err}", conns=conns,
                 )
+                if retry is not None:
+                    outcome = "retried"
+                    raise retry from err
+                raise
 
             try:
                 with Span("executor.submit"):
                     pids = await self._launch_all(conns, staged)
             except TransportError as err:
-                if operation_id in self._cancelled_ops:
+                if self._is_cancelled(operation_id):
                     raise asyncio.CancelledError(
                         f"task {operation_id} cancelled during launch"
                     ) from err
+                retry = self._plan_retry(
+                    attempt, deadline, reason="launch", error=err,
+                    message=f"task launch failed: {err}",
+                    conns=conns,
+                )
+                if retry is not None:
+                    outcome = "retried"
+                    raise retry from err
                 # Nonzero-submit routing mirrors ssh.py:553-557.
                 result = await self._on_dispatch_fail_async(
                     function,
@@ -1713,11 +2174,19 @@ class TPUExecutor(RemoteExecutor):
                     else:
                         status, blamed = await self._poll_all(conns, staged, pids)
                 if status is not TaskStatus.READY:
-                    if operation_id in self._cancelled_ops:
+                    if self._is_cancelled(operation_id):
                         # cancel() killed the harness: surface cancellation,
                         # never the local-fallback re-run of the body.
                         raise asyncio.CancelledError(
                             f"task {operation_id} cancelled"
+                        )
+                    if status is TaskStatus.TIMEOUT:
+                        # task_timeout escalates: kill the whole gang
+                        # (TERM, grace, KILL) instead of abandoning RUNNING
+                        # processes on billed TPU time, then classify the
+                        # timeout as transient for the retry budget.
+                        await self._escalate_timeout(
+                            operation_id, conns, addresses, pids
                         )
                     log_tail = await self._remote_log_tail(conns[blamed], staged)
                     obs_events.emit(
@@ -1728,13 +2197,40 @@ class TPUExecutor(RemoteExecutor):
                         status=status.value,
                         log_tail=log_tail,
                     )
-                    await self.cancel(operation_id)
+                    failure_msg = (
+                        f"remote task {operation_id} timed out after "
+                        f"{self.task_timeout:.1f}s on {addresses[blamed]}; "
+                        f"gang killed; log tail:\n{log_tail}"
+                        if status is TaskStatus.TIMEOUT
+                        else f"remote task {operation_id} failed on "
+                        f"{addresses[blamed]} ({status.value}); "
+                        f"log tail:\n{log_tail}"
+                    )
+                    retry = self._plan_retry(
+                        attempt,
+                        deadline,
+                        reason=(
+                            "timeout"
+                            if status is TaskStatus.TIMEOUT
+                            else "worker_dead"
+                        ),
+                        message=failure_msg,
+                        conns=conns,
+                    )
+                    if status is not TaskStatus.TIMEOUT:
+                        # Tear the rest of the gang down (escalation already
+                        # did for timeouts) WITHOUT the cancelled mark: this
+                        # is failure cleanup, not a user cancel, and it must
+                        # not clobber (or fake) one arriving concurrently.
+                        await self.cancel(operation_id, mark=False)
+                    if retry is not None:
+                        outcome = "retried"
+                        raise retry
                     result = await self._on_dispatch_fail_async(
                         function,
                         args,
                         kwargs,
-                        f"remote task {operation_id} failed on {addresses[blamed]} "
-                        f"({status.value}); log tail:\n{log_tail}",
+                        failure_msg,
                         operation_id=operation_id,
                         log_tail=log_tail,
                     )
@@ -1747,12 +2243,21 @@ class TPUExecutor(RemoteExecutor):
 
                 with Span("executor.fetch"):
                     result, exception = await self.query_result(conns[0], staged)
-            except (TransportError, OSError):
+            except (TransportError, OSError) as err:
                 # A control-plane channel died mid-task: drop the pooled
                 # transports so the next electron redials (the reference
                 # would silently reuse nothing — it never pooled).
-                await self.cancel(operation_id)
-                await self._discard_workers()
+                # mark=False: failure cleanup, not a user cancel.
+                await self.cancel(operation_id, mark=False)
+                await self._discard_workers(conns)
+                retry = self._plan_retry(
+                    attempt, deadline, reason="channel", error=err,
+                    message=f"control-plane channel died mid-task: {err}",
+                    conns=conns,
+                )
+                if retry is not None:
+                    outcome = "retried"
+                    raise retry from err
                 raise
 
             self._active.pop(operation_id, None)
@@ -1810,7 +2315,14 @@ class TPUExecutor(RemoteExecutor):
                 total_s=round(root.total(), 6),
             )
             self._active.pop(operation_id, None)
-            self._cancelled_ops.discard(operation_id)
+            if attempt > 0:
+                # Attempt-scoped cancel marks die with the attempt; the
+                # BASE id's mark is cleared only by run()'s own finally —
+                # discarding it here would erase a user cancel() that
+                # raced a transient failure on attempt 0 (whose operation
+                # id IS the base id) and let the retry driver relaunch a
+                # cancelled electron.
+                self._cancelled_ops.discard(operation_id)
             # Release per-task state retained by resident agent channels
             # (e.g. straggler exit events whose waiters were cancelled).
             for client in self._op_agents.pop(operation_id, []) or []:
@@ -1900,10 +2412,10 @@ class TPUExecutor(RemoteExecutor):
         self._active[staged.operation_id] = pids
         self._op_agents[staged.operation_id] = launched_via
         if errors:
-            await self.cancel(staged.operation_id)
-            # This is the all-or-nothing launch ABORT, not a user cancel:
-            # the failure must still route to the fallback policy.
-            self._cancelled_ops.discard(staged.operation_id)
+            # The all-or-nothing launch ABORT, not a user cancel
+            # (mark=False): the failure must still route to the fallback
+            # policy, and a real concurrent cancel's mark must survive.
+            await self.cancel(staged.operation_id, mark=False)
             raise TransportError(
                 f"launch failed on {len(errors)}/{len(conns)} workers: {errors[0]}"
             ) from errors[0]

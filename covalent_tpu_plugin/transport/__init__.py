@@ -15,11 +15,14 @@ backends so the executor logic is transport-agnostic:
 """
 
 from .base import CommandResult, Transport, TransportError
+from .chaos import ChaosPlan, ChaosTransport, plan_from_env, plan_from_spec
 from .local import LocalTransport
 from .pool import TransportPool
 from .ssh import SSHTransport, connect_with_retries
 
 __all__ = [
+    "ChaosPlan",
+    "ChaosTransport",
     "CommandResult",
     "Transport",
     "TransportError",
@@ -27,4 +30,6 @@ __all__ = [
     "SSHTransport",
     "TransportPool",
     "connect_with_retries",
+    "plan_from_env",
+    "plan_from_spec",
 ]

@@ -40,10 +40,22 @@ class TransportPool:
         self._guard = asyncio.Lock()
 
     async def acquire(
-        self, key: str, factory: Callable[[], Awaitable[Transport]]
+        self,
+        key: str,
+        factory: Callable[[], Awaitable[Transport]],
+        gate=None,
     ) -> Transport:
         """Return the pooled transport for ``key``, creating it via
-        ``factory`` exactly once even under concurrent electron fan-out."""
+        ``factory`` exactly once even under concurrent electron fan-out.
+
+        ``gate`` is an optional circuit breaker (duck-typed: ``check()`` /
+        ``record_success()`` / ``record_failure()``, see resilience.py)
+        consulted *before* a fresh dial: a quarantined host fails fast with
+        ``CircuitOpenError`` instead of burning the full connect-retry
+        envelope.  A pooled hit bypasses the gate — an already-live channel
+        is itself evidence the host works (a broken one gets discarded, and
+        its redial is gated).
+        """
         async with self._guard:
             lock = self._locks.setdefault(key, asyncio.Lock())
         async with lock:
@@ -51,28 +63,54 @@ class TransportPool:
             if transport is not None:
                 _POOL_ACQUIRES.labels(result="hit").inc()
                 return transport
+            if gate is not None:
+                gate.check()
             _POOL_ACQUIRES.labels(result="miss").inc()
             # The span surfaces what pooling saves: its histogram is the
             # per-dial handshake cost that hits only on a miss.
-            with Span("pool.connect", {"key": key}):
-                transport = await factory()
+            try:
+                with Span("pool.connect", {"key": key}):
+                    transport = await factory()
+            except BaseException:
+                if gate is not None:
+                    gate.record_failure()
+                raise
+            if gate is not None:
+                gate.record_success()
             self._transports[key] = transport
             _POOL_SIZE.inc()
             return transport
 
-    async def discard(self, key: str) -> None:
-        """Drop (and close) a broken transport so the next acquire redials."""
-        transport = self._transports.pop(key, None)
-        if transport is not None:
-            _POOL_SIZE.dec()
-            obs_events.emit("pool.discard", key=key)
-            await transport.close()
+    async def discard(self, key: str, only=None) -> bool:
+        """Drop (and close) a broken transport so the next acquire redials.
+
+        ``only`` (an iterable of transports) scopes the discard to the
+        channels the caller actually observed failing: under concurrent
+        fan-out, electron A's teardown must not close the FRESH channel
+        electron B just redialed under the same key — that cascade turns
+        one injected fault into N spurious launch failures.  Returns
+        whether a transport was discarded.
+        """
+        transport = self._transports.get(key)
+        if transport is None:
+            return False
+        if only is not None and not any(transport is t for t in only):
+            return False
+        self._transports.pop(key, None)
+        _POOL_SIZE.dec()
+        obs_events.emit("pool.discard", key=key)
+        await transport.close()
+        return True
 
     async def close_all(self) -> None:
         transports = list(self._transports.values())
         self._transports.clear()
         _POOL_SIZE.dec(len(transports))
         await asyncio.gather(*(t.close() for t in transports), return_exceptions=True)
+
+    def has(self, key: str) -> bool:
+        """Whether a live transport is currently pooled under ``key``."""
+        return key in self._transports
 
     def __len__(self) -> int:
         return len(self._transports)

@@ -103,6 +103,15 @@ class SSHTransport(Transport):
 
     async def _open(self) -> None:
         if self.backend == "minissh":
+            # Validate the pin configuration BEFORE importing the minissh
+            # stack: the config error is actionable on any host, while the
+            # import needs `cryptography` — a missing optional dep must not
+            # mask the real diagnostic.
+            if self.strict_host_keys and self.known_host_key is None:
+                raise TransportError(
+                    "minissh backend with strict_host_keys=True needs "
+                    "known_host_key (a key object or public-key file path)"
+                )
             from . import minissh
 
             known = self.known_host_key
@@ -111,11 +120,6 @@ class SSHTransport(Transport):
 
                 with open(known, "rb") as fh:
                     known = serialization.load_ssh_public_key(fh.read())
-            if self.strict_host_keys and known is None:
-                raise TransportError(
-                    "minissh backend with strict_host_keys=True needs "
-                    "known_host_key (a key object or public-key file path)"
-                )
             self._conn = await minissh.connect(
                 self.hostname,
                 self.port,

@@ -186,6 +186,18 @@ async def _execute_graph(graph: Graph, result: Result) -> None:
             task_metadata["pip_deps"] = list(spec.deps_pip.packages)
         fn = wrap_task(spec.fn, spec.call_before, spec.call_after)
         node_event(spec, "running")
+
+        def retry_fields() -> dict:
+            # Resilient executors (TPUExecutor) expose per-operation
+            # attempt counts; stamping them on the terminal node event
+            # makes "this node survived N-1 transient faults" a first-class
+            # observable rather than something to reconstruct from retries.
+            getter = getattr(executor, "attempts_of", None)
+            if getter is None:
+                return {}
+            attempts = getter(f"{dispatch_id}_{spec.node_id}")
+            return {"attempts": attempts} if attempts > 1 else {}
+
         try:
             with Span(
                 "workflow.node",
@@ -195,14 +207,14 @@ async def _execute_graph(graph: Graph, result: Result) -> None:
                 output = await executor.run(fn, args, kwargs, task_metadata)
         except asyncio.CancelledError:
             _NODES_TOTAL.labels(status="cancelled").inc()
-            node_event(spec, "cancelled")
+            node_event(spec, "cancelled", **retry_fields())
             raise
         except BaseException as err:
             _NODES_TOTAL.labels(status="failed").inc()
-            node_event(spec, "failed", error=repr(err))
+            node_event(spec, "failed", error=repr(err), **retry_fields())
             raise
         _NODES_TOTAL.labels(status="completed").inc()
-        node_event(spec, "completed")
+        node_event(spec, "completed", **retry_fields())
         result.node_outputs[spec.node_id] = output
         return output
 

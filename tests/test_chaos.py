@@ -1,0 +1,336 @@
+"""Chaos-driven integration tests: real dispatches, injected faults.
+
+Each test runs the FULL executor lifecycle over the local transport (real
+subprocess gangs, real staged files) with a scripted :class:`ChaosPlan`
+injecting exactly the fault under test, and asserts the resilience layer's
+recovery contract: transient faults are retried to success with zero local
+fallbacks, timeouts kill the whole remote process group (no orphan pids),
+and a quarantined connect path heals through the circuit's half-open probe.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+import time
+
+import pytest
+
+from covalent_tpu_plugin.agent import AGENT_RESTARTS_TOTAL, AgentError
+from covalent_tpu_plugin.obs.metrics import REGISTRY
+from covalent_tpu_plugin.resilience import TASK_RETRIES_TOTAL
+from covalent_tpu_plugin.transport import ChaosPlan
+
+from .helpers import make_local_executor
+
+METADATA = {"dispatch_id": "chaos", "node_id": 0}
+
+
+def counter_value(name: str, **labels) -> float:
+    metric = REGISTRY.get(name)
+    if metric is None:
+        return 0.0
+    child = metric.labels(**labels) if labels else metric
+    return child.value
+
+
+def retries_total() -> float:
+    metric = REGISTRY.get("covalent_tpu_task_retries_total")
+    if metric is None:
+        return 0.0
+    return sum(child.value for _, child in metric._series())
+
+
+def make_resilient_executor(tmp_path, **kwargs):
+    kwargs.setdefault("max_task_retries", 2)
+    kwargs.setdefault("retry_base_delay", 0.05)
+    kwargs.setdefault("retry_max_delay", 0.1)
+    # Prove retries (not the CPU fallback) did the recovering: the
+    # fallback is ON, and the tests assert its counter never moves.
+    kwargs.setdefault("run_local_on_dispatch_fail", True)
+    kwargs.setdefault("poll_freq", 0.1)
+    return make_local_executor(tmp_path, **kwargs)
+
+
+def pid_running(pid: int) -> bool:
+    """True for a live process; zombies count as dead (a killed child is a
+    zombie until its reparented parent reaps it — ``os.kill(pid, 0)`` alone
+    would misread that as an orphan)."""
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            state = f.read().rsplit(") ", 1)[1].split()[0]
+    except (FileNotFoundError, ProcessLookupError, IndexError):
+        return False
+    return state not in ("Z", "X", "x")
+
+
+def assert_pid_gone(pid: int, within_s: float = 8.0) -> None:
+    deadline = time.monotonic() + within_s
+    while time.monotonic() < deadline:
+        if not pid_running(pid):
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"pid {pid} still alive after {within_s}s")
+
+
+def test_mid_run_channel_death_retried_to_success(tmp_path, run_async):
+    """A channel that dies mid-poll (after submit) is retried end to end:
+    gang torn down, workers redialed, artifacts re-staged via CAS, and the
+    electron completes with ZERO local fallbacks."""
+    plan = ChaosPlan(drop_match="if test -f", max_faults=1)
+    ex = make_resilient_executor(tmp_path, chaos=plan)
+    fallbacks_before = counter_value(
+        "covalent_tpu_tasks_total", outcome="fallback_local"
+    )
+    retries_before = counter_value(
+        "covalent_tpu_task_retries_total", reason="channel"
+    )
+
+    async def flow():
+        try:
+            return await ex.run(lambda a, b: a + b, [20, 22], {}, METADATA)
+        finally:
+            await ex.close()
+
+    assert run_async(flow()) == 42
+    assert plan.faults_injected == 1          # the death actually happened
+    assert ex.last_attempts == 2              # one retry, then success
+    assert counter_value(
+        "covalent_tpu_task_retries_total", reason="channel"
+    ) == retries_before + 1
+    assert counter_value(
+        "covalent_tpu_tasks_total", outcome="fallback_local"
+    ) == fallbacks_before  # recovery came from the retry, not the fallback
+
+
+def test_connect_fault_retried_through_fresh_dial(tmp_path, run_async):
+    """A refused dial burns the (single-attempt) connect envelope, the
+    retry driver backs off and redials, and the electron completes."""
+    plan = ChaosPlan(connect_errors=1, max_faults=1)
+    ex = make_resilient_executor(
+        tmp_path, chaos=plan, max_connection_attempts=1
+    )
+    before = counter_value(
+        "covalent_tpu_task_retries_total", reason="connect"
+    )
+
+    async def flow():
+        try:
+            return await ex.run(lambda: "ok", [], {}, METADATA)
+        finally:
+            await ex.close()
+
+    assert run_async(flow()) == "ok"
+    assert plan.faults_injected == 1
+    assert ex.last_attempts == 2
+    assert counter_value(
+        "covalent_tpu_task_retries_total", reason="connect"
+    ) == before + 1
+    # The dial failure and the healed redial were both recorded.
+    assert ex._breakers.get("localhost").state.value == "closed"
+
+
+def test_truncated_upload_caught_by_digest_and_retried(tmp_path, run_async):
+    """An upload truncated in flight fails the worker's CAS digest check
+    (a remote exception -> permanent), but the spec re-upload on retry is
+    clean.  The fault lands on the *function pickle* upload; the harness
+    detects the torn artifact before unpickling."""
+    plan = ChaosPlan(truncate_uploads=1, max_faults=1)
+    ex = make_resilient_executor(tmp_path, chaos=plan, max_task_retries=2)
+    before = retries_total()
+
+    async def flow():
+        try:
+            return await ex.run(lambda: "intact", [], {}, METADATA)
+        finally:
+            await ex.close()
+
+    # The torn artifact surfaces as a remote RuntimeError (digest
+    # mismatch) — by design a PERMANENT fault (re-raised, not retried,
+    # not fallback-swallowed): content errors must fail loud.
+    with pytest.raises(RuntimeError, match="digest"):
+        run_async(flow())
+    assert plan.faults_injected == 1
+    assert retries_total() == before  # permanent: no retry burned
+
+
+def test_timeout_escalation_kills_gang_no_orphans_then_retry(
+    tmp_path, run_async
+):
+    """task_timeout expiry kills the remote process group — harness AND the
+    user function's own child — and the timeout is classified transient:
+    the retried attempt completes."""
+    marker = str(tmp_path / "attempted")
+    child_pid_file = str(tmp_path / "child.pid")
+
+    def sleepy_once(marker_path, pid_path):
+        import os
+        import subprocess
+        import sys
+        import time
+
+        if os.path.exists(marker_path):
+            return "second-attempt"
+        with open(marker_path, "w") as f:
+            f.write("x")
+        child = subprocess.Popen(
+            [sys.executable, "-c", "import time; time.sleep(120)"]
+        )
+        with open(pid_path, "w") as f:
+            f.write(str(child.pid))
+        time.sleep(120)
+
+    ex = make_resilient_executor(
+        tmp_path, task_timeout=4.0, max_task_retries=2
+    )
+    ex.TIMEOUT_KILL_GRACE_S = 0.3
+    before = counter_value(
+        "covalent_tpu_task_retries_total", reason="timeout"
+    )
+
+    async def flow():
+        try:
+            return await ex.run(
+                sleepy_once, [marker, child_pid_file], {}, METADATA
+            )
+        finally:
+            await ex.close()
+
+    result = run_async(flow())
+    assert result == "second-attempt"
+    assert counter_value(
+        "covalent_tpu_task_retries_total", reason="timeout"
+    ) == before + 1
+    # No orphans: the harness pid (pid file of attempt 1) and the user
+    # function's own child are both gone.
+    pid_file = tmp_path / "remote" / "pid_chaos_0.0"
+    assert pid_file.exists(), "first attempt never wrote its pid file"
+    assert_pid_gone(int(pid_file.read_text().strip()))
+    assert os.path.exists(child_pid_file), "first attempt never spawned"
+    assert_pid_gone(int(open(child_pid_file).read().strip()))
+
+
+def test_cancelled_op_is_not_retried(tmp_path, run_async):
+    """cancel() during a gang's run surfaces CancelledError — never a
+    retry, never the local fallback re-running the body."""
+    ex = make_resilient_executor(tmp_path, max_task_retries=3)
+    before = retries_total()
+
+    async def flow():
+        task = asyncio.ensure_future(
+            ex.run(lambda: __import__("time").sleep(60), [], {}, METADATA)
+        )
+        # Wait for the gang to actually launch, then cancel by base id.
+        for _ in range(200):
+            if ex._active:
+                break
+            await asyncio.sleep(0.05)
+        await ex.cancel("chaos_0")
+        try:
+            with pytest.raises(asyncio.CancelledError):
+                await task
+        finally:
+            await ex.close()
+
+    run_async(flow())
+    assert retries_total() == before
+
+
+def test_user_cancel_racing_transient_failure_not_retried(
+    tmp_path, run_async
+):
+    """A user cancel() landing DURING a transient failure's gang teardown
+    must not be erased by it: the retry driver sees the mark and surfaces
+    CancelledError instead of relaunching a cancelled electron."""
+    plan = ChaosPlan(drop_match="if test -f", max_faults=1)
+    ex = make_resilient_executor(tmp_path, chaos=plan, max_task_retries=3)
+    real_discard = ex._discard_workers
+
+    async def discard_then_user_cancel(conns=None):
+        await real_discard(conns)
+        # The user's cancel arrives while the failure handler is mid-
+        # teardown, before the retry is raised.
+        await ex.cancel("chaos_0")
+
+    ex._discard_workers = discard_then_user_cancel
+    before = retries_total()
+
+    async def flow():
+        try:
+            with pytest.raises(asyncio.CancelledError):
+                await ex.run(lambda: 42, [], {}, METADATA)
+        finally:
+            ex._discard_workers = real_discard
+            await ex.close()
+
+    run_async(flow())
+    assert plan.faults_injected == 1
+    # The retry was *counted* (the failure preceded the cancel) but never
+    # executed: the driver bailed at the post-backoff cancellation check.
+    assert retries_total() == before + 1
+    assert "chaos_0" not in ex._cancelled_ops  # run()'s finally cleaned up
+
+
+def test_four_node_fanout_survives_one_channel_death(tmp_path, run_async):
+    """Acceptance: a 4-electron fan-out with exactly ONE injected channel
+    death completes every node successfully with zero fallback_local
+    outcomes and the retry recorded."""
+    plan = ChaosPlan(drop_match="if test -f", max_faults=1)
+    ex = make_resilient_executor(tmp_path, chaos=plan)
+    fallbacks_before = counter_value(
+        "covalent_tpu_tasks_total", outcome="fallback_local"
+    )
+    retries_before = retries_total()
+
+    async def flow():
+        try:
+            return await asyncio.gather(
+                *(
+                    ex.run(
+                        lambda i=i: i * 10, [],
+                        {},
+                        {"dispatch_id": "fan", "node_id": i},
+                    )
+                    for i in range(4)
+                )
+            )
+        finally:
+            await ex.close()
+
+    results = run_async(flow())
+    assert results == [0, 10, 20, 30]
+    assert plan.faults_injected == 1
+    assert retries_total() >= retries_before + 1
+    assert counter_value(
+        "covalent_tpu_tasks_total", outcome="fallback_local"
+    ) == fallbacks_before
+
+
+def test_cached_agent_failed_ping_restarts_agent(tmp_path, run_async):
+    """Satellite: a cached agent whose channel no longer answers ping is
+    discarded and restarted (counter bumped) instead of surfacing the RPC
+    error to the electron."""
+    ex = make_local_executor(
+        tmp_path, use_agent="pool", pool_preload="cloudpickle"
+    )
+    restarts_before = AGENT_RESTARTS_TOTAL.value
+
+    async def flow():
+        first = await ex.run(lambda: 1, [], {}, METADATA)
+        stale = ex._agents.get("localhost")
+        assert stale is not None, "pool agent did not start"
+
+        async def failing_ping(timeout=None):
+            raise AgentError("agent@localhost: no event within 0.1s")
+
+        stale.ping = failing_ping  # hung server: alive-looking, no pongs
+        second = await ex.run(lambda: 2, [], {}, METADATA)
+        fresh = ex._agents.get("localhost")
+        await ex.close()
+        return first, second, stale, fresh
+
+    first, second, stale, fresh = run_async(flow())
+    assert (first, second) == (1, 2)
+    assert fresh is not None and fresh is not stale  # genuinely restarted
+    assert AGENT_RESTARTS_TOTAL.value == restarts_before + 1

@@ -1,0 +1,440 @@
+"""Unit tests for the resilience layer (resilience.py, transport/chaos.py).
+
+State machines and policies are tested with injected clocks/seeds so every
+assertion is deterministic; the end-to-end recovery behavior (real
+subprocess gangs under injected faults) lives in tests/test_chaos.py.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from covalent_tpu_plugin.cache import CASIndex
+from covalent_tpu_plugin.resilience import (
+    CircuitBreaker,
+    CircuitBreakerRegistry,
+    CircuitOpenError,
+    CircuitState,
+    Deadline,
+    FaultClass,
+    RetryPolicy,
+    classify_error,
+)
+from covalent_tpu_plugin.transport import TransportPool
+from covalent_tpu_plugin.transport.base import CommandResult, TransportError
+from covalent_tpu_plugin.transport.chaos import (
+    ChaosPlan,
+    ChaosTransport,
+    plan_from_spec,
+)
+
+from .helpers import FakeTransport
+
+
+class Clock:
+    """Manually-advanced monotonic clock for breaker/deadline tests."""
+
+    def __init__(self) -> None:
+        self.now = 100.0
+
+    def __call__(self) -> float:
+        return self.now
+
+
+# --------------------------------------------------------------------- #
+# Fault classification
+# --------------------------------------------------------------------- #
+
+
+def test_classify_transport_errors_transient():
+    from covalent_tpu_plugin.agent import AgentError
+
+    assert classify_error(TransportError("channel died")) == (
+        FaultClass.TRANSIENT, "transport",
+    )
+    # AgentError (RPC loss) subclasses TransportError: same class.
+    assert classify_error(AgentError("rpc lost"))[0] is FaultClass.TRANSIENT
+    assert classify_error(ConnectionRefusedError())[0] is FaultClass.TRANSIENT
+    assert classify_error(OSError("broken pipe"))[0] is FaultClass.TRANSIENT
+
+
+def test_classify_circuit_open_is_transient_with_own_reason():
+    assert classify_error(CircuitOpenError("open")) == (
+        FaultClass.TRANSIENT, "circuit_open",
+    )
+
+
+def test_classify_user_and_cancel_permanent():
+    assert classify_error(ValueError("bad topology"))[0] is FaultClass.PERMANENT
+    assert classify_error(ZeroDivisionError())[0] is FaultClass.PERMANENT
+    assert classify_error(asyncio.CancelledError()) == (
+        FaultClass.PERMANENT, "cancelled",
+    )
+
+
+# --------------------------------------------------------------------- #
+# RetryPolicy
+# --------------------------------------------------------------------- #
+
+
+def test_retry_policy_budget_and_fault_gating():
+    policy = RetryPolicy(max_retries=2)
+    unbounded = Deadline(0.0)
+    assert policy.should_retry(0, FaultClass.TRANSIENT, unbounded)
+    assert policy.should_retry(1, FaultClass.TRANSIENT, unbounded)
+    assert not policy.should_retry(2, FaultClass.TRANSIENT, unbounded)
+    assert not policy.should_retry(0, FaultClass.PERMANENT, unbounded)
+
+
+def test_retry_policy_respects_wall_deadline():
+    clock = Clock()
+    policy = RetryPolicy(max_retries=5)
+    deadline = Deadline(10.0, clock=clock)
+    assert policy.should_retry(0, FaultClass.TRANSIENT, deadline)
+    clock.now += 11.0
+    assert not policy.should_retry(0, FaultClass.TRANSIENT, deadline)
+
+
+def test_retry_delay_full_jitter_bounds_and_determinism():
+    a = RetryPolicy(max_retries=8, base_delay=0.5, max_delay=4.0, seed=7)
+    b = RetryPolicy(max_retries=8, base_delay=0.5, max_delay=4.0, seed=7)
+    delays_a = [a.delay(i) for i in range(8)]
+    delays_b = [b.delay(i) for i in range(8)]
+    assert delays_a == delays_b  # seeded => reproducible
+    for attempt, delay in enumerate(delays_a):
+        assert 0.0 <= delay <= min(4.0, 0.5 * 2 ** attempt)
+
+
+# --------------------------------------------------------------------- #
+# Deadline
+# --------------------------------------------------------------------- #
+
+
+def test_deadline_unbounded():
+    d = Deadline(0.0)
+    assert not d.bounded
+    assert d.remaining() is None
+    assert not d.expired
+
+
+def test_deadline_counts_down_and_expires():
+    clock = Clock()
+    d = Deadline(5.0, clock=clock)
+    clock.now += 2.0
+    assert d.remaining() == pytest.approx(3.0)
+    clock.now += 4.0
+    assert d.expired
+    assert d.remaining() == 0.0
+
+
+# --------------------------------------------------------------------- #
+# CircuitBreaker state machine
+# --------------------------------------------------------------------- #
+
+
+def make_breaker(clock, threshold=3, cooldown=30.0):
+    return CircuitBreaker(
+        "w0", failure_threshold=threshold, cooldown=cooldown, clock=clock
+    )
+
+
+def test_circuit_opens_after_consecutive_failures():
+    breaker = make_breaker(Clock(), threshold=3)
+    breaker.record_failure()
+    breaker.record_failure()
+    breaker.check()  # still closed below threshold
+    breaker.record_failure()
+    assert breaker.state is CircuitState.OPEN
+    with pytest.raises(CircuitOpenError, match="circuit open for w0"):
+        breaker.check()
+
+
+def test_circuit_success_resets_consecutive_count():
+    breaker = make_breaker(Clock(), threshold=2)
+    breaker.record_failure()
+    breaker.record_success()
+    breaker.record_failure()
+    assert breaker.state is CircuitState.CLOSED  # 1 < threshold after reset
+
+
+def test_circuit_half_opens_after_cooldown_then_closes_on_success():
+    clock = Clock()
+    breaker = make_breaker(clock, threshold=1, cooldown=30.0)
+    breaker.record_failure()
+    assert breaker.state is CircuitState.OPEN
+    clock.now += 31.0
+    assert breaker.state is CircuitState.HALF_OPEN
+    breaker.check()  # the probe gets through
+    # ...but a concurrent second caller during the probe fails fast
+    with pytest.raises(CircuitOpenError, match="probe in flight"):
+        breaker.check()
+    breaker.record_success()
+    assert breaker.state is CircuitState.CLOSED
+    breaker.check()  # back to normal
+
+
+def test_circuit_failed_probe_reopens_with_fresh_cooldown():
+    clock = Clock()
+    breaker = make_breaker(clock, threshold=1, cooldown=30.0)
+    breaker.record_failure()
+    clock.now += 31.0
+    breaker.check()  # half-open probe
+    breaker.record_failure()
+    assert breaker.state is CircuitState.OPEN
+    clock.now += 29.0  # fresh cooldown: not elapsed yet
+    assert breaker.state is CircuitState.OPEN
+    clock.now += 2.0
+    assert breaker.state is CircuitState.HALF_OPEN
+
+
+def test_registry_one_breaker_per_address():
+    registry = CircuitBreakerRegistry(failure_threshold=2, cooldown=5.0)
+    assert registry.get("a") is registry.get("a")
+    assert registry.get("a") is not registry.get("b")
+    registry.get("a").record_failure()
+    registry.get("a").record_failure()
+    assert registry.states() == {"a": "open", "b": "closed"}
+
+
+# --------------------------------------------------------------------- #
+# Pool gating
+# --------------------------------------------------------------------- #
+
+
+def test_pool_acquire_gated_by_breaker(run_async):
+    """The pool fails fast on a quarantined key and records dial outcomes."""
+    clock = Clock()
+    breaker = make_breaker(clock, threshold=2, cooldown=10.0)
+    dials = []
+
+    async def failing_factory():
+        dials.append("dial")
+        raise TransportError("refused")
+
+    async def flow():
+        pool = TransportPool()
+        for _ in range(2):
+            with pytest.raises(TransportError):
+                await pool.acquire("k", failing_factory, gate=breaker)
+        # Threshold reached: next acquire must NOT dial.
+        with pytest.raises(CircuitOpenError):
+            await pool.acquire("k", failing_factory, gate=breaker)
+        assert len(dials) == 2
+        # Cooldown elapses -> half-open probe dials again and can heal.
+        clock.now += 11.0
+        fake = FakeTransport()
+
+        async def ok_factory():
+            dials.append("dial")
+            return fake
+
+        got = await pool.acquire("k", ok_factory, gate=breaker)
+        assert got is fake
+        assert breaker.state is CircuitState.CLOSED
+        await pool.close_all()
+
+    run_async(flow())
+
+
+# --------------------------------------------------------------------- #
+# Chaos spec parsing
+# --------------------------------------------------------------------- #
+
+
+def test_plan_from_spec_roundtrip():
+    plan = plan_from_spec(
+        "seed=9,delay=0.01,drop_after=5,max_faults=2,drop_match=if test -f"
+    )
+    assert plan.seed == 9
+    assert plan.delay == pytest.approx(0.01)
+    assert plan.drop_after == 5
+    assert plan.max_faults == 2
+    assert plan.drop_match == "if test -f"
+    assert plan.active
+
+
+def test_plan_from_spec_empty_and_invalid():
+    assert plan_from_spec("") is None
+    assert plan_from_spec("   ") is None
+    with pytest.raises(ValueError, match="unknown chaos spec key"):
+        plan_from_spec("tpyo=1")
+    with pytest.raises(ValueError, match="key=value"):
+        plan_from_spec("justakey")
+
+
+def test_plan_fault_budget():
+    plan = ChaosPlan(run_errors=10, max_faults=2)
+    assert plan.take_fault("run")
+    assert plan.take_fault("run")
+    assert not plan.take_fault("run")
+    assert plan.faults_injected == 2
+
+
+# --------------------------------------------------------------------- #
+# ChaosTransport
+# --------------------------------------------------------------------- #
+
+
+def test_chaos_drop_after_kills_channel_permanently(run_async):
+    inner = FakeTransport()
+    chaos = ChaosTransport(inner, ChaosPlan(drop_after=2))
+
+    async def flow():
+        await chaos.run("one")
+        await chaos.run("two")
+        with pytest.raises(TransportError, match="dropped after"):
+            await chaos.run("three")
+        # Dead is dead: every later op fails without new fault budget.
+        with pytest.raises(TransportError, match="dead"):
+            await chaos.run("four")
+        with pytest.raises(TransportError, match="dead"):
+            await chaos.put("/a", "/b")
+
+    run_async(flow())
+    assert inner.commands == ["one", "two"]
+    assert chaos.plan.faults_injected == 1
+
+
+def test_chaos_drop_match_targets_specific_command(run_async):
+    inner = FakeTransport()
+    chaos = ChaosTransport(
+        inner, ChaosPlan(drop_match="if test -f", drop_match_skip=1)
+    )
+
+    async def flow():
+        await chaos.run("if test -f /r.pkl; then echo READY; fi")  # skipped
+        await chaos.run("mkdir -p cache")
+        with pytest.raises(TransportError, match="dropped on command"):
+            await chaos.run("if test -f /r.pkl; then echo READY; fi")
+
+    run_async(flow())
+    assert len(inner.commands) == 2
+
+
+def test_chaos_connect_errors_consume_budget(run_async):
+    inner = FakeTransport()
+    chaos = ChaosTransport(inner, ChaosPlan(connect_errors=1))
+
+    async def flow():
+        with pytest.raises(ConnectionRefusedError):
+            await chaos._open()
+        await chaos._open()  # budget spent: connects fine now
+        await chaos.run("ok")
+
+    run_async(flow())
+    assert inner.commands == ["ok"]
+
+
+def test_chaos_run_errors_do_not_kill_channel(run_async):
+    inner = FakeTransport()
+    chaos = ChaosTransport(inner, ChaosPlan(run_errors=1))
+
+    async def flow():
+        with pytest.raises(TransportError, match="run failed"):
+            await chaos.run("first")
+        await chaos.run("second")
+
+    run_async(flow())
+    assert inner.commands == ["second"]
+
+
+def test_chaos_truncate_upload_corrupts_payload(tmp_path, run_async):
+    from covalent_tpu_plugin.transport.local import LocalTransport
+
+    src = tmp_path / "artifact.bin"
+    dst = tmp_path / "uploaded.bin"
+    src.write_bytes(b"0123456789abcdef")
+    chaos = ChaosTransport(LocalTransport(), ChaosPlan(truncate_uploads=1))
+
+    async def flow():
+        await chaos.put(str(src), str(dst))
+
+    run_async(flow())
+    assert dst.read_bytes() == b"01234567"  # half the payload shipped
+    # Budget spent: the next upload is intact.
+    run_async(chaos.put(str(src), str(tmp_path / "clean.bin")))
+    assert (tmp_path / "clean.bin").read_bytes() == src.read_bytes()
+
+
+def test_chaos_seeded_probabilistic_faults_reproducible(run_async):
+    async def sequence(seed):
+        inner = FakeTransport()
+        chaos = ChaosTransport(
+            inner, ChaosPlan(seed=seed, p_run_error=0.5)
+        )
+        outcomes = []
+        for i in range(12):
+            try:
+                await chaos.run(f"cmd{i}")
+                outcomes.append("ok")
+            except TransportError:
+                outcomes.append("err")
+        return outcomes
+
+    async def flow():
+        first = await sequence(3)
+        second = await sequence(3)
+        other = await sequence(4)
+        return first, second, other
+
+    first, second, other = run_async(flow())
+    assert first == second
+    assert "err" in first and "ok" in first
+    assert first != other  # different seed, different fault pattern
+
+
+# --------------------------------------------------------------------- #
+# CAS probe fallback (satellite: exists_batch failure must not fail
+# preflight)
+# --------------------------------------------------------------------- #
+
+
+class _BrokenBatchTransport(FakeTransport):
+    async def exists_batch(self, paths):
+        raise TransportError("SFTP subsystem refused")
+
+
+def test_cas_probe_falls_back_to_per_artifact(run_async):
+    conn = _BrokenBatchTransport(
+        responses={
+            "test -e": lambda cmd: CommandResult(
+                0 if "have.pkl" in cmd else 1, "", ""
+            ),
+        }
+    )
+    index = CASIndex()
+
+    async def flow():
+        await index.ensure_probed(
+            "k", conn, [("d1", "/cas/have.pkl"), ("d2", "/cas/missing.pkl")]
+        )
+
+    run_async(flow())
+    assert index.known("k", "d1")          # found by the per-path probe
+    assert not index.known("k", "d2")
+    # One `test -e` round-trip per artifact was issued.
+    assert sum("test -e" in c for c in conn.commands) == 2
+
+
+class _TotallyBrokenTransport(FakeTransport):
+    async def exists_batch(self, paths):
+        raise TransportError("channel dead")
+
+    async def run(self, command, timeout=None):
+        raise TransportError("channel dead")
+
+
+def test_cas_probe_degrades_to_all_missing(run_async):
+    """Both probe tiers failing reads as nothing-present (spurious
+    re-upload at worst), never a failed preflight."""
+    index = CASIndex()
+
+    async def flow():
+        await index.ensure_probed(
+            "k", _TotallyBrokenTransport(), [("d1", "/cas/a.pkl")]
+        )
+
+    run_async(flow())
+    assert not index.known("k", "d1")

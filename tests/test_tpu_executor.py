@@ -337,9 +337,11 @@ def test_poll_task_detects_dead_process(tmp_path, run_async):
 
 
 def test_poll_task_timeout(tmp_path, run_async):
+    """task_timeout expiry surfaces as TIMEOUT (escalation fodder), not
+    DEAD — the caller kills the gang and classifies for retry."""
     fake = FakeTransport({"if test -f": CommandResult(0, "RUNNING\n", "")})
     ex = make_executor(tmp_path, task_timeout=0.15, poll_freq=0.05)
-    assert run_async(ex._poll_task(fake, "/r.pkl", 1)) is TaskStatus.DEAD
+    assert run_async(ex._poll_task(fake, "/r.pkl", 1)) is TaskStatus.TIMEOUT
 
 
 def test_poll_all_blames_dead_nonzero_worker(tmp_path, run_async):

@@ -165,9 +165,12 @@ def test_fused_xent_under_sharded_train_step():
     step_fused = make_train_step(
         loss_fused, mesh, shardings, donate_state=False
     )
-    with mesh:
-        _, m_std = step_std(state, {"tokens": tokens})
-        _, m_fused = step_fused(state, {"tokens": tokens})
+    # No `with mesh:` around the jitted steps: an ambient mesh makes flax
+    # apply the params' *logical* axis names as sharding constraints during
+    # tracing (Partitioned.unbox), which physical meshes reject; the steps
+    # carry explicit in/out shardings and need no ambient mesh.
+    _, m_std = step_std(state, {"tokens": tokens})
+    _, m_fused = step_fused(state, {"tokens": tokens})
     assert abs(float(m_std["loss"]) - float(m_fused["loss"])) < 5e-3
     gs, gf = float(m_std["grad_norm"]), float(m_fused["grad_norm"])
     assert abs(gs - gf) / gs < 0.02
