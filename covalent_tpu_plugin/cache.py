@@ -44,6 +44,7 @@ import cloudpickle
 from .obs import events as obs_events
 from .obs.metrics import REGISTRY
 from .obs.trace import Span
+from .transport import codec as codec_mod
 from .transport.base import Transport, TransportError
 from .utils.log import app_log
 
@@ -57,6 +58,7 @@ __all__ = [
     "harness_digest",
     "CAS_UPLOADS_TOTAL",
     "RESULT_CACHE_TOTAL",
+    "STAGING_OPS_TOTAL",
 ]
 
 #: Subdirectory of ``remote_cache`` holding digest-addressed artifacts.
@@ -72,6 +74,12 @@ RESULT_CACHE_TOTAL = REGISTRY.counter(
     "covalent_tpu_result_cache_total",
     "Electron result-memoization events by result",
     ("result",),
+)
+STAGING_OPS_TOTAL = REGISTRY.counter(
+    "covalent_tpu_staging_ops_total",
+    "Control-plane round trips spent shipping staged artifacts, by path "
+    "(per_file = put+publish per artifact, bundled = one tar per worker)",
+    ("mode",),
 )
 
 
@@ -192,12 +200,18 @@ class CASIndex:
         digest: str,
         local_path: str,
         remote_path: str,
+        *,
+        codec: "codec_mod.Codec | None" = None,
+        python_path: str = "python3",
     ) -> None:
         """Upload ``local_path`` unless ``key`` already holds ``digest``.
 
         Single-flight per (key, digest): concurrent electrons of a fan-out
         sharing one function pickle trigger exactly one put; the rest await
-        it and count as hits.
+        it and count as hits.  With a negotiated ``codec``, the payload
+        ships compressed and the remote publish verifies the CAS digest
+        against the *decompressed* bytes — same round-trip count as the
+        raw temp-put + atomic-rename path (codec.put_file), fewer bytes.
         """
         while True:
             present = self._present.setdefault(key, set())
@@ -215,21 +229,105 @@ class CASIndex:
                 "executor.cas_put",
                 {"key": key, "digest": digest[:12]},
             ):
-                # Temp name + atomic rename: CAS paths are shared across
+                # Atomic publish either way: CAS paths are shared across
                 # executors (each workflow dispatch builds its own index),
                 # so another dispatcher's existence probe must never see a
                 # half-written artifact at the digest path.  Orphaned .tmp
                 # files from a crashed put are swept by the pre-flight TTL
                 # prune.
-                tmp = f"{remote_path}.tmp-{uuid.uuid4().hex[:8]}"
-                await conn.put(local_path, tmp)
-                await conn.rename(tmp, remote_path)
+                stats = await codec_mod.put_file(
+                    conn, local_path, remote_path,
+                    codec=codec, python_path=python_path, digest=digest,
+                )
+            STAGING_OPS_TOTAL.labels(mode="per_file").inc(stats["ops"])
             present.add(digest)
             CAS_UPLOADS_TOTAL.labels(result="miss").inc()
         finally:
             self._inflight.pop((key, digest), None)
             if not future.done():
                 future.set_result(None)
+
+    async def ensure_bundle(
+        self,
+        key: str,
+        conn: Transport,
+        artifacts: "list[tuple[str, str, str]]",
+        *,
+        codec: "codec_mod.Codec | None" = None,
+        python_path: str = "python3",
+    ) -> None:
+        """Ship every missing artifact of ``[(local, remote, digest)]`` in
+        ONE bundle (one put + one unpack exec) instead of per-file pairs.
+
+        Artifacts the worker already holds (or that a concurrent electron
+        is uploading) count as hits exactly like :meth:`ensure`; when at
+        most one artifact is actually missing the per-file path is used —
+        a bundle of one would pay tar overhead for zero round-trip
+        savings.  Missing digests are registered in the single-flight map
+        for the bundle's duration, so a concurrent electron sharing the
+        function pickle awaits this bundle instead of double-uploading.
+        """
+        # Wait out any in-flight uploads overlapping our artifact set, then
+        # settle hits/misses against the post-wait present set.
+        while True:
+            pending = [
+                self._inflight[(key, digest)]
+                for _, _, digest in artifacts
+                if (key, digest) in self._inflight
+            ]
+            if not pending:
+                break
+            await asyncio.gather(*pending)
+        present = self._present.setdefault(key, set())
+        missing: list[tuple[str, str, str]] = []
+        seen: set[str] = set()
+        for local, remote, digest in artifacts:
+            if digest in present:
+                CAS_UPLOADS_TOTAL.labels(result="hit").inc()
+            elif digest not in seen:  # identical payloads bundle once
+                seen.add(digest)
+                missing.append((local, remote, digest))
+        if len(missing) <= 1:
+            for local, remote, digest in missing:
+                await self.ensure(
+                    key, conn, digest, local, remote,
+                    codec=codec, python_path=python_path,
+                )
+            return
+        loop = asyncio.get_running_loop()
+        futures = {}
+        for _, _, digest in missing:
+            futures[digest] = loop.create_future()
+            self._inflight[(key, digest)] = futures[digest]
+        try:
+            bundle_path = (
+                f"{os.path.dirname(missing[0][1])}/"
+                f"bundle-{uuid.uuid4().hex[:12]}.tar"
+            )
+            with Span(
+                "executor.cas_bundle",
+                {"key": key, "members": len(missing)},
+            ):
+                stats = await conn.put_bundle(
+                    missing, bundle_path,
+                    python_path=python_path, codec=codec,
+                )
+            STAGING_OPS_TOTAL.labels(mode="bundled").inc(stats["ops"])
+            for _, _, digest in missing:
+                present.add(digest)
+                CAS_UPLOADS_TOTAL.labels(result="miss").inc()
+            obs_events.emit(
+                "cas.bundle",
+                key=key,
+                members=len(missing),
+                wire_bytes=stats["wire_bytes"],
+                codec=stats["codec"],
+            )
+        finally:
+            for _, _, digest in missing:
+                self._inflight.pop((key, digest), None)
+                if not futures[digest].done():
+                    futures[digest].set_result(None)
 
     def forget(self, key: str) -> None:
         """Evict one connection's CAS knowledge (channel discarded: the

@@ -80,6 +80,7 @@ from .transport import (
     TransportPool,
     connect_with_retries,
 )
+from .transport import codec as codec_mod
 from .transport.chaos import plan_from_spec
 from .utils.config import get_config, update_config
 from .utils.log import app_log
@@ -145,6 +146,23 @@ _EXECUTOR_PLUGIN_DEFAULTS = {
     # plugins register at import) measurably slows TPU backend init in the
     # children; interpreter+sitecustomize startup is the big win anyway.
     "pool_preload": "cloudpickle",
+    # Wire codec (transport/codec.py): "auto" negotiates the best codec
+    # both ends support (zstd > zlib > raw) during pre-flight and applies
+    # it to staged uploads — same round-trip count, fewer bytes; "zlib"/
+    # "zstd" pin one AND additionally compress result downloads (which
+    # cost one extra round trip, so they're opt-in); "off" ships raw.
+    # COVALENT_TPU_COMPRESS overrides per process.
+    "compress": "auto",
+    # Bundled staging: pack a worker's missing artifacts (function pickle,
+    # harness, spec) into ONE tar shipped with a single put + unpack exec
+    # instead of put+publish pairs per artifact.
+    "bundle": True,
+    # DAG-driven connection prewarm: the workflow runner pre-dials this
+    # executor's pooled transports (and starts its agents) while a node's
+    # upstream dependencies are still running, so dial latency overlaps
+    # upstream compute.  Breaker-gated; disabled automatically under a
+    # chaos plan so fault budgets are spent only by real dispatch ops.
+    "prewarm": True,
     "profile_dir": "",
     # Resilience layer (resilience.py).  max_task_retries counts full-gang
     # re-submissions after a *transient* failure (channel death, connect/
@@ -183,6 +201,11 @@ _ACTIVE_ELECTRONS = REGISTRY.gauge(
 _OVERHEAD_HIST = REGISTRY.histogram(
     "covalent_tpu_dispatch_overhead_seconds",
     "Per-electron dispatch overhead (lifecycle stages minus execute)",
+)
+_PREWARM_TOTAL = REGISTRY.counter(
+    "covalent_tpu_prewarm_total",
+    "DAG-driven connection prewarm attempts by result",
+    ("result",),
 )
 
 
@@ -261,6 +284,17 @@ class StagedTask:
         ]
 
 
+class _StageUploadFailed(Exception):
+    """Internal tag: a per-worker pipeline failed in its *upload* leg.
+
+    The pipelined dispatch (upload -> launch per worker, no global
+    barrier) needs to preserve the pre-pipeline failure routing: upload
+    faults take the channel path (discard + redial + retry, no local
+    fallback) while launch faults take the launch path (fallback
+    allowed).  ``__cause__`` carries the real error.
+    """
+
+
 class _RetryDispatch(Exception):
     """Internal control flow: this attempt failed transiently and the retry
     budget allows another.  Raised by ``_run_attempt``'s failure sites and
@@ -321,6 +355,9 @@ class TPUExecutor(RemoteExecutor):
         task_env: dict[str, str] | None = None,
         use_agent: bool | str | None = None,
         pool_preload: str | None = None,
+        compress: str | None = None,
+        bundle: bool | None = None,
+        prewarm: bool | None = None,
         profile_dir: str | None = None,
         cache_results: bool | None = None,
         result_cache_max_entries: int | None = None,
@@ -407,6 +444,28 @@ class TPUExecutor(RemoteExecutor):
             self.use_agent = False
         #: comma-separated modules the pool server imports once at start.
         self.pool_preload = str(resolve(pool_preload, "pool_preload"))
+        #: wire codec policy: explicit arg > COVALENT_TPU_COMPRESS > config.
+        env_compress = os.environ.get("COVALENT_TPU_COMPRESS")
+        if compress is None and env_compress is not None:
+            compress = env_compress.strip().lower() or None
+        self.compress = str(resolve(compress, "compress")).lower()
+        if self.compress in ("0", "false", "no", "none", "raw"):
+            self.compress = "off"
+        elif self.compress in ("1", "true", "yes", "on"):
+            self.compress = "auto"
+        if self.compress not in ("auto", "off", "zlib", "zstd"):
+            raise ValueError(
+                f'compress must be "auto"/"off"/"zlib"/"zstd", '
+                f"got {self.compress!r}"
+            )
+        #: bundled staging (one tar per worker instead of per-file pairs).
+        self.bundle = bool(resolve(bundle, "bundle"))
+        #: whether the workflow runner may pre-dial this executor.
+        self.prewarm_enabled = bool(resolve(prewarm, "prewarm"))
+        #: pool key -> codec names the worker advertised at pre-flight.
+        self._wire_codecs: dict[str, list[str]] = {}
+        #: a prewarm already warmed this loop's pool (reset on discard).
+        self._prewarmed = False
         #: result memoization (cache.py level 2): explicit arg > env var >
         #: config > default-off.  Env is the workflow-layer switch — each
         #: dispatch resolves a fresh alias executor, and the disk-backed
@@ -702,7 +761,10 @@ class TPUExecutor(RemoteExecutor):
             # re-prove their environment and re-probe their artifact cache
             # (the worker may have been recreated with an empty disk).
             self._preflighted.discard(key)
+            self._wire_codecs.pop(key, None)
             self._cas.forget(key)
+        # A recreated worker must be re-dialed by the next prewarm too.
+        self._prewarmed = False
         # A mid-run control-plane failure may mean the TPU itself was
         # preempted/recreated with new IPs: re-discover on the next electron
         # instead of dialing stale addresses forever.
@@ -722,6 +784,58 @@ class TPUExecutor(RemoteExecutor):
                 f"failed to connect to {len(errors)}/{len(addresses)} workers: {errors[0]}"
             ) from errors[0]
         return list(results)  # type: ignore[list-item]
+
+    async def prewarm(self) -> bool:
+        """Best-effort pre-dial of this executor's control plane.
+
+        The workflow runner calls this for a node whose upstream
+        dependencies are still running, so the connect handshake,
+        pre-flight round trip, codec negotiation, and agent warm-up all
+        overlap upstream compute instead of sitting on the node's own
+        critical path.  Everything it touches is the cached/idempotent
+        fast path the real dispatch reuses (pool single-flight, breaker
+        gate included); failures are swallowed — the dispatch itself will
+        surface them with its full retry envelope.  No-op when disabled,
+        already warm, or under a chaos plan (injected fault budgets must
+        be spent by real dispatch ops, not warmup).
+        """
+        if not self.prewarm_enabled or self._chaos is not None:
+            return False
+        if self._prewarmed:
+            return False
+        self._guard_event_loop()
+        self._prewarmed = True  # optimistic: concurrent callers skip
+        try:
+            with Span("executor.prewarm", {"transport": self.transport_kind}):
+                conns = await self._connect_all()
+                addresses = self._worker_addresses()
+                await asyncio.gather(
+                    *(
+                        self._preflight(c, key=self._pool_key(a))
+                        for a, c in zip(addresses, conns)
+                    ),
+                    *(self._agent_for(c) for c in conns),
+                )
+        except asyncio.CancelledError:
+            self._prewarmed = False
+            raise
+        except Exception as err:  # noqa: BLE001 - warmup is advisory
+            self._prewarmed = False  # let a later node retry
+            _PREWARM_TOTAL.labels(result="failed").inc()
+            obs_events.emit(
+                "executor.prewarm_failed",
+                transport=self.transport_kind,
+                error=repr(err),
+            )
+            app_log.debug("prewarm failed (dispatch will retry): %s", err)
+            return False
+        _PREWARM_TOTAL.labels(result="warmed").inc()
+        obs_events.emit(
+            "executor.prewarm",
+            transport=self.transport_kind,
+            workers=len(conns),
+        )
+        return True
 
     def _on_dispatch_fail(
         self, fn: Callable, args: tuple, kwargs: dict, message: str
@@ -955,6 +1069,13 @@ class TPUExecutor(RemoteExecutor):
                 f'eval "$(conda shell.bash hook)" && conda activate '
                 f"{shlex.quote(self.conda_env)}"
             )
+        # Codec negotiation rides the same compound command (zero extra
+        # round trips): the clause prints COVALENT_TPU_CODECS=... and
+        # always exits 0, so a probe failure means the raw fallback, never
+        # a failed pre-flight.
+        codec_probe = codec_mod.probe_clause(self.python_path, self.compress)
+        if codec_probe:
+            checks.append(codec_probe)
         # -E -S skips site/sitecustomize processing: the check only needs
         # the interpreter's existence + major version, and a site hook that
         # imports heavy ML runtimes (as TPU-VM images do) would turn a
@@ -965,6 +1086,31 @@ class TPUExecutor(RemoteExecutor):
             f"{self.python_path} -E -S -c 'import sys; print(sys.version_info[0])'"
         )
         return " && ".join(checks)
+
+    def _codec_for(
+        self, key: str, conn: Transport
+    ) -> "codec_mod.Codec | None":
+        """The negotiated wire codec for one connection (None = raw).
+
+        Zero-wire transports (shared filesystem) always ship raw; a pinned
+        codec the worker didn't advertise degrades to raw with a warning
+        rather than failing dispatch.
+        """
+        if self.compress == "off" or getattr(conn, "zero_wire", False):
+            return None
+        remote = self._wire_codecs.get(key, ())
+        if self.compress in ("zlib", "zstd"):
+            if (
+                self.compress in remote
+                and self.compress in codec_mod.available_codecs()
+            ):
+                return codec_mod.get_codec(self.compress)
+            app_log.warning(
+                "compress=%r pinned but %s did not negotiate it; "
+                "shipping raw", self.compress, conn.address,
+            )
+            return None
+        return codec_mod.pick_codec(remote)
 
     def _cas_prune_clause(self) -> str | None:
         """Age-prune shell clause for the CAS dir; None when disabled."""
@@ -1033,6 +1179,9 @@ class TPUExecutor(RemoteExecutor):
             breaker.record_failure()
             raise
         breaker.record_success()
+        # Codec negotiation settled by the same round trip: remember what
+        # the worker advertised (absent/garbled -> raw fallback).
+        self._wire_codecs[key] = codec_mod.parse_probe(result.stdout)
         self._preflighted.add(key)
 
     async def _upload_task(
@@ -1049,15 +1198,30 @@ class TPUExecutor(RemoteExecutor):
         ONE batched existence probe per connection lifetime, and identical
         payloads racing from concurrent electrons upload single-flight.
         The harness (digest constant per package version) therefore ships
-        once per connection, not once per electron × worker.
+        once per connection, not once per electron × worker.  What DOES
+        ship rides the fast path: ≥2 missing artifacts pack into one
+        bundle (one put + one unpack exec), and payloads are compressed
+        with the codec negotiated at pre-flight — the remote side always
+        verifies CAS digests against the decompressed bytes.
         """
         key = key or self._pool_key(conn.address)
         artifacts = staged.artifacts(process_id)
         await self._cas.ensure_probed(
             key, conn, [(digest, remote) for _, remote, digest in artifacts]
         )
+        codec = self._codec_for(key, conn)
+        if self.bundle:
+            await self._cas.ensure_bundle(
+                key, conn,
+                [(local, remote, digest) for local, remote, digest in artifacts],
+                codec=codec, python_path=self.python_path,
+            )
+            return
         for local, remote, digest in artifacts:
-            await self._cas.ensure(key, conn, digest, local, remote)
+            await self._cas.ensure(
+                key, conn, digest, local, remote,
+                codec=codec, python_path=self.python_path,
+            )
 
     # ------------------------------------------------------------------ #
     # Submit / status / poll / fetch / cancel / cleanup                  #
@@ -1499,10 +1663,26 @@ class TPUExecutor(RemoteExecutor):
         )
 
     async def query_result(
-        self, conn: Transport, staged: StagedTask
+        self, conn: Transport, staged: StagedTask, key: str | None = None
     ) -> tuple[Any, BaseException | None]:
-        """Fetch + unpickle ``(result, exception)`` (reference: ssh.py:434-458)."""
-        await conn.get(staged.remote_result_file, staged.local_result_file)
+        """Fetch + unpickle ``(result, exception)`` (reference: ssh.py:434-458).
+
+        With an explicitly pinned codec the result rides the wire
+        compressed (codec.get_file) — one extra pack round trip, so it is
+        never engaged by the ``auto`` policy, whose wins must be free.
+        ``key`` is the worker's pool key (the identity codecs were
+        negotiated under — the *configured* address, which can differ
+        from ``conn.address``); callers without one get the raw path.
+        """
+        codec = (
+            self._codec_for(key, conn)
+            if key is not None and self.compress in ("zlib", "zstd")
+            else None
+        )
+        await codec_mod.get_file(
+            conn, staged.remote_result_file, staged.local_result_file,
+            codec=codec, python_path=self.python_path,
+        )
         return load_result(staged.local_result_file)
 
     async def _remote_log_tail(self, conn: Transport, staged: StagedTask) -> str:
@@ -1695,6 +1875,9 @@ class TPUExecutor(RemoteExecutor):
             ]
             if process_id == 0:
                 files.append(staged.remote_result_file)
+                # Pinned-codec downloads stage a packed copy next to the
+                # result (codec.get_file); harmless rm -f otherwise.
+                files.append(f"{staged.remote_result_file}.z")
             else:
                 files.append(f"{staged.remote_result_file}.done.{process_id}")
             result = await conn.remove(files)
@@ -1785,6 +1968,8 @@ class TPUExecutor(RemoteExecutor):
             )
         self._cleanup_tasks = set()
         self._preflighted.clear()
+        self._wire_codecs.clear()
+        self._prewarmed = False
         # CASIndex holds loop-bound locks/futures; present-set knowledge is
         # cheap to rebuild via one probe per redialed connection.
         self._cas = CASIndex()
@@ -2058,6 +2243,31 @@ class TPUExecutor(RemoteExecutor):
             with Span("executor.validate"):
                 await self._validate_credentials()
 
+            # Pipelined attempt, leg 1: cloudpickle serialization + spec
+            # staging run on a worker thread WHILE the connection dial and
+            # pre-flight round-trips are in flight — the two legs share no
+            # state beyond the (pre-resolved) worker topology.
+            await self._ensure_workers()
+
+            def _stage() -> StagedTask:
+                with Span("executor.stage"):
+                    return self._write_function_files(
+                        operation_id,
+                        function,
+                        args,
+                        kwargs,
+                        current_remote_workdir,
+                        pip_deps=task_metadata.get("pip_deps", ()),
+                        payload=staged_payload,
+                    )
+
+            stage_task = asyncio.create_task(asyncio.to_thread(_stage))
+            # Retrieve the staging exception even on paths that never await
+            # the task (outer cancellation mid-dial): the error is either
+            # re-raised from the awaits below or deliberately secondary.
+            stage_task.add_done_callback(
+                lambda t: None if t.cancelled() else t.exception()
+            )
             try:
                 with Span("executor.connect"):
                     conns = await self._connect_all()
@@ -2074,6 +2284,16 @@ class TPUExecutor(RemoteExecutor):
                         *(self._agent_for(c) for c in conns),
                     )
             except (TransportError, OSError, ValueError) as err:
+                # Join the staging leg (its own error, if any, is
+                # secondary to the connect failure — exactly the error
+                # precedence of the pre-pipeline sequential order) and
+                # remove the dead attempt's local staging.
+                try:
+                    doomed: StagedTask | None = await stage_task
+                except Exception:  # noqa: BLE001 - connect error wins
+                    doomed = None
+                if doomed is not None:
+                    self._remove_local_staging(doomed)
                 retry = self._plan_retry(
                     attempt, deadline, reason="connect", error=err,
                     message=f"could not reach TPU workers: {err}",
@@ -2091,28 +2311,33 @@ class TPUExecutor(RemoteExecutor):
                 )
                 outcome = "fallback_local"
                 return result
+            except BaseException:
+                # Cancellation (or an unexpected error) mid-dial: the
+                # staging thread is uncancellable and its files would
+                # otherwise leak in cache_dir — join it briefly and
+                # unlink them before re-raising.
+                try:
+                    self._remove_local_staging(await stage_task)
+                except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                    pass  # double-cancel or staging's own error: nothing staged
+                raise
 
-            with Span("executor.stage"):
-                staged = self._write_function_files(
-                    operation_id,
-                    function,
-                    args,
-                    kwargs,
-                    current_remote_workdir,
-                    pip_deps=task_metadata.get("pip_deps", ()),
-                    payload=staged_payload,
-                )
+            # Staging errors (e.g. an unpicklable electron) surface here,
+            # after a successful connect — same precedence as before.
+            staged = await stage_task
+
             try:
-                with Span("executor.upload"):
-                    await asyncio.gather(
-                        *(
-                            self._upload_task(
-                                c, staged, i, key=self._pool_key(addresses[i])
-                            )
-                            for i, c in enumerate(conns)
-                        )
-                    )
-            except (TransportError, OSError) as err:
+                # Leg 2: per-worker upload -> launch pipelines with no
+                # global barrier between the stages (worker 0 can launch
+                # while worker 7 still uploads); the all-or-nothing launch
+                # guarantee is enforced on the far side of the gather.
+                pids = await self._dispatch_all(conns, staged)
+            except _StageUploadFailed as tag:
+                err = tag.__cause__ or tag
+                if not isinstance(err, (TransportError, OSError)):
+                    # Content faults (CodecIntegrityError: torn/corrupt
+                    # payload) are permanent — fail loud, keep the channel.
+                    raise err
                 # A channel that dies mid-upload is the same transient as
                 # one dying mid-poll: tear down, redial, re-stage (CAS
                 # makes the repeat cheap).  Without budget the error
@@ -2125,11 +2350,7 @@ class TPUExecutor(RemoteExecutor):
                 if retry is not None:
                     outcome = "retried"
                     raise retry from err
-                raise
-
-            try:
-                with Span("executor.submit"):
-                    pids = await self._launch_all(conns, staged)
+                raise err
             except TransportError as err:
                 if self._is_cancelled(operation_id):
                     raise asyncio.CancelledError(
@@ -2242,7 +2463,9 @@ class TPUExecutor(RemoteExecutor):
                         await self._await_stragglers(conns, staged, pids)
 
                 with Span("executor.fetch"):
-                    result, exception = await self.query_result(conns[0], staged)
+                    result, exception = await self.query_result(
+                        conns[0], staged, key=self._pool_key(addresses[0])
+                    )
             except (TransportError, OSError) as err:
                 # A control-plane channel died mid-task: drop the pooled
                 # transports so the next electron redials (the reference
@@ -2303,6 +2526,14 @@ class TPUExecutor(RemoteExecutor):
                 root.record_error(outcome)
             root.end()
             self.last_timings = root.summary()
+            # Stage spans SUM concurrent work (pipelined upload/submit run
+            # per worker, staging overlaps the dial), so the wall-clock
+            # overhead the caller actually waited is reported separately:
+            # elapsed time minus the task's own runtime.
+            self.last_timings["wall_overhead"] = max(
+                0.0,
+                root.total() - root.stage_durations.get("execute", 0.0),
+            )
             _ACTIVE_ELECTRONS.dec()
             _TASKS_TOTAL.labels(outcome=outcome).inc()
             _OVERHEAD_HIST.observe(root.overhead())
@@ -2332,74 +2563,54 @@ class TPUExecutor(RemoteExecutor):
             # tears them down.  Non-pooled (error) states are handled by
             # the pool itself.
 
-    async def _launch_all(
-        self, conns: list[Transport], staged: StagedTask
-    ) -> dict[str, int]:
-        """All-or-nothing N-worker launch (SURVEY §7 'hard parts').
+    def _remove_local_staging(self, staged: StagedTask) -> None:
+        """Unlink a dead attempt's local staging (pipelining stages them
+        even when the concurrent connect leg fails)."""
+        for path in [staged.function_file, *staged.local_spec_files]:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
 
-        Starts the harness on every worker concurrently; if any launch
-        fails, kills the ones that did start before raising.  PIDs are keyed
-        by the *configured* worker address so :meth:`cancel` resolves them
-        through the same pool key that opened the connection.
+    async def _dispatch_all(
+        self,
+        conns: list[Transport],
+        staged: StagedTask,
+        upload: bool = True,
+    ) -> dict[str, int]:
+        """Per-worker upload→launch pipelines with an all-or-nothing
+        launch barrier (SURVEY §7 'hard parts').
+
+        Each worker's chain runs independently — no global barrier between
+        the upload and submit stages, so a fast worker launches while a
+        slow one still uploads; the per-worker ``executor.upload``/
+        ``executor.submit`` spans therefore SUM worker time in
+        ``last_timings`` (wall savings show in ``wall_overhead``).  If any
+        chain fails, workers that did start are killed before raising —
+        upload-leg failures re-raise tagged :class:`_StageUploadFailed` so
+        the caller keeps the channel-vs-launch failure routing.  PIDs are
+        keyed by the *configured* worker address so :meth:`cancel`
+        resolves them through the same pool key that opened the
+        connection.
         """
         addresses = self._worker_addresses()
         launched_via: list[AgentClient | None] = [None] * len(conns)
 
-        async def launch_one(i: int, conn: Transport) -> int:
-            client = await self._agent_for(conn)
-            if client is not None:
+        async def chain(i: int, conn: Transport) -> int:
+            if upload:
                 try:
-                    pid = await self._submit_via_agent(client, staged, i)
-                    launched_via[i] = client
-                    return pid
-                except AgentError as err:
-                    if getattr(err, "maybe_started", False):
-                        # The run command reached (or may have reached) the
-                        # worker before the channel failed: the harness could
-                        # already be alive.  Relaunching would double-run the
-                        # task; kill any orphan and abort this worker's
-                        # launch instead.  Two handles cover both runtimes:
-                        # the pid file the harness writes at startup (pool
-                        # forks keep the server's cmdline, so pkill alone
-                        # can't find them) and the spec path in the native
-                        # agent's exec'd command line.  The pid file is
-                        # written moments after fork, so retry over a short
-                        # grace window rather than racing it once.
-                        pid_file = shlex.quote(f"{staged.remote_pid_file}.{i}")
-                        # -s (non-empty) + the harness's atomic pid write
-                        # mean a readable pid IS complete; echo only on a
-                        # kill that had a real target so the retry loop
-                        # can't declare victory on an empty race window.
-                        # The pkill pattern brackets its first character
-                        # ([s]pec-style) so the reaping shell — whose own
-                        # command line contains the spec path — can never
-                        # match and TERM itself.
-                        spec_path = staged.remote_spec_file(i)
-                        pkill_pattern = f"[{spec_path[0]}]{spec_path[1:]}"
-                        reap = (
-                            f"if [ -s {pid_file} ]; then "
-                            f"kill -TERM $(cat {pid_file}) 2>/dev/null; "
-                            "echo KILLED; fi; pkill -f "
-                            + shlex.quote(pkill_pattern)
-                            + " 2>/dev/null && echo PKILLED || true"
+                    with Span("executor.upload"):
+                        await self._upload_task(
+                            conn, staged, i,
+                            key=self._pool_key(addresses[i]),
                         )
-                        for _attempt in range(4):
-                            reaped = await conn.run(reap)
-                            if "KILLED" in reaped.stdout:  # matches PKILLED too
-                                break
-                            await asyncio.sleep(0.5)
-                        raise TransportError(
-                            f"agent submit on {conn.address} failed after the "
-                            f"run command was sent: {err}"
-                        ) from err
-                    app_log.warning(
-                        "agent submit on %s failed (%s); nohup fallback",
-                        conn.address, err,
-                    )
-            return await self.submit_task(conn, staged, i)
+                except Exception as err:
+                    raise _StageUploadFailed(str(err)) from err
+            with Span("executor.submit"):
+                return await self._launch_one(i, conn, staged, launched_via)
 
         results = await asyncio.gather(
-            *(launch_one(i, c) for i, c in enumerate(conns)),
+            *(chain(i, c) for i, c in enumerate(conns)),
             return_exceptions=True,
         )
         pids: dict[str, int] = {}
@@ -2412,14 +2623,81 @@ class TPUExecutor(RemoteExecutor):
         self._active[staged.operation_id] = pids
         self._op_agents[staged.operation_id] = launched_via
         if errors:
-            # The all-or-nothing launch ABORT, not a user cancel
-            # (mark=False): the failure must still route to the fallback
-            # policy, and a real concurrent cancel's mark must survive.
+            # The all-or-nothing abort, not a user cancel (mark=False):
+            # the failure must still route to the fallback policy, and a
+            # real concurrent cancel's mark must survive.
             await self.cancel(staged.operation_id, mark=False)
+            for err in errors:
+                if isinstance(err, asyncio.CancelledError):
+                    raise err
+            for err in errors:
+                if isinstance(err, _StageUploadFailed):
+                    raise err
             raise TransportError(
-                f"launch failed on {len(errors)}/{len(conns)} workers: {errors[0]}"
+                f"launch failed on {len(errors)}/{len(conns)} workers: "
+                f"{errors[0]}"
             ) from errors[0]
         return pids
+
+    async def _launch_one(
+        self,
+        i: int,
+        conn: Transport,
+        staged: StagedTask,
+        launched_via: "list[AgentClient | None]",
+    ) -> int:
+        """Start one worker's harness (agent fast path, nohup fallback)."""
+        client = await self._agent_for(conn)
+        if client is not None:
+            try:
+                pid = await self._submit_via_agent(client, staged, i)
+                launched_via[i] = client
+                return pid
+            except AgentError as err:
+                if getattr(err, "maybe_started", False):
+                    # The run command reached (or may have reached) the
+                    # worker before the channel failed: the harness could
+                    # already be alive.  Relaunching would double-run the
+                    # task; kill any orphan and abort this worker's
+                    # launch instead.  Two handles cover both runtimes:
+                    # the pid file the harness writes at startup (pool
+                    # forks keep the server's cmdline, so pkill alone
+                    # can't find them) and the spec path in the native
+                    # agent's exec'd command line.  The pid file is
+                    # written moments after fork, so retry over a short
+                    # grace window rather than racing it once.
+                    pid_file = shlex.quote(f"{staged.remote_pid_file}.{i}")
+                    # -s (non-empty) + the harness's atomic pid write
+                    # mean a readable pid IS complete; echo only on a
+                    # kill that had a real target so the retry loop
+                    # can't declare victory on an empty race window.
+                    # The pkill pattern brackets its first character
+                    # ([s]pec-style) so the reaping shell — whose own
+                    # command line contains the spec path — can never
+                    # match and TERM itself.
+                    spec_path = staged.remote_spec_file(i)
+                    pkill_pattern = f"[{spec_path[0]}]{spec_path[1:]}"
+                    reap = (
+                        f"if [ -s {pid_file} ]; then "
+                        f"kill -TERM $(cat {pid_file}) 2>/dev/null; "
+                        "echo KILLED; fi; pkill -f "
+                        + shlex.quote(pkill_pattern)
+                        + " 2>/dev/null && echo PKILLED || true"
+                    )
+                    for _attempt in range(4):
+                        reaped = await conn.run(reap)
+                        if "KILLED" in reaped.stdout:  # matches PKILLED too
+                            break
+                        await asyncio.sleep(0.5)
+                    raise TransportError(
+                        f"agent submit on {conn.address} failed after the "
+                        f"run command was sent: {err}"
+                    ) from err
+                app_log.warning(
+                    "agent submit on %s failed (%s); nohup fallback",
+                    conn.address, err,
+                )
+        return await self.submit_task(conn, staged, i)
 
     async def _await_stragglers(
         self,

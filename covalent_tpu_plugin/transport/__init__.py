@@ -16,6 +16,7 @@ backends so the executor logic is transport-agnostic:
 
 from .base import CommandResult, Transport, TransportError
 from .chaos import ChaosPlan, ChaosTransport, plan_from_env, plan_from_spec
+from .codec import Codec, CodecIntegrityError
 from .local import LocalTransport
 from .pool import TransportPool
 from .ssh import SSHTransport, connect_with_retries
@@ -23,6 +24,8 @@ from .ssh import SSHTransport, connect_with_retries
 __all__ = [
     "ChaosPlan",
     "ChaosTransport",
+    "Codec",
+    "CodecIntegrityError",
     "CommandResult",
     "Transport",
     "TransportError",

@@ -40,6 +40,11 @@ class Transport(ABC):
     #: Human-readable address for logs ("user@host" or "localhost").
     address: str = "?"
 
+    #: True when bytes never cross a wire (shared filesystem): the codec
+    #: layer (transport/codec.py) skips compression for such backends —
+    #: compressing a local copy burns CPU to save bytes that were free.
+    zero_wire: bool = False
+
     @abstractmethod
     async def run(self, command: str, timeout: float | None = None) -> CommandResult:
         """Execute a shell command on the worker and capture its output."""
@@ -111,6 +116,61 @@ class Transport(ABC):
         import shlex
 
         return await self.run("rm -f " + " ".join(shlex.quote(p) for p in paths))
+
+    async def put_bundle(
+        self,
+        items: "list[tuple[str, str, str]]",
+        bundle_path: str,
+        python_path: str = "python3",
+        codec=None,
+    ) -> dict:
+        """Ship many files in ONE upload + ONE remote exec.
+
+        ``items`` is ``[(local_path, remote_path, sha256_digest)]`` (empty
+        digest skips verification for that member).  The default packs a
+        (codec-compressed when profitable) tar, ``put``s it to
+        ``bundle_path``, and unpacks it remotely with a single
+        ``python -c`` exec that verifies each member's digest against the
+        *decompressed* bytes and publishes it atomically — so a fan-out's
+        N per-worker spec round trips collapse to 2, and a torn bundle
+        raises :class:`~.codec.CodecIntegrityError` (permanent) instead
+        of launching against corrupt artifacts.  Backends with direct
+        filesystem access override this to skip the tar entirely;
+        fault-injection wrappers inherit it so their ``put``/``run``
+        faults apply to the bundle exactly as to any other transfer.
+        """
+        import asyncio
+        import os
+        import tempfile
+
+        from . import codec as codec_mod
+
+        payload, codec_name = await asyncio.to_thread(
+            codec_mod.build_bundle, items, codec
+        )
+        fd, tmp_local = tempfile.mkstemp(prefix="covalent-tpu-bundle-")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(payload)
+            await self.put(tmp_local, bundle_path)
+        finally:
+            try:
+                os.unlink(tmp_local)
+            except OSError:
+                pass
+        codec_mod._check_exec(
+            await self.run(
+                codec_mod.unpack_command(python_path, bundle_path, codec_name)
+            ),
+            f"bundle unpack of {bundle_path}",
+        )
+        codec_mod.record_wire("up", codec_name, len(payload))
+        return {
+            "ops": 2,
+            "wire_bytes": len(payload),
+            "codec": codec_name,
+            "members": len(items),
+        }
 
     async def start_process(self, command: str, describe: str = ""):
         """Start a long-lived remote process with piped stdin/stdout.

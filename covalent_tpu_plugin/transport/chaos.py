@@ -164,6 +164,14 @@ class ChaosTransport(Transport):
     the recovery path the resilience layer must drive.
     """
 
+    #: A chaos wrapper *simulates a network* even over a shared-fs inner
+    #: transport, so the codec layer negotiates compression through it and
+    #: ``put_bundle`` deliberately rides the base-class implementation:
+    #: its tar travels through THIS class's ``put`` (truncation faults
+    #: corrupt it) and its unpack through ``run`` (drop faults kill it),
+    #: exactly like a real wire.
+    zero_wire = False
+
     def __init__(self, inner: Transport, plan: ChaosPlan) -> None:
         self.inner = inner
         self.plan = plan
@@ -264,17 +272,12 @@ class ChaosTransport(Transport):
         await self._gate("get")
         await self.inner.get(remote_path, local_path)
 
-    async def exists_batch(self, paths: list[str]) -> list[bool]:
-        await self._gate("exists_batch")
-        return await self.inner.exists_batch(paths)
-
-    async def rename(self, src: str, dst: str) -> None:
-        await self._gate("rename")
-        await self.inner.rename(src, dst)
-
-    async def remove(self, paths: list[str]) -> CommandResult:
-        await self._gate("remove")
-        return await self.inner.remove(paths)
+    # exists_batch / rename / remove deliberately NOT forwarded to the
+    # inner transport: the base-class implementations ride self.run (one
+    # gated round trip each), so a chaos-wrapped LocalTransport behaves
+    # op-for-op like a real SSH wire — a shell exec per probe/publish —
+    # instead of silently borrowing the inner backend's direct-filesystem
+    # fast paths.  Faults still apply exactly once, via the run gate.
 
     async def start_process(self, command: str, describe: str = ""):
         await self._gate("start_process", command)

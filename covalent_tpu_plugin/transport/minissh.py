@@ -268,6 +268,16 @@ class _PacketStream:
         length = struct.unpack(">I", head_plain[:4])[0]
         if not 1 <= length <= 4 * _MAX_PACKET:
             raise MiniSSHError(f"bad packet length {length}")
+        # RFC 4253 §6: the total packet (4-byte length field + payload)
+        # must be a whole number of cipher blocks.  A garbled/hostile
+        # length that violates this would otherwise feed readexactly a
+        # negative count (ValueError) or desync the CTR keystream —
+        # reject it as a clean protocol error instead.
+        if length < self.block - 4 or (4 + length) % self.block:
+            raise MiniSSHError(
+                f"invalid packet length {length} for cipher block size "
+                f"{self.block}"
+            )
         rest = await reader.readexactly(4 + length - self.block)
         if self._cipher is not None:
             rest_plain = self._cipher.update(rest) if rest else b""
@@ -302,21 +312,39 @@ def _kexinit_payload() -> bytes:
     return out + _byte(0) + _u32(0)
 
 
-def _check_kexinit(payload: bytes) -> None:
+def _check_kexinit(payload: bytes) -> bool:
     """Verify the peer offers our one suite (RFC 4253 §7.1 negotiation
-    degenerates to set-intersection against singleton lists)."""
+    degenerates to set-intersection against singleton lists).
+
+    Returns whether a *wrongly guessed* first kex packet follows the
+    peer's KEXINIT (RFC 4253 §7, ``first_kex_packet_follows``): the guess
+    is only right when the peer's FIRST-listed kex and host-key
+    algorithms match the negotiated (our singleton) choice; a mismatched
+    guess means the caller must read and discard one packet before the
+    real key exchange, instead of desyncing the handshake on it.
+    """
     r = _Reader(payload)
     r.byte()
     r.off += 16  # cookie
     wanted = [_KEX_ALG, _HOSTKEY_ALG, _CIPHER_ALG, _CIPHER_ALG,
               _MAC_ALG, _MAC_ALG, _COMP_ALG, _COMP_ALG]
+    offered_lists = []
     for want in wanted:
         offered = r.namelist()
+        offered_lists.append(offered)
         if want not in offered:
             raise MiniSSHError(
                 f"no common algorithm: need {want.decode()}, "
                 f"peer offers {b','.join(offered).decode()!r}"
             )
+    r.namelist()  # languages client-to-server
+    r.namelist()  # languages server-to-client
+    first_kex_packet_follows = r.boolean()
+    guess_right = (
+        offered_lists[0][:1] == [_KEX_ALG]
+        and offered_lists[1][:1] == [_HOSTKEY_ALG]
+    )
+    return first_kex_packet_follows and not guess_right
 
 
 def _derive(letter: bytes, k_mp: bytes, h: bytes, session_id: bytes,
@@ -464,11 +492,16 @@ class _Connection:
         peer_kexinit = await self.inbound.read_packet(self.reader)
         if peer_kexinit[0] != MSG_KEXINIT:
             raise MiniSSHError("expected KEXINIT")
-        _check_kexinit(peer_kexinit)
+        discard_guess = _check_kexinit(peer_kexinit)
 
         if server:
             v_c, v_s = peer_version, _VERSION
             i_c, i_s = peer_kexinit, my_kexinit
+            if discard_guess:
+                # RFC 4253 §7: the peer optimistically sent its first kex
+                # packet for an algorithm we didn't negotiate — ignore it;
+                # the peer re-sends the correct one.
+                await self.inbound.read_packet(self.reader)
             pkt = await self.inbound.read_packet(self.reader)
             if pkt[0] != MSG_KEX_ECDH_INIT:
                 raise MiniSSHError("expected KEX_ECDH_INIT")
@@ -499,6 +532,10 @@ class _Connection:
                 serialization.Encoding.Raw, serialization.PublicFormat.Raw
             )
             await self.send(_byte(MSG_KEX_ECDH_INIT) + _string(q_c))
+            if discard_guess:
+                # Mirror of the server-side discard: a server that guessed
+                # an unnegotiated suite sent one bogus kex packet first.
+                await self.inbound.read_packet(self.reader)
             pkt = await self.inbound.read_packet(self.reader)
             if pkt[0] != MSG_KEX_ECDH_REPLY:
                 raise MiniSSHError("expected KEX_ECDH_REPLY")
@@ -985,7 +1022,12 @@ class _ServerConnection(_Connection):
                 raise MiniSSHError(f"unsupported service {service!r}")
             if method == b"password" and not r.boolean():
                 password = r.string().decode()
-                if self.server.users.get(user) == password:
+                expected = self.server.users.get(user)
+                # compare_digest: the password check must not leak match
+                # length/prefix through timing (RFC 4252 §8 caution).
+                if expected is not None and hmac_mod.compare_digest(
+                    expected.encode(), password.encode()
+                ):
                     self.username = user
                     await self.send(_byte(MSG_USERAUTH_SUCCESS))
                     return
@@ -995,7 +1037,7 @@ class _ServerConnection(_Connection):
                 sig_blob = r.string()
                 signed = _string(self.session_id) + pkt[: r.off - 4 - len(sig_blob)]
                 if alg == _HOSTKEY_ALG and any(
-                    blob == k for k in self.server.authorized_keys
+                    blob == k for k in self.server.keys_for(user)
                 ):
                     try:
                         _ed25519_from_blob(blob).verify(
@@ -1168,29 +1210,48 @@ class _ServerConnection(_Connection):
 class MiniSSHServer:
     """An in-process SSH server: the test matrix's real sshd.
 
-    ``users`` maps username → password; ``authorized_keys`` lists
-    ed25519 public keys (key objects or wire blobs) accepted for
-    publickey auth.  Exec requests run as local subprocesses under
-    ``cwd``/``env`` — pointing a transport at ``127.0.0.1`` makes
-    localhost the worker host, the same shape as the reference's
-    functional tier against a real machine.
+    ``users`` maps username → password; ``authorized_keys`` accepts
+    either a dict ``username -> [ed25519 public keys]`` (production
+    shape: a key authenticates only the user it was authorized for) or a
+    bare list of keys accepted for ANY username — the test-server
+    convenience, matching fixtures that don't care about usernames; keys
+    may be key objects or wire blobs.  Exec requests run as local
+    subprocesses under ``cwd``/``env`` — pointing a transport at
+    ``127.0.0.1`` makes localhost the worker host, the same shape as the
+    reference's functional tier against a real machine.
     """
 
     def __init__(self, host_key=None, users: dict[str, str] | None = None,
                  authorized_keys=(), cwd: str | None = None,
                  env: dict | None = None) -> None:
+        def blob(k):
+            if isinstance(k, (bytes, bytearray)):
+                return bytes(k)
+            return _ed25519_blob(
+                k.public_key() if hasattr(k, "public_key") else k
+            )
+
         self.host_key = host_key or generate_host_key()
         self.users = dict(users or {})
-        self.authorized_keys = [
-            k if isinstance(k, (bytes, bytearray))
-            else _ed25519_blob(k.public_key() if hasattr(k, "public_key") else k)
-            for k in authorized_keys
-        ]
+        if isinstance(authorized_keys, dict):
+            self.authorized_keys: "dict[str, list[bytes]] | list[bytes]" = {
+                user: [blob(k) for k in keys]
+                for user, keys in authorized_keys.items()
+            }
+        else:
+            self.authorized_keys = [blob(k) for k in authorized_keys]
         self.cwd = cwd
         self.env = env
         self._server: asyncio.AbstractServer | None = None
         self._connections: set[_ServerConnection] = set()
         self.port = 0
+
+    def keys_for(self, user: str) -> "list[bytes]":
+        """Authorized key blobs for ``user`` (the global-list form accepts
+        any username — test-server behavior, see class docstring)."""
+        if isinstance(self.authorized_keys, dict):
+            return self.authorized_keys.get(user, [])
+        return self.authorized_keys
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> None:
         self._server = await asyncio.start_server(self._accept, host, port)

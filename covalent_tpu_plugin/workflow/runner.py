@@ -143,6 +143,8 @@ async def _execute_graph(graph: Graph, result: Result) -> None:
     futures: dict[int, asyncio.Future] = {}
     executors: dict[Any, Any] = {}
     created: list[Any] = []
+    #: in-flight connection prewarms (reaped before executor close).
+    prewarm_tasks: set[asyncio.Task] = set()
 
     def executor_for(spec: Any) -> Any:
         key = spec if isinstance(spec, str) else id(spec)
@@ -166,6 +168,17 @@ async def _execute_graph(graph: Graph, result: Result) -> None:
     async def run_node(spec) -> Any:
         deps = spec.dependencies()
         if deps:
+            # DAG-driven prewarm: this node is blocked on upstream nodes,
+            # which is exactly when its executor's dial + pre-flight +
+            # agent warm-up can run for free — the handshake latency
+            # overlaps upstream compute instead of landing on this node's
+            # critical path once it unblocks.  Best-effort and breaker-
+            # gated inside prewarm(); errors never touch the node.
+            prewarmer = getattr(executor_for(spec.executor), "prewarm", None)
+            if prewarmer is not None:
+                task = asyncio.ensure_future(prewarmer())
+                prewarm_tasks.add(task)
+                task.add_done_callback(prewarm_tasks.discard)
             dep_results = await asyncio.gather(
                 *(futures[d] for d in deps), return_exceptions=True
             )
@@ -270,6 +283,12 @@ async def _execute_graph(graph: Graph, result: Result) -> None:
         result.error = "".join(traceback.format_exception(err))
         app_log.error("dispatch %s failed: %s", dispatch_id, err)
     finally:
+        # Reap prewarms before closing executors: a dial racing its own
+        # pool teardown would leak the fresh transport.
+        for task in list(prewarm_tasks):
+            task.cancel()
+        if prewarm_tasks:
+            await asyncio.gather(*prewarm_tasks, return_exceptions=True)
         for instance in created:
             closer = getattr(instance, "close", None)
             if closer is not None:

@@ -213,15 +213,17 @@ def test_harness_put_at_most_once_per_connection_two_electrons(
     second_puts = fake.puts[len(first_puts):]
     spans_second = put_span_count() - spans0 - spans_first
 
-    # Puts land under temp names and are atomically renamed into the
-    # digest path, so match on the artifact suffix inside the temp name.
-    harness_remote = [p for _, p in fake.puts if ".py.tmp-" in p]
-    assert len(harness_remote) == 1  # harness put at most once
-    assert len(first_puts) == 3  # function + harness + spec
+    # The cold first electron ships its 3 missing artifacts (function +
+    # harness + spec) as ONE bundle put; the warm second electron misses
+    # only its spec, so the bundle path degrades to a single per-file put
+    # under a temp name, atomically renamed into the digest path.
+    assert len(first_puts) == 1 and "/bundle-" in first_puts[0][1]
     assert len(second_puts) == 1  # only the new spec (fn + harness hit)
     assert ".json.tmp-" in second_puts[0][1]
     assert counter_value(CAS_UPLOADS_TOTAL, result="hit") - hits0 >= 2
-    assert spans_second < spans_first  # upload span count drops
+    # The second electron never pays a bundle span: its upload traffic is
+    # one per-file put for the new spec.
+    assert spans_first == 0 and spans_second == 1
 
 
 def test_discarded_connection_reprobes_and_reuploads(tmp_path, run_async):
@@ -238,8 +240,11 @@ def test_discarded_connection_reprobes_and_reuploads(tmp_path, run_async):
         await ex.run(fn, [], {}, {"dispatch_id": "d", "node_id": 1})
 
     run_async(flow())
-    harness_puts = [p for _, p in fake.puts if ".py.tmp-" in p]
-    assert len(harness_puts) == 2  # re-uploaded after discard
+    # Each cold electron ships one bundle (fn + harness + spec); the
+    # discard between them evicts the present set, so the SECOND electron
+    # re-bundles everything instead of trusting stale CAS knowledge.
+    bundle_puts = [p for _, p in fake.puts if "/bundle-" in p]
+    assert len(bundle_puts) == 2  # re-uploaded after discard
 
 
 # --------------------------------------------------------------------- #
@@ -521,11 +526,14 @@ def test_spec_content_distinguishes_workers(tmp_path):
 
 
 def test_cas_put_is_atomic_publish(tmp_path, run_async):
-    """Uploads land under a temp name and are renamed into the digest path,
-    so a concurrent probe can never see a half-written artifact."""
+    """Per-file uploads land under a temp name and are renamed into the
+    digest path, so a concurrent probe can never see a half-written
+    artifact (bundle=False pins the per-file path; the bundled path's
+    atomicity is the unpack program's per-member tmp+replace, covered in
+    test_fastpath)."""
     fake = FakeTransport(scripted_ok_responses())
     fake.result_payload = (1, None)
-    ex = make_executor(tmp_path, fake)
+    ex = make_executor(tmp_path, fake, bundle=False)
     run_async(ex.run(lambda: 1, [], {}, dict(METADATA)))
     # No put targets a bare digest path directly...
     assert all(".tmp-" in remote for _, remote in fake.puts)

@@ -59,8 +59,11 @@ _jit_oracle = {}
 
 def oracle(model, params, prompt, cap, eos=None):
     """Jitted per-shape batch-1 generate(), cached across tests: the
-    oracle for every bit-equality assertion here."""
-    key = (id(model), prompt.size, cap, eos)
+    oracle for every bit-equality assertion here.  Keyed on the model
+    OBJECT (flax modules are hashable dataclasses), not ``id(model)`` —
+    a GC'd model's id can be reused by a different module, which would
+    silently serve the wrong compiled oracle."""
+    key = (model, prompt.size, cap, eos)
     if key not in _jit_oracle:
         _jit_oracle[key] = jax.jit(
             lambda pp, t: generate(

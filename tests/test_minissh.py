@@ -112,6 +112,184 @@ def test_wrong_mac_key_rejected():
         run(inp.read_packet(_FeedReader(out.wrap(b"data"))))
 
 
+def test_invalid_encrypted_packet_length_clean_error():
+    """A garbled/hostile length that is below the cipher-block minimum or
+    not block-aligned must raise a clean protocol error BEFORE readexactly
+    (a negative count ValueError) or a CTR keystream desync."""
+    key, iv, mac = b"k" * 16, b"i" * 16, b"m" * 32
+    out = _PacketStream()
+    out.arm(key, iv, mac, encrypt=True)
+    inp = _PacketStream()
+    inp.arm(key, iv, mac, encrypt=False)
+
+    def forged_head(length: int) -> bytes:
+        # Encrypt a head block whose decrypted length field is `length`
+        # using the receiver's own keystream position (fresh streams, so
+        # the first block's keystream matches).
+        head_plain = _u32(length) + b"\x04" + b"\x00" * 11
+        return out._cipher.update(head_plain)
+
+    # length < block - 4: readexactly count would go negative.
+    with pytest.raises(MiniSSHError, match="invalid packet length"):
+        run(inp.read_packet(_FeedReader(forged_head(7) + b"\x00" * 64)))
+    # misaligned length: (4 + length) not a multiple of the block size.
+    out2 = _PacketStream()
+    out2.arm(key, iv, mac, encrypt=True)
+    inp2 = _PacketStream()
+    inp2.arm(key, iv, mac, encrypt=False)
+    head_plain = _u32(21) + b"\x04" + b"\x00" * 11
+    forged = out2._cipher.update(head_plain)
+    with pytest.raises(MiniSSHError, match="invalid packet length"):
+        run(inp2.read_packet(_FeedReader(forged + b"\x00" * 64)))
+
+
+def test_kexinit_guess_flag_parsed_and_mismatch_discarded():
+    """RFC 4253 §7 first_kex_packet_follows: a wrongly guessed first kex
+    packet is reported for discard; a right guess (or no guess) is not."""
+    from covalent_tpu_plugin.transport.minissh import (
+        _check_kexinit,
+        _kexinit_payload,
+    )
+
+    # Our own KEXINIT: no guess, right algorithms.
+    assert _check_kexinit(_kexinit_payload()) is False
+
+    def kexinit(first_lists: dict, follows: bool) -> bytes:
+        lists = [
+            first_lists.get("kex", minissh._KEX_ALG),
+            first_lists.get("hostkey", minissh._HOSTKEY_ALG),
+            minissh._CIPHER_ALG, minissh._CIPHER_ALG,
+            minissh._MAC_ALG, minissh._MAC_ALG,
+            minissh._COMP_ALG, minissh._COMP_ALG,
+            b"", b"",
+        ]
+        out = bytes([minissh.MSG_KEXINIT]) + b"\x00" * 16
+        for item in lists:
+            out += _string(item)
+        return out + bytes([1 if follows else 0]) + _u32(0)
+
+    # Guess flag set, but the peer's first-listed algorithms match ours:
+    # the guessed packet IS the right one — nothing to discard.
+    assert _check_kexinit(kexinit({}, follows=True)) is False
+    # Peer guessed a kex algorithm we didn't negotiate: discard one packet.
+    wrong = {"kex": b"diffie-hellman-group14-sha256," + minissh._KEX_ALG}
+    assert _check_kexinit(kexinit(wrong, follows=True)) is True
+    # Same first-list mismatch WITHOUT the flag: nothing was sent early.
+    assert _check_kexinit(kexinit(wrong, follows=False)) is False
+
+
+def test_password_auth_rejects_wrong_and_unknown_users():
+    async def flow():
+        server = await minissh.serve(users={"u": "pw"})
+        try:
+            for user, pw in (("u", "wrong"), ("ghost", "pw")):
+                with pytest.raises(minissh.MiniSSHAuthError):
+                    await minissh.connect(
+                        "127.0.0.1", server.port, user, password=pw
+                    )
+            conn = await minissh.connect(
+                "127.0.0.1", server.port, "u", password="pw"
+            )
+            conn.close()
+            await conn.wait_closed()
+        finally:
+            server.close()
+            await server.wait_closed()
+
+    run(flow())
+
+
+def test_authorized_keys_bound_to_username():
+    """Dict-form authorized_keys authenticate only their own user; the
+    legacy list form stays global (documented test-server behavior)."""
+    from cryptography.hazmat.primitives.asymmetric import ed25519
+
+    alice_key = ed25519.Ed25519PrivateKey.generate()
+
+    async def flow():
+        server = await minissh.serve(
+            authorized_keys={"alice": [alice_key.public_key()]}
+        )
+        try:
+            conn = await minissh.connect(
+                "127.0.0.1", server.port, "alice", client_key=alice_key
+            )
+            res = await conn.run("echo ok")
+            assert res.stdout.strip() == "ok"
+            conn.close()
+            await conn.wait_closed()
+            # Same key under a different username must be rejected.
+            with pytest.raises(minissh.MiniSSHAuthError):
+                await minissh.connect(
+                    "127.0.0.1", server.port, "mallory",
+                    client_key=alice_key,
+                )
+        finally:
+            server.close()
+            await server.wait_closed()
+
+        # Legacy global list: any username authenticates (test fixtures).
+        server = await minissh.serve(
+            authorized_keys=[alice_key.public_key()]
+        )
+        try:
+            conn = await minissh.connect(
+                "127.0.0.1", server.port, "anyone", client_key=alice_key
+            )
+            conn.close()
+            await conn.wait_closed()
+        finally:
+            server.close()
+            await server.wait_closed()
+
+    run(flow())
+
+
+def test_put_bundle_over_minissh_roundtrip(tmp_path):
+    """The generic bundle path over a REAL encrypted channel: one cat
+    upload + one unpack exec, members digest-verified on the far side."""
+    import hashlib
+    import os
+    import sys
+
+    from covalent_tpu_plugin.transport import SSHTransport
+    from covalent_tpu_plugin.transport import codec as codec_mod
+
+    os.makedirs(tmp_path / "cas", exist_ok=True)
+    items = []
+    body = '{"spec": "payload", "idx": %d}\n' * 64
+    for i in range(3):
+        local = tmp_path / f"art{i}.json"
+        local.write_text(body % i)
+        digest = hashlib.sha256(local.read_bytes()).hexdigest()
+        items.append((str(local), str(tmp_path / "cas" / f"art{i}"), digest))
+
+    async def flow():
+        server = await minissh.serve(users={"u": "pw"})
+        try:
+            transport = SSHTransport(
+                "127.0.0.1", username="u", port=server.port,
+                strict_host_keys=False, backend="minissh", password="pw",
+            )
+            await transport._open()
+            stats = await transport.put_bundle(
+                items, str(tmp_path / "cas" / "bundle.tar"),
+                python_path=sys.executable,
+                codec=codec_mod.get_codec("zlib"),
+            )
+            assert stats["codec"] == "zlib" and stats["members"] == 3
+            for local, remote, digest in items:
+                assert hashlib.sha256(
+                    open(remote, "rb").read()
+                ).hexdigest() == digest
+            await transport.close()
+        finally:
+            server.close()
+            await server.wait_closed()
+
+    run(flow())
+
+
 def test_exec_exit_status_and_streams():
     async def flow():
         server = await minissh.serve(users={"u": "pw"})

@@ -354,8 +354,17 @@ def test_full_run_produces_ordered_span_set(tmp_path, run_async, events_file):
     (root,) = [s for s in spans if s["name"] == "executor.run"]
     assert root["attributes"]["outcome"] == "completed"
     children = [s for s in spans if s.get("parent_id") == root["span_id"]]
-    # Every lifecycle stage present, in start order, all in the root's trace.
-    assert [s["name"] for s in children] == EXPECTED_LIFECYCLE
+    # Every lifecycle stage present, all in the root's trace.  The stage
+    # span is pipelined (serialization overlaps the connect/pre-flight
+    # round trips), so only the strictly-sequential stages keep a fixed
+    # completion order.
+    assert sorted(s["name"] for s in children) == sorted(EXPECTED_LIFECYCLE)
+    sequential = [
+        s["name"] for s in children if s["name"] != "executor.stage"
+    ]
+    assert sequential == [
+        n for n in EXPECTED_LIFECYCLE if n != "executor.stage"
+    ]
     assert all(s["trace_id"] == root["trace_id"] for s in children)
     assert all(s["status"] == "OK" for s in children)
     # Task-state transitions bracket the trace.

@@ -518,7 +518,7 @@ def test_launch_all_is_all_or_nothing(tmp_path, run_async):
 
     async def flow():
         with pytest.raises(TransportError, match="launch failed"):
-            await ex._launch_all([good, bad], staged)
+            await ex._dispatch_all([good, bad], staged, upload=False)
 
     run_async(flow())
     assert any("kill" in c and "111" in c for c in good.commands)
