@@ -44,7 +44,8 @@ BENCH_PHASES = {
     phase.strip()
     for phase in os.environ.get(
         "BENCH_PHASES",
-        "overhead,fanout,cached_fanout,bundled_fanout,chaos_fanout,tpu",
+        "overhead,obs_tax,fanout,cached_fanout,bundled_fanout,"
+        "chaos_fanout,tpu",
     ).split(",")
     if phase.strip()
 }
@@ -53,6 +54,15 @@ BENCH_PHASES = {
 # sub-phases, each of which self-skips as the electron's deadline nears.
 OVERHEAD_BUDGET_S = float(os.environ.get("BENCH_OVERHEAD_BUDGET_S", "60"))
 FANOUT_BUDGET_S = float(os.environ.get("BENCH_FANOUT_BUDGET_S", "45"))
+#: SLO asserted on the overhead phase: p95 of per-electron wall overhead
+#: (elapsed minus execute) must stay under the north-star dispatch budget.
+WALL_OVERHEAD_BUDGET_S = float(
+    os.environ.get("BENCH_WALL_OVERHEAD_BUDGET_S", "2.0")
+)
+#: SLO asserted on the obs_tax phase: full telemetry (events stream +
+#: heartbeats + ops endpoint) may cost at most this fraction of obs-off
+#: wall time per electron (plus a small absolute floor for timer noise).
+OBS_TAX_BUDGET_PCT = float(os.environ.get("BENCH_OBS_TAX_BUDGET_PCT", "3.0"))
 # 570 (was 360, 480, then 540): the r4 TPU run showed the phase list
 # needs ~450 s cold (tunnel compiles dominate; the persistent cache
 # roughly halves a warm run) — 360 skipped lm_spec, and 480 left a warm
@@ -96,6 +106,19 @@ def spread_stats(values, prefix: str) -> dict:
     if len(values) >= 2:
         out[f"{prefix}_ms_stdev"] = round(statistics.stdev(values) * 1e3, 3)
     return out
+
+
+def percentile(values, q: float) -> float:
+    """Linear-interpolated percentile of a small sample (q in [0, 1])."""
+    ordered = sorted(values)
+    if not ordered:
+        return float("nan")
+    if len(ordered) == 1:
+        return ordered[0]
+    rank = q * (len(ordered) - 1)
+    lo = int(rank)
+    hi = min(lo + 1, len(ordered) - 1)
+    return ordered[lo] + (ordered[hi] - ordered[lo]) * (rank - lo)
 
 
 def load_last_known_good() -> dict | None:
@@ -1546,10 +1569,27 @@ async def main() -> None:
         summary["dispatch_overhead_ms_stdev"] = spread_stats(
             overheads, "overhead"
         ).get("overhead_ms_stdev")
+        # SLO view: percentile summary of the wall overhead (what a caller
+        # actually waited beyond the task), asserted against the dispatch
+        # budget so CI turns red the day the control plane regresses.
+        summary["wall_overhead_p50_s"] = round(
+            percentile(wall_overheads, 0.50), 4
+        )
+        summary["wall_overhead_p95_s"] = round(
+            percentile(wall_overheads, 0.95), 4
+        )
+        summary["wall_overhead_budget_s"] = WALL_OVERHEAD_BUDGET_S
+        summary["wall_overhead_within_budget"] = (
+            summary["wall_overhead_p95_s"] <= WALL_OVERHEAD_BUDGET_S
+        )
         emit({"phase": "overhead", "dispatch_overhead_s": summary[
             "dispatch_overhead_s"], "per_probe": [round(o, 4) for o in overheads],
             "electron_wall_s": summary["electron_wall_s"],
             "wall_overhead_s": summary["dispatch_wall_overhead_s"],
+            "wall_overhead_p50_s": summary["wall_overhead_p50_s"],
+            "wall_overhead_p95_s": summary["wall_overhead_p95_s"],
+            "wall_overhead_within_budget":
+                summary["wall_overhead_within_budget"],
             # Per-stage latency breakdown of the final probe (same keys as
             # last_timings: connect/stage/upload/submit/execute/fetch/...).
             "breakdown": {
@@ -1562,6 +1602,102 @@ async def main() -> None:
         emit({"phase": "overhead", "skipped": "BENCH_PHASES"})
     except Exception as error:  # noqa: BLE001
         emit({"phase": "overhead", "error": repr(error)})
+
+    # ---- phase 1b: telemetry tax (obs-on vs obs-off wall delta) ----------
+    # The fleet observability plane (event stream + heartbeats + backhaul +
+    # ops endpoint) must never become the new hot path: measure the same
+    # trivial electron with everything on vs everything off
+    # (COVALENT_TPU_METRICS=0 semantics: no events, no heartbeats) and
+    # assert the per-electron delta stays under OBS_TAX_BUDGET_PCT.
+    try:
+        if "obs_tax" not in BENCH_PHASES:
+            raise _PhaseSkipped
+        from covalent_tpu_plugin.obs import events as obs_events
+        from covalent_tpu_plugin.obs.opsserver import (
+            ensure_ops_server,
+            shutdown_ops_server,
+        )
+
+        OBS_TAX_PROBES = 7
+
+        async def tax_arm(obs_on: bool) -> list:
+            arm = "on" if obs_on else "off"
+            # Agent (pool) mode on both arms: completion is PUSHED, so the
+            # wall numbers measure real work, not poll-schedule alignment
+            # (a poll-based arm quantizes to the probe boundary, which
+            # dwarfs any telemetry delta with bimodal noise).
+            arm_executor = TPUExecutor(
+                transport="local",
+                cache_dir=f"{workdir}/cache_obs_{arm}",
+                remote_cache=f"{workdir}/remote_obs_{arm}",
+                python_path=sys.executable,
+                poll_freq=0.2,
+                heartbeat_interval=0.5 if obs_on else 0.0,
+                task_env={
+                    "PYTHONPATH": repo_root + os.pathsep
+                    + os.environ.get("PYTHONPATH", ""),
+                },
+            )
+            if obs_on:
+                obs_events.configure(f"{workdir}/obs_tax_events.jsonl")
+                ensure_ops_server(port=0)
+            else:
+                obs_events.configure(None)
+            walls = []
+            try:
+                await arm_executor.run(
+                    trivial_electron, [0], {},
+                    {"dispatch_id": f"taxwarm{arm}", "node_id": 0},
+                )
+                for i in range(OBS_TAX_PROBES):
+                    t0 = time.perf_counter()
+                    await arm_executor.run(
+                        trivial_electron, [i], {},
+                        {"dispatch_id": f"tax{arm}", "node_id": i},
+                    )
+                    walls.append(time.perf_counter() - t0)
+            finally:
+                await arm_executor.close()
+                if obs_on:
+                    shutdown_ops_server()
+                obs_events.reset()
+            return walls
+
+        async def obs_tax_phase():
+            # off first, then on: any residual warmup bias favors the OFF
+            # arm, making the <budget assertion strictly harder to pass.
+            off_walls = await tax_arm(False)
+            on_walls = await tax_arm(True)
+            return on_walls, off_walls
+
+        on_walls, off_walls = await asyncio.wait_for(
+            obs_tax_phase(), OVERHEAD_BUDGET_S
+        )
+        on_s = statistics.median(on_walls)
+        off_s = statistics.median(off_walls)
+        tax_pct = (on_s - off_s) / off_s * 100.0
+        # 15 ms absolute floor keeps subprocess-spawn jitter from failing a
+        # run whose relative delta is noise, not telemetry cost.
+        tax_ok = on_s <= off_s * (1.0 + OBS_TAX_BUDGET_PCT / 100.0) + 0.015
+        summary["obs_tax_on_wall_s"] = round(on_s, 4)
+        summary["obs_tax_off_wall_s"] = round(off_s, 4)
+        summary["obs_tax_pct"] = round(tax_pct, 2)
+        summary["obs_tax_budget_pct"] = OBS_TAX_BUDGET_PCT
+        summary["obs_tax_ok"] = tax_ok
+        emit({
+            "phase": "obs_tax",
+            "on_wall_s": summary["obs_tax_on_wall_s"],
+            "off_wall_s": summary["obs_tax_off_wall_s"],
+            "tax_pct": summary["obs_tax_pct"],
+            "budget_pct": OBS_TAX_BUDGET_PCT,
+            "ok": tax_ok,
+            **spread_stats(on_walls, "obs_on_wall"),
+            **spread_stats(off_walls, "obs_off_wall"),
+        })
+    except _PhaseSkipped:
+        emit({"phase": "obs_tax", "skipped": "BENCH_PHASES"})
+    except Exception as error:  # noqa: BLE001
+        emit({"phase": "obs_tax", "error": repr(error)})
 
     # ---- phase 2: 8-electron fan-out (BASELINE config 3) -----------------
     async def fanout8(fn, extra_args, dispatch_id):

@@ -173,6 +173,14 @@ class AgentClient:
         self._pongs = 0
         self._dead: BaseException | None = None
         self._cond = asyncio.Condition()
+        #: sink for backhauled telemetry lines: called ``(task_id, data)``
+        #: for every FRESH event the agent's watch side-band pushes.  Set
+        #: by the executor; exceptions are contained (observer contract).
+        self.on_telemetry = None
+        #: task id -> highest worker-event ``seq`` seen; a re-watch after a
+        #: reconnect re-tails from offset 0, so duplicates are expected and
+        #: dropped here.
+        self._telemetry_seq: dict[str, int] = {}
         self._reader = asyncio.create_task(self._read_loop())
 
     # -- lifecycle -----------------------------------------------------------
@@ -228,6 +236,9 @@ class AgentClient:
                     kind = event.get("event")
                     task_id = event.get("id", "")
                     _AGENT_EVENTS.labels(event=str(kind)).inc()
+                    if kind == "telemetry":
+                        self._handle_telemetry(task_id, event.get("data"))
+                        continue  # side-band: no waiter state to notify
                     if kind == "started":
                         self._started[task_id] = int(event["pid"])
                     elif kind == "exit":
@@ -256,6 +267,42 @@ class AgentClient:
             async with self._cond:
                 self._dead = err
                 self._cond.notify_all()
+
+    def _handle_telemetry(self, task_id: str, data) -> None:
+        """Dedup one backhauled event by ``seq`` and hand it to the sink.
+
+        Worker events carry a per-process monotonically increasing ``seq``
+        (harness ``_emit_worker_event``); a re-watch after channel loss
+        replays the whole file, so everything at-or-below the high-water
+        mark is a duplicate.  Events without a seq pass through — better a
+        duplicate observation than a dropped one.
+        """
+        if not isinstance(data, dict):
+            return
+        seq = data.get("seq")
+        if isinstance(seq, int):
+            if seq <= self._telemetry_seq.get(task_id, 0):
+                return
+            self._telemetry_seq[task_id] = seq
+        callback = self.on_telemetry
+        if callback is None:
+            return
+        try:
+            callback(task_id, data)
+        except Exception as err:  # noqa: BLE001 - observers must not break
+            app_log.debug("telemetry callback failed: %s", err)
+
+    async def watch(self, task_id: str, path: str) -> None:
+        """Start the telemetry side-band for one task's worker-local file.
+
+        The agent tails ``path`` from offset 0 (flushing any backlog
+        buffered while no channel was attached) and pushes each JSONL line
+        as a ``telemetry`` event routed to :attr:`on_telemetry`.
+        """
+        await self._send({"cmd": "watch", "id": task_id, "path": path})
+
+    async def unwatch(self, task_id: str) -> None:
+        await self._send({"cmd": "unwatch", "id": task_id})
 
     async def _wait(self, predicate, timeout: float | None):
         """Await ``predicate(self)`` truthy, raising AgentError on channel death."""
@@ -372,6 +419,7 @@ class AgentClient:
         self._started.pop(task_id, None)
         self._exits.pop(task_id, None)
         self._errors.pop(task_id, None)
+        self._telemetry_seq.pop(task_id, None)
 
     async def kill(self, task_id: str, sig: int = 15) -> None:
         await self._send({"cmd": "kill", "id": task_id, "sig": sig})

@@ -30,33 +30,239 @@ from __future__ import annotations
 import json
 import os
 import sys
+import threading
 import time
 
 
-def _emit_worker_event(spec: dict, type: str, **fields) -> None:
+#: Per-process worker-event sequence + write-failure accounting.  The seq
+#: lets the dispatcher dedup re-delivered lines (the telemetry side-band
+#: re-tails from offset 0 after a reconnect) and is shared by EVERY worker
+#: record — lifecycle events, streamed heartbeats, and the heartbeat
+#: snapshot file all draw from one locked counter, so the dispatcher's
+#: seq-based dedup compares a single monotonic domain whichever road a
+#: record arrives by.  The failure counter backs the swallow-and-count
+#: contract — an unwritable/ENOSPC events path must never take down the
+#: task it was observing, but the first failure leaves one line on stderr
+#: so the silence is diagnosable from the task log.
+_worker_event_seq = 0
+_worker_event_lock = threading.Lock()
+_worker_event_failures = 0
+
+
+def _build_worker_event(spec: dict, type: str, **fields) -> dict:
+    """One worker record: ts/pid/seq envelope + trace context + fields.
+
+    The single assembly point for every worker-side record (events and
+    heartbeat snapshots alike), so the schema cannot drift between sinks
+    and the seq counter stays atomic under the heartbeat thread.
+    """
+    global _worker_event_seq
+    with _worker_event_lock:
+        _worker_event_seq += 1
+        seq = _worker_event_seq
+    trace = spec.get("trace") or {}
+    event = {
+        "ts": round(time.time(), 6),
+        "pid": os.getpid(),
+        "seq": seq,
+        "type": type,
+        "operation_id": spec.get("operation_id"),
+    }
+    if trace.get("trace_id"):
+        event["trace_id"] = trace.get("trace_id")
+        event["parent_id"] = trace.get("span_id")
+        if trace.get("attempt") is not None:
+            event["attempt"] = trace.get("attempt")
+    event.update(fields)
+    return event
+
+
+def _append_event_line(event: dict, paths: list) -> None:
+    """Swallow-and-count JSONL append of one event to every sink path."""
+    global _worker_event_failures
+    try:
+        line = json.dumps(event, default=repr) + "\n"
+    except (TypeError, ValueError):
+        return
+    for path in paths:
+        try:
+            with open(path, "a", encoding="utf-8") as f:
+                f.write(line)
+        except OSError as err:
+            _worker_event_failures += 1
+            if _worker_event_failures == 1:
+                print(
+                    f"worker events unwritable ({path}: {err}); "
+                    "further failures swallowed",
+                    file=sys.stderr,
+                )
+
+
+def _worker_event_paths(spec: dict) -> list:
+    """Every sink one worker event lands in (deduped, order-stable).
+
+    ``events_file`` is the dispatcher's own stream (shared filesystem);
+    ``telemetry_file`` is the per-task side-band the resident agent tails
+    back over its channel.  Heartbeats go to the telemetry file only (see
+    ``_start_heartbeat``); lifecycle events go to both.
+    """
+    paths = []
+    for key in ("events_file", "telemetry_file"):
+        path = spec.get(key)
+        if path and path not in paths:
+            paths.append(path)
+    env_path = os.environ.get("COVALENT_TPU_EVENTS_PATH")
+    if not paths and env_path:
+        paths.append(env_path)
+    return paths
+
+
+def _emit_worker_event(spec: dict, type: str, _paths=None, **fields) -> None:
     """Append one structured JSONL event from the worker side.
 
     Mirrors the dispatcher's ``obs.events`` line format (ts/pid/type) but
     stays stdlib-only — this file runs on workers where the plugin is not
-    installed.  The sink path comes from the spec's ``events_file`` (set by
-    the stager when the dispatcher has events enabled) or the worker's own
-    ``COVALENT_TPU_EVENTS_PATH``; unset means no-op, and write failures
-    never fail the task they were observing.
+    installed.  Sink paths come from the spec (``events_file`` /
+    ``telemetry_file``, set by the stager) or the worker's own
+    ``COVALENT_TPU_EVENTS_PATH``; no path means no-op.  Trace context from
+    the spec (``trace``: trace/parent span ids + attempt) is stamped on
+    every event so worker-side records join the dispatch trace.
+
+    Never raises: write failures are swallowed and counted, with a single
+    stderr note on the first one — an ENOSPC events disk must not fail the
+    electron it was observing.
     """
-    path = spec.get("events_file") or os.environ.get("COVALENT_TPU_EVENTS_PATH")
-    if not path:
+    paths = _worker_event_paths(spec) if _paths is None else _paths
+    if not paths:
         return
+    _append_event_line(_build_worker_event(spec, type, **fields), paths)
+
+
+def _heartbeat_payload(metrics_file: str) -> dict:
+    """One heartbeat's body: process vitals + user-published progress.
+
+    Everything best-effort and stdlib-only.  The user function publishes
+    progress (step counter, examples/s, tokens/s, ...) by writing a small
+    JSON object to ``$COVALENT_TPU_WORKER_METRICS_PATH``; the beat folds it
+    in verbatim.  jax device-memory stats are read ONLY when the task
+    already imported jax AND a backend is live — the heartbeat thread must
+    never be the thing that triggers (or races) backend initialization.
+    """
+    payload: dict = {}
     try:
-        with open(path, "a", encoding="utf-8") as f:
-            f.write(json.dumps({
-                "ts": round(time.time(), 6),
-                "pid": os.getpid(),
-                "type": type,
-                "operation_id": spec.get("operation_id"),
-                **fields,
-            }) + "\n")
-    except OSError:
+        import resource
+
+        usage = resource.getrusage(resource.RUSAGE_SELF)
+        # ru_maxrss is KiB on Linux, bytes on macOS.
+        scale = 1 if sys.platform == "darwin" else 1024
+        payload["rss_bytes"] = int(usage.ru_maxrss) * scale
+        payload["cpu_s"] = round(usage.ru_utime + usage.ru_stime, 3)
+    except Exception:  # noqa: BLE001 - vitals are best-effort
         pass
+    if metrics_file:
+        try:
+            with open(metrics_file, encoding="utf-8") as f:
+                user = json.load(f)
+            if isinstance(user, dict):
+                step = user.pop("step", None)
+                if isinstance(step, (int, float)):
+                    payload["step"] = step
+                if user:
+                    payload["metrics"] = user
+        except (OSError, ValueError):
+            pass
+    if "jax" in sys.modules:
+        try:
+            import jax
+
+            from jax._src import xla_bridge
+
+            if xla_bridge.backends_are_initialized():
+                device = jax.local_devices()[0]
+                stats = device.memory_stats() or {}
+                mem = {
+                    k: stats[k]
+                    for k in ("bytes_in_use", "peak_bytes_in_use")
+                    if k in stats
+                }
+                if mem:
+                    payload["device_mem"] = mem
+        except Exception:  # noqa: BLE001 - absent on CPU backends
+            pass
+    return payload
+
+
+def _start_heartbeat(spec: dict):
+    """Launch the heartbeat thread; returns a stop Event (or None).
+
+    Cadence comes from the spec's ``heartbeat_s`` (0/absent disables).
+    Each beat does two things:
+
+    * emits a ``worker.heartbeat`` event into the *telemetry* side-band
+      file (never the shared lifecycle stream — beats are high-volume
+      plumbing, not dispatch history), which the resident agent tails
+      back to the dispatcher in near-real-time;
+    * atomically refreshes a tiny snapshot file (``<pid_file>.hb``) that
+      the dispatcher's status probe reads piggybacked on its existing
+      round trip, so the poll path gets liveness for free.
+
+    The first beat fires immediately so even sub-second electrons leave
+    one, and the dispatcher's stall detector has a baseline to age.
+    """
+    try:
+        interval = float(spec.get("heartbeat_s") or 0)
+    except (TypeError, ValueError):
+        interval = 0.0
+    if interval <= 0:
+        return None
+    # Resolve every side-band path to absolute BEFORE the task chdirs into
+    # its workdir: the beat thread runs concurrently with the chdir'd
+    # function, and a relative remote_cache would otherwise scatter
+    # snapshots across working directories.
+    pid_file = spec.get("pid_file")
+    hb_file = os.path.abspath(f"{pid_file}.hb") if pid_file else None
+    metrics_file = (
+        os.path.abspath(f"{pid_file}.metrics") if pid_file else ""
+    )
+    if metrics_file:
+        # The user function's progress-publishing hook.
+        os.environ["COVALENT_TPU_WORKER_METRICS_PATH"] = metrics_file
+    telemetry_paths = [
+        os.path.abspath(p) for p in (spec.get("telemetry_file"),) if p
+    ]
+    stop = threading.Event()
+
+    def beat_loop() -> None:
+        hb_seq = 0
+        while True:
+            hb_seq += 1
+            # ONE event, one seq, two sinks: the streamed telemetry line
+            # and the probe-read snapshot must be the same record so the
+            # dispatcher's seq dedup works across delivery roads (e.g. an
+            # agent-channel death downgrading to the polling path).
+            event = _build_worker_event(
+                spec, "worker.heartbeat",
+                hb_seq=hb_seq, interval_s=interval,
+                **_heartbeat_payload(metrics_file),
+            )
+            if telemetry_paths:
+                _append_event_line(event, telemetry_paths)
+            if hb_file:
+                try:
+                    tmp = f"{hb_file}.tmp.{os.getpid()}"
+                    with open(tmp, "w", encoding="utf-8") as f:
+                        f.write(json.dumps(event, default=repr))
+                    os.replace(tmp, hb_file)
+                except (OSError, TypeError, ValueError):
+                    pass  # liveness reporting must never fail the task
+            if stop.wait(interval):
+                return
+
+    thread = threading.Thread(
+        target=beat_loop, name="covalent-tpu-heartbeat", daemon=True
+    )
+    thread.start()
+    return stop
 
 
 def install_pip_deps(pip_deps: list) -> None:
@@ -160,6 +366,10 @@ def run_task(spec: dict) -> int:
     distributed = spec.get("distributed")
     process_id = int(distributed["process_id"]) if distributed else 0
     _emit_worker_event(spec, "worker.task_started", process_id=process_id)
+    # Liveness starts before any blocking stage (pip install, distributed
+    # barrier, the task itself): a worker hung anywhere keeps beating —
+    # and one that goes silent is genuinely wedged.
+    heartbeat_stop = _start_heartbeat(spec)
 
     pip_deps = spec.get("pip_deps") or []
     if pip_deps:
@@ -275,6 +485,8 @@ def run_task(spec: dict) -> int:
         with open(done, "w") as f:
             f.write("done\n")
 
+    if heartbeat_stop is not None:
+        heartbeat_stop.set()
     _emit_worker_event(
         spec, "worker.task_finished", process_id=process_id,
         ok=exception is None,
@@ -295,6 +507,20 @@ def run_task(spec: dict) -> int:
 #   -> {"cmd":"run","id":"op","spec":"/path/spec.json","log":"/path/log"}
 #   <- {"event":"started","id":"op","pid":123}
 #   <- {"event":"exit","id":"op","code":0,"signal":0}
+#
+# Telemetry side-band: the dispatcher asks the server to tail a task's
+# worker-local JSONL file (heartbeats + worker events) back over the same
+# channel, turning post-mortem log files into a near-real-time stream:
+#
+#   -> {"cmd":"watch","id":"op","path":"/path/telemetry.jsonl"}
+#   <- {"event":"watching","id":"op"}
+#   <- {"event":"telemetry","id":"op","data":{...}}        (per line, pushed)
+#   -> {"cmd":"unwatch","id":"op"}
+#   <- {"event":"unwatched","id":"op"}
+#
+# A watch always starts from offset 0, so events buffered in the file while
+# a channel was down are flushed on the reconnecting client's re-watch; the
+# dispatcher dedups by each event's `seq`.
 #
 # Fork-safety: the parent preloads modules (cloudpickle, jax, ...) but never
 # initializes an XLA backend or runs a computation — backend init happens in
@@ -349,7 +575,50 @@ def _spawn_task(command: dict, children: dict) -> None:
     _emit({"event": "started", "id": task_id, "pid": pid})
 
 
-def _reap(children: dict) -> None:
+#: Per-pump read ceiling: one oversized telemetry burst must not wedge the
+#: command loop behind a single giant read.
+_WATCH_READ_LIMIT = 256 * 1024
+
+
+def _pump_watchers(watchers: dict) -> None:
+    """Forward new complete JSONL lines from every watched file.
+
+    Each watcher tracks a byte offset; partial trailing lines wait in a
+    buffer for the next pump.  Unparsable lines are dropped (the side-band
+    forwards structured events only), and a missing file just means the
+    task hasn't emitted yet.
+    """
+    for task_id, w in list(watchers.items()):
+        try:
+            size = os.path.getsize(w["path"])
+        except OSError:
+            continue
+        if size < w["pos"]:
+            w["pos"], w["buf"] = 0, ""  # truncated/rotated: start over
+        if size == w["pos"]:
+            continue
+        try:
+            with open(w["path"], "r", encoding="utf-8", errors="replace") as f:
+                f.seek(w["pos"])
+                chunk = f.read(_WATCH_READ_LIMIT)
+                w["pos"] = f.tell()
+        except OSError:
+            continue
+        w["buf"] += chunk
+        while "\n" in w["buf"]:
+            line, w["buf"] = w["buf"].split("\n", 1)
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                data = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(data, dict):
+                _emit({"event": "telemetry", "id": task_id, "data": data})
+
+
+def _reap(children: dict, watchers: dict | None = None) -> None:
     while True:
         try:
             pid, status = os.waitpid(-1, os.WNOHANG)
@@ -361,6 +630,12 @@ def _reap(children: dict) -> None:
         if task_id is None:
             continue
         code = os.waitstatus_to_exitcode(status)
+        if watchers is not None and task_id in watchers:
+            # Auto-unwatch on exit (after one final pump so the tail of
+            # the telemetry file is flushed): a long-lived server must not
+            # keep stat()ing files of finished tasks forever.
+            _pump_watchers({task_id: watchers[task_id]})
+            del watchers[task_id]
         _emit({
             "event": "exit",
             "id": task_id,
@@ -394,20 +669,24 @@ def serve() -> int:
     sel.register(rpipe, selectors.EVENT_READ, "sigchld")
 
     children: dict = {}
+    #: task id -> {"path", "pos", "buf"} telemetry tails (watch cmd).
+    watchers: dict = {}
     buffer = ""
     running = True
     stdin_open = True
     _emit({"event": "ready", "pid": os.getpid(), "mode": "pool"})
 
     while running and (stdin_open or children):
-        for key, _ in sel.select():
+        # With live watchers the select wakes on a short tick so telemetry
+        # lines flow without any inbound traffic; otherwise block freely.
+        for key, _ in sel.select(timeout=0.25 if watchers else None):
             if key.data == "sigchld":
                 try:
                     while os.read(rpipe, 512):
                         pass
                 except BlockingIOError:
                     pass
-                _reap(children)
+                _reap(children, watchers)
                 continue
             data = os.read(0, 65536)
             if not data:
@@ -452,13 +731,30 @@ def serve() -> int:
                     else:
                         _emit({"event": "error", "id": target or "",
                                "message": "unknown task id"})
+                elif name == "watch":
+                    task_id = command.get("id")
+                    path = command.get("path")
+                    if not task_id or not path:
+                        _emit({"event": "error", "id": task_id or "",
+                               "message": "watch requires id and path"})
+                    else:
+                        # Offset 0 on every (re-)watch: a reconnecting
+                        # dispatcher gets the buffered backlog flushed.
+                        watchers[task_id] = {"path": path, "pos": 0,
+                                             "buf": ""}
+                        _emit({"event": "watching", "id": task_id})
+                elif name == "unwatch":
+                    task_id = command.get("id")
+                    watchers.pop(task_id, None)
+                    _emit({"event": "unwatched", "id": task_id or ""})
                 elif name == "shutdown":
                     _emit({"event": "bye"})
                     running = False
                 else:
                     _emit({"event": "error",
                            "message": f"unknown cmd: {name}"})
-        _reap(children)  # belt-and-braces against missed wakeups
+        _pump_watchers(watchers)
+        _reap(children, watchers)  # belt-and-braces against missed wakeups
     return 0
 
 

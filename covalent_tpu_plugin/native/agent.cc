@@ -17,9 +17,19 @@
 //   <- {"event":"exit","id":"<op>","code":0,"signal":0}        (pushed)
 //   -> {"cmd":"kill","id":"<op>","sig":15}
 //   <- {"event":"killed","id":"<op>"}   (exit event still follows from reaper)
+//   -> {"cmd":"watch","id":"<op>","path":"/path/telemetry.jsonl"}
+//   <- {"event":"watching","id":"<op>"}
+//   <- {"event":"telemetry","id":"<op>","data":{...}}     (per line, pushed)
+//   -> {"cmd":"unwatch","id":"<op>"}
+//   <- {"event":"unwatched","id":"<op>"}
 //   -> {"cmd":"shutdown"}
 //   <- {"event":"bye"}
 //   <- {"event":"error","message":"..."}  (malformed input, unknown id, ...)
+//
+// The watch side-band tails a task's worker-local JSONL telemetry file
+// (heartbeats, worker events) back over the channel in near-real-time.  A
+// (re-)watch always starts at offset 0 so lines buffered while the channel
+// was down are flushed on reconnect; the dispatcher dedups by `seq`.
 //
 // Children run in their own sessions (setsid + exec), so they survive an
 // agent/channel drop exactly like the fallback path's `nohup` launch — the
@@ -41,6 +51,7 @@
 #include <map>
 #include <poll.h>
 #include <string>
+#include <sys/stat.h>
 #include <sys/wait.h>
 #include <unistd.h>
 #include <vector>
@@ -377,6 +388,81 @@ static void kill_task(const Json& cmd) {
   emit_error("unknown task id", id_field->s);
 }
 
+// ---------------------------------------------------------------------------
+// Telemetry side-band: tail watched JSONL files back over the channel.
+// ---------------------------------------------------------------------------
+
+struct Watcher {
+  std::string path;
+  off_t pos = 0;
+  std::string buf;
+};
+
+static std::map<std::string, Watcher> g_watchers;
+
+static void watch_task(const Json& cmd) {
+  const Json* id_field = cmd.get("id");
+  const Json* path_field = cmd.get("path");
+  if (!id_field || id_field->type != Json::Str || !path_field ||
+      path_field->type != Json::Str || path_field->s.empty()) {
+    emit_error("watch requires string id and path");
+    return;
+  }
+  // Offset 0 on every (re-)watch: reconnect flushes the buffered backlog.
+  Watcher w;
+  w.path = path_field->s;
+  g_watchers[id_field->s] = std::move(w);
+  emit("{\"event\":\"watching\",\"id\":\"" + json_escape(id_field->s) + "\"}");
+}
+
+static void unwatch_task(const Json& cmd) {
+  const Json* id_field = cmd.get("id");
+  if (!id_field || id_field->type != Json::Str) {
+    emit_error("unwatch requires string id");
+    return;
+  }
+  g_watchers.erase(id_field->s);
+  emit("{\"event\":\"unwatched\",\"id\":\"" + json_escape(id_field->s) + "\"}");
+}
+
+static void pump_watchers() {
+  for (auto& kv : g_watchers) {
+    Watcher& w = kv.second;
+    struct stat st;
+    if (stat(w.path.c_str(), &st) != 0) continue;  // not written yet
+    if (st.st_size < w.pos) {  // truncated/rotated: start over
+      w.pos = 0;
+      w.buf.clear();
+    }
+    if (st.st_size == w.pos) continue;
+    int fd = open(w.path.c_str(), O_RDONLY);
+    if (fd < 0) continue;
+    if (lseek(fd, w.pos, SEEK_SET) >= 0) {
+      // One bounded read per pump: a telemetry burst must not starve the
+      // command loop.
+      char chunk[65536];
+      ssize_t n = read(fd, chunk, sizeof chunk);
+      if (n > 0) {
+        w.pos += n;
+        w.buf.append(chunk, (size_t)n);
+      }
+    }
+    close(fd);
+    size_t nl;
+    while ((nl = w.buf.find('\n')) != std::string::npos) {
+      std::string line = w.buf.substr(0, nl);
+      w.buf.erase(0, nl + 1);
+      if (line.empty()) continue;
+      Json parsed;
+      // Validate before forwarding; a valid line embeds verbatim as the
+      // data object (it is already JSON).
+      if (!parse_json(line, parsed) || parsed.type != Json::Obj) continue;
+      emit("{\"event\":\"telemetry\",\"id\":\"" + json_escape(kv.first) +
+           "\",\"data\":" + line + "}");
+    }
+  }
+}
+
 static void reap_children() {
   while (true) {
     int status = 0;
@@ -386,6 +472,13 @@ static void reap_children() {
     if (it == g_tasks.end()) continue;
     int code = WIFEXITED(status) ? WEXITSTATUS(status) : -1;
     int sig = WIFSIGNALED(status) ? WTERMSIG(status) : 0;
+    if (g_watchers.count(it->second.id)) {
+      // Auto-unwatch on exit, after one final pump so the tail of the
+      // telemetry file is flushed ahead of the exit event: a long-lived
+      // agent must not keep stat()ing files of finished tasks forever.
+      pump_watchers();
+      g_watchers.erase(it->second.id);
+    }
     emit("{\"event\":\"exit\",\"id\":\"" + json_escape(it->second.id) +
          "\",\"code\":" + std::to_string(code) +
          ",\"signal\":" + std::to_string(sig) + "}");
@@ -413,6 +506,8 @@ static void handle_line(const std::string& line, bool& running) {
   if (name == "ping") emit("{\"event\":\"pong\"}");
   else if (name == "run") spawn(cmd);
   else if (name == "kill") kill_task(cmd);
+  else if (name == "watch") watch_task(cmd);
+  else if (name == "unwatch") unwatch_task(cmd);
   else if (name == "shutdown") { emit("{\"event\":\"bye\"}"); running = false; }
   else emit_error("unknown cmd: " + name);
 }
@@ -450,11 +545,14 @@ int main() {
     fds[nfds].events = POLLIN;
     nfds++;
 
-    int rc = poll(fds, nfds, -1);
+    // Live watchers wake the loop on a short tick so telemetry flows
+    // without inbound traffic; otherwise block until a command/SIGCHLD.
+    int rc = poll(fds, nfds, g_watchers.empty() ? -1 : 250);
     if (rc < 0) {
-      if (errno == EINTR) { reap_children(); continue; }
+      if (errno == EINTR) { reap_children(); pump_watchers(); continue; }
       break;
     }
+    pump_watchers();
 
     for (nfds_t k = 0; k < nfds; k++) {
       if (!(fds[k].revents & (POLLIN | POLLHUP | POLLERR))) continue;

@@ -14,10 +14,15 @@ Three cooperating layers, zero hard third-party dependencies:
 Environment:
 
 ``COVALENT_TPU_EVENTS_PATH``
-    Path of the JSONL event log; unset disables the stream.
+    Path of the JSONL event log; unset disables the stream (size-bounded
+    by ``COVALENT_TPU_EVENTS_MAX_BYTES`` / ``COVALENT_TPU_EVENTS_BACKUPS``).
 ``COVALENT_TPU_METRICS``
     Path to dump the metrics registry to at interpreter exit — JSON
-    snapshot by default, Prometheus text when the path ends in ``.prom``.
+    snapshot by default, Prometheus text when the path ends in ``.prom``;
+    ``0``/``off`` explicitly disables the exit dump.
+``COVALENT_TPU_OPS_PORT``
+    Start the ops HTTP endpoint (``/metrics``, ``/status``, ``/events``)
+    on this port; unset disables it (see :mod:`.opsserver`).
 """
 
 from __future__ import annotations
@@ -27,6 +32,7 @@ import os
 
 from .events import EventSink, configure as configure_events, emit as emit_event
 from .events import get_sink
+from .heartbeat import MONITOR, HeartbeatMonitor
 from .metrics import (
     DEFAULT_LATENCY_BUCKETS,
     REGISTRY,
@@ -35,7 +41,20 @@ from .metrics import (
     Histogram,
     Registry,
 )
-from .trace import SPAN_HISTOGRAM, Span, current_span, span
+from .opsserver import (
+    OpsServer,
+    ensure_ops_server,
+    register_status_provider,
+    unregister_status_provider,
+)
+from .trace import (
+    SPAN_HISTOGRAM,
+    Span,
+    context_of,
+    current_span,
+    extract_context,
+    span,
+)
 
 __all__ = [
     "Counter",
@@ -47,12 +66,20 @@ __all__ = [
     "Span",
     "span",
     "current_span",
+    "context_of",
+    "extract_context",
     "SPAN_HISTOGRAM",
     "EventSink",
     "get_sink",
     "configure_events",
     "emit_event",
     "dump_metrics",
+    "HeartbeatMonitor",
+    "MONITOR",
+    "OpsServer",
+    "ensure_ops_server",
+    "register_status_provider",
+    "unregister_status_provider",
 ]
 
 _METRICS_ENV = "COVALENT_TPU_METRICS"
@@ -74,7 +101,7 @@ def dump_metrics(path: str, registry: Registry = REGISTRY) -> None:
 
 def _dump_at_exit() -> None:  # pragma: no cover - exercised via subprocess test
     path = os.environ.get(_METRICS_ENV)
-    if not path:
+    if not path or path.strip().lower() in ("0", "off", "false", "none"):
         return
     try:
         dump_metrics(path)

@@ -17,6 +17,13 @@ a single attribute check — so instrumented hot paths cost nothing in
 production runs that don't ask for events.  ``configure(path)`` overrides
 the environment for the current process (tests, embedding apps).
 
+The stream is size-bounded for long-running fleets: once the file exceeds
+``COVALENT_TPU_EVENTS_MAX_BYTES`` (default 64 MiB) it rotates shift-style
+(``events.jsonl`` -> ``events.jsonl.1`` -> ``.2`` ...), keeping
+``COVALENT_TPU_EVENTS_BACKUPS`` rotated files (default 2) so a dispatcher
+that streams heartbeats for weeks cannot grow its event log without
+limit.  Setting the byte bound to 0 disables rotation.
+
 Every event carries ``ts`` (unix seconds), ``pid``, and ``type``; span
 events additionally carry trace/span/parent ids so the JSONL doubles as a
 flat trace export.
@@ -34,13 +41,42 @@ __all__ = ["EventSink", "get_sink", "configure", "emit", "add_listener",
            "remove_listener"]
 
 _ENV_VAR = "COVALENT_TPU_EVENTS_PATH"
+_MAX_BYTES_ENV = "COVALENT_TPU_EVENTS_MAX_BYTES"
+_BACKUPS_ENV = "COVALENT_TPU_EVENTS_BACKUPS"
+_DEFAULT_MAX_BYTES = 64 * 1024 * 1024
+_DEFAULT_BACKUPS = 2
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
 
 
 class EventSink:
     """Thread-safe JSONL appender bound to one path (or disabled)."""
 
-    def __init__(self, path: str | None) -> None:
+    def __init__(
+        self,
+        path: str | None,
+        max_bytes: int | None = None,
+        backups: int | None = None,
+    ) -> None:
         self.path = path or None
+        #: rotate once the file exceeds this many bytes (0 = never).
+        self.max_bytes = (
+            _env_int(_MAX_BYTES_ENV, _DEFAULT_MAX_BYTES)
+            if max_bytes is None
+            else int(max_bytes)
+        )
+        #: rotated generations kept (``path.1`` .. ``path.N``).
+        self.backups = max(
+            0,
+            _env_int(_BACKUPS_ENV, _DEFAULT_BACKUPS)
+            if backups is None
+            else int(backups),
+        )
         self._lock = threading.Lock()
         self._fh = None
         self._failed = False
@@ -80,6 +116,8 @@ class EventSink:
                     self._fh = open(self.path, "a", encoding="utf-8")
                 self._fh.write(line)
                 self._fh.flush()
+                if self.max_bytes > 0 and self._fh.tell() >= self.max_bytes:
+                    self._rotate_locked()
             except OSError as err:
                 self._failed = True
                 from ..utils.log import app_log
@@ -89,6 +127,28 @@ class EventSink:
                 )
                 return None
         return event
+
+    def _rotate_locked(self) -> None:
+        """Shift-rotate ``path`` -> ``path.1`` -> ... (caller holds _lock).
+
+        With ``backups == 0`` the file is simply truncated: bounded either
+        way.  A rotation failure is swallowed — the stream keeps appending
+        to the (oversized) live file rather than dying mid-dispatch.
+        """
+        self._fh.close()
+        self._fh = None
+        try:
+            for i in range(self.backups - 1, 0, -1):
+                src = f"{self.path}.{i}"
+                if os.path.exists(src):
+                    os.replace(src, f"{self.path}.{i + 1}")
+            if self.backups > 0:
+                os.replace(self.path, f"{self.path}.1")
+            else:
+                os.truncate(self.path, 0)
+        except OSError:
+            pass
+        self._fh = open(self.path, "a", encoding="utf-8")
 
     def close(self) -> None:
         with self._lock:

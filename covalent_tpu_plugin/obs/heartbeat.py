@@ -1,0 +1,212 @@
+"""Worker liveness from streamed heartbeats: the fleet's pulse.
+
+Each worker harness beats on a fixed cadence (``heartbeat_s`` in the task
+spec): a ``worker.heartbeat`` event carrying a monotonically increasing
+``seq``, the process RSS, optional jax device-memory stats, and whatever
+progress metrics the user function published (step counter, tokens/s).
+Those beats reach the dispatcher by two roads — the agent channel's
+telemetry side-band (push, near-real-time) or a heartbeat snapshot file
+piggybacked on the status-probe round trip (poll path) — and both feed the
+process-wide :data:`MONITOR` here.
+
+The monitor answers the two questions the fleet plane needs:
+
+* **liveness** — :meth:`HeartbeatMonitor.stalled` names workers that have
+  beaten at least once and then fallen silent past their stall threshold,
+  which the executor classifies as a ``worker_stalled`` transient (gang
+  teardown + retry) *before* the hard ``task_timeout`` fires;
+* **visibility** — :meth:`HeartbeatMonitor.snapshot` is the per-worker
+  last-heartbeat view the ops ``/status`` endpoint serves while an
+  electron runs.
+
+Dedup is by ``seq``: the poll path re-reads the same snapshot file every
+probe and the agent path re-tails the telemetry file from offset 0 after a
+reconnect, so :meth:`record` reports whether a beat was *fresh* and only
+fresh beats move the metrics below.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable
+
+from .metrics import REGISTRY
+
+__all__ = ["HeartbeatMonitor", "MONITOR"]
+
+HEARTBEATS_TOTAL = REGISTRY.counter(
+    "covalent_tpu_worker_heartbeats_total",
+    "Fresh worker heartbeats received by the dispatcher",
+    ("worker",),
+)
+_WORKER_STEP = REGISTRY.gauge(
+    "covalent_tpu_worker_step",
+    "Latest step counter a worker's heartbeat reported",
+    ("worker",),
+)
+_WORKER_RSS = REGISTRY.gauge(
+    "covalent_tpu_worker_rss_bytes",
+    "Latest resident-set size a worker's heartbeat reported",
+    ("worker",),
+)
+STALLS_TOTAL = REGISTRY.counter(
+    "covalent_tpu_worker_stalls_total",
+    "Workers declared stalled after missing their heartbeat deadline",
+    ("worker",),
+)
+
+
+class HeartbeatMonitor:
+    """Last-heartbeat bookkeeping per (operation, worker).
+
+    Thread-safe: beats arrive on the dispatcher event loop (agent
+    telemetry, status probes) while the ops server thread reads snapshots.
+    ``clock`` is injectable for deterministic stall tests.
+    """
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic) -> None:
+        self._clock = clock
+        self._lock = threading.Lock()
+        #: operation_id -> {"stall_after": s, "started": clock()}
+        self._ops: dict[str, dict[str, Any]] = {}
+        #: (operation_id, worker) -> {"at": clock(), "seq": n, "hb": {...}}
+        self._beats: dict[tuple[str, str], dict[str, Any]] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    #: Floor on the never-beat deadline: a cold harness pays interpreter
+    #: startup + imports before its first beat, and that launch window must
+    #: never read as a stall however tight the configured threshold is.
+    LAUNCH_SLACK_S = 10.0
+
+    def watch(
+        self,
+        operation_id: str,
+        stall_after: float,
+        workers: "tuple[str, ...] | list[str]" = (),
+        interval: float = 0.0,
+        launch_slack: float | None = None,
+    ) -> None:
+        """Start liveness bookkeeping for one dispatch attempt.
+
+        ``stall_after`` is the silence (seconds since the last beat) after
+        which a worker that has beaten before counts as stalled; <= 0
+        disables stall detection for the operation (beats still record for
+        the ``/status`` view).  ``workers`` names the processes EXPECTED
+        to beat: one that never beats at all within
+        ``max(stall_after + interval, launch_slack)`` of this call is
+        equally stalled — a harness can wedge before its first beat lands
+        (e.g. frozen mid-write), and silence-from-birth must not be
+        blindness.
+        """
+        slack = self.LAUNCH_SLACK_S if launch_slack is None else launch_slack
+        with self._lock:
+            self._ops[operation_id] = {
+                "stall_after": float(stall_after),
+                "nobeat_after": max(
+                    float(stall_after) + float(interval), float(slack)
+                ),
+                "workers": tuple(workers),
+                "started": self._clock(),
+            }
+
+    def forget(self, operation_id: str) -> None:
+        with self._lock:
+            self._ops.pop(operation_id, None)
+            for key in [k for k in self._beats if k[0] == operation_id]:
+                del self._beats[key]
+
+    # -- recording ---------------------------------------------------------
+
+    def record(
+        self, operation_id: str, worker: str, heartbeat: dict
+    ) -> bool:
+        """File one heartbeat; returns True when it is *fresh* (new seq).
+
+        Duplicate deliveries (snapshot-file re-reads, telemetry re-tails
+        after reconnect) are identified by ``seq`` and do not refresh the
+        liveness clock — a stalled worker whose stale snapshot keeps being
+        re-read must still go stale here.
+        """
+        seq = heartbeat.get("seq")
+        key = (operation_id, worker)
+        with self._lock:
+            last = self._beats.get(key)
+            if last is not None and seq is not None and seq <= last["seq"]:
+                return False
+            self._beats[key] = {
+                "at": self._clock(),
+                "seq": seq if seq is not None else -1,
+                "hb": dict(heartbeat),
+            }
+        HEARTBEATS_TOTAL.labels(worker=worker).inc()
+        step = heartbeat.get("step")
+        if isinstance(step, (int, float)):
+            _WORKER_STEP.labels(worker=worker).set(step)
+        rss = heartbeat.get("rss_bytes")
+        if isinstance(rss, (int, float)):
+            _WORKER_RSS.labels(worker=worker).set(rss)
+        return True
+
+    # -- queries -----------------------------------------------------------
+
+    def last(self, operation_id: str) -> dict[str, dict[str, Any]]:
+        """worker -> {"age_s", "seq", **last heartbeat} for one operation."""
+        now = self._clock()
+        with self._lock:
+            return {
+                worker: {
+                    "age_s": round(now - entry["at"], 3),
+                    "seq": entry["seq"],
+                    **entry["hb"],
+                }
+                for (op, worker), entry in self._beats.items()
+                if op == operation_id
+            }
+
+    def stalled(self, operation_id: str) -> list[tuple[str, float]]:
+        """``(worker, silence_s)`` for workers past their stall deadline.
+
+        Two ways to stall: a worker that beat and went silent for
+        ``stall_after``; and an *expected* worker (named in :meth:`watch`)
+        that never beat at all within the no-beat deadline
+        (``max(stall_after + interval, launch_slack)``).  An operation
+        whose expected set was not declared only gets the first kind, so a
+        task with heartbeats disabled is never killed by a detector it
+        cannot feed.
+
+        This is a *suspicion*, not a verdict: the executor confirms
+        against the worker's snapshot file before acting (and counts
+        ``covalent_tpu_worker_stalls_total`` only for confirmed stalls).
+        """
+        now = self._clock()
+        with self._lock:
+            op = self._ops.get(operation_id)
+            if op is None or op["stall_after"] <= 0:
+                return []
+            out = []
+            beaten = set()
+            for (o, worker), entry in self._beats.items():
+                if o != operation_id:
+                    continue
+                beaten.add(worker)
+                if now - entry["at"] < op["stall_after"]:
+                    continue
+                out.append((worker, round(now - entry["at"], 3)))
+            silence = now - op["started"]
+            if silence >= op.get("nobeat_after", float("inf")):
+                for worker in op.get("workers", ()):
+                    if worker not in beaten:
+                        out.append((worker, round(silence, 3)))
+        return out
+
+    def snapshot(self) -> dict[str, dict[str, dict[str, Any]]]:
+        """operation_id -> worker -> last-heartbeat view (ops ``/status``)."""
+        with self._lock:
+            ops = set(self._ops) | {op for op, _ in self._beats}
+        return {op: self.last(op) for op in sorted(ops)}
+
+
+#: Process-wide monitor every dispatch path records into.
+MONITOR = HeartbeatMonitor()

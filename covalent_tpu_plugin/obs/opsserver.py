@@ -1,0 +1,247 @@
+"""Ops status endpoint: a stdlib HTTP thread serving the fleet's live view.
+
+Opt-in via one environment variable::
+
+    COVALENT_TPU_OPS_PORT=9464 python my_workflow.py
+    curl localhost:9464/metrics   # Prometheus text exposition (scrapable)
+    curl localhost:9464/status    # JSON: in-flight electrons, heartbeats,
+                                  #       circuit breakers, dispatches
+    curl localhost:9464/events    # bounded tail of the structured stream
+
+Port 0 binds an ephemeral port (tests); the bound port is readable from
+``OpsServer.port`` and logged in the ``ops.server_started`` event.  The
+server binds ``COVALENT_TPU_OPS_HOST`` (default loopback — exposing an
+unauthenticated ops port beyond the host is an operator decision, not a
+default) and runs entirely on daemon threads: it can never hold the
+dispatcher open at exit.
+
+``/status`` is assembled from *status providers*: components register a
+zero-argument callable (``TPUExecutor`` its in-flight/breaker view, the
+workflow runner its dispatch table) and the handler merges their dicts at
+request time.  Providers are held weakly by convention — register a
+closure over a weakref, return ``{}`` when the owner is gone — so a
+forgotten executor cannot be kept alive by its ops registration.
+
+``/events`` is fed by an in-process event listener into a bounded ring
+buffer (``COVALENT_TPU_EVENTS_TAIL`` entries, default 256), so the tail
+works even when no JSONL path is configured.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable
+from urllib.parse import parse_qs, urlparse
+
+from . import events as _events
+from .heartbeat import MONITOR
+from .metrics import REGISTRY
+
+__all__ = [
+    "OpsServer",
+    "ensure_ops_server",
+    "register_status_provider",
+    "unregister_status_provider",
+]
+
+_PORT_ENV = "COVALENT_TPU_OPS_PORT"
+_HOST_ENV = "COVALENT_TPU_OPS_HOST"
+_TAIL_ENV = "COVALENT_TPU_EVENTS_TAIL"
+
+_providers_lock = threading.Lock()
+_providers: dict[str, Callable[[], dict]] = {}
+
+
+def register_status_provider(name: str, provider: Callable[[], dict]) -> None:
+    """Contribute a dict to ``/status`` under ``name`` (last write wins)."""
+    with _providers_lock:
+        _providers[name] = provider
+
+
+def unregister_status_provider(name: str) -> None:
+    with _providers_lock:
+        _providers.pop(name, None)
+
+
+def _tail_size() -> int:
+    try:
+        return max(16, int(os.environ.get(_TAIL_ENV, "256")))
+    except ValueError:
+        return 256
+
+
+class OpsServer:
+    """One HTTP thread serving /metrics, /status, /events, /healthz."""
+
+    def __init__(self, port: int, host: str | None = None) -> None:
+        self.host = host or os.environ.get(_HOST_ENV) or "127.0.0.1"
+        self.started_at = time.time()
+        self._tail: collections.deque = collections.deque(
+            maxlen=_tail_size()
+        )
+        self._listener = self._tail.append
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # protocol-only stdout stays clean
+                pass
+
+            def _send(self, code: int, body: bytes, content_type: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self) -> None:  # noqa: N802 - http.server contract
+                try:
+                    url = urlparse(self.path)
+                    route = url.path.rstrip("/") or "/"
+                    if route == "/metrics":
+                        self._send(
+                            200, REGISTRY.prometheus_text().encode(),
+                            "text/plain; version=0.0.4",
+                        )
+                    elif route == "/status":
+                        self._send(
+                            200,
+                            json.dumps(
+                                server.status(), default=repr, indent=2
+                            ).encode(),
+                            "application/json",
+                        )
+                    elif route == "/events":
+                        params = parse_qs(url.query)
+                        try:
+                            n = int(params.get("n", ["0"])[0])
+                        except ValueError:
+                            n = 0
+                        self._send(
+                            200, server.events_tail(n).encode(),
+                            "application/x-ndjson",
+                        )
+                    elif route in ("/", "/healthz"):
+                        self._send(200, b"ok\n", "text/plain")
+                    else:
+                        self._send(404, b"not found\n", "text/plain")
+                except BrokenPipeError:  # client went away mid-write
+                    pass
+                except Exception as err:  # noqa: BLE001 - ops must not crash
+                    try:
+                        self._send(
+                            500, f"error: {err!r}\n".encode(), "text/plain"
+                        )
+                    except Exception:  # noqa: BLE001
+                        pass
+
+        self._httpd = ThreadingHTTPServer((self.host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = int(self._httpd.server_address[1])
+        # Only after the bind succeeded: a failed construction must not
+        # leave an orphaned listener on the event stream (ensure_ops_server
+        # retries on every executor init, which would accumulate them).
+        _events.add_listener(self._listener)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="covalent-tpu-ops",
+            daemon=True,
+        )
+        self._thread.start()
+
+    # -- payload assembly --------------------------------------------------
+
+    def status(self) -> dict[str, Any]:
+        """The /status JSON: merged provider views + heartbeat snapshot."""
+        out: dict[str, Any] = {
+            "ts": round(time.time(), 3),
+            "pid": os.getpid(),
+            "uptime_s": round(time.time() - self.started_at, 3),
+            "heartbeats": MONITOR.snapshot(),
+            "in_flight": {},
+        }
+        with _providers_lock:
+            providers = dict(_providers)
+        for name, provider in providers.items():
+            try:
+                view = provider()
+            except Exception as err:  # noqa: BLE001 - one bad provider
+                view = {"error": repr(err)}
+            if view is None:
+                # Provider's owner was garbage collected: prune the entry.
+                unregister_status_provider(name)
+                continue
+            # Aggregate every provider's in-flight map at the top level so
+            # "is electron X running" is one key lookup for operators/CI.
+            in_flight = view.get("in_flight")
+            if isinstance(in_flight, dict):
+                out["in_flight"].update(in_flight)
+            if view:
+                out.setdefault("providers", {})[name] = view
+        return out
+
+    def events_tail(self, n: int = 0) -> str:
+        """Last ``n`` (default: all buffered) events as JSONL."""
+        events = list(self._tail)
+        if n > 0:
+            events = events[-n:]
+        return "".join(
+            json.dumps(event, default=repr) + "\n" for event in events
+        )
+
+    def close(self) -> None:
+        _events.remove_listener(self._listener)
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+_server_lock = threading.Lock()
+_server: OpsServer | None = None
+
+
+def ensure_ops_server(port: int | None = None) -> OpsServer | None:
+    """Start the process-wide ops server once; None when not configured.
+
+    ``port`` overrides the environment (tests/embedders); with neither an
+    explicit port nor ``COVALENT_TPU_OPS_PORT`` this is a no-op, so the
+    call is safe on every executor/runner startup path.
+    """
+    global _server
+    with _server_lock:
+        if _server is not None:
+            return _server
+        if port is None:
+            raw = os.environ.get(_PORT_ENV, "").strip()
+            if not raw:
+                return None
+            try:
+                port = int(raw)
+            except ValueError:
+                from ..utils.log import app_log
+
+                app_log.warning("ignoring non-integer %s=%r", _PORT_ENV, raw)
+                return None
+        try:
+            _server = OpsServer(port)
+        except OSError as err:
+            from ..utils.log import app_log
+
+            app_log.warning("ops server failed to bind port %s: %s", port, err)
+            return None
+    _events.emit(
+        "ops.server_started", host=_server.host, port=_server.port
+    )
+    return _server
+
+
+def shutdown_ops_server() -> None:
+    """Stop and forget the process-wide server (tests)."""
+    global _server
+    with _server_lock:
+        if _server is not None:
+            _server.close()
+            _server = None

@@ -37,7 +37,10 @@ from typing import Any
 from . import events as _events
 from .metrics import REGISTRY
 
-__all__ = ["Span", "span", "current_span", "SPAN_HISTOGRAM"]
+__all__ = [
+    "Span", "span", "current_span", "SPAN_HISTOGRAM",
+    "context_of", "extract_context",
+]
 
 #: Name of the histogram every finished span observes into.
 SPAN_HISTOGRAM = "covalent_tpu_span_duration_seconds"
@@ -56,6 +59,30 @@ def current_span() -> "Span | None":
     return _current.get()
 
 
+def context_of(span: "Span", **extra: Any) -> dict[str, Any]:
+    """Wire-format trace context for propagation across a process boundary.
+
+    The dispatcher stamps this dict into the harness task spec and agent
+    RPCs so worker-side events join the dispatch trace: ``trace_id`` is
+    the trace to join, ``span_id`` the parent for whatever the remote side
+    records.  ``extra`` rides along verbatim (e.g. ``attempt=N``, which
+    the retry driver preserves so one trace follows an electron across
+    gang re-submissions).
+    """
+    return {"trace_id": span.trace_id, "span_id": span.span_id, **extra}
+
+
+def extract_context(carrier: dict | None) -> tuple[str, str] | None:
+    """``(trace_id, parent_span_id)`` from a :func:`context_of` dict."""
+    if not carrier:
+        return None
+    trace_id = carrier.get("trace_id")
+    span_id = carrier.get("span_id")
+    if not trace_id or not span_id:
+        return None
+    return str(trace_id), str(span_id)
+
+
 class Span:
     """One timed operation with ids, status, and attributes.
 
@@ -67,7 +94,7 @@ class Span:
     __slots__ = (
         "name", "trace_id", "span_id", "parent_id", "attributes",
         "status", "start_ts", "duration_s", "stage_durations",
-        "_t0", "_token", "_parent", "_emit", "_activate",
+        "_t0", "_token", "_parent", "_emit", "_activate", "_context",
     )
 
     def __init__(
@@ -77,10 +104,14 @@ class Span:
         emit: bool = True,
         parent: "Span | None" = None,
         activate: bool = True,
+        context: tuple[str, str] | None = None,
     ) -> None:
         """``parent`` overrides contextvar lookup; ``activate=False`` keeps
         the span out of the ambient context (long-lived roots that are never
-        exited, like the StageTimer shim's, must not capture it)."""
+        exited, like the StageTimer shim's, must not capture it).
+        ``context`` — a ``(trace_id, parent_span_id)`` pair from
+        :func:`extract_context` — adopts a *remote* parent when no local
+        one applies, joining a trace that started in another process."""
         self.name = name
         self.attributes: dict[str, Any] = dict(attributes or {})
         self.status = "OK"
@@ -97,6 +128,7 @@ class Span:
         self._parent: Span | None = parent
         self._emit = emit
         self._activate = activate
+        self._context = context
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -107,6 +139,8 @@ class Span:
         if parent is not None:
             self.trace_id = parent.trace_id
             self.parent_id = parent.span_id
+        elif self._context is not None:
+            self.trace_id, self.parent_id = self._context
         else:
             self.trace_id = _new_id(16)
         self.start_ts = time.time()

@@ -49,6 +49,7 @@ __all__ = [
     "Deadline",
     "FaultClass",
     "RetryPolicy",
+    "WorkerStalledError",
     "classify_error",
     "TASK_RETRIES_TOTAL",
 ]
@@ -74,6 +75,16 @@ _CIRCUIT_TRANSITIONS = REGISTRY.counter(
 # --------------------------------------------------------------------------
 # Fault classification
 # --------------------------------------------------------------------------
+
+
+class WorkerStalledError(TransportError):
+    """Liveness failure: a worker that was heartbeating went silent past
+    its stall threshold while its process still looks alive (or its state
+    is unknowable).  Raised by the missed-heartbeat detector
+    (``obs.heartbeat.MONITOR`` via the executor's pollers) so a wedged
+    worker is classified and retried *before* the hard ``task_timeout``
+    fires.  Transient by construction — a gang restart on fresh state is
+    exactly the remedy for a hang."""
 
 
 class FaultClass(str, Enum):
@@ -108,6 +119,10 @@ def classify_error(error: BaseException) -> tuple[FaultClass, str]:
             # cooldown into the half-open probe.
             return FaultClass.TRANSIENT, "circuit_open"
         cause = cause.__cause__
+    if isinstance(error, WorkerStalledError):
+        # Missed-heartbeat liveness failures keep their own label so an
+        # operator can tell a wedged worker from a dropped channel.
+        return FaultClass.TRANSIENT, "worker_stalled"
     if isinstance(error, TransportError):
         # Covers AgentError (agent RPC loss) and chaos-injected faults too.
         return FaultClass.TRANSIENT, "transport"

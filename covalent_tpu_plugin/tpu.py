@@ -34,6 +34,8 @@ import asyncio
 import json
 import os
 import shlex
+import time
+import weakref
 from enum import Enum
 from pathlib import Path
 from typing import Any, Callable, Sequence
@@ -59,8 +61,14 @@ from .cache import (
 )
 from .executor_base import RemoteExecutor
 from .obs import events as obs_events
+from .obs.heartbeat import MONITOR, STALLS_TOTAL
 from .obs.metrics import REGISTRY
-from .obs.trace import Span
+from .obs.opsserver import (
+    ensure_ops_server,
+    register_status_provider,
+    unregister_status_provider,
+)
+from .obs.trace import Span, context_of
 from .parallel.distributed import coordinator_spec
 from .resilience import (
     TASK_RETRIES_TOTAL,
@@ -68,6 +76,7 @@ from .resilience import (
     Deadline,
     FaultClass,
     RetryPolicy,
+    WorkerStalledError,
     classify_error,
 )
 from .transport import (
@@ -182,6 +191,17 @@ _EXECUTOR_PLUGIN_DEFAULTS = {
     # Fault-injection spec (transport/chaos.py); also COVALENT_TPU_CHAOS.
     # Empty = no chaos wrapper (the production default).
     "chaos": "",
+    # Worker heartbeat cadence (obs/heartbeat.py): each harness process
+    # beats every N seconds — step counter, RSS, device-memory stats —
+    # into the telemetry side-band the dispatcher streams back (agent
+    # channel) or reads piggybacked on its status probe (poll path).
+    # 0 disables; COVALENT_TPU_HEARTBEAT_S overrides per process.
+    "heartbeat_interval": 5.0,
+    # Silence after which a worker that WAS heartbeating is declared
+    # stalled (classified `worker_stalled` transient, gang retried before
+    # the hard task_timeout).  0 = 3x the heartbeat interval;
+    # COVALENT_TPU_STALL_S overrides per process.
+    "stall_threshold": 0.0,
 }
 
 
@@ -207,6 +227,10 @@ _PREWARM_TOTAL = REGISTRY.counter(
     "DAG-driven connection prewarm attempts by result",
     ("result",),
 )
+_WALL_OVERHEAD_HIST = REGISTRY.histogram(
+    "covalent_tpu_wall_overhead_seconds",
+    "Per-electron wall-clock dispatch overhead (elapsed minus execute)",
+)
 
 
 def _split_host_port(hostport: str) -> tuple[str, int | None]:
@@ -229,6 +253,8 @@ class TaskStatus(str, Enum):
     STARTING = "STARTING"    # no result, no pid file yet (launch window)
     DEAD = "DEAD"            # process gone and no result -> failure
     TIMEOUT = "TIMEOUT"      # task_timeout expired with processes RUNNING
+    STALLED = "STALLED"      # a heartbeating worker went silent past its
+    #                          stall threshold while its process looks alive
 
 
 class StagedTask:
@@ -256,6 +282,18 @@ class StagedTask:
         self.remote_result_file = f"{remote_cache}/result_{operation_id}.pkl"
         self.remote_log_file = f"{remote_cache}/log_{operation_id}.txt"
         self.remote_pid_file = f"{remote_cache}/pid_{operation_id}"
+
+    def remote_telemetry_file(self, process_id: int) -> str:
+        """Worker-local JSONL side-band (heartbeats + worker events) the
+        agent channel tails back to the dispatcher."""
+        return (
+            f"{self.remote_cache}/telemetry_{self.operation_id}"
+            f".{process_id}.jsonl"
+        )
+
+    def remote_hb_file(self, process_id: int) -> str:
+        """Atomic latest-heartbeat snapshot the status probe piggybacks."""
+        return f"{self.remote_pid_file}.{process_id}.hb"
 
     @property
     def remote_function_file(self) -> str:
@@ -370,6 +408,8 @@ class TPUExecutor(RemoteExecutor):
         circuit_threshold: int | None = None,
         circuit_cooldown: float | None = None,
         chaos: "str | ChaosPlan | None" = None,
+        heartbeat_interval: float | None = None,
+        stall_threshold: float | None = None,
         pool: TransportPool | None = None,
     ) -> None:
         def resolve(value, key):
@@ -517,6 +557,30 @@ class TPUExecutor(RemoteExecutor):
             if chaos is None:
                 chaos = os.environ.get("COVALENT_TPU_CHAOS")
             self._chaos = plan_from_spec(str(resolve(chaos, "chaos") or ""))
+        #: worker liveness: heartbeat cadence shipped in the task spec and
+        #: the silence past which a beating worker counts as stalled.  Env
+        #: is the workflow-layer switch, same chain as the retry budget.
+        def resolve_float_env(value, env_name, key):
+            env_value = os.environ.get(env_name)
+            if value is None and env_value is not None:
+                try:
+                    value = float(env_value)
+                except ValueError:
+                    app_log.warning(
+                        "ignoring non-numeric %s=%r", env_name, env_value
+                    )
+            return max(0.0, float(resolve(value, key)))
+
+        self.heartbeat_interval = resolve_float_env(
+            heartbeat_interval, "COVALENT_TPU_HEARTBEAT_S",
+            "heartbeat_interval",
+        )
+        self.stall_threshold = resolve_float_env(
+            stall_threshold, "COVALENT_TPU_STALL_S", "stall_threshold"
+        )
+        #: live per-operation view served by the ops /status endpoint:
+        #: operation_id -> {"stage", "attempt", "trace_id", "since"}.
+        self._op_status: dict[str, dict[str, Any]] = {}
         #: attempts consumed by the most recent run() (1 = no retries).
         self.last_attempts = 0
         #: base operation id -> attempts consumed; read (and popped) by the
@@ -571,6 +635,60 @@ class TPUExecutor(RemoteExecutor):
         #: per-address locks making agent creation single-flight.
         self._agent_locks: dict[str, asyncio.Lock] = {}
         self.last_timings: dict[str, float] = {}
+
+        # Fleet ops plane: start the (env-gated) status endpoint and expose
+        # this executor's live view on it.  The provider holds only a
+        # weakref — a dropped executor answers None and the server prunes
+        # the registration instead of keeping the instance alive.
+        ensure_ops_server()
+        self._ops_provider_name = f"executor:{id(self):x}"
+        provider_name = self._ops_provider_name
+        self_ref = weakref.ref(
+            self, lambda _ref: unregister_status_provider(provider_name)
+        )
+
+        def _ops_provider():
+            executor = self_ref()
+            return (
+                executor._status_snapshot() if executor is not None else None
+            )
+
+        register_status_provider(provider_name, _ops_provider)
+
+    def _stall_after(self) -> float:
+        """Seconds of heartbeat silence that declare a worker stalled."""
+        if self.heartbeat_interval <= 0:
+            return 0.0
+        if self.stall_threshold > 0:
+            return self.stall_threshold
+        return 3.0 * self.heartbeat_interval
+
+    def _status_snapshot(self) -> dict[str, Any]:
+        """This executor's contribution to the ops ``/status`` payload."""
+        try:
+            addresses = self._worker_addresses()
+        except Exception:  # noqa: BLE001 - topology may be unresolvable
+            addresses = []
+        in_flight = {}
+        for op, state in list(self._op_status.items()):
+            in_flight[op] = {
+                **state,
+                "age_s": round(time.time() - state.get("since", 0.0), 3),
+                "pids": dict(self._active.get(op, {})),
+                "heartbeats": MONITOR.last(op),
+            }
+        return {
+            "transport": self.transport_kind,
+            "workers": addresses,
+            "heartbeat_interval_s": self.heartbeat_interval,
+            "stall_after_s": self._stall_after(),
+            "in_flight": in_flight,
+            "circuit_breakers": self._breakers.states(),
+            "agents": {
+                address: (client.mode if client is not None else None)
+                for address, client in self._agents.items()
+            },
+        }
 
     # ------------------------------------------------------------------ #
     # Worker topology                                                    #
@@ -895,6 +1013,7 @@ class TPUExecutor(RemoteExecutor):
         current_remote_workdir: str,
         pip_deps: Sequence[str] = (),
         payload: bytes | None = None,
+        trace: dict | None = None,
     ) -> StagedTask:
         """Stage the function pickle + per-worker task specs locally.
 
@@ -904,7 +1023,9 @@ class TPUExecutor(RemoteExecutor):
         each gets its own ``process_id`` for ``jax.distributed``.
         ``payload`` carries pre-serialized ``(fn, args, kwargs)`` bytes when
         the result-cache lookup already pickled them, so a cold cached
-        dispatch never serializes a large argument set twice.
+        dispatch never serializes a large argument set twice.  ``trace``
+        (obs.trace.context_of) stamps the dispatch trace/span ids + attempt
+        into every spec so worker-side events join the dispatch trace.
         """
         staged = StagedTask(operation_id, Path(self.cache_dir), self.remote_cache)
         if payload is None:
@@ -950,6 +1071,16 @@ class TPUExecutor(RemoteExecutor):
             }
             if events_file:
                 spec["events_file"] = events_file
+            if trace:
+                spec["trace"] = trace
+            if self.heartbeat_interval > 0:
+                # Liveness side-band: the harness beats into a worker-local
+                # telemetry file (agent channel tails it back) and keeps an
+                # atomic snapshot the status probe reads piggybacked.
+                spec["heartbeat_s"] = self.heartbeat_interval
+                spec["telemetry_file"] = staged.remote_telemetry_file(
+                    process_id
+                )
             if self.task_env:
                 spec["env"] = self.task_env
             if self.profile_dir:
@@ -1372,6 +1503,141 @@ class TPUExecutor(RemoteExecutor):
             log=staged.remote_log_file,
         )
 
+    def _record_heartbeat(
+        self, operation_id: str, worker: str, heartbeat: dict
+    ) -> None:
+        """File one worker heartbeat: liveness monitor + dispatcher stream.
+
+        Shared by the poll path (snapshot piggybacked on the status probe)
+        and the agent backhaul.  Only a FRESH beat (new ``seq`` — the
+        monitor dedups re-reads/re-tails) is re-emitted as a dispatcher
+        ``worker.heartbeat`` event and moves the per-worker gauges, so the
+        streamed record matches the worker's actual cadence.
+        """
+        fresh = MONITOR.record(operation_id, worker, heartbeat)
+        if not fresh:
+            return
+        body = {
+            k: v for k, v in heartbeat.items()
+            if k not in ("type", "pid", "ts")
+        }
+        worker_ts = heartbeat.get("ts")
+        obs_events.emit(
+            "worker.heartbeat",
+            worker=worker,
+            **({"worker_ts": worker_ts} if worker_ts else {}),
+            **body,
+        )
+
+    def _handle_backhaul(
+        self, operation_id: str, worker: str, data: dict
+    ) -> None:
+        """One telemetry line pushed up an agent channel's side-band.
+
+        Heartbeats feed the liveness monitor; other worker events are
+        re-emitted into the dispatcher's stream — except on the local
+        transport, where the shared filesystem already delivered them
+        (the harness writes the dispatcher's JSONL directly).
+        """
+        if data.get("type") == "worker.heartbeat":
+            self._record_heartbeat(operation_id, worker, data)
+            return
+        if self.transport_kind == "local":
+            return
+        body = {k: v for k, v in data.items() if k not in ("type", "ts")}
+        worker_ts = data.get("ts")
+        obs_events.emit(
+            str(data.get("type") or "worker.event"),
+            worker=worker,
+            backhaul=True,
+            **({"worker_ts": worker_ts} if worker_ts else {}),
+            **body,
+        )
+
+    async def _start_backhaul(
+        self, operation_id: str, staged: StagedTask
+    ) -> None:
+        """Open the telemetry side-band on every agent-launched worker.
+
+        Best-effort: a watch that fails leaves that worker on the
+        file-based fallback (heartbeat snapshot piggybacked on probes,
+        telemetry tail fetched on failure) — never fails the dispatch.
+        The server auto-unwatches when the task exits, and events written
+        while no channel was attached are flushed on the next (re-)watch,
+        deduped by ``seq`` on this side.
+        """
+        if self.heartbeat_interval <= 0:
+            return
+        clients = self._op_agents.get(operation_id) or []
+        addresses = self._worker_addresses()
+        for i, client in enumerate(clients):
+            if client is None or not client.alive:
+                continue
+            worker = addresses[i] if i < len(addresses) else client.address
+            if client.on_telemetry is None:
+                client.on_telemetry = (
+                    lambda task_id, data, _worker=worker: (
+                        self._handle_backhaul(task_id, _worker, data)
+                    )
+                )
+            try:
+                await client.watch(
+                    operation_id, staged.remote_telemetry_file(i)
+                )
+            except AgentError:
+                pass  # poll-path liveness still covers this worker
+
+    async def _confirm_heartbeats(
+        self,
+        operation_id: str,
+        conns: list[Transport],
+        staged: StagedTask,
+        pids: dict[str, int],
+        addresses: list[str],
+    ) -> None:
+        """Read every suspect worker's heartbeat snapshot directly.
+
+        The stall verdict must never hinge on the streaming side-band
+        alone: before the agent wait declares a worker stalled, this
+        re-reads the ``.hb`` files over the control channel (the same
+        probe shape the polling path uses) so a healthy worker whose
+        telemetry stream failed refreshes its liveness clock and survives.
+        Best-effort — probe failures leave the monitor unchanged and the
+        verdict to the caller.
+        """
+
+        async def probe_one(i: int, conn: Transport) -> None:
+            worker = addresses[i] if i < len(addresses) else conn.address
+            marker = (
+                staged.remote_result_file
+                if i == 0
+                else f"{staged.remote_result_file}.done.{i}"
+            )
+            try:
+                await self.get_status(
+                    conn,
+                    marker,
+                    pids.get(worker),
+                    f"{staged.remote_pid_file}.{i}",
+                    hb_file=staged.remote_hb_file(i),
+                    on_heartbeat=lambda hb, _w=worker: (
+                        self._record_heartbeat(operation_id, _w, hb)
+                    ),
+                )
+            except (TransportError, OSError):
+                pass
+
+        suspects = {w for w, _ in MONITOR.stalled(operation_id)}
+        await asyncio.gather(
+            *(
+                probe_one(i, conn)
+                for i, conn in enumerate(conns)
+                if (addresses[i] if i < len(addresses) else conn.address)
+                in suspects
+            ),
+            return_exceptions=True,
+        )
+
     async def _await_all_agent(
         self,
         clients: list[AgentClient],
@@ -1386,10 +1652,19 @@ class TPUExecutor(RemoteExecutor):
         confirms the result file, preserving the polling path's READY
         definition); a non-zero worker exiting unsuccessfully first fails
         fast with correct blame.  Any agent-channel death downgrades to
-        :meth:`_poll_all` — the tasks themselves are unaffected.
+        :meth:`_poll_all` — the tasks themselves are unaffected.  With
+        heartbeats on, the wait wakes on a short tick to consult the
+        liveness monitor (fed by the telemetry side-band) so a silent
+        worker surfaces as STALLED before any hard timeout.
         """
         op = staged.operation_id
         timeout = self.task_timeout or None
+        stall_after = self._stall_after()
+        # Wake often enough to catch a stall promptly but never beat
+        # faster than a quarter of the threshold (cheap: no round trips).
+        wake = (
+            min(1.0, max(0.25, stall_after / 4.0)) if stall_after else None
+        )
 
         async def exit_of(i: int) -> tuple[int, int, int]:
             code, sig = await clients[i].wait_exit(op)
@@ -1397,6 +1672,7 @@ class TPUExecutor(RemoteExecutor):
 
         waiters = [asyncio.ensure_future(exit_of(i)) for i in range(len(clients))]
         try:
+            addresses = self._worker_addresses()
             pending = set(waiters)
             deadline = (
                 asyncio.get_running_loop().time() + timeout if timeout else None
@@ -1407,10 +1683,36 @@ class TPUExecutor(RemoteExecutor):
                     remaining = deadline - asyncio.get_running_loop().time()
                     if remaining <= 0:
                         return TaskStatus.TIMEOUT, 0  # matches _poll_all
+                wait_for = remaining
+                if wake is not None:
+                    wait_for = (
+                        wake if remaining is None else min(remaining, wake)
+                    )
                 done, pending = await asyncio.wait(
-                    pending, timeout=remaining, return_when=asyncio.FIRST_COMPLETED
+                    pending, timeout=wait_for, return_when=asyncio.FIRST_COMPLETED
                 )
                 if not done:
+                    if wake is not None:
+                        if MONITOR.stalled(op):
+                            # Confirm against the file-based ground truth
+                            # before killing anything: the telemetry
+                            # side-band can fail (watch rejected, channel
+                            # congestion, unwritable telemetry file) while
+                            # the worker beats on — its .hb snapshot is
+                            # the feed that cannot lie about that.  One
+                            # round-trip, only on stall suspicion.
+                            await self._confirm_heartbeats(
+                                op, conns, staged, pids, addresses
+                            )
+                        stalled = MONITOR.stalled(op)
+                        if stalled:
+                            worker, _silence = stalled[0]
+                            return TaskStatus.STALLED, (
+                                addresses.index(worker)
+                                if worker in addresses
+                                else 0
+                            )
+                        continue  # wake tick; deadline re-checked on top
                     return TaskStatus.TIMEOUT, 0
                 # Worker 0 first: its successful completion outranks another
                 # worker's post-barrier teardown failure, matching
@@ -1455,6 +1757,8 @@ class TPUExecutor(RemoteExecutor):
         remote_result_file: str,
         pid: int | None = None,
         pid_file: str | None = None,
+        hb_file: str | None = None,
+        on_heartbeat: Callable[[dict], None] | None = None,
     ) -> TaskStatus:
         """Combined result-exists + process-alive probe, one round-trip.
 
@@ -1465,6 +1769,11 @@ class TPUExecutor(RemoteExecutor):
         harness writes at startup is the liveness source instead; a missing
         pid file reports STARTING, which the poller tolerates only for a
         bounded grace window.
+
+        ``hb_file`` piggybacks the worker's latest heartbeat snapshot on
+        the SAME round trip (its JSON precedes the status token on stdout);
+        a parsed beat is handed to ``on_heartbeat`` — this is how the
+        polling path gets worker liveness for free.
         """
         if pid is not None:
             liveness = f"elif kill -0 {pid} 2>/dev/null; then echo RUNNING; "
@@ -1478,13 +1787,33 @@ class TPUExecutor(RemoteExecutor):
             )
         else:
             liveness = "elif true; then echo RUNNING; "
+        hb_clause = ""
+        if hb_file:
+            quoted_hb = shlex.quote(hb_file)
+            # `echo` terminates the snapshot (written without a newline) so
+            # the status token below always sits alone on the last line.
+            hb_clause = f"test -s {quoted_hb} && cat {quoted_hb} && echo; "
         probe = (
-            f"if test -f {shlex.quote(remote_result_file)}; then echo READY; "
+            hb_clause
+            + f"if test -f {shlex.quote(remote_result_file)}; then echo READY; "
             + liveness
             + "else echo DEAD; fi"
         )
         result = await conn.run(probe)
-        token = result.stdout.strip().splitlines()[-1] if result.stdout.strip() else ""
+        lines = result.stdout.strip().splitlines()
+        token = lines[-1] if lines else ""
+        if on_heartbeat is not None:
+            for line in lines[:-1]:
+                line = line.strip()
+                if not line.startswith("{"):
+                    continue
+                try:
+                    heartbeat = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(heartbeat, dict):
+                    on_heartbeat(heartbeat)
+                break
         try:
             return TaskStatus(token)
         except ValueError:
@@ -1573,9 +1902,15 @@ class TPUExecutor(RemoteExecutor):
         """
         failures: dict[Any, int] = {}
 
-        async def probe_once(key, conn, path, pid, pid_file=None) -> TaskStatus:
+        async def probe_once(
+            key, conn, path, pid, pid_file=None, hb_file=None,
+            on_heartbeat=None,
+        ) -> TaskStatus:
             try:
-                status = await self.get_status(conn, path, pid, pid_file)
+                status = await self.get_status(
+                    conn, path, pid, pid_file,
+                    hb_file=hb_file, on_heartbeat=on_heartbeat,
+                )
             except TransportError:
                 failures[key] = failures.get(key, 0) + 1
                 if failures[key] >= max_consecutive:
@@ -1619,6 +1954,13 @@ class TPUExecutor(RemoteExecutor):
         """
         addresses = self._worker_addresses()
         tolerant = self._tolerant_status()
+        op = staged.operation_id
+        liveness = self.heartbeat_interval > 0
+
+        def hb_recorder(worker: str):
+            if not liveness:
+                return None
+            return lambda hb: self._record_heartbeat(op, worker, hb)
 
         async def probe() -> tuple[TaskStatus, int]:
             statuses = await asyncio.gather(
@@ -1628,6 +1970,8 @@ class TPUExecutor(RemoteExecutor):
                     staged.remote_result_file,
                     pids.get(addresses[0]),
                     f"{staged.remote_pid_file}.0",
+                    hb_file=staged.remote_hb_file(0) if liveness else None,
+                    on_heartbeat=hb_recorder(addresses[0]),
                 ),
                 *(
                     # Workers 1..N-1 are "done" at their marker file — same
@@ -1638,6 +1982,10 @@ class TPUExecutor(RemoteExecutor):
                         f"{staged.remote_result_file}.done.{i}",
                         pids.get(addresses[i]),
                         f"{staged.remote_pid_file}.{i}",
+                        hb_file=(
+                            staged.remote_hb_file(i) if liveness else None
+                        ),
+                        on_heartbeat=hb_recorder(addresses[i]),
                     )
                     for i in range(1, len(conns))
                 ),
@@ -1653,6 +2001,19 @@ class TPUExecutor(RemoteExecutor):
             for i, status in enumerate(statuses):
                 if status is TaskStatus.STARTING:
                     return TaskStatus.STARTING, i
+            # Liveness: every process looked alive, but a worker that WAS
+            # heartbeating and has gone silent past its threshold is wedged
+            # — surface it now, before the hard task_timeout would.
+            if liveness:
+                stalled = MONITOR.stalled(op)
+                if stalled:
+                    worker, _silence = stalled[0]
+                    blamed = (
+                        addresses.index(worker)
+                        if worker in addresses
+                        else 0
+                    )
+                    return TaskStatus.STALLED, blamed
             return TaskStatus.RUNNING, 0
 
         status, blamed = await self._wait_while_running(probe)
@@ -1688,6 +2049,28 @@ class TPUExecutor(RemoteExecutor):
     async def _remote_log_tail(self, conn: Transport, staged: StagedTask) -> str:
         """Worker logs are the #1 debugging surface on pods (SURVEY §5)."""
         result = await conn.run(f"tail -n 50 {shlex.quote(staged.remote_log_file)}")
+        return result.stdout.strip()
+
+    async def _remote_telemetry_tail(
+        self, conn: Transport, staged: StagedTask, process_id: int
+    ) -> str:
+        """Last worker-side telemetry lines for a failure report.
+
+        Events buffered in the worker-local side-band file while no agent
+        channel was attached (or on the poll path, which never streams)
+        surface here with the failure instead of needing a post-mortem
+        scp.  Best-effort: an empty string when telemetry is off or the
+        tail itself fails.
+        """
+        if self.heartbeat_interval <= 0:
+            return ""
+        path = staged.remote_telemetry_file(process_id)
+        try:
+            result = await conn.run(
+                f"tail -n 20 {shlex.quote(path)} 2>/dev/null; true"
+            )
+        except (TransportError, OSError):
+            return ""
         return result.stdout.strip()
 
     def attempts_of(self, operation_id: str) -> int:
@@ -1770,26 +2153,41 @@ class TPUExecutor(RemoteExecutor):
         conns: list[Transport],
         addresses: list[str],
         pids: dict[str, int],
+        reason: str = "timeout",
     ) -> None:
-        """Reap a timed-out gang: TERM every worker's process group, give
-        ``TIMEOUT_KILL_GRACE_S`` for cleanup handlers, then KILL survivors.
+        """Reap a timed-out (or stalled) gang: TERM every worker's process
+        group, give ``TIMEOUT_KILL_GRACE_S`` for cleanup handlers, then
+        KILL survivors.
 
         The harness calls ``setsid`` at startup, so ``kill -- -pid``
         reaches the user function's own children too — no orphan pids left
-        accruing billed TPU time.  Deliberately does NOT go through
-        :meth:`cancel`: escalation is a *failure* being classified for
-        retry, and must never read as a user cancellation.
+        accruing billed TPU time.  The KILL pass is what makes this safe
+        for stalls: a truly wedged (e.g. stopped) process may never act on
+        TERM.  Deliberately does NOT go through :meth:`cancel`: escalation
+        is a *failure* being classified for retry, and must never read as
+        a user cancellation.
         """
         obs_events.emit(
-            "task.timeout_escalated",
+            "task.timeout_escalated"
+            if reason == "timeout"
+            else "task.stall_escalated",
             operation_id=operation_id,
             timeout_s=self.task_timeout,
+            **({"stall_after_s": self._stall_after()}
+               if reason != "timeout" else {}),
             pids=pids,
         )
-        app_log.warning(
-            "task %s exceeded task_timeout=%.1fs; killing the gang (%s)",
-            operation_id, self.task_timeout, pids,
-        )
+        if reason == "timeout":
+            app_log.warning(
+                "task %s exceeded task_timeout=%.1fs; killing the gang (%s)",
+                operation_id, self.task_timeout, pids,
+            )
+        else:
+            app_log.warning(
+                "task %s stalled (no heartbeat for %.1fs); killing the "
+                "gang (%s)",
+                operation_id, self._stall_after(), pids,
+            )
 
         def group_kill(pid: int, sig: str) -> str:
             # `kill -s SIG -- -pid`: the one group-kill spelling both bash
@@ -1872,6 +2270,10 @@ class TPUExecutor(RemoteExecutor):
                 staged.remote_spec_file(process_id),
                 staged.remote_log_file,
                 f"{staged.remote_pid_file}.{process_id}",
+                # Liveness/telemetry side-band artifacts.
+                staged.remote_telemetry_file(process_id),
+                staged.remote_hb_file(process_id),
+                f"{staged.remote_pid_file}.{process_id}.metrics",
             ]
             if process_id == 0:
                 files.append(staged.remote_result_file)
@@ -1980,6 +2382,7 @@ class TPUExecutor(RemoteExecutor):
         # From here on, run() stops deferring cleanup (inline instead): a
         # task scheduled after this drain begins would race the pool close.
         self._closing = True
+        unregister_status_provider(self._ops_provider_name)
         pending = [t for t in self._cleanup_tasks if not t.done()]
         loop = asyncio.get_running_loop()
         foreign = [t for t in pending if t.get_loop() is not loop]
@@ -2095,58 +2498,77 @@ class TPUExecutor(RemoteExecutor):
         deadline: Deadline,
     ) -> Any:
         attempt = 0
-        while True:
-            operation_id = (
-                base_operation_id
-                if attempt == 0
-                else f"{base_operation_id}.r{attempt}"
-            )
-            self.last_attempts = attempt + 1
-            if len(self._op_attempts) > 1024:  # unread entries (direct API use)
-                self._op_attempts.pop(next(iter(self._op_attempts)))
-            self._op_attempts[base_operation_id] = attempt + 1
-            try:
-                return await self._run_attempt(
-                    function, args, kwargs, task_metadata,
-                    operation_id, attempt, deadline,
+        # One span — one TRACE — for the whole electron, however many gang
+        # attempts it takes: each attempt's `executor.run` root parents
+        # here (or under the ambient workflow.node span when dispatched
+        # through the runner), so a single trace id follows the electron
+        # across retries with the attempt number as a span attribute.
+        task_span = Span(
+            "executor.task",
+            {
+                "operation_id": base_operation_id,
+                "max_retries": policy.max_retries,
+            },
+        )
+        task_span.__enter__()
+        try:
+            while True:
+                operation_id = (
+                    base_operation_id
+                    if attempt == 0
+                    else f"{base_operation_id}.r{attempt}"
                 )
-            except _RetryDispatch as retry:
-                TASK_RETRIES_TOTAL.labels(reason=retry.reason).inc()
-                delay = policy.delay(attempt)
-                remaining = deadline.remaining()
-                if remaining is not None:
-                    # The wall budget bounds when new attempts may START
-                    # (an in-flight attempt is never killed by it): never
-                    # sleep past it, and the next failure's should_retry
-                    # sees the expired deadline and takes the terminal
-                    # path.
-                    delay = min(delay, remaining)
-                app_log.warning(
-                    "task %s attempt %d/%d failed (%s: %s); retrying in "
-                    "%.2fs%s",
-                    base_operation_id, attempt + 1, policy.max_retries + 1,
-                    retry.reason, retry.message, delay,
-                    " after redial" if retry.redial else "",
-                )
-                obs_events.emit(
-                    "task.retry",
-                    operation_id=operation_id,
-                    attempt=attempt + 1,
-                    max_retries=policy.max_retries,
-                    reason=retry.reason,
-                    delay_s=round(delay, 3),
-                    redial=retry.redial,
-                    error=retry.message,
-                )
-                if retry.redial and retry.conns:
-                    await self._discard_workers(retry.conns)
-                if delay:
-                    await asyncio.sleep(delay)
-                if self._is_cancelled(base_operation_id):
-                    raise asyncio.CancelledError(
-                        f"task {base_operation_id} cancelled between retries"
+                self.last_attempts = attempt + 1
+                if len(self._op_attempts) > 1024:  # unread (direct API use)
+                    self._op_attempts.pop(next(iter(self._op_attempts)))
+                self._op_attempts[base_operation_id] = attempt + 1
+                try:
+                    return await self._run_attempt(
+                        function, args, kwargs, task_metadata,
+                        operation_id, attempt, deadline,
                     )
-                attempt += 1
+                except _RetryDispatch as retry:
+                    TASK_RETRIES_TOTAL.labels(reason=retry.reason).inc()
+                    delay = policy.delay(attempt)
+                    remaining = deadline.remaining()
+                    if remaining is not None:
+                        # The wall budget bounds when new attempts may
+                        # START (an in-flight attempt is never killed by
+                        # it): never sleep past it, and the next failure's
+                        # should_retry sees the expired deadline and takes
+                        # the terminal path.
+                        delay = min(delay, remaining)
+                    app_log.warning(
+                        "task %s attempt %d/%d failed (%s: %s); retrying in "
+                        "%.2fs%s",
+                        base_operation_id, attempt + 1,
+                        policy.max_retries + 1,
+                        retry.reason, retry.message, delay,
+                        " after redial" if retry.redial else "",
+                    )
+                    obs_events.emit(
+                        "task.retry",
+                        operation_id=operation_id,
+                        attempt=attempt + 1,
+                        max_retries=policy.max_retries,
+                        reason=retry.reason,
+                        delay_s=round(delay, 3),
+                        redial=retry.redial,
+                        error=retry.message,
+                    )
+                    if retry.redial and retry.conns:
+                        await self._discard_workers(retry.conns)
+                    if delay:
+                        await asyncio.sleep(delay)
+                    if self._is_cancelled(base_operation_id):
+                        raise asyncio.CancelledError(
+                            f"task {base_operation_id} cancelled between "
+                            "retries"
+                        )
+                    attempt += 1
+        finally:
+            task_span.set_attribute("attempts", attempt + 1)
+            task_span.end()
 
     async def _run_attempt(
         self,
@@ -2199,6 +2621,18 @@ class TPUExecutor(RemoteExecutor):
             state="starting",
             trace_id=root.trace_id,
         )
+        # Live ops view (/status): stage advances at each lifecycle edge.
+        self._op_status[operation_id] = {
+            "stage": "starting",
+            "attempt": attempt + 1,
+            "trace_id": root.trace_id,
+            "dispatch_id": dispatch_id,
+            "node_id": node_id,
+            "since": time.time(),
+        }
+        # Worker-side records join this attempt's trace (same trace id
+        # across attempts — the parent executor.task span owns it).
+        trace_context = context_of(root, attempt=attempt)
         outcome = "failed"
         staged: StagedTask | None = None
         conns: list[Transport] = []
@@ -2259,6 +2693,7 @@ class TPUExecutor(RemoteExecutor):
                         current_remote_workdir,
                         pip_deps=task_metadata.get("pip_deps", ()),
                         payload=staged_payload,
+                        trace=trace_context,
                     )
 
             stage_task = asyncio.create_task(asyncio.to_thread(_stage))
@@ -2268,6 +2703,7 @@ class TPUExecutor(RemoteExecutor):
             stage_task.add_done_callback(
                 lambda t: None if t.cancelled() else t.exception()
             )
+            self._op_status[operation_id]["stage"] = "connecting"
             try:
                 with Span("executor.connect"):
                     conns = await self._connect_all()
@@ -2326,6 +2762,7 @@ class TPUExecutor(RemoteExecutor):
             # after a successful connect — same precedence as before.
             staged = await stage_task
 
+            self._op_status[operation_id]["stage"] = "launching"
             try:
                 # Leg 2: per-worker upload -> launch pipelines with no
                 # global barrier between the stages (worker 0 can launch
@@ -2383,6 +2820,17 @@ class TPUExecutor(RemoteExecutor):
                 pids=pids,
             )
             addresses = self._worker_addresses()
+            self._op_status[operation_id]["stage"] = "executing"
+            if self.heartbeat_interval > 0:
+                # Liveness bookkeeping for this attempt, then the telemetry
+                # side-band on every agent-launched worker (best-effort).
+                MONITOR.watch(
+                    operation_id,
+                    self._stall_after(),
+                    workers=addresses,
+                    interval=self.heartbeat_interval,
+                )
+                await self._start_backhaul(operation_id, staged)
             try:
                 with Span("executor.execute"):
                     agents = self._op_agents.get(operation_id, [])
@@ -2401,15 +2849,31 @@ class TPUExecutor(RemoteExecutor):
                         raise asyncio.CancelledError(
                             f"task {operation_id} cancelled"
                         )
-                    if status is TaskStatus.TIMEOUT:
-                        # task_timeout escalates: kill the whole gang
-                        # (TERM, grace, KILL) instead of abandoning RUNNING
-                        # processes on billed TPU time, then classify the
-                        # timeout as transient for the retry budget.
+                    if status is TaskStatus.STALLED:
+                        # Confirmed verdict (the pollers already re-read
+                        # the snapshot ground truth): count it here, not
+                        # at suspicion time in the monitor.
+                        STALLS_TOTAL.labels(worker=addresses[blamed]).inc()
+                    if status in (TaskStatus.TIMEOUT, TaskStatus.STALLED):
+                        # Both escalate: kill the whole gang (TERM, grace,
+                        # KILL) instead of abandoning RUNNING processes on
+                        # billed TPU time.  KILL matters doubly for stalls
+                        # — a SIGSTOP'd/wedged harness may never act on
+                        # TERM — then the failure classifies as transient
+                        # for the retry budget.
                         await self._escalate_timeout(
-                            operation_id, conns, addresses, pids
+                            operation_id, conns, addresses, pids,
+                            reason=(
+                                "timeout"
+                                if status is TaskStatus.TIMEOUT
+                                else "stall"
+                            ),
                         )
                     log_tail = await self._remote_log_tail(conns[blamed], staged)
+                    telemetry_tail = await self._remote_telemetry_tail(
+                        conns[blamed], staged, blamed
+                    )
+                    last_beats = MONITOR.last(operation_id)
                     obs_events.emit(
                         "task.failed",
                         operation_id=operation_id,
@@ -2417,32 +2881,69 @@ class TPUExecutor(RemoteExecutor):
                         worker=addresses[blamed],
                         status=status.value,
                         log_tail=log_tail,
-                    )
-                    failure_msg = (
-                        f"remote task {operation_id} timed out after "
-                        f"{self.task_timeout:.1f}s on {addresses[blamed]}; "
-                        f"gang killed; log tail:\n{log_tail}"
-                        if status is TaskStatus.TIMEOUT
-                        else f"remote task {operation_id} failed on "
-                        f"{addresses[blamed]} ({status.value}); "
-                        f"log tail:\n{log_tail}"
-                    )
-                    retry = self._plan_retry(
-                        attempt,
-                        deadline,
-                        reason=(
-                            "timeout"
-                            if status is TaskStatus.TIMEOUT
-                            else "worker_dead"
+                        **(
+                            {"telemetry_tail": telemetry_tail}
+                            if telemetry_tail
+                            else {}
                         ),
-                        message=failure_msg,
-                        conns=conns,
+                        **(
+                            {"last_heartbeats": last_beats}
+                            if last_beats
+                            else {}
+                        ),
                     )
-                    if status is not TaskStatus.TIMEOUT:
+                    if status is TaskStatus.TIMEOUT:
+                        failure_msg = (
+                            f"remote task {operation_id} timed out after "
+                            f"{self.task_timeout:.1f}s on "
+                            f"{addresses[blamed]}; gang killed; log tail:\n"
+                            f"{log_tail}"
+                        )
+                    elif status is TaskStatus.STALLED:
+                        silence = last_beats.get(addresses[blamed], {}).get(
+                            "age_s"
+                        )
+                        failure_msg = (
+                            f"remote task {operation_id} stalled on "
+                            f"{addresses[blamed]}: process alive but no "
+                            f"heartbeat for "
+                            f"{silence if silence is not None else '?'}s "
+                            f"(threshold {self._stall_after():.1f}s); gang "
+                            f"killed; log tail:\n{log_tail}"
+                        )
+                    else:
+                        failure_msg = (
+                            f"remote task {operation_id} failed on "
+                            f"{addresses[blamed]} ({status.value}); "
+                            f"log tail:\n{log_tail}"
+                        )
+                    if status is TaskStatus.STALLED:
+                        # Route through the classifier: WorkerStalledError
+                        # is the liveness layer's fault type, keeping its
+                        # own retry-reason label.
+                        retry = self._plan_retry(
+                            attempt, deadline,
+                            error=WorkerStalledError(failure_msg),
+                            message=failure_msg, conns=conns,
+                        )
+                    else:
+                        retry = self._plan_retry(
+                            attempt,
+                            deadline,
+                            reason=(
+                                "timeout"
+                                if status is TaskStatus.TIMEOUT
+                                else "worker_dead"
+                            ),
+                            message=failure_msg,
+                            conns=conns,
+                        )
+                    if status not in (TaskStatus.TIMEOUT, TaskStatus.STALLED):
                         # Tear the rest of the gang down (escalation already
-                        # did for timeouts) WITHOUT the cancelled mark: this
-                        # is failure cleanup, not a user cancel, and it must
-                        # not clobber (or fake) one arriving concurrently.
+                        # did for timeouts/stalls) WITHOUT the cancelled
+                        # mark: this is failure cleanup, not a user cancel,
+                        # and it must not clobber (or fake) one arriving
+                        # concurrently.
                         await self.cancel(operation_id, mark=False)
                     if retry is not None:
                         outcome = "retried"
@@ -2462,6 +2963,7 @@ class TPUExecutor(RemoteExecutor):
                     with Span("executor.reap"):
                         await self._await_stragglers(conns, staged, pids)
 
+                self._op_status[operation_id]["stage"] = "fetching"
                 with Span("executor.fetch"):
                     result, exception = await self.query_result(
                         conns[0], staged, key=self._pool_key(addresses[0])
@@ -2537,6 +3039,12 @@ class TPUExecutor(RemoteExecutor):
             _ACTIVE_ELECTRONS.dec()
             _TASKS_TOTAL.labels(outcome=outcome).inc()
             _OVERHEAD_HIST.observe(root.overhead())
+            # The wall view (elapsed minus execute) is the number the
+            # overhead budget is asserted against — give it its own
+            # percentile-capable series, not just a per-run scalar.
+            _WALL_OVERHEAD_HIST.observe(self.last_timings["wall_overhead"])
+            self._op_status.pop(operation_id, None)
+            MONITOR.forget(operation_id)
             obs_events.emit(
                 "task.state",
                 operation_id=operation_id,

@@ -27,6 +27,7 @@ from typing import Any, Callable
 
 from ..obs import events as obs_events
 from ..obs.metrics import REGISTRY
+from ..obs.opsserver import ensure_ops_server, register_status_provider
 from ..obs.trace import Span
 from ..utils.log import app_log
 from .dag import Graph, Lattice, Node
@@ -336,7 +337,27 @@ def _dispatcher_loop() -> asyncio.AbstractEventLoop:
                 target=loop.run_forever, name="covalent-tpu-dispatcher", daemon=True
             ).start()
             _LOOP = loop
+            # The dispatcher process is what operators point the ops
+            # endpoint at: start it (env-gated no-op otherwise) and expose
+            # the live dispatch table on /status.
+            ensure_ops_server()
+            register_status_provider("workflow", _workflow_status)
         return _LOOP
+
+
+def _workflow_status() -> dict:
+    """The runner's /status view: every retained dispatch and its state."""
+    with _RESULTS_LOCK:
+        dispatches = {
+            dispatch_id: result.status.value
+            for dispatch_id, result in _RESULTS.items()
+        }
+    return {
+        "dispatches": dispatches,
+        "running": sorted(
+            d for d, s in dispatches.items() if s == Status.RUNNING.value
+        ),
+    }
 
 
 def dispatch(lattice: Lattice) -> Callable[..., str]:

@@ -371,11 +371,16 @@ def test_full_run_produces_ordered_span_set(tmp_path, run_async, events_file):
     states = [e["state"] for e in events if e["type"] == "task.state"]
     assert states == ["starting", "submitted", "completed"]
     # The worker harness joined the same JSONL stream (shared fs).
+    # Heartbeats interleave on their own cadence (covered in
+    # test_fleetobs); the lifecycle pair must bracket them.
     worker = [e for e in events if e["type"].startswith("worker.")]
-    assert [e["type"] for e in worker] == [
-        "worker.task_started", "worker.task_finished",
-    ]
+    assert [
+        e["type"] for e in worker if e["type"] != "worker.heartbeat"
+    ] == ["worker.task_started", "worker.task_finished"]
     assert all(e["operation_id"] == "obs_0" for e in worker)
+    # Trace propagation: every worker-side record joined the dispatch
+    # trace stamped into the task spec.
+    assert all(e["trace_id"] == root["trace_id"] for e in worker)
     # last_timings kept its pre-obs contract, fed by the same spans.
     assert ex.last_timings["overhead"] == pytest.approx(
         sum(s["duration_s"] for s in children if s["name"] != "executor.execute"),
