@@ -45,7 +45,7 @@ BENCH_PHASES = {
     for phase in os.environ.get(
         "BENCH_PHASES",
         "overhead,obs_tax,fanout,cached_fanout,bundled_fanout,"
-        "chaos_fanout,tpu",
+        "chaos_fanout,sched_fanout,tpu",
     ).split(",")
     if phase.strip()
 }
@@ -2072,6 +2072,156 @@ async def main() -> None:
         emit({"phase": "chaos_fanout", "skipped": "BENCH_PHASES"})
     except Exception as error:  # noqa: BLE001
         emit({"phase": "chaos_fanout", "error": repr(error)})
+
+    # ---- phase 2d: fleet scheduler fan-out vs naive 1:1 dispatch ---------
+    # 16 electrons, 2 tenants, through the fleet work queue onto 2 warm
+    # local pools (bin-packed onto pooled gangs, deficit-round-robin
+    # fairness between the tenants) vs the pre-fleet shape: one FRESH
+    # executor per electron, mapped 1:1 and dispatched sequentially.  The
+    # scheduler arm's wall includes its own prewarm, so the comparison
+    # charges the fleet for warming its gangs; warm-gang reuse must still
+    # show as strictly fewer transport dials (connects < electrons) at
+    # wall no worse than the naive arm's.
+    try:
+        if "sched_fanout" not in BENCH_PHASES:
+            raise _PhaseSkipped
+        from covalent_tpu_plugin.fleet import FleetExecutor
+
+        SCHED_ELECTRONS = 16
+
+        def pool_connect_misses() -> float:
+            """Fresh transport dials (pool misses) recorded so far."""
+            return sum(
+                value for key, value in metrics_totals().items()
+                if key.startswith("covalent_tpu_pool_acquires_total{")
+                and "result=miss" in key
+            )
+
+        def sched_task_env() -> dict:
+            return {
+                "PYTHONPATH": repo_root + os.pathsep
+                + os.environ.get("PYTHONPATH", ""),
+            }
+
+        def sched_pool(tag: str, capacity: int) -> dict:
+            return {
+                "name": tag,
+                "transport": "local",
+                "capacity": capacity,
+                "executor": {
+                    "cache_dir": f"{workdir}/cache_sched_{tag}",
+                    "remote_cache": f"{workdir}/remote_sched_{tag}",
+                    "python_path": sys.executable,
+                    "poll_freq": 0.2,
+                    "use_agent": False,
+                    "prewarm": True,
+                    "task_env": sched_task_env(),
+                },
+            }
+
+        async def naive_arm() -> dict:
+            connects0 = pool_connect_misses()
+            t0 = time.perf_counter()
+            results = []
+            for i in range(SCHED_ELECTRONS):
+                ex = TPUExecutor(
+                    transport="local",
+                    cache_dir=f"{workdir}/cache_sched_naive",
+                    remote_cache=f"{workdir}/remote_sched_naive_{i}",
+                    python_path=sys.executable,
+                    poll_freq=0.2,
+                    use_agent=False,
+                    prewarm=False,
+                    task_env=sched_task_env(),
+                )
+                try:
+                    results.append(await ex.run(
+                        trivial_electron, [i], {},
+                        {"dispatch_id": "schednaive", "node_id": i},
+                    ))
+                finally:
+                    await ex.close()
+            return {
+                "wall_s": time.perf_counter() - t0,
+                "connects": pool_connect_misses() - connects0,
+                "results": results,
+            }
+
+        async def fleet_arm() -> dict:
+            fleet = FleetExecutor(
+                pools=[sched_pool("sa", 4), sched_pool("sb", 4)],
+                ensure_fallback=False,
+            )
+            try:
+                connects0 = pool_connect_misses()
+                t0 = time.perf_counter()
+                # Warm both gangs THEN pack the whole backlog onto them:
+                # the dial + pre-flight cost is inside the measured wall.
+                await fleet.prewarm()
+                results = await asyncio.gather(*(
+                    fleet.run(
+                        trivial_electron, [i], {},
+                        {"dispatch_id": "schedfleet", "node_id": i,
+                         "tenant": "heavy" if i % 2 else "light"},
+                    )
+                    for i in range(SCHED_ELECTRONS)
+                ))
+                wall = time.perf_counter() - t0
+                connects = pool_connect_misses() - connects0
+                status = fleet.scheduler.status()
+                placements = {
+                    name: view["placed_total"]
+                    for name, view in status["pools"].items()
+                }
+                decisions = dict(fleet.scheduler.decisions)
+            finally:
+                await fleet.close()
+            return {
+                "wall_s": wall,
+                "connects": connects,
+                "results": list(results),
+                "placements": placements,
+                "decisions": decisions,
+            }
+
+        async def sched_phase():
+            return await naive_arm(), await fleet_arm()
+
+        naive, fleet_run = await asyncio.wait_for(
+            sched_phase(), FANOUT_BUDGET_S * 2
+        )
+        assert fleet_run["results"] == naive["results"], (
+            fleet_run["results"], naive["results"])
+        summary["sched_fanout_wall_s"] = round(fleet_run["wall_s"], 3)
+        summary["sched_fanout_naive_wall_s"] = round(naive["wall_s"], 3)
+        summary["sched_fanout_connects"] = round(fleet_run["connects"], 1)
+        summary["sched_fanout_naive_connects"] = round(naive["connects"], 1)
+        summary["sched_fanout_placements"] = fleet_run["placements"]
+        summary["sched_fanout_decisions"] = fleet_run["decisions"]
+        # Warm-gang bin-packing: 16 electrons over 2 pooled gangs dial a
+        # handful of channels, never one per electron.
+        summary["sched_fanout_fewer_connects"] = bool(
+            fleet_run["connects"] < SCHED_ELECTRONS
+        )
+        summary["sched_fanout_no_slower"] = bool(
+            fleet_run["wall_s"] <= naive["wall_s"]
+        )
+        emit({
+            "phase": "sched_fanout",
+            "electrons": SCHED_ELECTRONS,
+            "wall_s": summary["sched_fanout_wall_s"],
+            "naive_wall_s": summary["sched_fanout_naive_wall_s"],
+            "connects": summary["sched_fanout_connects"],
+            "naive_connects": summary["sched_fanout_naive_connects"],
+            "placements": fleet_run["placements"],
+            "decisions": fleet_run["decisions"],
+            "fewer_connects": summary["sched_fanout_fewer_connects"],
+            "no_slower": summary["sched_fanout_no_slower"],
+        })
+    except _PhaseSkipped:
+        emit({"phase": "sched_fanout", "skipped": "BENCH_PHASES"})
+    except Exception as error:  # noqa: BLE001
+        emit({"phase": "sched_fanout", "error": repr(error)})
 
     # ---- phase 3: all accelerator work, ONE electron, ONE backend init ---
     # The whole phase lives under ONE wall-clock deadline (the old

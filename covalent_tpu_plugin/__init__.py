@@ -15,6 +15,7 @@ built-in minimal workflow layer (``electron``/``lattice``/``dispatch``/
 
 from . import obs
 from .cache import CASIndex, ResultCache
+from .fleet import FleetExecutor, FleetScheduler, PoolRegistry, PoolSpec
 from .resilience import CircuitBreaker, Deadline, RetryPolicy
 from .tpu import EXECUTOR_PLUGIN_NAME, TPUExecutor
 from .transport import ChaosPlan, ChaosTransport
@@ -30,6 +31,10 @@ __all__ = [
     "Deadline",
     "ChaosPlan",
     "ChaosTransport",
+    "FleetExecutor",
+    "FleetScheduler",
+    "PoolRegistry",
+    "PoolSpec",
 ]
 
 __version__ = "0.1.0"

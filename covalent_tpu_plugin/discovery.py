@@ -86,6 +86,47 @@ def discover_tpu_endpoints(
     return endpoints
 
 
+def discover_pool_spec(
+    tpu_name: str,
+    zone: str = "",
+    project: str = "",
+    capacity: int = 1,
+    name: "str | None" = None,
+    prefer_external: bool = True,
+    timeout: float = 60.0,
+) -> dict:
+    """A fleet pool spec dict resolved from one TPU's live endpoints.
+
+    The fleet-registry wiring: ``discover_tpu_endpoints()`` results become
+    a registrable pool spec (``PoolRegistry.register`` /
+    ``register_tpu``), so a fleet is stood up from TPU names without
+    hand-listing workers.  The control plane keeps the same external-IP
+    preference the executor uses; discovery failures propagate as
+    :class:`DiscoveryError` rather than registering an empty pool.
+    """
+    endpoints = discover_tpu_endpoints(
+        tpu_name, zone=zone, project=project, timeout=timeout
+    )
+    workers = [
+        (ext or int_) if prefer_external else (int_ or ext)
+        for ext, int_ in endpoints
+    ]
+    return {
+        "name": name or tpu_name,
+        "workers": tuple(workers),
+        "capacity": max(1, int(capacity)),
+        "tpu_name": tpu_name,
+        "zone": zone,
+        "project": project,
+        # The raw (external, internal) pairs ride along so the pool's
+        # executor can seed its discovery cache: one gcloud subprocess
+        # per registration, not a second at first dispatch (which could
+        # also disagree with the registered workers if the TPU was
+        # re-created in between).
+        "endpoints": tuple(endpoints),
+    }
+
+
 def discover_tpu_workers(
     tpu_name: str,
     zone: str = "",

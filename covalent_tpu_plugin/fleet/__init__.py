@@ -1,0 +1,47 @@
+"""Fleet scheduler tier: pool registry, fair work queue, placement engine.
+
+The executor layer (``tpu.py``) dispatches ONE electron onto ONE gang as
+fast and as safely as the transport allows; this package is the tier above
+it that operates a *fleet* under sustained traffic (ROADMAP item 1; the
+Podracer architectures — Anakin/Sebulba, arXiv:2104.06272 — are the
+blueprint: centralized queues feeding pools of TPU workers, with placement
+decoupled from execution):
+
+* :mod:`fleet.lease` — the :class:`GangLease` seam splitting the
+  executor's run-attempt state machine from gang *ownership*
+  (acquire / pre-flight / discard), so a scheduler — not the executor —
+  can own placement.
+* :mod:`fleet.pools` — named executor pools (slice shape, capacity, a
+  CPU/local fallback), registrable from config/env
+  (``COVALENT_TPU_POOLS``) or from ``discovery.py`` endpoints.
+* :mod:`fleet.queue` — bounded admission-controlled work queue with
+  per-tenant weighted fairness (deficit round-robin).
+* :mod:`fleet.scheduler` — bin-packed placement of queued electrons onto
+  *warm* gangs, breaker-aware rerouting, autoscale watermark hooks.
+* :mod:`fleet.executor` — the :class:`FleetExecutor` facade keeping the
+  ``@ct.electron(executor=...)`` surface: electrons submitted through it
+  ride the queue instead of mapping 1:1 onto gangs.
+"""
+
+from .executor import FleetExecutor, default_scheduler, reset_default_scheduler
+from .lease import GangLease
+from .pools import Pool, PoolRegistry, PoolSpec, parse_pool_specs
+from .queue import FairWorkQueue, QueueFullError, WorkItem
+from .scheduler import AutoscaleHook, FleetScheduler, LocalPoolAutoscaler
+
+__all__ = [
+    "AutoscaleHook",
+    "FairWorkQueue",
+    "FleetExecutor",
+    "FleetScheduler",
+    "GangLease",
+    "LocalPoolAutoscaler",
+    "Pool",
+    "PoolRegistry",
+    "PoolSpec",
+    "QueueFullError",
+    "WorkItem",
+    "default_scheduler",
+    "parse_pool_specs",
+    "reset_default_scheduler",
+]

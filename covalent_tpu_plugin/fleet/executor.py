@@ -1,0 +1,185 @@
+"""FleetExecutor: the ``@ct.electron(executor=...)`` facade over the queue.
+
+Electrons keep the executor surface they already have — the workflow
+runner calls ``run(fn, args, kwargs, task_metadata)`` and awaits a result
+— but a ``FleetExecutor`` routes that call through the fleet work queue
+instead of mapping it 1:1 onto a gang: admission control applies, tenants
+share under deficit round-robin, and the placement engine bin-packs the
+electron onto whichever pool's warm gang fits best.
+
+Three spellings::
+
+    # 1. The process-wide default fleet (pools from COVALENT_TPU_POOLS /
+    #    the fleet.pools config key, CPU fallback auto-registered):
+    @ct.electron(executor="fleet")
+    def task(...): ...
+
+    # 2. Tenant/pool-tagged facades over the same shared scheduler:
+    heavy = FleetExecutor(tenant="batch")
+    @ct.electron(executor=heavy, metadata={"tenant": "batch"})
+
+    # 3. A private fleet (owns its scheduler; closed with the facade):
+    fleet = FleetExecutor(pools=[
+        {"name": "v5e", "workers": ["w1", "w2"], "capacity": 4},
+        {"name": "cpu", "fallback": True, "capacity": 2},
+    ])
+
+Electron metadata wins over the facade's defaults: the runner threads
+``metadata={"tenant": ..., "pool": ...}`` into ``task_metadata``, so one
+facade instance can serve many tenants.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Sequence
+
+from ..utils.config import get_config
+from ..utils.log import app_log
+from .pools import PoolRegistry, PoolSpec
+from .queue import DEFAULT_TENANT, FairWorkQueue
+from .scheduler import AutoscaleHook, FleetScheduler
+
+_default_lock = threading.Lock()
+_default: FleetScheduler | None = None
+
+
+def default_scheduler() -> FleetScheduler:
+    """The process-wide fleet scheduler, built lazily on first use.
+
+    Pools come from ``COVALENT_TPU_POOLS`` (or the ``fleet.pools`` config
+    key); a CPU/local fallback pool is always ensured so ``executor=
+    "fleet"`` works out of the box.  Queue knobs read the ``fleet.*``
+    config keys (``queue_depth``, ``admission``, ``tenant_weights``).
+    """
+    global _default
+    with _default_lock:
+        if _default is None:
+            registry = PoolRegistry.from_environment()
+            registry.ensure_fallback()
+            _default = FleetScheduler(registry, queue=_queue_from_config())
+        return _default
+
+
+def reset_default_scheduler() -> None:
+    """Forget the process default (tests; the old one is NOT closed —
+    callers holding electrons on it drain first)."""
+    global _default
+    with _default_lock:
+        _default = None
+
+
+def _queue_from_config() -> FairWorkQueue:
+    weights_raw = get_config("fleet.tenant_weights", {}) or {}
+    weights = {}
+    if isinstance(weights_raw, dict):
+        for tenant, weight in weights_raw.items():
+            try:
+                weights[str(tenant)] = float(weight)
+            except (TypeError, ValueError):
+                continue
+    return FairWorkQueue(
+        max_depth=int(get_config("fleet.queue_depth", 1024) or 0),
+        policy=str(get_config("fleet.admission", "reject") or "reject"),
+        weights=weights,
+    )
+
+
+class FleetExecutor:
+    """Queue-routed executor facade (``executor="fleet"`` registers one).
+
+    ``scheduler`` binds an explicit scheduler; ``pools`` builds a private
+    one from specs (owned: ``close()`` tears it down); with neither, the
+    facade rides the shared process default.  ``tenant``/``pool`` are
+    defaults for electrons that carry no metadata of their own.
+    """
+
+    SHORT_NAME = "fleet"
+
+    def __init__(
+        self,
+        scheduler: FleetScheduler | None = None,
+        tenant: str = DEFAULT_TENANT,
+        pool: str | None = None,
+        pools: "Sequence[PoolSpec | dict] | None" = None,
+        queue: FairWorkQueue | None = None,
+        autoscale: AutoscaleHook | None = None,
+        ensure_fallback: bool = True,
+    ) -> None:
+        if scheduler is not None and pools is not None:
+            raise ValueError("pass either `scheduler` or `pools`, not both")
+        if pools is None and (queue is not None or autoscale is not None):
+            # Silently dropping a caller's bounded queue would disable
+            # the admission control they configured.
+            raise ValueError(
+                "queue=/autoscale= configure a PRIVATE scheduler and "
+                "require pools=; tune the shared fleet via the fleet.* "
+                "config keys (queue_depth, admission, tenant_weights) or "
+                "pass an explicit scheduler"
+            )
+        self.tenant = str(tenant)
+        self.pool = pool
+        self._owns_scheduler = False
+        if pools is not None:
+            registry = PoolRegistry()
+            for spec in pools:
+                registry.register(spec)
+            if ensure_fallback:
+                registry.ensure_fallback()
+            # Private fleets honor the same fleet.* config knobs as the
+            # shared default (an explicit queue always wins): queue_depth/
+            # admission/tenant_weights apply to the README's pools= shape.
+            scheduler = FleetScheduler(
+                registry,
+                queue=queue if queue is not None else _queue_from_config(),
+                autoscale=autoscale,
+            )
+            self._owns_scheduler = True
+        self._scheduler = scheduler
+
+    @property
+    def scheduler(self) -> FleetScheduler:
+        if self._scheduler is None:
+            self._scheduler = default_scheduler()
+        return self._scheduler
+
+    async def run(
+        self,
+        function: Callable,
+        args: list | tuple,
+        kwargs: dict,
+        task_metadata: dict,
+    ) -> Any:
+        metadata = dict(task_metadata or {})
+        metadata.setdefault("tenant", self.tenant)
+        if self.pool is not None:
+            metadata.setdefault("pool", self.pool)
+        return await self.scheduler.run(function, args, kwargs, metadata)
+
+    async def prewarm(self) -> bool:
+        """DAG-driven warm-up hook (the runner calls this on dep-blocked
+        nodes): warms the fleet's accelerator pools."""
+        return await self.scheduler.prewarm()
+
+    async def cancel(self, operation_id: str | None = None) -> None:
+        """Cancel one electron by operation id — or, on a PRIVATELY owned
+        fleet, everything.  A facade riding the shared scheduler refuses
+        the cancel-all spelling: other dispatches and facades share that
+        queue, and one caller's teardown must not fail their electrons."""
+        if operation_id is None and not self._owns_scheduler:
+            app_log.warning(
+                "FleetExecutor.cancel() without an operation id ignored: "
+                "this facade rides the shared fleet scheduler, and a "
+                "blanket cancel would kill other dispatches' electrons"
+            )
+            return
+        await self.scheduler.cancel(operation_id)
+
+    def attempts_of(self, operation_id: str) -> int:
+        return self.scheduler.attempts_of(operation_id)
+
+    async def close(self) -> None:
+        """Close a privately owned scheduler; shared ones stay up (other
+        facades and future dispatches ride them)."""
+        if self._owns_scheduler and self._scheduler is not None:
+            await self._scheduler.close()

@@ -1,0 +1,67 @@
+"""The GangLease seam: gang *ownership* split from the run-attempt machine.
+
+Historically ``TPUExecutor._run_attempt`` both *owned* its gang (dialing
+every worker, running pre-flight, warming agents, discarding channels on
+failure) and *drove* the attempt state machine over it (stage, upload,
+launch, poll, fetch, retry classification).  A fleet scheduler needs those
+concerns apart: placement — which pool's warm gang an electron lands on —
+belongs to the tier above the executor, while the attempt machine stays
+where the transport knowledge lives.
+
+:class:`GangLease` is that seam.  ``TPUExecutor.lease_gang()`` acquires a
+fully warmed gang (pooled connections + pre-flight + resident agents) and
+returns a lease; the attempt machine consumes the lease's channels, and the
+scheduler can hold/warm leases independently of any electron.  Ownership
+operations route through the lease:
+
+* ``lease.conns`` / ``lease.addresses`` — the gang's live channels.
+* ``lease.discard()`` — drop exactly these channels (a concurrent
+  electron's fresh redial under the same keys survives).
+
+The lease holds only a weak contract with its owner (duck-typed
+``_discard_workers``), so fakes/stub executors in tests can vend leases
+too.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+
+class GangLease:
+    """Ownership handle for one warm gang of workers.
+
+    Produced by ``TPUExecutor.lease_gang()`` after connect + pre-flight +
+    agent warm-up all succeeded; the holder may run one (or, bin-packed
+    over time, many) electrons over ``conns`` and must route teardown
+    through :meth:`discard` rather than closing channels directly.
+    """
+
+    __slots__ = ("_owner", "conns", "addresses")
+
+    def __init__(
+        self, owner: Any, conns: Sequence[Any], addresses: Sequence[str]
+    ) -> None:
+        self._owner = owner
+        self.conns = list(conns)
+        self.addresses = list(addresses)
+
+    def __len__(self) -> int:
+        return len(self.conns)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<GangLease {len(self.conns)} worker(s): {self.addresses}>"
+
+    @property
+    def owner(self) -> Any:
+        """The executor that vended this lease."""
+        return self._owner
+
+    async def discard(self) -> None:
+        """Drop exactly this lease's channels from the owner's pool.
+
+        Scoped the same way mid-run error teardown is: only the channels
+        this lease actually holds are discarded, so a concurrent
+        electron's fresh redial under the same pool key survives.
+        """
+        await self._owner._discard_workers(self.conns)

@@ -1,0 +1,470 @@
+"""Named executor pools: slice shape, capacity, warmth, breaker summary.
+
+A :class:`Pool` wraps ONE executor instance (its pooled transports ARE the
+warm gang) plus a capacity — how many electrons may run on that gang
+concurrently.  Bin-packing falls out of that shape: the scheduler places
+up to ``capacity`` queued electrons onto the same warm executor, so N
+electrons pay the gang's dial/pre-flight cost once instead of N times.
+
+Pools come from three places, all landing in one :class:`PoolRegistry`:
+
+* **Declared** — :class:`PoolSpec` built in code, from config
+  (``fleet.pools``) or the environment (``COVALENT_TPU_POOLS``); compact
+  form ``name=addr1+addr2@capN`` entries separated by ``;``, or a JSON
+  list/dict of spec objects.
+* **Discovered** — ``discovery.discover_pool_spec()`` resolves a TPU
+  name's worker endpoints into a registrable spec, so a fleet stands up
+  without hand-listing workers (compact form ``name=tpu:NAME@capN``
+  defers discovery to the executor's own ``tpu_name`` path).
+* **Fallback** — a CPU/local pool the registry can auto-provide, the
+  placement engine's target of last resort when every accelerator pool is
+  full or quarantined.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from ..obs.metrics import REGISTRY
+from ..utils.config import get_config
+from ..utils.log import app_log
+
+POOL_SLOTS = REGISTRY.gauge(
+    "covalent_tpu_pool_slots",
+    "Fleet pool slot occupancy by state",
+    ("pool", "state"),
+)
+
+POOLS_ENV = "COVALENT_TPU_POOLS"
+
+#: capacity applied when a spec (or compact entry) names none.
+DEFAULT_CAPACITY = 1
+#: capacity of the auto-provided CPU/local fallback pool.
+FALLBACK_CAPACITY = 2
+
+
+@dataclass
+class PoolSpec:
+    """Declarative description of one executor pool.
+
+    ``workers`` + ``transport`` (or ``tpu_name``/``zone``/``project`` for
+    discovery-backed pools) describe the slice; ``capacity`` is the number
+    of electrons the pool's warm gang runs concurrently; ``fallback``
+    marks the pool placement falls back to when accelerator pools are
+    saturated or breaker-quarantined.  ``executor`` carries extra
+    ``TPUExecutor`` kwargs verbatim (cache dirs, poll cadence, chaos —
+    whatever the deployment needs).
+    """
+
+    name: str
+    workers: tuple[str, ...] = ()
+    tpu_name: str = ""
+    zone: str = ""
+    project: str = ""
+    transport: str = ""
+    capacity: int = DEFAULT_CAPACITY
+    fallback: bool = False
+    executor: dict[str, Any] = field(default_factory=dict)
+    #: (external_ip, internal_ip) pairs from registration-time discovery;
+    #: seeds the executor's endpoint cache so a discovered pool's first
+    #: dispatch skips the duplicate gcloud subprocess.
+    endpoints: tuple[tuple[str, str], ...] = ()
+
+    def __post_init__(self) -> None:
+        self.workers = tuple(self.workers)
+        self.endpoints = tuple(
+            (str(external), str(internal))
+            for external, internal in self.endpoints
+        )
+        self.capacity = max(1, int(self.capacity))
+        if not self.name:
+            raise ValueError("pool spec needs a name")
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]) -> "PoolSpec":
+        known = {f for f in cls.__dataclass_fields__}  # noqa: C416
+        unknown = set(data) - known
+        if unknown:
+            raise ValueError(
+                f"unknown pool spec field(s) {sorted(unknown)} "
+                f"(known: {sorted(known)})"
+            )
+        return cls(**data)
+
+
+def _default_executor_factory(spec: PoolSpec) -> Any:
+    """Build the pool's executor from its spec (TPUExecutor for every
+    kind — ``transport="local"`` IS the CPU fallback shape)."""
+    from ..tpu import TPUExecutor  # deferred: tpu.py imports fleet.lease
+
+    kwargs: dict[str, Any] = dict(spec.executor)
+    if spec.workers:
+        kwargs.setdefault("workers", list(spec.workers))
+    if spec.tpu_name:
+        kwargs.setdefault("tpu_name", spec.tpu_name)
+        if spec.zone:
+            kwargs.setdefault("zone", spec.zone)
+        if spec.project:
+            kwargs.setdefault("project", spec.project)
+    if spec.transport:
+        kwargs.setdefault("transport", spec.transport)
+    elif not (spec.workers or spec.tpu_name or kwargs.get("hostname")):
+        # No topology at all: a local pool (the fallback shape).
+        kwargs.setdefault("transport", "local")
+    executor = TPUExecutor(**kwargs)
+    if spec.endpoints and executor.tpu_name:
+        executor.seed_endpoints(spec.endpoints)
+    return executor
+
+
+class Pool:
+    """One registered pool: spec + lazily built executor + slot accounting.
+
+    ``executor_factory`` is injectable so tests (and the scheduler's unit
+    tier) can vend stub executors; anything with an async
+    ``run(fn, args, kwargs, task_metadata)`` works, and warmth/breaker
+    views degrade gracefully when the optional surface
+    (``is_warm``/``gang_state``/``prewarm``/``close``) is absent.
+    """
+
+    def __init__(
+        self,
+        spec: PoolSpec,
+        executor_factory: Callable[[PoolSpec], Any] | None = None,
+        executor: Any = None,
+    ) -> None:
+        self.spec = spec
+        self._factory = executor_factory or _default_executor_factory
+        self._executor = executor
+        self.in_use = 0
+        #: electrons ever placed here (per-pool placement breakdown).
+        self.placed_total = 0
+        self._publish_slots()
+
+    # -- identity -----------------------------------------------------------
+
+    @property
+    def name(self) -> str:
+        return self.spec.name
+
+    @property
+    def capacity(self) -> int:
+        return self.spec.capacity
+
+    @capacity.setter
+    def capacity(self, value: int) -> None:
+        """Autoscale hooks resize pools by writing this (min 1)."""
+        self.spec.capacity = max(1, int(value))
+        self._publish_slots()
+
+    @property
+    def fallback(self) -> bool:
+        return self.spec.fallback
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (
+            f"<Pool {self.name}: {self.in_use}/{self.capacity} in use, "
+            f"warm={self.warm}>"
+        )
+
+    # -- executor + warmth --------------------------------------------------
+
+    @property
+    def started(self) -> bool:
+        return self._executor is not None
+
+    @property
+    def executor(self) -> Any:
+        if self._executor is None:
+            self._executor = self._factory(self.spec)
+        return self._executor
+
+    @property
+    def warm(self) -> bool:
+        """Whether the pool's gang holds live pre-flighted connections."""
+        if self._executor is None:
+            return False
+        return bool(getattr(self._executor, "is_warm", False))
+
+    def breaker_states(self) -> dict[str, str]:
+        """worker address -> circuit state (empty when unavailable)."""
+        if self._executor is None:
+            return {}
+        state_of = getattr(self._executor, "gang_state", None)
+        if state_of is None:
+            return {}
+        try:
+            return dict(state_of().get("breakers") or {})
+        except Exception:  # noqa: BLE001 - placement must not crash on a view
+            return {}
+
+    @property
+    def breaker_open(self) -> bool:
+        """True when ANY of the pool's workers is breaker-quarantined.
+
+        A gang launch is all-or-nothing, so one open worker makes the
+        whole pool unplaceable until its cooldown: placement routes
+        around it instead of burning the dial + retry envelope.
+        """
+        return any(
+            state == "open" for state in self.breaker_states().values()
+        )
+
+    # -- slot accounting ----------------------------------------------------
+
+    @property
+    def free_slots(self) -> int:
+        return max(0, self.capacity - self.in_use)
+
+    def place(self) -> None:
+        self.in_use += 1
+        self.placed_total += 1
+        self._publish_slots()
+
+    def release(self) -> None:
+        self.in_use = max(0, self.in_use - 1)
+        self._publish_slots()
+
+    def _publish_slots(self) -> None:
+        POOL_SLOTS.labels(pool=self.name, state="in_use").set(self.in_use)
+        POOL_SLOTS.labels(pool=self.name, state="free").set(self.free_slots)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    async def prewarm(self) -> bool:
+        """Best-effort gang warm-up (breaker-gated inside the executor)."""
+        warmer = getattr(self.executor, "prewarm", None)
+        if warmer is None:
+            return False
+        return bool(await warmer())
+
+    async def close(self) -> None:
+        if self._executor is None:
+            return
+        closer = getattr(self._executor, "close", None)
+        if closer is not None:
+            await closer()
+
+    def status(self) -> dict[str, Any]:
+        """This pool's contribution to the ops ``/status`` fleet view."""
+        return {
+            "capacity": self.capacity,
+            "in_use": self.in_use,
+            "free": self.free_slots,
+            "warm": self.warm,
+            "fallback": self.fallback,
+            "placed_total": self.placed_total,
+            "workers": list(self.spec.workers)
+            or ([self.spec.tpu_name] if self.spec.tpu_name else ["local"]),
+            "breakers": self.breaker_states(),
+        }
+
+
+def parse_pool_specs(text: str) -> list[PoolSpec]:
+    """Parse ``COVALENT_TPU_POOLS`` / ``fleet.pools`` into specs.
+
+    Two forms:
+
+    * JSON — a list of spec objects (or one object), field names matching
+      :class:`PoolSpec`: ``[{"name": "v5e", "workers": ["w1", "w2"],
+      "capacity": 4}, {"name": "cpu", "fallback": true}]``.
+    * Compact — ``;``-separated ``name=target@capN`` entries where
+      ``target`` is ``+``-joined worker addresses, ``tpu:NAME`` (deferred
+      gcloud discovery), or ``local`` (CPU fallback pool — implies
+      ``fallback`` unless other pools also claim it):
+      ``v5e=10.0.0.1+10.0.0.2@4;spare=tpu:my-v5e-8@2;cpu=local@2``.
+      Addresses may carry a login (``edge=ubuntu@10.0.0.9``): a trailing
+      ``@suffix`` is only read as capacity when it is numeric (or
+      ``cap``-prefixed, which always claims to be one).
+    """
+    text = (text or "").strip()
+    if not text:
+        return []
+    if text[0] in "[{":
+        data = json.loads(text)
+        if isinstance(data, dict):
+            data = [data]
+        return [PoolSpec.from_dict(dict(entry)) for entry in data]
+    specs: list[PoolSpec] = []
+    for entry in text.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        name, sep, target = entry.partition("=")
+        if not sep or not name.strip() or not target.strip():
+            raise ValueError(
+                f"bad pool entry {entry!r} (want name=target[@capN])"
+            )
+        target = target.strip()
+        capacity = DEFAULT_CAPACITY
+        head, sep, cap_text = target.rpartition("@")
+        if sep:
+            cap_text = cap_text.strip()
+            digits = (
+                cap_text[len("cap"):]
+                if cap_text.startswith("cap")
+                else cap_text
+            )
+            if digits.isdigit() and head.strip():
+                target, capacity = head.strip(), int(digits)
+            elif not head.strip() or not cap_text or cap_text.startswith("cap"):
+                raise ValueError(
+                    f"bad capacity in pool entry {entry!r}"
+                )
+            # else: the '@' belongs to a user@host worker address —
+            # capacity stays default unless an explicit @capN follows.
+        spec_kwargs: dict[str, Any] = {
+            "name": name.strip(), "capacity": capacity,
+        }
+        if target == "local":
+            spec_kwargs.update(transport="local", fallback=True)
+        elif target.startswith("tpu:"):
+            spec_kwargs["tpu_name"] = target[len("tpu:"):]
+        else:
+            spec_kwargs["workers"] = tuple(
+                w.strip() for w in target.split("+") if w.strip()
+            )
+        specs.append(PoolSpec(**spec_kwargs))
+    return specs
+
+
+class PoolRegistry:
+    """Named pools + the fallback, the placement engine's world view."""
+
+    def __init__(
+        self,
+        executor_factory: Callable[[PoolSpec], Any] | None = None,
+    ) -> None:
+        self._factory = executor_factory
+        self._pools: dict[str, Pool] = {}
+
+    def __len__(self) -> int:
+        return len(self._pools)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._pools
+
+    def get(self, name: str) -> Pool | None:
+        return self._pools.get(name)
+
+    def pools(self) -> list[Pool]:
+        return list(self._pools.values())
+
+    def register(
+        self,
+        spec: "PoolSpec | dict[str, Any]",
+        executor: Any = None,
+    ) -> Pool:
+        """Register (or replace) one pool; returns the live :class:`Pool`.
+
+        A replaced pool's started executor is closed (its pooled
+        transports and resident agents would otherwise leak for the
+        process lifetime) — asynchronously when an event loop is running,
+        with a logged warning otherwise.
+        """
+        if isinstance(spec, dict):
+            spec = PoolSpec.from_dict(spec)
+        displaced = self._pools.get(spec.name)
+        pool = Pool(spec, executor_factory=self._factory, executor=executor)
+        self._pools[spec.name] = pool
+        if displaced is not None and displaced.started:
+            try:
+                loop = asyncio.get_running_loop()
+            except RuntimeError:
+                app_log.warning(
+                    "pool %s replaced outside an event loop; the previous "
+                    "executor's connections could not be closed",
+                    spec.name,
+                )
+            else:
+                task = loop.create_task(displaced.close())
+                task.add_done_callback(
+                    lambda t: None if t.cancelled() else t.exception()
+                )
+        return pool
+
+    def register_tpu(
+        self,
+        tpu_name: str,
+        zone: str = "",
+        project: str = "",
+        capacity: int = DEFAULT_CAPACITY,
+        name: str | None = None,
+        prefer_external: bool = True,
+        timeout: float = 60.0,
+        **spec_kwargs: Any,
+    ) -> Pool:
+        """Resolve a TPU's workers via ``discovery.py`` and register them.
+
+        The satellite wiring: ``discover_tpu_endpoints()`` results become
+        a registrable pool spec, so a fleet stands up from TPU names
+        alone.  ``prefer_external``/``timeout`` forward to discovery (a
+        dispatcher inside the VPC wants internal IPs); remaining kwargs
+        land on the :class:`PoolSpec`.  Discovery failures propagate (a
+        pool that silently registered empty would be a placement black
+        hole).
+        """
+        from ..discovery import discover_pool_spec
+
+        data = discover_pool_spec(
+            tpu_name, zone=zone, project=project,
+            capacity=capacity, name=name,
+            prefer_external=prefer_external, timeout=timeout,
+        )
+        data.update(spec_kwargs)
+        return self.register(data)
+
+    def ensure_fallback(
+        self, capacity: int = FALLBACK_CAPACITY, **executor_kwargs: Any
+    ) -> Pool:
+        """The fallback pool, auto-registering a local/CPU one if absent."""
+        existing = self.fallback_pool()
+        if existing is not None:
+            return existing
+        return self.register(
+            PoolSpec(
+                name="local-fallback",
+                transport="local",
+                capacity=capacity,
+                fallback=True,
+                executor=dict(executor_kwargs),
+            )
+        )
+
+    def fallback_pool(self) -> Pool | None:
+        for pool in self._pools.values():
+            if pool.fallback:
+                return pool
+        return None
+
+    def total_capacity(self) -> int:
+        return sum(pool.capacity for pool in self._pools.values())
+
+    async def close(self) -> None:
+        for pool in self._pools.values():
+            try:
+                await pool.close()
+            except Exception as err:  # noqa: BLE001 - best-effort teardown
+                app_log.warning("pool %s close failed: %s", pool.name, err)
+
+    @classmethod
+    def from_environment(
+        cls,
+        env_value: str | None = None,
+        executor_factory: Callable[[PoolSpec], Any] | None = None,
+    ) -> "PoolRegistry":
+        """Registry from ``COVALENT_TPU_POOLS`` (or the ``fleet.pools``
+        config key when the env var is unset)."""
+        import os
+
+        if env_value is None:
+            env_value = os.environ.get(POOLS_ENV)
+        if env_value is None:
+            env_value = str(get_config("fleet.pools", "") or "")
+        registry = cls(executor_factory=executor_factory)
+        for spec in parse_pool_specs(env_value):
+            registry.register(spec)
+        return registry

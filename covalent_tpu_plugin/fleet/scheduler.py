@@ -1,0 +1,561 @@
+"""The fleet placement engine: queue pump, bin-packing, breaker-aware routing.
+
+One :class:`FleetScheduler` owns a :class:`~covalent_tpu_plugin.fleet.pools.
+PoolRegistry` and a :class:`~covalent_tpu_plugin.fleet.queue.FairWorkQueue`
+and runs a single pump task on the dispatcher event loop.  Each cycle it
+pops the fairest queued electron (deficit round-robin over tenants) and
+**bin-packs** it onto a pool: up to ``capacity`` electrons share one warm
+gang, so the gang's dial + pre-flight cost amortises across the whole
+backlog instead of being paid 1:1 per electron.
+
+Placement preference, in order: the electron's pinned pool (metadata
+``pool`` — a preference, not a constraint), accelerator pools over the
+CPU fallback, **warm** gangs over cold, then most free slots.  Pools with
+an OPEN circuit breaker on any worker are routed around entirely — the
+decision is counted ``rerouted`` — rather than burning the dial + retry
+envelope against a quarantined host; once every placeable pool is open,
+the pump idles on a short tick so cooldown-driven HALF_OPEN promotion
+re-admits pools without new traffic.
+
+Autoscale rides the queue depth: crossing the high watermark fires
+``on_high`` (edge-triggered), draining back to the low watermark fires
+``on_low``.  The default hook is a no-op; :class:`LocalPoolAutoscaler`
+resizes a named pool's capacity — the shape a cloud implementation
+(spin up a TPU slice, register the pool) plugs into.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import time
+import weakref
+from typing import Any, Callable
+
+from ..obs import events as obs_events
+from ..obs.metrics import REGISTRY
+from ..obs.opsserver import (
+    ensure_ops_server,
+    register_status_provider,
+    unregister_status_provider,
+)
+from ..utils.log import app_log
+from .pools import Pool, PoolRegistry
+from .queue import DEFAULT_TENANT, FairWorkQueue, QueueFullError, WorkItem
+
+SCHED_DECISIONS_TOTAL = REGISTRY.counter(
+    "covalent_tpu_sched_decisions_total",
+    "Fleet scheduler decisions by outcome",
+    ("outcome",),
+)
+
+#: pump idle tick while backlog exists but no pool is placeable (waits out
+#: breaker cooldowns without new traffic); releases wake it sooner.
+_BLOCKED_TICK_S = 0.25
+
+
+class AutoscaleHook:
+    """Watermark callbacks; the default implementation is a no-op.
+
+    ``on_high(depth, registry)`` fires once when the queue depth crosses
+    the high watermark (edge-triggered; re-arms after draining below the
+    low watermark); ``on_low(depth, registry)`` fires once on the way
+    back down.  Implementations spin pool capacity up/down — resize a
+    local pool, provision a TPU slice and ``registry.register`` it,
+    whatever the deployment can do.
+    """
+
+    def on_high(self, depth: int, registry: PoolRegistry) -> None:
+        """Queue pressure: add capacity if you can."""
+
+    def on_low(self, depth: int, registry: PoolRegistry) -> None:
+        """Pressure released: shed surplus capacity."""
+
+
+class LocalPoolAutoscaler(AutoscaleHook):
+    """Resize one named pool's slot count between min/max capacity.
+
+    The test/local implementation of the autoscale contract: scale-up
+    adds ``step`` slots (bounded by ``max_capacity``), scale-down removes
+    them (never below ``min_capacity``).  In-flight electrons are never
+    interrupted — capacity only bounds NEW placements.
+    """
+
+    def __init__(
+        self,
+        pool_name: str,
+        step: int = 1,
+        max_capacity: int = 8,
+        min_capacity: int = 1,
+    ) -> None:
+        self.pool_name = pool_name
+        self.step = max(1, int(step))
+        self.max_capacity = int(max_capacity)
+        self.min_capacity = max(1, int(min_capacity))
+        self.scale_ups = 0
+        self.scale_downs = 0
+
+    def on_high(self, depth: int, registry: PoolRegistry) -> None:
+        pool = registry.get(self.pool_name)
+        if pool is None or pool.capacity >= self.max_capacity:
+            return
+        pool.capacity = min(self.max_capacity, pool.capacity + self.step)
+        self.scale_ups += 1
+        obs_events.emit(
+            "fleet.scale_up",
+            pool=self.pool_name,
+            capacity=pool.capacity,
+            queue_depth=depth,
+        )
+
+    def on_low(self, depth: int, registry: PoolRegistry) -> None:
+        pool = registry.get(self.pool_name)
+        if pool is None or pool.capacity <= self.min_capacity:
+            return
+        pool.capacity = max(self.min_capacity, pool.capacity - self.step)
+        self.scale_downs += 1
+        obs_events.emit(
+            "fleet.scale_down",
+            pool=self.pool_name,
+            capacity=pool.capacity,
+            queue_depth=depth,
+        )
+
+
+class FleetScheduler:
+    """Fair queue + bin-packed, breaker-aware placement over a pool registry.
+
+    ``high_watermark``/``low_watermark`` of 0 pick defaults at check time
+    (high = 2× total pool capacity, min 4; low = 0 — "drained").
+    ``clock`` is injectable for deterministic tests.
+    """
+
+    _ids = itertools.count()
+
+    def __init__(
+        self,
+        registry: PoolRegistry,
+        queue: FairWorkQueue | None = None,
+        autoscale: AutoscaleHook | None = None,
+        high_watermark: int = 0,
+        low_watermark: int = 0,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.registry = registry
+        # NOT `queue or ...`: an empty FairWorkQueue is falsy (__len__).
+        # The default queue shares this scheduler's clock so queue_wait_s
+        # and oldest_age never mix two time sources under a fake clock.
+        self.queue = (
+            queue if queue is not None else FairWorkQueue(clock=clock)
+        )
+        self.autoscale = autoscale or AutoscaleHook()
+        self.high_watermark = max(0, int(high_watermark))
+        self.low_watermark = max(0, int(low_watermark))
+        self._clock = clock
+        self._above_high = False
+        self._closing = False
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._wake: asyncio.Event | None = None
+        self._pump_task: asyncio.Task | None = None
+        #: operation_id -> (pool, item, runner task) for in-flight electrons.
+        self._running: dict[str, tuple[Pool, WorkItem, asyncio.Task]] = {}
+        #: operation_id -> pool that ran it (attempts_of delegation);
+        #: bounded FIFO so direct-API users can't grow it unread.
+        self._ran: dict[str, Pool] = {}
+        self.decisions: dict[str, int] = {
+            "queued": 0, "placed": 0, "shed": 0, "rerouted": 0,
+        }
+
+        # Ops plane: the scheduler's live view under /status "fleet"
+        # (weakref provider, same pruning contract as the executor's).
+        ensure_ops_server()
+        self._ops_name = f"fleet:{next(self._ids)}"
+        ops_name = self._ops_name
+        self_ref = weakref.ref(
+            self, lambda _ref: unregister_status_provider(ops_name)
+        )
+
+        def _ops_provider():
+            scheduler = self_ref()
+            return scheduler.status() if scheduler is not None else None
+
+        register_status_provider(ops_name, _ops_provider)
+
+    # -- submission ---------------------------------------------------------
+
+    async def run(
+        self,
+        function: Callable,
+        args: tuple,
+        kwargs: dict,
+        task_metadata: dict,
+    ) -> Any:
+        """Queue one electron and await its result.
+
+        The executor-compatible entry point: admission control may raise
+        :class:`QueueFullError` immediately (classified permanent); an
+        admitted electron resolves with whatever the placed pool's
+        executor ``run`` returns or raises.
+        """
+        if self._closing:
+            raise RuntimeError("fleet scheduler is closed")
+        loop = asyncio.get_running_loop()
+        self._ensure_pump(loop)
+        item = WorkItem(
+            fn=function,
+            args=tuple(args or ()),
+            kwargs=dict(kwargs or {}),
+            task_metadata=dict(task_metadata or {}),
+            tenant=str(
+                (task_metadata or {}).get("tenant") or DEFAULT_TENANT
+            ),
+            future=loop.create_future(),
+        )
+        try:
+            shed = self.queue.put(item)
+        except QueueFullError:
+            self._count("shed")
+            obs_events.emit(
+                "fleet.shed",
+                operation_id=item.operation_id,
+                tenant=item.tenant,
+                depth=self.queue.depth,
+                policy=self.queue.policy,
+            )
+            raise
+        for victim in shed:
+            self._count("shed")
+            obs_events.emit(
+                "fleet.shed",
+                operation_id=victim.operation_id,
+                tenant=victim.tenant,
+                depth=self.queue.depth,
+                policy=self.queue.policy,
+            )
+            if victim.future is not None and not victim.future.done():
+                victim.future.set_exception(
+                    QueueFullError(
+                        f"electron {victim.operation_id} (tenant "
+                        f"{victim.tenant!r}) shed: queue at depth bound "
+                        f"({self.queue.max_depth})"
+                    )
+                )
+        self._count("queued")
+        obs_events.emit(
+            "fleet.queued",
+            operation_id=item.operation_id,
+            tenant=item.tenant,
+            depth=self.queue.depth,
+        )
+        self._check_high_watermark()
+        self._wake.set()
+        try:
+            return await item.future
+        except asyncio.CancelledError:
+            # The caller gave up (wait_for timeout, task cancel): don't
+            # leave the electron running detached on a capacity slot —
+            # unqueue it, or tear the placed attempt down through the
+            # owning executor's cancel (remote process groups included).
+            # Detached task: the caller's cancellation must not be
+            # blocked on the remote kill round trips.
+            cleanup = loop.create_task(self.cancel(item.operation_id))
+            cleanup.add_done_callback(
+                lambda t: None if t.cancelled() else t.exception()
+            )
+            raise
+
+    # -- pump ---------------------------------------------------------------
+
+    def _ensure_pump(self, loop: asyncio.AbstractEventLoop) -> None:
+        if (
+            self._pump_task is not None
+            and not self._pump_task.done()
+            and self._loop is loop
+        ):
+            return
+        if self._loop is not None and self._loop is not loop:
+            if not self._loop.is_closed() and self._loop.is_running():
+                raise RuntimeError(
+                    "FleetScheduler is bound to a different running event "
+                    "loop; one scheduler serves one dispatcher loop"
+                )
+            dropped = self.queue.drain()
+            if dropped:
+                # Their futures belong to the dead loop — unresolvable.
+                app_log.warning(
+                    "fleet scheduler moved event loops; dropping %d queued "
+                    "electron(s) from the previous loop", len(dropped),
+                )
+            # In-flight entries died with the old loop without running
+            # _run_item's finally: give their slots back, or the leaked
+            # capacity eventually deadlocks placement.
+            for pool, _item, _task in self._running.values():
+                pool.release()
+            self._running.clear()
+        self._loop = loop
+        self._wake = asyncio.Event()
+        self._pump_task = loop.create_task(self._pump())
+
+    async def _pump(self) -> None:
+        """The one placement loop: pop fairly, place greedily, park politely."""
+        while not self._closing:
+            if self.queue.depth == 0:
+                await self._wake.wait()
+                self._wake.clear()
+                continue
+            placed = self._place_next()
+            if placed:
+                continue
+            # Backlog exists but nothing is placeable (all pools full or
+            # breaker-open): sleep a short tick so breaker cooldowns can
+            # promote OPEN -> HALF_OPEN; a slot release wakes us sooner.
+            try:
+                await asyncio.wait_for(self._wake.wait(), _BLOCKED_TICK_S)
+            except asyncio.TimeoutError:
+                pass
+            else:
+                self._wake.clear()
+
+    def _has_placeable(self) -> bool:
+        """Whether ANY pool could take an electron right now (cheap: no
+        ranking) — the guard that keeps DRR pops slot-backed."""
+        return any(
+            pool.free_slots > 0 and not pool.breaker_open
+            for pool in self.registry.pools()
+        )
+
+    def _place_next(self) -> bool:
+        """Place the fairest queued electron; False when nothing placeable."""
+        if not self._has_placeable():
+            return False
+        item = self.queue.pop()
+        if item is None:
+            return False
+        if item.future is not None and item.future.done():
+            # Cancelled while queued (cancel() races the pump): skip it.
+            return True
+        pool, rerouted = self._select_pool(item)
+        if pool is None:
+            # Unreachable without an await between the placeable check
+            # and selection; requeue defensively rather than lose the
+            # electron (put preserves its original enqueue stamp).
+            self.queue.put(item)
+            return False
+        outcome = "rerouted" if rerouted else "placed"
+        self._count(outcome)
+        obs_events.emit(
+            "fleet.placed",
+            operation_id=item.operation_id,
+            tenant=item.tenant,
+            pool=pool.name,
+            rerouted=rerouted,
+            queue_wait_s=round(
+                max(0.0, self._clock() - item.enqueued_at), 4
+            ),
+            depth=self.queue.depth,
+        )
+        pool.place()
+        task = self._loop.create_task(self._run_item(pool, item))
+        self._running[item.operation_id] = (pool, item, task)
+        return True
+
+    def _select_pool(
+        self, item: WorkItem | None
+    ) -> tuple[Pool | None, bool]:
+        """``(chosen pool, rerouted?)`` for one electron (None = wait).
+
+        Preference: pinned pool first, accelerator pools before the
+        fallback, warm gangs before cold, most free slots first.
+        ``rerouted`` is True when a pool with free slots was skipped
+        because a worker breaker is OPEN — placement routed around the
+        quarantine instead of dialing into it.
+        """
+        available = [
+            pool for pool in self.registry.pools() if pool.free_slots > 0
+        ]
+        if not available:
+            return None, False
+        preferred = (
+            item.task_metadata.get("pool") if item is not None else None
+        )
+
+        def rank(pool: Pool):
+            return (
+                0 if pool.name == preferred else 1,
+                1 if pool.fallback else 0,
+                0 if pool.warm else 1,
+                -pool.free_slots,
+                pool.name,
+            )
+
+        ranked = sorted(available, key=rank)
+        placeable = [pool for pool in ranked if not pool.breaker_open]
+        if not placeable:
+            return None, False
+        # Rerouted means the quarantine CHANGED the decision: the pool we
+        # picked is not the one ranking would have picked — an open pool
+        # ranked below the winner diverted nothing and counts as placed.
+        rerouted = placeable[0] is not ranked[0]
+        return placeable[0], rerouted
+
+    async def _run_item(self, pool: Pool, item: WorkItem) -> None:
+        operation_id = item.operation_id
+        try:
+            result = await pool.executor.run(
+                item.fn, item.args, item.kwargs, item.task_metadata
+            )
+        except asyncio.CancelledError:
+            if item.future is not None and not item.future.done():
+                item.future.cancel()
+            raise
+        except BaseException as err:  # noqa: BLE001 - relayed to the caller
+            if item.future is not None and not item.future.done():
+                item.future.set_exception(err)
+        else:
+            if item.future is not None and not item.future.done():
+                item.future.set_result(result)
+        finally:
+            pool.release()
+            self._running.pop(operation_id, None)
+            if len(self._ran) > 1024:  # unread (direct API use)
+                self._ran.pop(next(iter(self._ran)))
+            self._ran[operation_id] = pool
+            if self._wake is not None:
+                self._wake.set()
+            self._check_low_watermark()
+
+    # -- watermarks ---------------------------------------------------------
+
+    def _high_mark(self) -> int:
+        if self.high_watermark > 0:
+            return self.high_watermark
+        return max(4, 2 * self.registry.total_capacity())
+
+    def _check_high_watermark(self) -> None:
+        depth = self.queue.depth
+        if not self._above_high and depth >= self._high_mark():
+            self._above_high = True
+            obs_events.emit(
+                "fleet.watermark_high",
+                depth=depth,
+                high_watermark=self._high_mark(),
+            )
+            try:
+                self.autoscale.on_high(depth, self.registry)
+            except Exception as err:  # noqa: BLE001 - hooks are advisory
+                app_log.warning("autoscale on_high failed: %s", err)
+
+    def _check_low_watermark(self) -> None:
+        depth = self.queue.depth
+        if self._above_high and depth <= self.low_watermark:
+            self._above_high = False
+            obs_events.emit(
+                "fleet.watermark_low",
+                depth=depth,
+                low_watermark=self.low_watermark,
+            )
+            try:
+                self.autoscale.on_low(depth, self.registry)
+            except Exception as err:  # noqa: BLE001 - hooks are advisory
+                app_log.warning("autoscale on_low failed: %s", err)
+
+    # -- executor-compatible surface ---------------------------------------
+
+    async def prewarm(self) -> bool:
+        """Best-effort warm-up of every non-fallback pool's gang."""
+        pools = [p for p in self.registry.pools() if not p.fallback]
+        if not pools:
+            return False
+        results = await asyncio.gather(
+            *(pool.prewarm() for pool in pools), return_exceptions=True
+        )
+        return any(r is True for r in results)
+
+    async def cancel(self, operation_id: str | None = None) -> None:
+        """Cancel queued (never-placed) and in-flight electrons.
+
+        Queued items resolve their futures cancelled without ever
+        touching a pool; placed items delegate to the owning executor's
+        ``cancel`` so remote process groups die too.
+        """
+
+        def matches(item: WorkItem) -> bool:
+            return operation_id is None or item.operation_id == operation_id
+
+        for item in self.queue.remove(matches):
+            if item.future is not None and not item.future.done():
+                item.future.cancel()
+            obs_events.emit(
+                "fleet.cancelled_queued",
+                operation_id=item.operation_id,
+                tenant=item.tenant,
+            )
+        for op, (pool, _item, _task) in list(self._running.items()):
+            if operation_id is not None and op != operation_id:
+                continue
+            canceller = getattr(pool.executor, "cancel", None)
+            if canceller is not None:
+                try:
+                    await canceller(op)
+                except Exception as err:  # noqa: BLE001 - best-effort kill
+                    app_log.warning(
+                        "fleet cancel %s on pool %s: %s", op, pool.name, err
+                    )
+
+    def attempts_of(self, operation_id: str) -> int:
+        """Delegate per-operation attempt counts to the pool that ran it."""
+        pool = self._ran.pop(operation_id, None)
+        if pool is None or not pool.started:
+            return 1
+        getter = getattr(pool.executor, "attempts_of", None)
+        return getter(operation_id) if getter is not None else 1
+
+    async def close(self) -> None:
+        """Stop the pump, fail queued work, close every pool executor."""
+        self._closing = True
+        unregister_status_provider(self._ops_name)
+        for item in self.queue.drain():
+            if item.future is not None and not item.future.done():
+                item.future.cancel()
+        if self._pump_task is not None:
+            self._pump_task.cancel()
+            try:
+                await self._pump_task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._pump_task = None
+        running = [task for _pool, _item, task in self._running.values()]
+        if running:
+            await asyncio.gather(*running, return_exceptions=True)
+        await self.registry.close()
+
+    # -- observability ------------------------------------------------------
+
+    def _count(self, outcome: str) -> None:
+        SCHED_DECISIONS_TOTAL.labels(outcome=outcome).inc()
+        self.decisions[outcome] = self.decisions.get(outcome, 0) + 1
+
+    def status(self) -> dict[str, Any]:
+        """The ``fleet`` section of the ops ``/status`` payload."""
+        return {
+            "queue": {
+                "depth": self.queue.depth,
+                "max_depth": self.queue.max_depth,
+                "policy": self.queue.policy,
+                "oldest_age_s": round(self.queue.oldest_age(), 3),
+                "tenants": self.queue.backlog(),
+            },
+            "pools": {
+                pool.name: pool.status() for pool in self.registry.pools()
+            },
+            # list() snapshots in one C-level (GIL-atomic) step: this is
+            # read from the ops HTTP thread while the pump mutates.
+            "running": sorted(list(self._running)),
+            "decisions": dict(self.decisions),
+            "autoscale": {
+                "high_watermark": self._high_mark(),
+                "low_watermark": self.low_watermark,
+                "above_high": self._above_high,
+                "hook": type(self.autoscale).__name__,
+            },
+        }

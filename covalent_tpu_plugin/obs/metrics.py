@@ -109,6 +109,23 @@ class _Metric:
     def _new_child(self):  # pragma: no cover - abstract
         raise NotImplementedError
 
+    def remove(self, **labels: Any) -> None:
+        """Drop one labeled series (no-op when absent).
+
+        Series whose label values are user-derived and unbounded — e.g.
+        the per-tenant queue depth gauge — must be removed when their
+        owner retires, or the registry (and every /metrics scrape) grows
+        monotonically for the process lifetime.
+        """
+        if set(labels) != set(self.label_names):
+            raise ValueError(
+                f"{self.name}: expected labels {self.label_names}, "
+                f"got {tuple(labels)}"
+            )
+        key = tuple(_fmt_label_value(labels[n]) for n in self.label_names)
+        with self._lock:
+            self._children.pop(key, None)
+
     def _series(self) -> list[tuple[dict[str, str], Any]]:
         with self._lock:
             return [

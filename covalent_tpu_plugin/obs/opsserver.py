@@ -166,6 +166,7 @@ class OpsServer:
         }
         with _providers_lock:
             providers = dict(_providers)
+        fleet_views: dict[str, Any] = {}
         for name, provider in providers.items():
             try:
                 view = provider()
@@ -180,8 +181,20 @@ class OpsServer:
             in_flight = view.get("in_flight")
             if isinstance(in_flight, dict):
                 out["in_flight"].update(in_flight)
-            if view:
+            if name.partition(":")[0] == "fleet" and view:
+                # The scheduler's live view (queue depth, per-tenant
+                # backlog, per-pool capacity/in-use/breakers) is a
+                # first-class /status section, not buried in providers.
+                # One scheduler (the common case) IS the section; several
+                # live ones nest by provider name instead of silently
+                # overwriting each other.
+                fleet_views[name] = view
+            elif view:
                 out.setdefault("providers", {})[name] = view
+        if len(fleet_views) == 1:
+            out["fleet"] = next(iter(fleet_views.values()))
+        elif fleet_views:
+            out["fleet"] = fleet_views
         return out
 
     def events_tail(self, n: int = 0) -> str:

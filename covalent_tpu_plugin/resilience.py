@@ -107,6 +107,17 @@ def classify_error(error: BaseException) -> tuple[FaultClass, str]:
     """
     if isinstance(error, asyncio.CancelledError):
         return FaultClass.PERMANENT, "cancelled"
+    # Duck-typed self-classification: layers above the transport (e.g. the
+    # fleet queue's admission shed) tag their exceptions with fault_label/
+    # fault_transient instead of importing this module — admission control
+    # must read PERMANENT (retrying amplifies the very overload that shed
+    # the work) without resilience.py depending on the scheduler tier.
+    label = getattr(error, "fault_label", None)
+    if isinstance(label, str) and label:
+        transient = bool(getattr(error, "fault_transient", False))
+        return (
+            FaultClass.TRANSIENT if transient else FaultClass.PERMANENT
+        ), label
     # Follow the cause chain: aggregation layers (e.g. _connect_all's
     # "failed to connect to N workers" TransportError) wrap the breaker's
     # fail-fast, and quarantine-driven failures must stay distinguishable.

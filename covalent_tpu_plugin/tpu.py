@@ -60,6 +60,7 @@ from .cache import (
     harness_digest,
 )
 from .executor_base import RemoteExecutor
+from .fleet.lease import GangLease
 from .obs import events as obs_events
 from .obs.heartbeat import MONITOR, STALLS_TOTAL
 from .obs.metrics import REGISTRY
@@ -737,6 +738,20 @@ class TPUExecutor(RemoteExecutor):
             )
         return self._discovered_endpoints
 
+    def seed_endpoints(
+        self, endpoints: Sequence[tuple[str, str]]
+    ) -> None:
+        """Pre-fill the ``tpu_name`` discovery cache from an external
+        resolution — fleet registration already ran gcloud once, so the
+        first dispatch must not pay (or race) a second subprocess.  Gang
+        teardown still clears the cache, keeping the re-discovery path
+        for re-created TPUs."""
+        pairs = [
+            (str(external), str(internal)) for external, internal in endpoints
+        ]
+        if pairs:
+            self._discovered_endpoints = pairs
+
     async def _ensure_workers(self) -> None:
         """Warm the discovery cache off the event loop (gcloud can be slow)."""
         if self.tpu_name and self._discovered_endpoints is None:
@@ -903,6 +918,93 @@ class TPUExecutor(RemoteExecutor):
             ) from errors[0]
         return list(results)  # type: ignore[list-item]
 
+    # ------------------------------------------------------------------ #
+    # Gang ownership (the GangLease seam)                                #
+    # ------------------------------------------------------------------ #
+
+    async def lease_gang(
+        self, dialed: "list[Transport] | None" = None
+    ) -> GangLease:
+        """Acquire a fully warmed gang behind the ownership seam.
+
+        Connect to every worker (pooled, breaker-gated), run the batched
+        pre-flight, and warm the resident agents — then hand the gang back
+        as a :class:`~covalent_tpu_plugin.fleet.lease.GangLease` so the
+        caller (the attempt state machine in :meth:`_run_attempt`, a
+        prewarm, or the fleet scheduler bin-packing electrons onto warm
+        gangs) holds ownership explicitly instead of reaching into the
+        transport pool.  Raises exactly what the dial/pre-flight path
+        raises (``TransportError``/``OSError``/``ValueError``), so every
+        caller keeps its existing failure routing.
+
+        ``dialed`` (when given) receives the connected channels as soon
+        as the dial succeeds — BEFORE pre-flight can fail — so a caller
+        whose retry policy discards the failed attempt's channels still
+        holds them when pre-flight (not the dial) is what raised; without
+        this, a redial retry would silently reuse the dead pooled
+        transports pre-flight just proved broken.
+        """
+        with Span("executor.connect"):
+            conns = await self._connect_all()
+        if dialed is not None:
+            dialed.extend(conns)
+        addresses = self._worker_addresses()
+        with Span("executor.preflight"):
+            # Agent warm-up (upload + compile on first use) rides the same
+            # gather as the env checks: independent round-trips, so the
+            # first electron hides the one-time compile cost.
+            await asyncio.gather(
+                *(
+                    self._preflight(c, key=self._pool_key(a))
+                    for a, c in zip(addresses, conns)
+                ),
+                *(self._agent_for(c) for c in conns),
+            )
+        return GangLease(self, conns, addresses)
+
+    @property
+    def is_warm(self) -> bool:
+        """Whether at least one pooled channel has passed pre-flight.
+
+        The fleet placement engine prefers pools whose gangs are warm —
+        a leased-and-preflighted channel means the next electron skips
+        the dial + pre-flight round trips entirely.
+        """
+        return bool(self._preflighted)
+
+    def gang_state(self) -> dict[str, Any]:
+        """Placement-facing snapshot: warmth + per-address breaker states.
+
+        The scheduler consults this instead of private executor state so
+        placement can route around open breakers (no dial is even
+        attempted against a quarantined host) and prefer warm gangs.
+        Addresses never dialed report ``closed`` — an unknown host is
+        placeable, and the breaker gate still protects the actual dial.
+
+        Called synchronously from the scheduler pump on the dispatcher
+        loop, so it must never block: a ``tpu_name`` whose endpoints are
+        not yet discovered reports no addresses (falling back to every
+        known breaker state) instead of running gcloud here —
+        ``_ensure_workers`` fills that cache off-loop on first dispatch.
+        """
+        if self.tpu_name and self._discovered_endpoints is None:
+            addresses = []
+        else:
+            try:
+                addresses = self._worker_addresses()
+            except Exception:  # noqa: BLE001 - topology may be unresolvable
+                addresses = []
+        states = self._breakers.states()
+        return {
+            "warm": self.is_warm,
+            "workers": addresses,
+            "breakers": (
+                {a: states.get(a, "closed") for a in addresses}
+                if addresses
+                else dict(states)
+            ),
+        }
+
     async def prewarm(self) -> bool:
         """Best-effort pre-dial of this executor's control plane.
 
@@ -925,15 +1027,7 @@ class TPUExecutor(RemoteExecutor):
         self._prewarmed = True  # optimistic: concurrent callers skip
         try:
             with Span("executor.prewarm", {"transport": self.transport_kind}):
-                conns = await self._connect_all()
-                addresses = self._worker_addresses()
-                await asyncio.gather(
-                    *(
-                        self._preflight(c, key=self._pool_key(a))
-                        for a, c in zip(addresses, conns)
-                    ),
-                    *(self._agent_for(c) for c in conns),
-                )
+                lease = await self.lease_gang()
         except asyncio.CancelledError:
             self._prewarmed = False
             raise
@@ -951,7 +1045,7 @@ class TPUExecutor(RemoteExecutor):
         obs_events.emit(
             "executor.prewarm",
             transport=self.transport_kind,
-            workers=len(conns),
+            workers=len(lease),
         )
         return True
 
@@ -2705,20 +2799,15 @@ class TPUExecutor(RemoteExecutor):
             )
             self._op_status[operation_id]["stage"] = "connecting"
             try:
-                with Span("executor.connect"):
-                    conns = await self._connect_all()
-                with Span("executor.preflight"):
-                    # Agent warm-up (upload + compile on first use) rides the
-                    # same gather as the env checks: independent round-trips,
-                    # so the first electron hides the one-time compile cost.
-                    addresses = self._worker_addresses()
-                    await asyncio.gather(
-                        *(
-                            self._preflight(c, key=self._pool_key(a))
-                            for a, c in zip(addresses, conns)
-                        ),
-                        *(self._agent_for(c) for c in conns),
-                    )
+                # Gang acquisition goes through the ownership seam: the
+                # attempt machine consumes a warm lease and never touches
+                # the transport pool directly (the fleet scheduler holds
+                # the same lease type when it owns placement).  `conns`
+                # doubles as the dialed out-param so a pre-flight failure
+                # still hands this attempt's channels to the retry
+                # planner's discard (redial must not reuse them).
+                lease = await self.lease_gang(dialed=conns)
+                conns = lease.conns
             except (TransportError, OSError, ValueError) as err:
                 # Join the staging leg (its own error, if any, is
                 # secondary to the connect failure — exactly the error

@@ -44,6 +44,10 @@ class NodeSpec:
     deps_pip: DepsPip | None = None
     call_before: list[DepsCall] = field(default_factory=list)
     call_after: list[DepsCall] = field(default_factory=list)
+    #: free-form electron metadata threaded into task_metadata (the fleet
+    #: scheduler reads ``tenant`` for fairness and ``pool`` for placement
+    #: preference); reserved runner keys (dispatch_id, node_id) win.
+    metadata: dict = field(default_factory=dict)
 
     def dependencies(self) -> set[int]:
         deps: set[int] = set()
@@ -96,9 +100,11 @@ class Electron:
         deps_bash: Any = None,
         call_before: Sequence[Any] = (),
         call_after: Sequence[Any] = (),
+        metadata: dict | None = None,
     ):
         self.fn = fn
         self.executor = executor
+        self.metadata = dict(metadata or {})
         if deps_pip is not None and not isinstance(deps_pip, DepsPip):
             deps_pip = DepsPip(packages=deps_pip)
         self.deps_pip = deps_pip
@@ -129,6 +135,7 @@ class Electron:
                 deps_pip=self.deps_pip,
                 call_before=self.call_before,
                 call_after=self.call_after,
+                metadata=dict(self.metadata),
             )
         )
         return Node(node_id, self.__name__)
@@ -142,6 +149,7 @@ def electron(
     deps_bash: Any = None,
     call_before: Sequence[Any] = (),
     call_after: Sequence[Any] = (),
+    metadata: dict | None = None,
 ) -> Any:
     """``@electron`` / ``@electron(executor="tpu", deps_pip=...)`` decorator."""
 
@@ -153,6 +161,7 @@ def electron(
             deps_bash=deps_bash,
             call_before=call_before,
             call_after=call_after,
+            metadata=metadata,
         )
 
     if fn is not None:

@@ -79,10 +79,15 @@ def resolve_executor(spec: Any) -> Any:
 
 
 def _register_builtins() -> None:
+    from ..fleet.executor import FleetExecutor
     from ..tpu import TPUExecutor
 
     register_executor("local", LocalExecutor)
     register_executor("tpu", TPUExecutor)
+    # executor="fleet": electrons ride the shared fleet work queue
+    # (admission control + tenant fairness + bin-packed placement onto
+    # warm pools) instead of mapping 1:1 onto a private gang.
+    register_executor("fleet", FleetExecutor)
 
 
 _register_builtins()

@@ -192,7 +192,22 @@ async def _execute_graph(graph: Graph, result: Result) -> None:
         kwargs = _resolve_value(dict(spec.kwargs), result.node_outputs)
         executor = executor_for(spec.executor)
         result._node_executors[spec.node_id] = executor
-        task_metadata = {"dispatch_id": dispatch_id, "node_id": spec.node_id}
+        # Electron metadata rides to the executor: the fleet queue keys
+        # per-tenant fairness on `tenant` and placement preference on
+        # `pool`.  Runner-managed keys are filtered out: pip_deps is
+        # DepsPip's contract (metadata must not smuggle worker-side pip
+        # installs), and dispatch/node identity is never user-writable.
+        task_metadata = {
+            **{
+                key: value
+                for key, value in (
+                    getattr(spec, "metadata", None) or {}
+                ).items()
+                if key not in ("dispatch_id", "node_id", "pip_deps")
+            },
+            "dispatch_id": dispatch_id,
+            "node_id": spec.node_id,
+        }
         if spec.deps_pip and spec.deps_pip.packages:
             # Installed by the worker harness *before* unpickling the task
             # (the pickle may import the dependency), reference ct.DepsPip

@@ -1,0 +1,976 @@
+"""Fleet scheduler tier: queue fairness, admission, placement, autoscale.
+
+Covers ISSUE 7's acceptance surface with no real transports where
+possible: the DRR queue and placement engine run against stub pools
+(deterministic, fake-clock-friendly), while the end-to-end tests drive
+real ``TPUExecutor`` pools over the local transport through the workflow
+engine — proving warm-gang bin-packing (connects < electrons), the
+``GangLease`` seam, and breaker-aware rerouting.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import shlex
+import sys
+
+import pytest
+
+import covalent_tpu_plugin.workflow as ct
+from covalent_tpu_plugin.fleet import (
+    FairWorkQueue,
+    FleetExecutor,
+    FleetScheduler,
+    GangLease,
+    LocalPoolAutoscaler,
+    Pool,
+    PoolRegistry,
+    PoolSpec,
+    QueueFullError,
+    WorkItem,
+    parse_pool_specs,
+)
+from covalent_tpu_plugin.fleet.scheduler import SCHED_DECISIONS_TOTAL
+from covalent_tpu_plugin.resilience import FaultClass, classify_error
+
+from .helpers import make_local_executor
+
+
+def item(tenant: str, n: int = 0, **metadata) -> WorkItem:
+    return WorkItem(
+        fn=lambda: n,
+        args=(),
+        kwargs={},
+        task_metadata={"dispatch_id": "d", "node_id": n, **metadata},
+        tenant=tenant,
+    )
+
+
+# ---------------------------------------------------------------------------
+# FairWorkQueue: deficit round-robin fairness
+# ---------------------------------------------------------------------------
+
+
+def test_drr_interleaves_equal_weight_tenants():
+    queue = FairWorkQueue()
+    for n in range(100):
+        queue.put(item("heavy", n))
+    for n in range(5):
+        queue.put(item("light", 1000 + n))
+    order = [queue.pop().tenant for _ in range(len(queue))]
+    # The light tenant's entire backlog drains within the first rounds:
+    # a 100-deep heavy lane cannot starve a 5-deep light one.
+    assert order.index("light") <= 2
+    assert all(t == "heavy" for t in order[12:])
+    assert order[:10].count("light") == 5
+
+
+def test_drr_respects_weights():
+    queue = FairWorkQueue(weights={"a": 3.0, "b": 1.0})
+    for n in range(40):
+        queue.put(item("a", n))
+        queue.put(item("b", 100 + n))
+    first = [queue.pop().tenant for _ in range(16)]
+    # Unit-cost DRR with quantum 1: service ratio is exactly the weights.
+    assert first.count("a") == 12 and first.count("b") == 4
+
+
+def test_drr_weight_must_be_positive():
+    with pytest.raises(ValueError, match="weight"):
+        FairWorkQueue(weights={"a": 0.0})
+
+
+def test_quantum_must_be_positive():
+    # quantum <= 0 would earn no lane any credit and spin pop() forever.
+    with pytest.raises(ValueError, match="quantum"):
+        FairWorkQueue(quantum=0.0)
+
+
+def test_queue_backlog_and_oldest_age_use_injected_clock():
+    now = [100.0]
+    queue = FairWorkQueue(clock=lambda: now[0])
+    queue.put(item("a", 1))
+    now[0] += 7.5
+    queue.put(item("b", 2))
+    assert queue.backlog() == {"a": 1, "b": 1}
+    assert queue.oldest_age() == pytest.approx(7.5)
+    queue.pop()
+    assert queue.depth == 1
+
+
+# ---------------------------------------------------------------------------
+# Admission control
+# ---------------------------------------------------------------------------
+
+
+def test_admission_reject_at_depth_bound_is_classified_permanent():
+    queue = FairWorkQueue(max_depth=2)
+    queue.put(item("a", 1))
+    queue.put(item("a", 2))
+    with pytest.raises(QueueFullError) as err:
+        queue.put(item("a", 3))
+    fault, label = classify_error(err.value)
+    assert fault is FaultClass.PERMANENT
+    assert label == "admission_shed"
+
+
+def test_admission_shed_oldest_returns_victim():
+    queue = FairWorkQueue(max_depth=2, policy="shed_oldest")
+    first = item("a", 1)
+    queue.put(first)
+    queue.put(item("b", 2))
+    shed = queue.put(item("a", 3))
+    assert shed == [first]
+    assert queue.depth == 2
+    assert queue.backlog() == {"a": 1, "b": 1}
+
+
+def test_drained_tenant_lane_and_gauge_series_retire():
+    """Tenant strings are user-derived: drained lanes (and their queue-
+    depth gauge series) must not accumulate for the process lifetime."""
+    from covalent_tpu_plugin.obs.metrics import REGISTRY
+
+    queue = FairWorkQueue()
+    queue.put(item("ephemeral-tenant-xyz", 1))
+    assert "ephemeral-tenant-xyz" in queue._lanes
+    queue.pop()
+    assert "ephemeral-tenant-xyz" not in queue._lanes
+    gauge = REGISTRY.get("covalent_tpu_queue_depth")
+    tenants = {labels["tenant"] for labels, _child in gauge._series()}
+    assert "ephemeral-tenant-xyz" not in tenants
+
+
+def test_facade_rejects_queue_without_pools():
+    with pytest.raises(ValueError, match="require pools="):
+        FleetExecutor(queue=FairWorkQueue(max_depth=1))
+
+
+def test_remove_prunes_matching_items():
+    queue = FairWorkQueue()
+    keep = item("a", 1)
+    drop = item("b", 2)
+    queue.put(keep)
+    queue.put(drop)
+    removed = queue.remove(lambda i: i.tenant == "b")
+    assert removed == [drop]
+    assert queue.pop() is keep and queue.pop() is None
+
+
+# ---------------------------------------------------------------------------
+# Placement engine (stub pools: no transports)
+# ---------------------------------------------------------------------------
+
+
+class StubExecutor:
+    """Duck-typed executor: records runs, controllable warmth/breakers."""
+
+    def __init__(self, warm=False, breakers=None, delay=0.0, gate=None):
+        self.warm = warm
+        self.breakers = dict(breakers or {})
+        self.delay = delay
+        self.gate = gate  # optional event the run blocks on
+        self.ran: list[dict] = []
+        self.cancelled: list[str] = []
+        self.concurrent = 0
+        self.max_concurrent = 0
+
+    @property
+    def is_warm(self):
+        return self.warm
+
+    def gang_state(self):
+        return {"warm": self.warm, "breakers": dict(self.breakers)}
+
+    async def run(self, fn, args, kwargs, task_metadata):
+        self.ran.append(dict(task_metadata))
+        self.concurrent += 1
+        self.max_concurrent = max(self.max_concurrent, self.concurrent)
+        try:
+            if self.gate is not None:
+                await self.gate.wait()
+            elif self.delay:
+                await asyncio.sleep(self.delay)
+            return fn(*args, **kwargs)
+        finally:
+            self.concurrent -= 1
+
+    async def cancel(self, operation_id=None):
+        self.cancelled.append(operation_id)
+
+    async def close(self):
+        self.closed = True
+
+
+def stub_registry(**pools) -> tuple[PoolRegistry, dict[str, StubExecutor]]:
+    registry = PoolRegistry()
+    executors = {}
+    for name, (executor, capacity, fallback) in pools.items():
+        registry.register(
+            PoolSpec(name=name, capacity=capacity, fallback=fallback,
+                     transport="local"),
+            executor=executor,
+        )
+        executors[name] = executor
+    return registry, executors
+
+
+def test_placement_prefers_warm_pool(run_async):
+    warm = StubExecutor(warm=True)
+    cold = StubExecutor(warm=False)
+    registry, _ = stub_registry(cold=(cold, 2, False), warm=(warm, 2, False))
+    scheduler = FleetScheduler(registry)
+
+    async def go():
+        out = await scheduler.run(lambda: "ok", (), {}, {"node_id": 1})
+        await scheduler.close()
+        return out
+
+    assert run_async(go()) == "ok"
+    assert len(warm.ran) == 1 and not cold.ran
+
+
+def test_placement_prefers_accelerator_over_fallback(run_async):
+    accel = StubExecutor(warm=False)
+    cpu = StubExecutor(warm=True)  # warm fallback must still rank last
+    registry, _ = stub_registry(cpu=(cpu, 2, True), accel=(accel, 2, False))
+    scheduler = FleetScheduler(registry)
+
+    async def go():
+        await scheduler.run(lambda: 1, (), {}, {"node_id": 1})
+        await scheduler.close()
+
+    run_async(go())
+    assert len(accel.ran) == 1 and not cpu.ran
+
+
+def test_placement_honors_pool_pin(run_async):
+    a = StubExecutor(warm=True)
+    b = StubExecutor()
+    registry, _ = stub_registry(a=(a, 2, False), b=(b, 2, False))
+    scheduler = FleetScheduler(registry)
+
+    async def go():
+        await scheduler.run(
+            lambda: 1, (), {}, {"node_id": 1, "pool": "b"}
+        )
+        await scheduler.close()
+
+    run_async(go())
+    assert len(b.ran) == 1 and not a.ran
+
+
+def test_capacity_bounds_concurrency_and_bin_packs(run_async):
+    pool_exec = StubExecutor(delay=0.05)
+    registry, _ = stub_registry(only=(pool_exec, 2, False))
+    scheduler = FleetScheduler(registry)
+
+    async def go():
+        results = await asyncio.gather(*(
+            scheduler.run(lambda i=i: i, (), {}, {"node_id": i})
+            for i in range(6)
+        ))
+        await scheduler.close()
+        return results
+
+    assert run_async(go()) == [0, 1, 2, 3, 4, 5]
+    # Bin-packing: all six electrons rode ONE pool, never more than
+    # `capacity` at a time.
+    assert len(pool_exec.ran) == 6
+    assert pool_exec.max_concurrent == 2
+
+
+def test_open_breaker_reroutes_to_fallback(run_async):
+    quarantined = StubExecutor(warm=True, breakers={"w1": "open"})
+    fallback = StubExecutor()
+    registry, _ = stub_registry(
+        tpu=(quarantined, 2, False), cpu=(fallback, 2, True)
+    )
+    scheduler = FleetScheduler(registry)
+    before = SCHED_DECISIONS_TOTAL.labels(outcome="rerouted").value
+
+    async def go():
+        out = await scheduler.run(lambda: "routed", (), {}, {"node_id": 1})
+        await scheduler.close()
+        return out
+
+    assert run_async(go()) == "routed"
+    assert len(fallback.ran) == 1 and not quarantined.ran
+    assert scheduler.decisions["rerouted"] == 1
+    assert scheduler.decisions.get("placed", 0) == 0
+    assert SCHED_DECISIONS_TOTAL.labels(outcome="rerouted").value == before + 1
+
+
+def test_open_breaker_below_the_winner_counts_placed_not_rerouted(run_async):
+    """A quarantined pool that would NOT have won placement anyway must
+    not flip the decision to `rerouted` — only a changed choice counts."""
+    winner = StubExecutor(warm=True)
+    loser = StubExecutor(warm=True, breakers={"w1": "open"})
+    registry, _ = stub_registry(
+        # winner ranks first on free slots (4 vs 1) before breakers are
+        # even consulted; the open loser diverts nothing.
+        a=(winner, 4, False), z=(loser, 1, False)
+    )
+    scheduler = FleetScheduler(registry)
+
+    async def go():
+        out = await scheduler.run(lambda: "ok", (), {}, {"node_id": 1})
+        await scheduler.close()
+        return out
+
+    assert run_async(go()) == "ok"
+    assert len(winner.ran) == 1
+    assert scheduler.decisions["placed"] == 1
+    assert scheduler.decisions["rerouted"] == 0
+
+
+def test_select_pool_waits_when_everything_is_open():
+    quarantined = StubExecutor(breakers={"w1": "open"})
+    registry, _ = stub_registry(tpu=(quarantined, 2, False))
+    scheduler = FleetScheduler(registry)
+    pool, rerouted = scheduler._select_pool(item("a", 1))
+    assert pool is None and rerouted is False
+
+
+def test_half_open_breaker_is_placeable(run_async):
+    probing = StubExecutor(breakers={"w1": "half_open"})
+    registry, _ = stub_registry(tpu=(probing, 1, False))
+    scheduler = FleetScheduler(registry)
+
+    async def go():
+        out = await scheduler.run(lambda: 7, (), {}, {"node_id": 1})
+        await scheduler.close()
+        return out
+
+    assert run_async(go()) == 7
+    assert len(probing.ran) == 1
+
+
+def test_shed_policy_fails_oldest_queued_future(run_async):
+    gate = asyncio.Event
+    blocker = StubExecutor()
+    registry, _ = stub_registry(only=(blocker, 1, False))
+    scheduler = FleetScheduler(
+        registry,
+        queue=FairWorkQueue(max_depth=1, policy="shed_oldest"),
+    )
+
+    async def go():
+        blocker.gate = asyncio.Event()
+        running = asyncio.ensure_future(
+            scheduler.run(lambda: "running", (), {}, {"node_id": 0})
+        )
+        await asyncio.sleep(0.05)  # pump places it; the slot is now busy
+        queued = asyncio.ensure_future(
+            scheduler.run(lambda: "queued", (), {}, {"node_id": 1})
+        )
+        await asyncio.sleep(0.01)  # item 1 sits at the depth bound
+        newest = asyncio.ensure_future(
+            scheduler.run(lambda: "newest", (), {}, {"node_id": 2})
+        )
+        await asyncio.sleep(0.01)
+        with pytest.raises(QueueFullError, match="shed"):
+            await queued
+        blocker.gate.set()
+        assert await running == "running"
+        assert await newest == "newest"
+        await scheduler.close()
+
+    run_async(go())
+    assert scheduler.decisions["shed"] == 1
+
+
+def test_cancel_queued_electron_never_places_it(run_async):
+    blocker = StubExecutor()
+    registry, _ = stub_registry(only=(blocker, 1, False))
+    scheduler = FleetScheduler(registry)
+
+    async def go():
+        blocker.gate = asyncio.Event()
+        running = asyncio.ensure_future(
+            scheduler.run(lambda: 1, (), {}, {"dispatch_id": "d",
+                                              "node_id": 0})
+        )
+        await asyncio.sleep(0.05)
+        queued = asyncio.ensure_future(
+            scheduler.run(lambda: 2, (), {}, {"dispatch_id": "d",
+                                              "node_id": 1})
+        )
+        await asyncio.sleep(0.01)
+        await scheduler.cancel("d_1")
+        with pytest.raises(asyncio.CancelledError):
+            await queued
+        blocker.gate.set()
+        assert await running == 1
+        # The in-flight electron's executor got the cancel fan-out only
+        # for ids it owns; the queued one never reached a pool.
+        assert len(blocker.ran) == 1
+        await scheduler.close()
+
+    run_async(go())
+
+
+def test_caller_cancellation_tears_down_placed_electron(run_async):
+    """Cancelling the await of scheduler.run (wait_for timeout, task
+    cancel) must reach the placed electron: the owning executor's cancel
+    fires and the capacity slot comes back — no detached run burning a
+    slot to completion with the result discarded."""
+    blocker = StubExecutor()
+    registry, _ = stub_registry(only=(blocker, 1, False))
+    scheduler = FleetScheduler(registry)
+
+    async def go():
+        blocker.gate = asyncio.Event()
+        running = asyncio.ensure_future(
+            scheduler.run(lambda: 1, (), {}, {"dispatch_id": "d",
+                                              "node_id": 0})
+        )
+        await asyncio.sleep(0.05)
+        assert len(blocker.ran) == 1  # placed, blocked on the gate
+        running.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await running
+        for _ in range(50):  # detached cleanup task fans out cancel
+            if blocker.cancelled:
+                break
+            await asyncio.sleep(0.01)
+        assert blocker.cancelled == ["d_0"]
+        # The stub doesn't abort on cancel; release the gate and the
+        # slot must come back even though the caller is long gone.
+        blocker.gate.set()
+        for _ in range(50):
+            if registry.get("only").in_use == 0:
+                break
+            await asyncio.sleep(0.01)
+        assert registry.get("only").in_use == 0
+        await scheduler.close()
+
+    run_async(go())
+
+
+def test_errors_propagate_to_the_submitter(run_async):
+    class Boom(RuntimeError):
+        pass
+
+    def explode():
+        raise Boom("user code")
+
+    registry, _ = stub_registry(only=(StubExecutor(), 1, False))
+    scheduler = FleetScheduler(registry)
+
+    async def go():
+        with pytest.raises(Boom):
+            await scheduler.run(explode, (), {}, {"node_id": 1})
+        await scheduler.close()
+
+    run_async(go())
+
+
+def test_shared_facade_refuses_blanket_cancel(run_async):
+    """cancel() with no operation id on a facade riding a SHARED scheduler
+    must be a refused no-op — other dispatches share that queue."""
+    blocker = StubExecutor()
+    registry, _ = stub_registry(only=(blocker, 1, False))
+    scheduler = FleetScheduler(registry)
+    facade = FleetExecutor(scheduler=scheduler)
+
+    async def go():
+        blocker.gate = asyncio.Event()
+        running = asyncio.ensure_future(
+            facade.run(lambda: 1, (), {}, {"node_id": 0})
+        )
+        await asyncio.sleep(0.05)
+        queued = asyncio.ensure_future(
+            facade.run(lambda: 2, (), {}, {"node_id": 1})
+        )
+        await asyncio.sleep(0.01)
+        await facade.cancel()  # no op id + shared scheduler: refused
+        assert scheduler.queue.depth == 1
+        blocker.gate.set()
+        assert await running == 1
+        assert await queued == 2
+        await scheduler.close()
+
+    run_async(go())
+
+
+def test_scheduler_clock_threads_into_default_queue():
+    registry, _ = stub_registry(only=(StubExecutor(), 1, False))
+    now = [50.0]
+    scheduler = FleetScheduler(registry, clock=lambda: now[0])
+    # One clock for placement events AND queue aging — a fake-clock test
+    # must never mix time.monotonic into queue_wait_s / oldest_age.
+    scheduler.queue.put(item("a", 1))
+    now[0] += 4.0
+    assert scheduler.queue.oldest_age() == pytest.approx(4.0)
+
+
+def test_register_replace_closes_displaced_executor(run_async):
+    old_exec = StubExecutor()
+    registry = PoolRegistry()
+    registry.register(
+        PoolSpec(name="p", capacity=1, transport="local"), executor=old_exec
+    )
+    _ = registry.get("p").executor  # started
+
+    async def go():
+        registry.register(
+            PoolSpec(name="p", capacity=2, transport="local"),
+            executor=StubExecutor(),
+        )
+        await asyncio.sleep(0)  # let the displaced-close task run
+        assert getattr(old_exec, "closed", False) is True
+        assert registry.get("p").capacity == 2
+
+    run_async(go())
+
+
+# ---------------------------------------------------------------------------
+# Autoscale watermarks
+# ---------------------------------------------------------------------------
+
+
+def test_autoscale_watermarks_fire_edge_triggered(run_async):
+    blocker = StubExecutor()
+    registry, _ = stub_registry(only=(blocker, 1, False))
+    autoscaler = LocalPoolAutoscaler("only", step=2, max_capacity=4)
+    scheduler = FleetScheduler(
+        registry, autoscale=autoscaler, high_watermark=2, low_watermark=0
+    )
+
+    async def go():
+        blocker.gate = asyncio.Event()
+        futures = [
+            asyncio.ensure_future(
+                scheduler.run(lambda i=i: i, (), {}, {"node_id": i})
+            )
+            for i in range(4)
+        ]
+        await asyncio.sleep(0.05)
+        # Backlog crossed the high watermark exactly once.
+        assert autoscaler.scale_ups == 1
+        assert registry.get("only").capacity == 3
+        blocker.gate.set()
+        assert await asyncio.gather(*futures) == [0, 1, 2, 3]
+        await asyncio.sleep(0.05)
+        await scheduler.close()
+
+    run_async(go())
+    # Draining back to the low watermark fired exactly one scale-down.
+    assert autoscaler.scale_downs == 1
+    assert registry.get("only").capacity == 1
+
+
+def test_default_autoscale_hook_is_noop(run_async):
+    registry, _ = stub_registry(only=(StubExecutor(), 1, False))
+    scheduler = FleetScheduler(registry, high_watermark=1)
+
+    async def go():
+        out = await scheduler.run(lambda: 5, (), {}, {"node_id": 1})
+        await scheduler.close()
+        return out
+
+    assert run_async(go()) == 5  # no hook, no crash
+
+
+# ---------------------------------------------------------------------------
+# Pool specs / registry / discovery wiring
+# ---------------------------------------------------------------------------
+
+
+def test_parse_compact_pool_specs():
+    specs = parse_pool_specs(
+        "v5e=10.0.0.1+10.0.0.2@4; spare=tpu:my-v5e-8@2; cpu=local@3"
+    )
+    by_name = {s.name: s for s in specs}
+    assert by_name["v5e"].workers == ("10.0.0.1", "10.0.0.2")
+    assert by_name["v5e"].capacity == 4
+    assert by_name["spare"].tpu_name == "my-v5e-8"
+    assert by_name["cpu"].transport == "local"
+    assert by_name["cpu"].fallback and by_name["cpu"].capacity == 3
+
+
+def test_parse_json_pool_specs():
+    specs = parse_pool_specs(json.dumps([
+        {"name": "a", "workers": ["w1"], "capacity": 2},
+        {"name": "cpu", "fallback": True},
+    ]))
+    assert specs[0].workers == ("w1",) and specs[0].capacity == 2
+    assert specs[1].fallback
+
+
+@pytest.mark.parametrize("bad", ["nameonly", "x=@", "a=w1@cap_zz", "y=@4"])
+def test_parse_rejects_malformed_entries(bad):
+    with pytest.raises(ValueError):
+        parse_pool_specs(bad)
+
+
+def test_parse_keeps_login_in_worker_addresses():
+    """A trailing '@suffix' is capacity only when numeric; 'user@host'
+    worker addresses survive intact (with or without an explicit @capN)."""
+    specs = parse_pool_specs(
+        "edge=ubuntu@10.0.0.9;v5e=ubuntu@10.0.0.1+root@10.0.0.2@4"
+    )
+    by_name = {s.name: s for s in specs}
+    assert by_name["edge"].workers == ("ubuntu@10.0.0.9",)
+    assert by_name["edge"].capacity == 1
+    assert by_name["v5e"].workers == ("ubuntu@10.0.0.1", "root@10.0.0.2")
+    assert by_name["v5e"].capacity == 4
+
+
+def test_registry_from_environment(monkeypatch):
+    monkeypatch.setenv("COVALENT_TPU_POOLS", "a=w1@2;cpu=local@1")
+    registry = PoolRegistry.from_environment()
+    assert {p.name for p in registry.pools()} == {"a", "cpu"}
+    assert registry.fallback_pool().name == "cpu"
+    assert registry.total_capacity() == 3
+
+
+def test_ensure_fallback_is_idempotent():
+    registry = PoolRegistry()
+    first = registry.ensure_fallback()
+    assert registry.ensure_fallback() is first
+    assert first.fallback and first.spec.transport == "local"
+
+
+def test_register_tpu_resolves_workers_via_discovery(tmp_path, monkeypatch):
+    payload = tmp_path / "describe.json"
+    payload.write_text(json.dumps({
+        "state": "READY",
+        "networkEndpoints": [
+            {"ipAddress": "10.0.0.2",
+             "accessConfig": {"externalIp": "34.1.1.1"}},
+            {"ipAddress": "10.0.0.3",
+             "accessConfig": {"externalIp": "34.1.1.2"}},
+        ],
+    }))
+    monkeypatch.setenv(
+        "COVALENT_TPU_GCLOUD_CMD",
+        f"{shlex.quote(sys.executable)} -c " + shlex.quote(
+            "import sys; sys.stdout.write(open("
+            + repr(str(payload)) + ").read())"
+        ),
+    )
+    registry = PoolRegistry()
+    pool = registry.register_tpu("my-v5e", zone="us-west4-a", capacity=4)
+    assert pool.spec.workers == ("34.1.1.1", "34.1.1.2")
+    assert pool.capacity == 4 and pool.spec.tpu_name == "my-v5e"
+    assert registry.get("my-v5e") is pool
+    # Registration-time endpoints seed the executor's discovery cache:
+    # no second gcloud subprocess at first dispatch (prove it by making
+    # any further invocation fail loudly).
+    assert pool.spec.endpoints == (
+        ("34.1.1.1", "10.0.0.2"), ("34.1.1.2", "10.0.0.3"),
+    )
+    monkeypatch.setenv("COVALENT_TPU_GCLOUD_CMD", "false")
+    assert pool.executor._coordinator_address() == "10.0.0.2:8476"
+    assert pool.executor.gang_state()["workers"] == ["34.1.1.1", "34.1.1.2"]
+
+
+def test_gang_state_never_runs_discovery(monkeypatch):
+    """The scheduler pump reads gang_state() synchronously on the event
+    loop; an undiscovered tpu_name must report no addresses rather than
+    block on a gcloud subprocess."""
+    from covalent_tpu_plugin import discovery
+    from covalent_tpu_plugin.tpu import TPUExecutor
+
+    def boom(*_args, **_kwargs):
+        raise AssertionError("gang_state must not run discovery")
+
+    monkeypatch.setattr(discovery, "discover_tpu_endpoints", boom)
+    ex = TPUExecutor(tpu_name="never-discovered", transport="ssh",
+                     ssh_key_file="/dev/null")
+    state = ex.gang_state()
+    assert state["workers"] == [] and state["warm"] is False
+
+
+def test_pool_slot_accounting():
+    pool = Pool(PoolSpec(name="p", capacity=2, transport="local"),
+                executor=StubExecutor())
+    assert pool.free_slots == 2
+    pool.place()
+    pool.place()
+    assert pool.free_slots == 0 and pool.in_use == 2
+    pool.release()
+    assert pool.free_slots == 1 and pool.placed_total == 2
+    status = pool.status()
+    assert status["capacity"] == 2 and status["in_use"] == 1
+
+
+# ---------------------------------------------------------------------------
+# GangLease seam (real executor, local transport)
+# ---------------------------------------------------------------------------
+
+
+def test_lease_gang_warms_and_discard_cools(tmp_path, run_async):
+    ex = make_local_executor(tmp_path)
+
+    async def go():
+        assert not ex.is_warm
+        lease = await ex.lease_gang()
+        assert isinstance(lease, GangLease)
+        assert len(lease) == 1 and lease.owner is ex
+        assert ex.is_warm
+        state = ex.gang_state()
+        assert state["warm"] is True
+        assert set(state["breakers"].values()) <= {"closed"}
+        await lease.discard()
+        assert not ex.is_warm
+        await ex.close()
+
+    run_async(go())
+
+
+def test_lease_gang_hands_dialed_conns_out_on_preflight_failure(
+    tmp_path, run_async, monkeypatch
+):
+    """A pre-flight failure must still expose the dialed channels via the
+    `dialed` out-param — the retry driver discards exactly those before a
+    redial, or the next attempt reuses the broken pooled transports."""
+    from covalent_tpu_plugin.transport import TransportError
+
+    ex = make_local_executor(tmp_path)
+
+    async def broken_preflight(conn, key=None):
+        raise TransportError("preflight exploded")
+
+    monkeypatch.setattr(ex, "_preflight", broken_preflight)
+
+    async def go():
+        dialed = []
+        with pytest.raises(TransportError, match="preflight exploded"):
+            await ex.lease_gang(dialed=dialed)
+        assert len(dialed) == 1  # the connect succeeded and is exposed
+        await ex.close()
+
+    run_async(go())
+
+
+def test_pump_rebind_releases_orphaned_slots(run_async):
+    """Loop migration must give in-flight slots back: the old loop's
+    _run_item finallys never ran, and leaked in_use would deadlock."""
+    pool_exec = StubExecutor()
+    registry, _ = stub_registry(only=(pool_exec, 2, False))
+    scheduler = FleetScheduler(registry)
+    pool = registry.get("only")
+    dead_loop = asyncio.new_event_loop()
+    dead_loop.close()
+    pool.place()
+    scheduler._loop = dead_loop
+    scheduler._running["orphan_0"] = (pool, item("a", 0), None)
+
+    async def go():
+        out = await scheduler.run(lambda: "alive", (), {}, {"node_id": 9})
+        await scheduler.close()
+        return out
+
+    assert run_async(go()) == "alive"
+    assert pool.in_use == 0  # orphaned slot was released on rebind
+
+
+def test_private_fleet_honors_queue_config(tmp_config):
+    from covalent_tpu_plugin.utils.config import update_config
+
+    update_config(
+        {"queue_depth": 7, "admission": "shed_oldest",
+         "tenant_weights": {"batch": 2.0}},
+        section="fleet",
+    )
+    fleet = FleetExecutor(
+        pools=[{"name": "p", "transport": "local", "capacity": 1}],
+        ensure_fallback=False,
+    )
+    queue = fleet.scheduler.queue
+    assert queue.max_depth == 7
+    assert queue.policy == "shed_oldest"
+    assert queue.weight("batch") == 2.0
+
+
+def test_run_attempt_rides_the_lease_seam(tmp_path, run_async):
+    """An electron through run() leaves the executor warm: the attempt
+    machine acquired its gang through lease_gang, not ad-hoc dials."""
+    ex = make_local_executor(tmp_path)
+
+    async def go():
+        out = await ex.run(lambda x: x + 1, [41], {},
+                           {"dispatch_id": "lease", "node_id": 0})
+        warm = ex.is_warm
+        await ex.close()
+        return out, warm
+
+    out, warm = run_async(go())
+    assert out == 42 and warm
+
+
+# ---------------------------------------------------------------------------
+# End to end: FleetExecutor over real local pools
+# ---------------------------------------------------------------------------
+
+
+def local_pool_spec(tmp_path, name: str, capacity: int, fallback=False):
+    return {
+        "name": name,
+        "transport": "local",
+        "capacity": capacity,
+        "fallback": fallback,
+        "executor": {
+            "cache_dir": str(tmp_path / f"cache_{name}"),
+            "remote_cache": str(tmp_path / f"remote_{name}"),
+            "python_path": sys.executable,
+            "poll_freq": 0.2,
+            "use_agent": False,
+            "prewarm": False,
+            "task_env": {"JAX_PLATFORMS": "cpu"},
+        },
+    }
+
+
+def pool_connects() -> float:
+    from covalent_tpu_plugin.obs.metrics import REGISTRY
+
+    counter = REGISTRY.get("covalent_tpu_pool_acquires_total")
+    if counter is None:
+        return 0.0
+    return sum(
+        value.value
+        for labels, value in counter._series()
+        if labels.get("result") == "miss"
+    )
+
+
+def test_fleet_bin_packs_mixed_tenants_onto_warm_gangs(tmp_path, run_async):
+    """The acceptance workflow, scaled for the unit tier: 8 electrons,
+    2 tenants, 2 pools — every electron completes, connects < electrons
+    (warm-gang reuse), placements spread over both pools."""
+    fleet = FleetExecutor(
+        pools=[
+            local_pool_spec(tmp_path, "a", 2),
+            local_pool_spec(tmp_path, "b", 2),
+        ],
+        ensure_fallback=False,
+    )
+    connects_before = pool_connects()
+
+    async def go():
+        results = await asyncio.gather(*(
+            fleet.run(
+                lambda i=i: i * i, (), {},
+                {"dispatch_id": "fleet-e2e", "node_id": i,
+                 "tenant": "heavy" if i % 2 else "light"},
+            )
+            for i in range(8)
+        ))
+        status = fleet.scheduler.status()
+        await fleet.close()
+        return results, status
+
+    results, status = run_async(go())
+    assert results == [i * i for i in range(8)]
+    placed = {
+        name: view["placed_total"]
+        for name, view in status["pools"].items()
+    }
+    assert sum(placed.values()) == 8
+    assert all(count > 0 for count in placed.values()), placed
+    # Warm-gang reuse: 8 electrons over 2 single-worker local pools dial
+    # at most once per pool — strictly fewer connects than electrons.
+    connects = pool_connects() - connects_before
+    assert 0 < connects <= 2, connects
+
+
+def test_fleet_executor_through_workflow_engine(tmp_path):
+    """@ct.electron(executor=<FleetExecutor>) + tenant metadata: the
+    runner threads electron metadata into task_metadata, and the whole
+    lattice completes through the queue."""
+    fleet = FleetExecutor(
+        pools=[local_pool_spec(tmp_path, "wf", 2)],
+        ensure_fallback=False,
+    )
+
+    @ct.electron(executor=fleet, metadata={"tenant": "batch"})
+    def square(i):
+        return i * i
+
+    @ct.lattice
+    def flow(n):
+        return [square(i) for i in range(n)]
+
+    result = ct.dispatch_sync(flow)(4)
+    assert result.status is ct.Status.COMPLETED, result.error
+    assert result.result == [0, 1, 4, 9]
+    pool = fleet.scheduler.registry.get("wf")
+    assert pool.placed_total == 4
+    # Every electron ran under its metadata tenant.
+    assert fleet.scheduler.queue.backlog() == {}
+
+    # Teardown on the loop that owns the pooled transports.
+    from covalent_tpu_plugin.workflow import runner as runner_mod
+
+    asyncio.run_coroutine_threadsafe(
+        fleet.close(), runner_mod._dispatcher_loop()
+    ).result(30)
+
+
+def test_metadata_cannot_smuggle_runner_keys():
+    """Electron metadata must not inject runner-managed keys: pip_deps is
+    DepsPip's contract, and dispatch/node identity is never user-set."""
+    recorder = StubExecutor()
+
+    @ct.electron(
+        executor=recorder,
+        metadata={"pip_deps": ["evil-pkg"], "tenant": "t", "node_id": 99},
+    )
+    def task():
+        return 1
+
+    @ct.lattice
+    def flow():
+        return task()
+
+    result = ct.dispatch_sync(flow)()
+    assert result.status is ct.Status.COMPLETED, result.error
+    metadata = recorder.ran[0]
+    assert "pip_deps" not in metadata
+    assert metadata["tenant"] == "t"
+    assert metadata["node_id"] == 0  # the runner's id, not the user's
+
+
+def test_fleet_alias_resolves(tmp_path, monkeypatch):
+    """executor="fleet" resolves to a FleetExecutor over the default
+    scheduler (pools from COVALENT_TPU_POOLS + auto fallback)."""
+    from covalent_tpu_plugin.fleet import executor as fleet_executor_mod
+    from covalent_tpu_plugin.workflow.executors import resolve_executor
+
+    monkeypatch.setenv("COVALENT_TPU_POOLS", "")
+    fleet_executor_mod.reset_default_scheduler()
+    try:
+        instance = resolve_executor("fleet")
+        assert isinstance(instance, FleetExecutor)
+        scheduler = instance.scheduler
+        assert scheduler.registry.fallback_pool() is not None
+    finally:
+        fleet_executor_mod.reset_default_scheduler()
+
+
+def test_ops_status_carries_fleet_section(run_async):
+    """The scheduler's registered provider surfaces as a top-level
+    `fleet` section in the ops /status payload."""
+    from covalent_tpu_plugin.obs import opsserver
+
+    registry, _ = stub_registry(only=(StubExecutor(), 2, False))
+    scheduler = FleetScheduler(registry)
+    server = opsserver.OpsServer(0)
+    try:
+        status = server.status()
+        assert "fleet" in status
+        fleet_view = status["fleet"]
+        assert fleet_view["queue"]["depth"] == 0
+        assert fleet_view["pools"]["only"]["capacity"] == 2
+        assert "decisions" in fleet_view
+    finally:
+        server.close()
+
+    async def go():
+        await scheduler.close()
+
+    run_async(go())
