@@ -170,6 +170,14 @@ class AgentClient:
         self._started: dict[str, int] = {}
         self._exits: dict[str, tuple[int, int]] = {}
         self._errors: dict[str, str] = {}
+        #: function digests this channel's resident runtime has registered
+        #: (RPC dispatch); dies with the client, exactly like the remote
+        #: registry dies with the agent process.
+        self._registered: set[str] = set()
+        #: digest -> (code, message) for a failed registration.
+        self._register_errors: dict[str, tuple[str, str]] = {}
+        #: task id -> pushed ``result`` event (RPC invocations).
+        self._results: dict[str, dict] = {}
         self._pongs = 0
         self._dead: BaseException | None = None
         self._cond = asyncio.Condition()
@@ -245,6 +253,17 @@ class AgentClient:
                         self._exits[task_id] = (
                             int(event.get("code", -1)),
                             int(event.get("signal", 0)),
+                        )
+                    elif kind == "result":
+                        self._results[task_id] = event
+                    elif kind == "registered":
+                        self._registered.add(str(event.get("digest") or ""))
+                    elif kind == "register_error":
+                        self._register_errors[
+                            str(event.get("digest") or "")
+                        ] = (
+                            str(event.get("code") or "error"),
+                            str(event.get("message") or "?"),
                         )
                     elif kind == "pong":
                         self._pongs += 1
@@ -408,17 +427,131 @@ class AgentClient:
         self._exits.pop(task_id, None)
         return event
 
+    # -- RPC execute-by-digest ----------------------------------------------
+
+    @property
+    def registered_digests(self) -> frozenset:
+        """Function digests this channel's resident runtime holds."""
+        return frozenset(self._registered)
+
+    async def register_fn(
+        self,
+        digest: str,
+        path: str,
+        runner: list[str] | None = None,
+        timeout: float = 60.0,
+    ) -> None:
+        """Register a CAS-staged cloudpickled function by its digest.
+
+        The remote side verifies ``path``'s sha256 against ``digest``
+        BEFORE unpickling and keeps the loaded callable for invoke-by-
+        digest.  Idempotent per client: a digest this channel already
+        registered is a no-op.  A digest mismatch (torn or stale CAS
+        artifact) raises an :class:`AgentError` tagged PERMANENT via the
+        duck-typed ``fault_label`` hook — re-registering identical bytes
+        can never succeed, so the resilience layer must not burn gang
+        retries on it.  ``runner`` (native agent only) names the argv the
+        agent forks per invocation (``[python, harness, --rpc-child]``).
+        """
+        if digest in self._registered:
+            return
+        command: dict = {"cmd": "register_fn", "digest": digest, "path": path}
+        if runner:
+            command["runner"] = [str(part) for part in runner]
+        await self._send(command)
+
+        def settled(c: "AgentClient"):
+            if digest in c._register_errors:
+                code, message = c._register_errors.pop(digest)
+                failure = AgentError(
+                    f"agent@{c.address}: register {digest[:12]} failed "
+                    f"({code}): {message}"
+                )
+                if code == "digest_mismatch":
+                    failure.fault_label = "rpc_digest_mismatch"  # type: ignore[attr-defined]
+                    failure.fault_transient = False  # type: ignore[attr-defined]
+                raise failure
+            return digest in c._registered
+
+        await self._wait(settled, timeout)
+
+    async def invoke(
+        self,
+        task_id: str,
+        digest: str,
+        spec: dict | None = None,
+        args_b64: str | None = None,
+        args_path: str = "",
+        args_digest: str = "",
+        path: str = "",
+        timeout: float = 30.0,
+    ) -> int:
+        """Invoke a registered function by digest; returns the worker pid.
+
+        Args travel inline (``args_b64``) below the executor's size
+        threshold, else by CAS path + digest.  ``path`` (the function's
+        CAS artifact) rides along so a restarted runtime can self-heal a
+        lost registration, digest-verified.  The ``started`` ack bounds
+        this call; the result streams back separately (:meth:`wait_result`).
+        """
+        command: dict = {"cmd": "invoke", "id": task_id, "digest": digest}
+        if path:
+            command["path"] = path
+        if spec:
+            command["spec"] = dict(spec)
+        if args_b64 is not None:
+            command["args"] = args_b64
+        elif args_path:
+            command["args_path"] = args_path
+            if args_digest:
+                command["args_digest"] = args_digest
+        submit_span = Span(
+            "agent.invoke", {"address": self.address, "task_id": task_id}
+        )
+        submit_span.__enter__()
+        try:
+            await self._send(command)
+
+            def ready(c: "AgentClient"):
+                if task_id in c._errors:
+                    rejection = AgentError(
+                        f"agent@{c.address} rejected invoke {task_id}: "
+                        f"{c._errors.pop(task_id)}"
+                    )
+                    rejection.rejected = True  # type: ignore[attr-defined]
+                    raise rejection
+                return c._started.get(task_id)
+
+            pid = await self._wait(ready, timeout)
+            self._started.pop(task_id, None)
+            return pid
+        except AgentError as err:
+            submit_span.record_error(err)
+            raise
+        finally:
+            submit_span.end()
+
+    async def wait_result(
+        self, task_id: str, timeout: float | None = None
+    ) -> dict:
+        """Block until the invocation's pushed ``result`` event."""
+        event = await self._wait(lambda c: c._results.get(task_id), timeout)
+        self._results.pop(task_id, None)
+        return event
+
     def forget(self, task_id: str) -> None:
         """Drop any retained state for a finished/abandoned task.
 
-        Called by the executor when an operation leaves its books — e.g. a
-        straggler worker's exit event that no waiter consumed (its waiter
-        was cancelled once worker 0 resolved the task) must not accumulate
-        for the channel's lifetime.
+        Called by the executor when an operation leaves its books — on
+        EVERY exit path (success, kill, channel death, retry teardown):
+        a straggler's unconsumed exit event, an unclaimed RPC result, the
+        telemetry seq high-water mark, and any stored rejection must not
+        accumulate for the channel's lifetime.
         """
         self._started.pop(task_id, None)
         self._exits.pop(task_id, None)
         self._errors.pop(task_id, None)
+        self._results.pop(task_id, None)
         self._telemetry_seq.pop(task_id, None)
 
     async def kill(self, task_id: str, sig: int = 15) -> None:

@@ -51,6 +51,7 @@ from .utils.log import app_log
 __all__ = [
     "CAS_DIR",
     "CASIndex",
+    "FnRegistry",
     "ResultCache",
     "bytes_digest",
     "cas_path",
@@ -58,6 +59,7 @@ __all__ = [
     "harness_digest",
     "CAS_UPLOADS_TOTAL",
     "RESULT_CACHE_TOTAL",
+    "RPC_REGISTRATIONS_TOTAL",
     "STAGING_OPS_TOTAL",
 ]
 
@@ -80,6 +82,12 @@ STAGING_OPS_TOTAL = REGISTRY.counter(
     "Control-plane round trips spent shipping staged artifacts, by path "
     "(per_file = put+publish per artifact, bundled = one tar per worker)",
     ("mode",),
+)
+RPC_REGISTRATIONS_TOTAL = REGISTRY.counter(
+    "covalent_tpu_rpc_registrations_total",
+    "RPC function-registry decisions (hit = the connection's resident "
+    "runtime already holds the digest; miss = bytecode registered)",
+    ("result",),
 )
 
 
@@ -341,6 +349,100 @@ class CASIndex:
         deleted, e.g. a per-operation spec removed by cleanup)."""
         for present in self._present.values():
             present.discard(digest)
+
+
+class FnRegistry:
+    """Per-connection registered-function digests for RPC dispatch.
+
+    Mirrors :class:`CASIndex`: keyed by the executor's pool keys, with
+    single-flight registration so a fan-out of electrons sharing one
+    function triggers exactly one ``register_fn`` round trip per
+    connection, and per-key eviction (:meth:`forget`) when the channel is
+    discarded.  One extra wrinkle the CAS doesn't have: the remote
+    registry lives in the *agent process*, not on disk, so a restarted
+    agent under the same pool key silently loses everything — each set is
+    therefore bound to the client object that populated it, and a new
+    client resets the set before its first registration.
+    """
+
+    def __init__(self) -> None:
+        self._registered: dict[str, set[str]] = {}
+        #: pool key -> id(client) whose resident runtime owns the set.
+        self._owners: dict[str, int] = {}
+        self._inflight: dict[tuple[str, str], asyncio.Future] = {}
+
+    def known(self, key: str, digest: str) -> bool:
+        return digest in self._registered.get(key, ())
+
+    def holds(self, digest: str) -> bool:
+        """Whether ANY live connection registered this digest — the fleet
+        scheduler's placement-affinity probe."""
+        return any(digest in held for held in self._registered.values())
+
+    def count(self, key: str) -> int:
+        return len(self._registered.get(key, ()))
+
+    def counts(self) -> dict[str, int]:
+        """pool key -> registered-digest count (ops ``/status`` view)."""
+        return {key: len(held) for key, held in self._registered.items()}
+
+    def digests(self) -> set[str]:
+        """Union of registered digests across every connection."""
+        out: set[str] = set()
+        for held in self._registered.values():
+            out |= held
+        return out
+
+    async def ensure(
+        self,
+        key: str,
+        client,
+        digest: str,
+        path: str,
+        runner: "list[str] | None" = None,
+    ) -> None:
+        """Register ``digest`` on ``key``'s resident runtime, at most once.
+
+        ``client`` is the live :class:`~covalent_tpu_plugin.agent.
+        AgentClient`; its ``register_fn`` digest-verifies the CAS artifact
+        remotely before unpickling.  Raises exactly what the client
+        raises (``AgentError`` — a digest mismatch arrives tagged
+        permanent), leaving the digest unregistered so a retry re-runs
+        the registration.
+        """
+        if self._owners.get(key) != id(client):
+            # Fresh client under this key: the old resident runtime (and
+            # its in-process registry) is gone — re-register everything.
+            self._registered.pop(key, None)
+            self._owners[key] = id(client)
+        while True:
+            registered = self._registered.setdefault(key, set())
+            if digest in registered:
+                RPC_REGISTRATIONS_TOTAL.labels(result="hit").inc()
+                return
+            pending = self._inflight.get((key, digest))
+            if pending is None:
+                break
+            await pending  # winner settles (result-only, never raises)
+        future: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._inflight[(key, digest)] = future
+        try:
+            with Span(
+                "executor.rpc_register",
+                {"key": key, "digest": digest[:12]},
+            ):
+                await client.register_fn(digest, path, runner=runner)
+            registered.add(digest)
+            RPC_REGISTRATIONS_TOTAL.labels(result="miss").inc()
+        finally:
+            self._inflight.pop((key, digest), None)
+            if not future.done():
+                future.set_result(None)
+
+    def forget(self, key: str) -> None:
+        """Evict one connection's registrations (channel discarded)."""
+        self._registered.pop(key, None)
+        self._owners.pop(key, None)
 
 
 class ResultCache:

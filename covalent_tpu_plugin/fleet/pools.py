@@ -213,6 +213,35 @@ class Pool:
             state == "open" for state in self.breaker_states().values()
         )
 
+    def holds_fn_digest(self, digest: str) -> bool:
+        """Whether this pool's warm gang registered the electron's function
+        digest (RPC dispatch) — placement affinity: a holding gang invokes
+        by digest with zero staging/registration round trips."""
+        if self._executor is None or not digest:
+            return False
+        probe = getattr(self._executor, "holds_fn_digest", None)
+        if probe is None:
+            return False
+        try:
+            return bool(probe(digest))
+        except Exception:  # noqa: BLE001 - placement must not crash on a view
+            return False
+
+    def rpc_digest_count(self) -> int:
+        """Distinct function digests this pool's resident runtimes hold
+        (0 on stub/cold executors) — the scheduler's cheap pre-check that
+        affinity ranking could matter at all before it pays a cloudpickle
+        of the electron's function."""
+        if self._executor is None:
+            return 0
+        probe = getattr(self._executor, "rpc_digest_count", None)
+        if probe is None:
+            return 0
+        try:
+            return int(probe())
+        except Exception:  # noqa: BLE001 - placement must not crash on a view
+            return 0
+
     # -- slot accounting ----------------------------------------------------
 
     @property
@@ -250,7 +279,7 @@ class Pool:
 
     def status(self) -> dict[str, Any]:
         """This pool's contribution to the ops ``/status`` fleet view."""
-        return {
+        view = {
             "capacity": self.capacity,
             "in_use": self.in_use,
             "free": self.free_slots,
@@ -261,6 +290,20 @@ class Pool:
             or ([self.spec.tpu_name] if self.spec.tpu_name else ["local"]),
             "breakers": self.breaker_states(),
         }
+        if self._executor is not None:
+            # RPC dispatch views (absent on stub executors): how many
+            # function digests this gang's resident runtimes hold, and
+            # which dispatch mode each in-flight electron is riding.
+            counter = getattr(self._executor, "rpc_digest_count", None)
+            modes = getattr(self._executor, "in_flight_modes", None)
+            try:
+                if counter is not None:
+                    view["registered_digests"] = int(counter())
+                if modes is not None:
+                    view["in_flight_modes"] = dict(modes())
+            except Exception:  # noqa: BLE001 - status must not crash a view
+                pass
+        return view
 
 
 def parse_pool_specs(text: str) -> list[PoolSpec]:

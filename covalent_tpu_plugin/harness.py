@@ -318,21 +318,15 @@ def _to_host(tree):
     )
 
 
-def run_task(spec: dict) -> int:
-    """Execute one staged task described by ``spec``.  Returns the exit code."""
-    result_file = spec["result_file"]
+def _apply_spec_env(spec: dict) -> None:
+    """Apply the task's env contract to THIS process.
 
-    pid_file = spec.get("pid_file")
-    if pid_file:
-        # First thing, before any failure mode: the dispatcher's orphan
-        # cleanup kills by this pid when a launch channel dies mid-submit
-        # (a pool fork keeps the server's cmdline, so pkill can't find it).
-        # Atomic write: a reader must never observe an empty pid file.
-        tmp_pid = f"{pid_file}.tmp.{os.getpid()}"
-        with open(tmp_pid, "w") as f:
-            f.write(str(os.getpid()))
-        os.replace(tmp_pid, pid_file)
-
+    os.environ entries, a sys.path mirror for PYTHONPATH, and the jax
+    platform pin.  Shared by the per-task harness (``run_task``) and RPC
+    invocations executing inside the resident server — one server serves
+    one executor, so ``task_env`` is constant across its invocations and
+    the process-wide mutation is idempotent by construction.
+    """
     env = spec.get("env") or {}
     for key, value in env.items():
         os.environ[key] = str(value)
@@ -362,6 +356,24 @@ def run_task(spec: dict) -> int:
             jax.config.update("jax_platforms", str(platforms))
         except Exception:
             pass
+
+
+def run_task(spec: dict) -> int:
+    """Execute one staged task described by ``spec``.  Returns the exit code."""
+    result_file = spec["result_file"]
+
+    pid_file = spec.get("pid_file")
+    if pid_file:
+        # First thing, before any failure mode: the dispatcher's orphan
+        # cleanup kills by this pid when a launch channel dies mid-submit
+        # (a pool fork keeps the server's cmdline, so pkill can't find it).
+        # Atomic write: a reader must never observe an empty pid file.
+        tmp_pid = f"{pid_file}.tmp.{os.getpid()}"
+        with open(tmp_pid, "w") as f:
+            f.write(str(os.getpid()))
+        os.replace(tmp_pid, pid_file)
+
+    _apply_spec_env(spec)
 
     distributed = spec.get("distributed")
     process_id = int(distributed["process_id"]) if distributed else 0
@@ -531,9 +543,16 @@ def run_task(spec: dict) -> int:
 # --------------------------------------------------------------------------
 
 
+#: Serializes protocol writes: the serve loop, RPC invocation threads, and
+#: their heartbeat threads all share one stdout channel, and an interleaved
+#: write would corrupt the line protocol.
+_EMIT_LOCK = threading.Lock()
+
+
 def _emit(obj: dict) -> None:
-    sys.stdout.write(json.dumps(obj) + "\n")
-    sys.stdout.flush()
+    with _EMIT_LOCK:
+        sys.stdout.write(json.dumps(obj) + "\n")
+        sys.stdout.flush()
 
 
 def _spawn_task(command: dict, children: dict) -> None:
@@ -548,6 +567,13 @@ def _spawn_task(command: dict, children: dict) -> None:
     if pid == 0:
         rc = 1
         try:
+            # Fork-safety: an RPC invocation/heartbeat thread may hold the
+            # event or emit lock at fork time, and the child inherits the
+            # locked state with no thread to ever release it — fresh locks
+            # make the child's own event writes deadlock-free.
+            global _worker_event_lock, _EMIT_LOCK
+            _worker_event_lock = threading.Lock()
+            _EMIT_LOCK = threading.Lock()
             import signal as _signal
 
             _signal.set_wakeup_fd(-1)
@@ -573,6 +599,277 @@ def _spawn_task(command: dict, children: dict) -> None:
             os._exit(rc)
     children[pid] = task_id
     _emit({"event": "started", "id": task_id, "pid": pid})
+
+
+# --------------------------------------------------------------------------
+# RPC execute-by-digest: the resident executor loop.
+#
+# Launch mode (above) pays a fork + interpreter state per electron and
+# stages args/results through remote disk.  RPC mode keeps the *work* in
+# the resident interpreter too: the dispatcher ships the cloudpickled
+# function ONCE per connection into the CAS, registers it by digest, and
+# thereafter invokes by digest with args inline on the channel — results
+# stream back base64-pickled over the same channel.  No per-electron
+# process, no pid file, no poll loop, no result file:
+#
+#   -> {"cmd":"register_fn","digest":"<sha256>","path":"/cas/<sha256>.pkl"}
+#   <- {"event":"registered","digest":"<sha256>"}
+#   <- {"event":"register_error","digest":"...","code":"digest_mismatch"|
+#       "missing"|"load_failed","message":"..."}           (on failure)
+#   -> {"cmd":"invoke","id":"<op>","digest":"<sha256>","spec":{...},
+#       "args":"<b64 cloudpickle (args, kwargs)>"}            (inline)
+#       ... or "args_path"/"args_digest" for oversized args staged in the
+#       CAS (digest verified before unpickling, like the function itself)
+#   <- {"event":"started","id":"<op>","pid":<server pid>,"rpc":true}
+#   <- {"event":"telemetry","id":"<op>","data":{...}}   (task events +
+#       heartbeats, same schema/trace contract as launch-mode workers)
+#   <- {"event":"result","id":"<op>","ok":true,"data":"<b64 pickle of
+#       (result, exception)>"}
+#
+# Registration digest-verifies the CAS artifact BEFORE unpickling (the
+# same torn-payload guard run_task applies) and unpickles once; each
+# invocation runs on a daemon thread so the command loop stays live and
+# concurrent invocations share the warm imports.  A crash that takes the
+# resident process down surfaces to the dispatcher as a channel death —
+# classified transient, gang retried, function re-registered.
+# --------------------------------------------------------------------------
+
+
+def _load_fn_payload(path: str, digest: str):
+    """``(code, fn_or_error)``: digest-verified CAS bytes -> callable."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError as err:
+        return "missing", err
+    import hashlib
+
+    if hashlib.sha256(data).hexdigest() != digest:
+        return "digest_mismatch", RuntimeError(
+            f"registered function {path} does not match its content digest "
+            "(torn or stale CAS artifact)"
+        )
+    try:
+        import cloudpickle
+
+        return "", cloudpickle.loads(data)
+    except BaseException as err:  # noqa: BLE001 - arbitrary user payloads
+        return "load_failed", err
+
+
+def _rpc_register(command: dict, registry: dict) -> None:
+    digest = command.get("digest")
+    path = command.get("path")
+    if not digest or not path:
+        _emit({"event": "error", "message": "register_fn requires digest and path"})
+        return
+    if digest in registry:  # idempotent: re-register is a no-op ack
+        _emit({"event": "registered", "digest": digest})
+        return
+    code, loaded = _load_fn_payload(path, digest)
+    if code:
+        _emit({
+            "event": "register_error", "digest": digest,
+            "code": code, "message": repr(loaded),
+        })
+        return
+    registry[digest] = loaded
+    _emit({"event": "registered", "digest": digest})
+
+
+def _decode_rpc_args(command: dict) -> tuple:
+    """``(args, kwargs)`` from the invoke command (inline b64 or CAS path).
+
+    CAS-staged args are digest-verified before unpickling — oversized
+    payloads keep the same torn-artifact guard inline ones get for free
+    (the channel delivered the exact bytes the dispatcher encoded).
+    """
+    import base64
+
+    import cloudpickle
+
+    b64 = command.get("args")
+    if b64 is not None:
+        data = base64.b64decode(b64)
+    else:
+        path = command.get("args_path")
+        if not path:
+            return (), {}
+        with open(path, "rb") as f:
+            data = f.read()
+        expected = command.get("args_digest")
+        if expected:
+            import hashlib
+
+            if hashlib.sha256(data).hexdigest() != expected:
+                raise RuntimeError(
+                    f"staged RPC args {path} do not match their content "
+                    "digest (torn or stale CAS artifact)"
+                )
+    args, kwargs = cloudpickle.loads(data)
+    return tuple(args), dict(kwargs)
+
+
+def _encode_rpc_result(result, exception) -> str:
+    """Base64 of the ``(result, exception)`` pickle — byte-identical layout
+    to the result file launch mode writes, just streamed instead of
+    staged."""
+    import base64
+
+    try:
+        import cloudpickle as pick
+    except ImportError:
+        import pickle as pick
+    try:
+        data = pick.dumps((result, exception))
+    except BaseException as err:  # noqa: BLE001 - unpicklable user results
+        import pickle
+
+        data = pickle.dumps(
+            (None, RuntimeError(f"RPC result not picklable: {err!r}"))
+        )
+    return base64.b64encode(data).decode("ascii")
+
+
+def _emit_rpc_event(spec: dict, task_id: str, type: str, **fields) -> None:
+    """One worker-side record pushed straight over the channel.
+
+    Same envelope (`_build_worker_event`: ts/pid/seq/trace) as launch-mode
+    workers write to their telemetry files — the dispatcher's backhaul
+    handler can't tell the transports apart, which is the point.  The
+    ``rpc`` marker tells the dispatcher these events did NOT also land in
+    a shared-filesystem sink, so they re-emit even on the local transport.
+    """
+    _emit({
+        "event": "telemetry", "id": task_id,
+        "data": _build_worker_event(spec, type, rpc=True, **fields),
+    })
+
+
+def _start_rpc_heartbeat(spec: dict, task_id: str):
+    """Channel-streamed heartbeats for one invocation (no snapshot files)."""
+    try:
+        interval = float(spec.get("heartbeat_s") or 0)
+    except (TypeError, ValueError):
+        interval = 0.0
+    if interval <= 0:
+        return None
+    stop = threading.Event()
+
+    def beat_loop() -> None:
+        hb_seq = 0
+        while True:
+            hb_seq += 1
+            _emit_rpc_event(
+                spec, task_id, "worker.heartbeat",
+                hb_seq=hb_seq, interval_s=interval,
+                **_heartbeat_payload(""),
+            )
+            if stop.wait(interval):
+                return
+
+    threading.Thread(
+        target=beat_loop, name="covalent-tpu-rpc-heartbeat", daemon=True
+    ).start()
+    return stop
+
+
+def _run_rpc_task(command: dict, fn) -> None:
+    """Execute one registered function in-process and stream the result.
+
+    The launch-mode contract, minus the process: task_started /
+    heartbeats / task_finished events (trace-stamped from the spec), user
+    exceptions transported — never raised — and device arrays materialised
+    to host before pickling.
+    """
+    task_id = command.get("id") or ""
+    spec = dict(command.get("spec") or {})
+    spec.setdefault("operation_id", task_id)
+    # Same env contract as a launch-mode harness child (os.environ +
+    # PYTHONPATH sys.path mirror + jax platform pin): task_env must mean
+    # the same thing whichever runtime executes the function.
+    _apply_spec_env(spec)
+    result, exception = None, None
+    try:
+        args, kwargs = _decode_rpc_args(command)
+    except BaseException as err:  # noqa: BLE001 - torn args fail the task
+        args, kwargs, exception = (), {}, err
+    _emit_rpc_event(spec, task_id, "worker.task_started", process_id=0)
+    heartbeat_stop = _start_rpc_heartbeat(spec, task_id)
+    try:
+        if exception is None:
+            try:
+                result = fn(*args, **kwargs)
+                result = _to_host(result)
+            except Exception as task_error:  # noqa: BLE001 - transported
+                exception = task_error
+    finally:
+        if heartbeat_stop is not None:
+            heartbeat_stop.set()
+    _emit({
+        "event": "result", "id": task_id,
+        "ok": exception is None,
+        "data": _encode_rpc_result(result, exception),
+    })
+    _emit_rpc_event(
+        spec, task_id, "worker.task_finished", process_id=0,
+        ok=exception is None,
+        **({"error": repr(exception)} if exception is not None else {}),
+    )
+
+
+def _rpc_invoke(command: dict, registry: dict, sync: bool = False) -> None:
+    task_id = command.get("id")
+    digest = command.get("digest")
+    if not task_id or not digest:
+        _emit({"event": "error", "id": task_id or "",
+               "message": "invoke requires id and digest"})
+        return
+    fn = registry.get(digest)
+    if fn is None and command.get("path"):
+        # Self-heal a lost registration (agent restarted between the
+        # dispatcher's register and invoke) and serve the --rpc-child
+        # one-shot mode: load from the CAS path, digest verified.
+        code, loaded = _load_fn_payload(command["path"], digest)
+        if not code:
+            registry[digest] = fn = loaded
+    if fn is None:
+        _emit({"event": "error", "id": task_id, "code": "unregistered",
+               "message": f"no registered function for digest {digest[:12]}"})
+        return
+    _emit({"event": "started", "id": task_id, "pid": os.getpid(),
+           "rpc": True})
+    if sync:
+        _run_rpc_task(command, fn)
+        return
+    threading.Thread(
+        target=_run_rpc_task, args=(command, fn),
+        name=f"covalent-tpu-rpc-{task_id}", daemon=True,
+    ).start()
+
+
+def rpc_child() -> int:
+    """``harness.py --rpc-child``: one invocation, command on stdin.
+
+    The native C++ agent's invoke support: it forks this runner per
+    invocation, pipes the invoke command (which carries the CAS ``path``)
+    to stdin, and streams the started/telemetry/result events from stdout
+    back over its channel.  Slower than the resident pool loop (one
+    interpreter start per call) but keeps the protocol — and the
+    no-disk-for-args/results property — uniform across both runtimes.
+    """
+    line = sys.stdin.readline()
+    if not line.strip():
+        print("usage: harness.py --rpc-child  (invoke command on stdin)",
+              file=sys.stderr)
+        return 2
+    try:
+        command = json.loads(line)
+    except ValueError:
+        _emit({"event": "error", "message": "malformed invoke command"})
+        return 1
+    _rpc_invoke(command, {}, sync=True)
+    return 0
 
 
 #: Per-pump read ceiling: one oversized telemetry burst must not wedge the
@@ -671,6 +968,10 @@ def serve() -> int:
     children: dict = {}
     #: task id -> {"path", "pos", "buf"} telemetry tails (watch cmd).
     watchers: dict = {}
+    #: digest -> unpickled callable (register_fn cmd); dies with the
+    #: process, which is exactly the lifetime the dispatcher's
+    #: per-connection registered-set mirrors.
+    rpc_registry: dict = {}
     buffer = ""
     running = True
     stdin_open = True
@@ -710,6 +1011,10 @@ def serve() -> int:
                     _emit({"event": "pong"})
                 elif name == "run":
                     _spawn_task(command, children)
+                elif name == "register_fn":
+                    _rpc_register(command, rpc_registry)
+                elif name == "invoke":
+                    _rpc_invoke(command, rpc_registry)
                 elif name == "kill":
                     target = command.get("id")
                     sig = int(command.get("sig", 15))
@@ -761,8 +1066,13 @@ def serve() -> int:
 def main(argv: list[str]) -> int:
     if len(argv) == 2 and argv[1] == "--serve":
         return serve()
+    if len(argv) >= 2 and argv[1] == "--rpc-child":
+        return rpc_child()
     if len(argv) != 2:
-        print("usage: harness.py <task_spec.json> | --serve", file=sys.stderr)
+        print(
+            "usage: harness.py <task_spec.json> | --serve | --rpc-child",
+            file=sys.stderr,
+        )
         return 2
     # Become a session/process-group leader (pool-mode children already do
     # this in _spawn_task): the dispatcher's cancel and timeout-escalation

@@ -22,9 +22,31 @@
 //   <- {"event":"telemetry","id":"<op>","data":{...}}     (per line, pushed)
 //   -> {"cmd":"unwatch","id":"<op>"}
 //   <- {"event":"unwatched","id":"<op>"}
+//   -> {"cmd":"register_fn","digest":"<sha256>","path":"/cas/<sha256>.pkl",
+//       "runner":["python3","/cache/covalent_tpu_harness.py","--rpc-child"]}
+//   <- {"event":"registered","digest":"<sha256>"}
+//   <- {"event":"register_error","digest":"...","code":"digest_mismatch"|
+//       "missing","message":"..."}
+//   -> {"cmd":"invoke","id":"<op>","digest":"<sha256>","spec":{...},
+//       "args":"<b64>"}
+//   <- {"event":"started","id":"<op>", ...}      (emitted by the runner)
+//   <- {"event":"result","id":"<op>","ok":true,"data":"<b64>"}  (runner)
 //   -> {"cmd":"shutdown"}
 //   <- {"event":"bye"}
 //   <- {"event":"error","message":"..."}  (malformed input, unknown id, ...)
+//
+// RPC execute-by-digest: register_fn verifies the CAS artifact's sha256
+// IN THIS PROCESS before accepting the registration (a torn or stale
+// artifact is refused with code digest_mismatch, which the dispatcher
+// classifies permanent), and remembers digest -> {path, runner argv}.
+// invoke forks the registered runner (the Python harness in --rpc-child
+// mode), pipes the invoke command — args inline, nothing staged to disk —
+// to its stdin, and streams the runner's started/telemetry/result events
+// back over this channel verbatim.  The resident *interpreter* lives in
+// the harness pool server; this native path keeps the protocol uniform
+// for workers running only the C++ agent (one interpreter start per
+// invocation instead of a warm loop — the dispatcher prefers the pool
+// runtime for RPC dispatch when both are available).
 //
 // The watch side-band tails a task's worker-local JSONL telemetry file
 // (heartbeats, worker events) back over the channel in near-real-time.  A
@@ -294,6 +316,113 @@ static void emit_error(const std::string& message, const std::string& id = "") {
 }
 
 // ---------------------------------------------------------------------------
+// SHA-256 (FIPS 180-4): register_fn digest verification, no dependencies.
+// ---------------------------------------------------------------------------
+
+struct Sha256 {
+  uint32_t state[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                       0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+  uint64_t bitlen = 0;
+  unsigned char block[64];
+  size_t blocklen = 0;
+
+  static uint32_t rotr(uint32_t x, unsigned n) {
+    return (x >> n) | (x << (32 - n));
+  }
+
+  void transform(const unsigned char* p) {
+    static const uint32_t k[64] = {
+        0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b,
+        0x59f111f1, 0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01,
+        0x243185be, 0x550c7dc3, 0x72be5d74, 0x80deb1fe, 0x9bdc06a7,
+        0xc19bf174, 0xe49b69c1, 0xefbe4786, 0x0fc19dc6, 0x240ca1cc,
+        0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da, 0x983e5152,
+        0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+        0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc,
+        0x53380d13, 0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85,
+        0xa2bfe8a1, 0xa81a664b, 0xc24b8b70, 0xc76c51a3, 0xd192e819,
+        0xd6990624, 0xf40e3585, 0x106aa070, 0x19a4c116, 0x1e376c08,
+        0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a, 0x5b9cca4f,
+        0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+        0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+    uint32_t m[64];
+    for (int i = 0; i < 16; i++)
+      m[i] = (uint32_t)p[i * 4] << 24 | (uint32_t)p[i * 4 + 1] << 16 |
+             (uint32_t)p[i * 4 + 2] << 8 | (uint32_t)p[i * 4 + 3];
+    for (int i = 16; i < 64; i++) {
+      uint32_t s0 = rotr(m[i - 15], 7) ^ rotr(m[i - 15], 18) ^ (m[i - 15] >> 3);
+      uint32_t s1 = rotr(m[i - 2], 17) ^ rotr(m[i - 2], 19) ^ (m[i - 2] >> 10);
+      m[i] = m[i - 16] + s0 + m[i - 7] + s1;
+    }
+    uint32_t a = state[0], b = state[1], c = state[2], d = state[3];
+    uint32_t e = state[4], f = state[5], g = state[6], h = state[7];
+    for (int i = 0; i < 64; i++) {
+      uint32_t s1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+      uint32_t ch = (e & f) ^ (~e & g);
+      uint32_t t1 = h + s1 + ch + k[i] + m[i];
+      uint32_t s0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+      uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+      uint32_t t2 = s0 + maj;
+      h = g; g = f; f = e; e = d + t1;
+      d = c; c = b; b = a; a = t1 + t2;
+    }
+    state[0] += a; state[1] += b; state[2] += c; state[3] += d;
+    state[4] += e; state[5] += f; state[6] += g; state[7] += h;
+  }
+
+  void update(const unsigned char* p, size_t len) {
+    bitlen += (uint64_t)len * 8;
+    while (len > 0) {
+      size_t take = 64 - blocklen;
+      if (take > len) take = len;
+      memcpy(block + blocklen, p, take);
+      blocklen += take;
+      p += take;
+      len -= take;
+      if (blocklen == 64) {
+        transform(block);
+        blocklen = 0;
+      }
+    }
+  }
+
+  std::string hex_digest() {
+    block[blocklen++] = 0x80;
+    if (blocklen > 56) {
+      while (blocklen < 64) block[blocklen++] = 0;
+      transform(block);
+      blocklen = 0;
+    }
+    while (blocklen < 56) block[blocklen++] = 0;
+    for (int i = 7; i >= 0; i--) block[blocklen++] = (unsigned char)(bitlen >> (i * 8));
+    transform(block);
+    static const char* hexd = "0123456789abcdef";
+    std::string out;
+    out.reserve(64);
+    for (uint32_t word : state) {
+      for (int shift = 28; shift >= 0; shift -= 4)
+        out += hexd[(word >> shift) & 0xF];
+    }
+    return out;
+  }
+};
+
+static bool sha256_file(const std::string& path, std::string& hex_out) {
+  int fd = open(path.c_str(), O_RDONLY);
+  if (fd < 0) return false;
+  Sha256 sha;
+  char chunk[65536];
+  ssize_t n;
+  while ((n = read(fd, chunk, sizeof chunk)) > 0)
+    sha.update((const unsigned char*)chunk, (size_t)n);
+  bool ok = (n == 0);
+  close(fd);
+  if (!ok) return false;
+  hex_out = sha.hex_digest();
+  return true;
+}
+
+// ---------------------------------------------------------------------------
 // Child management.
 // ---------------------------------------------------------------------------
 
@@ -386,6 +515,156 @@ static void kill_task(const Json& cmd) {
     }
   }
   emit_error("unknown task id", id_field->s);
+}
+
+// ---------------------------------------------------------------------------
+// RPC execute-by-digest: registry + runner-forked invocations.
+// ---------------------------------------------------------------------------
+
+struct Registration {
+  std::string path;                 // CAS artifact holding the function
+  std::vector<std::string> runner;  // argv forked per invocation
+};
+
+static std::map<std::string, Registration> g_registry;
+
+struct RpcStream {
+  std::string id;
+  std::string buf;
+};
+
+//: runner-stdout fd -> stream state; lines are forwarded verbatim.
+static std::map<int, RpcStream> g_rpc_streams;
+
+static void register_fn(const Json& cmd) {
+  const Json* digest = cmd.get("digest");
+  const Json* path = cmd.get("path");
+  if (!digest || digest->type != Json::Str || !path ||
+      path->type != Json::Str || path->s.empty()) {
+    emit_error("register_fn requires digest and path");
+    return;
+  }
+  Registration reg;
+  reg.path = path->s;
+  const Json* runner = cmd.get("runner");
+  if (runner && runner->type == Json::Arr)
+    for (const auto& part : runner->arr)
+      if (part.type == Json::Str) reg.runner.push_back(part.s);
+  std::string hex;
+  if (!sha256_file(reg.path, hex)) {
+    emit("{\"event\":\"register_error\",\"digest\":\"" +
+         json_escape(digest->s) + "\",\"code\":\"missing\",\"message\":\"" +
+         json_escape("cannot read " + reg.path) + "\"}");
+    return;
+  }
+  if (hex != digest->s) {
+    // Refused, never stored: invoking a payload whose bytes don't match
+    // their content address would execute the wrong function.  The
+    // dispatcher classifies this permanent (torn or stale CAS artifact).
+    emit("{\"event\":\"register_error\",\"digest\":\"" +
+         json_escape(digest->s) +
+         "\",\"code\":\"digest_mismatch\",\"message\":\"" +
+         json_escape(reg.path + " does not match its content digest") +
+         "\"}");
+    return;
+  }
+  g_registry[digest->s] = std::move(reg);
+  emit("{\"event\":\"registered\",\"digest\":\"" + json_escape(digest->s) +
+       "\"}");
+}
+
+static void invoke_task(const Json& cmd, const std::string& raw_line) {
+  const Json* id_field = cmd.get("id");
+  const Json* digest = cmd.get("digest");
+  if (!id_field || id_field->type != Json::Str || !digest ||
+      digest->type != Json::Str) {
+    emit_error("invoke requires string id and digest");
+    return;
+  }
+  auto it = g_registry.find(digest->s);
+  if (it == g_registry.end()) {
+    emit("{\"event\":\"error\",\"id\":\"" + json_escape(id_field->s) +
+         "\",\"code\":\"unregistered\",\"message\":\"no registered function "
+         "for digest\"}");
+    return;
+  }
+  if (it->second.runner.empty()) {
+    emit("{\"event\":\"error\",\"id\":\"" + json_escape(id_field->s) +
+         "\",\"code\":\"no_runner\",\"message\":\"registration carried no "
+         "runner argv\"}");
+    return;
+  }
+  int in_pipe[2] = {-1, -1}, out_pipe[2] = {-1, -1};
+  if (pipe(in_pipe) != 0 || pipe(out_pipe) != 0) {
+    if (in_pipe[0] >= 0) { close(in_pipe[0]); close(in_pipe[1]); }
+    emit_error(std::string("pipe failed: ") + strerror(errno), id_field->s);
+    return;
+  }
+  pid_t pid = fork();
+  if (pid < 0) {
+    close(in_pipe[0]); close(in_pipe[1]);
+    close(out_pipe[0]); close(out_pipe[1]);
+    emit_error(std::string("fork failed: ") + strerror(errno), id_field->s);
+    return;
+  }
+  if (pid == 0) {
+    // Runner child: own session (kill -- -pid reaches it), invoke command
+    // on stdin, protocol events on stdout, stderr discarded.
+    setsid();
+    dup2(in_pipe[0], 0);
+    dup2(out_pipe[1], 1);
+    int devnull = open("/dev/null", O_WRONLY);
+    if (devnull >= 0) dup2(devnull, 2);
+    for (int fd = 3; fd < 256; fd++) close(fd);
+    std::vector<char*> argv;
+    argv.reserve(it->second.runner.size() + 1);
+    for (const auto& a : it->second.runner)
+      argv.push_back(const_cast<char*>(a.c_str()));
+    argv.push_back(nullptr);
+    execvp(argv[0], argv.data());
+    _exit(127);
+  }
+  close(in_pipe[0]);
+  close(out_pipe[1]);
+  // Feed the invoke command — it carries the CAS path and inline args, so
+  // the runner needs no disk staging — then close: exactly one line.
+  std::string payload = raw_line + "\n";
+  size_t off = 0;
+  while (off < payload.size()) {
+    ssize_t n = write(in_pipe[1], payload.data() + off, payload.size() - off);
+    if (n <= 0) break;
+    off += (size_t)n;
+  }
+  close(in_pipe[1]);
+  g_tasks[pid] = Task{pid, id_field->s};
+  g_rpc_streams[out_pipe[0]] = RpcStream{id_field->s, ""};
+  // No `started` from here: the runner emits its own, with the pid that
+  // actually executes the function.
+}
+
+static void pump_rpc_stream(int fd) {
+  auto it = g_rpc_streams.find(fd);
+  if (it == g_rpc_streams.end()) return;
+  char chunk[65536];
+  ssize_t n = read(fd, chunk, sizeof chunk);
+  if (n <= 0) {
+    close(fd);
+    g_rpc_streams.erase(it);
+    return;
+  }
+  RpcStream& s = it->second;
+  s.buf.append(chunk, (size_t)n);
+  size_t nl;
+  while ((nl = s.buf.find('\n')) != std::string::npos) {
+    std::string line = s.buf.substr(0, nl);
+    s.buf.erase(0, nl + 1);
+    if (line.empty()) continue;
+    Json parsed;
+    // Validate before forwarding; valid runner lines ARE protocol events
+    // (started/telemetry/result) and pass through verbatim.
+    if (!parse_json(line, parsed) || parsed.type != Json::Obj) continue;
+    emit(line);
+  }
 }
 
 // ---------------------------------------------------------------------------
@@ -505,6 +784,8 @@ static void handle_line(const std::string& line, bool& running) {
   const std::string& name = cmd_field->s;
   if (name == "ping") emit("{\"event\":\"pong\"}");
   else if (name == "run") spawn(cmd);
+  else if (name == "register_fn") register_fn(cmd);
+  else if (name == "invoke") invoke_task(cmd, line);
   else if (name == "kill") kill_task(cmd);
   else if (name == "watch") watch_task(cmd);
   else if (name == "unwatch") unwatch_task(cmd);
@@ -532,34 +813,34 @@ int main() {
   char chunk[4096];
 
   // Keep serving until shutdown — or, after stdin closes, until every child
-  // is reaped so no exit event is lost on a clean drain.
-  while (running && (stdin_open || !g_tasks.empty())) {
-    struct pollfd fds[2];
-    nfds_t nfds = 0;
-    if (stdin_open) {
-      fds[nfds].fd = 0;
-      fds[nfds].events = POLLIN;
-      nfds++;
-    }
-    fds[nfds].fd = g_sigchld_pipe[0];
-    fds[nfds].events = POLLIN;
-    nfds++;
+  // is reaped AND every RPC runner's stream is drained, so neither an exit
+  // event nor a buffered result line is lost on a clean drain.
+  while (running && (stdin_open || !g_tasks.empty() || !g_rpc_streams.empty())) {
+    std::vector<struct pollfd> fds;
+    if (stdin_open) fds.push_back({0, POLLIN, 0});
+    fds.push_back({g_sigchld_pipe[0], POLLIN, 0});
+    for (const auto& kv : g_rpc_streams) fds.push_back({kv.first, POLLIN, 0});
 
     // Live watchers wake the loop on a short tick so telemetry flows
-    // without inbound traffic; otherwise block until a command/SIGCHLD.
-    int rc = poll(fds, nfds, g_watchers.empty() ? -1 : 250);
+    // without inbound traffic; otherwise block until a command/SIGCHLD/
+    // runner output.
+    int rc = poll(fds.data(), (nfds_t)fds.size(), g_watchers.empty() ? -1 : 250);
     if (rc < 0) {
       if (errno == EINTR) { reap_children(); pump_watchers(); continue; }
       break;
     }
     pump_watchers();
 
-    for (nfds_t k = 0; k < nfds; k++) {
+    for (size_t k = 0; k < fds.size(); k++) {
       if (!(fds[k].revents & (POLLIN | POLLHUP | POLLERR))) continue;
       if (fds[k].fd == g_sigchld_pipe[0]) {
         char drain[64];
         while (read(g_sigchld_pipe[0], drain, sizeof drain) > 0) {}
         reap_children();
+      } else if (fds[k].fd != 0) {
+        // Runner stream (a stream erased earlier this sweep is a no-op
+        // inside pump_rpc_stream — never fall through to the stdin read).
+        pump_rpc_stream(fds[k].fd);
       } else {
         ssize_t n = read(0, chunk, sizeof chunk);
         if (n <= 0) {

@@ -31,8 +31,10 @@ validate -> connect -> stage -> upload -> submit -> poll -> fetch -> cleanup
 from __future__ import annotations
 
 import asyncio
+import base64
 import json
 import os
+import pickle
 import shlex
 import time
 import weakref
@@ -53,6 +55,7 @@ from .agent import (
 from .cache import (
     RESULT_CACHE_TOTAL,
     CASIndex,
+    FnRegistry,
     ResultCache,
     bytes_digest,
     cas_path,
@@ -140,6 +143,21 @@ _EXECUTOR_PLUGIN_DEFAULTS = {
     "task_timeout": 0.0,
     "task_env": {},
     "use_agent": True,
+    # RPC dispatch (ROADMAP item 3): "launch" runs every electron through
+    # the process-launch path (harness process per electron); "auto"
+    # executes eligible electrons (single-worker, no pip deps/profiling,
+    # no chaos plan) by digest on the warm resident runtime instead — ship
+    # the cloudpickled function once per connection via the CAS, invoke by
+    # digest over the agent channel, stream the result back without
+    # touching remote disk; "rpc" pins RPC mode even under a chaos plan
+    # (still falling back to launch when no resident runtime exists).
+    # COVALENT_TPU_DISPATCH_MODE overrides per process; electron metadata
+    # ("dispatch_mode") overrides per electron.
+    "dispatch_mode": "launch",
+    # Args at or below this many pickled bytes travel inline on the RPC
+    # channel; larger args are staged through the CAS (digest-verified
+    # remotely) instead.  COVALENT_TPU_RPC_INLINE_MAX overrides.
+    "rpc_inline_args_max": 64 * 1024,
     # Level-2 cache (cache.py): memoize completed electron results locally,
     # keyed by (function digest, args digest, executor env fingerprint).
     # Only sound for side-effect-free electrons, hence opt-in; the env var
@@ -334,6 +352,13 @@ class _StageUploadFailed(Exception):
     """
 
 
+class _RpcUnavailable(Exception):
+    """Internal control flow: this gang cannot host an RPC invocation
+    (no resident pool runtime on the worker).  Caught by the retry driver,
+    which re-runs the SAME attempt through the launch path — the ISSUE's
+    "automatic fallback on missing agent"."""
+
+
 class _RetryDispatch(Exception):
     """Internal control flow: this attempt failed transiently and the retry
     budget allows another.  Raised by ``_run_attempt``'s failure sites and
@@ -393,6 +418,8 @@ class TPUExecutor(RemoteExecutor):
         task_timeout: float | None = None,
         task_env: dict[str, str] | None = None,
         use_agent: bool | str | None = None,
+        dispatch_mode: str | None = None,
+        rpc_inline_args_max: int | None = None,
         pool_preload: str | None = None,
         compress: str | None = None,
         bundle: bool | None = None,
@@ -483,6 +510,34 @@ class TPUExecutor(RemoteExecutor):
             )
         if self.use_agent == "off":
             self.use_agent = False
+        #: RPC dispatch mode: explicit arg > COVALENT_TPU_DISPATCH_MODE >
+        #: config; per-electron metadata ("dispatch_mode") overrides again.
+        env_mode = os.environ.get("COVALENT_TPU_DISPATCH_MODE")
+        if dispatch_mode is None and env_mode is not None:
+            dispatch_mode = env_mode.strip().lower() or None
+        self.dispatch_mode = str(
+            resolve(dispatch_mode, "dispatch_mode")
+        ).lower()
+        if self.dispatch_mode not in ("launch", "auto", "rpc"):
+            raise ValueError(
+                f'dispatch_mode must be "launch", "auto" or "rpc", '
+                f"got {self.dispatch_mode!r}"
+            )
+        env_inline = os.environ.get("COVALENT_TPU_RPC_INLINE_MAX")
+        if rpc_inline_args_max is None and env_inline is not None:
+            try:
+                rpc_inline_args_max = int(env_inline)
+            except ValueError:
+                app_log.warning(
+                    "ignoring non-integer COVALENT_TPU_RPC_INLINE_MAX=%r",
+                    env_inline,
+                )
+        self.rpc_inline_args_max = max(
+            0, int(resolve(rpc_inline_args_max, "rpc_inline_args_max"))
+        )
+        #: dispatch mode the most recent attempt actually used
+        #: ("rpc"/"launch"); bench and tests assert the fast path engaged.
+        self.last_dispatch_mode = ""
         #: comma-separated modules the pool server imports once at start.
         self.pool_preload = str(resolve(pool_preload, "pool_preload"))
         #: wire codec policy: explicit arg > COVALENT_TPU_COMPRESS > config.
@@ -607,6 +662,10 @@ class TPUExecutor(RemoteExecutor):
         self._preflighted: set[str] = set()
         #: level-1 cache: per-connection CAS digest sets (cache.py).
         self._cas = CASIndex()
+        #: RPC function registry: per-connection registered-digest sets
+        #: mirroring the CAS index (evicted with the channel; self-resets
+        #: when a restarted agent loses its in-process registry).
+        self._fn_registry = FnRegistry()
         #: level-2 cache: opt-in electron result memoization.
         self._result_cache: ResultCache | None = (
             ResultCache(
@@ -683,12 +742,34 @@ class TPUExecutor(RemoteExecutor):
             "workers": addresses,
             "heartbeat_interval_s": self.heartbeat_interval,
             "stall_after_s": self._stall_after(),
+            "dispatch_mode": self.dispatch_mode,
+            "rpc_registered": self._fn_registry.counts(),
             "in_flight": in_flight,
             "circuit_breakers": self._breakers.states(),
             "agents": {
                 address: (client.mode if client is not None else None)
                 for address, client in self._agents.items()
             },
+        }
+
+    # -- RPC registry views (fleet placement + ops /status) ----------------
+
+    def holds_fn_digest(self, digest: str) -> bool:
+        """Whether any live connection's resident runtime registered this
+        function digest — the fleet scheduler's placement-affinity probe
+        (a holding gang skips the register round trip entirely)."""
+        return bool(digest) and self._fn_registry.holds(digest)
+
+    def rpc_digest_count(self) -> int:
+        """Distinct function digests registered across this executor's
+        connections (the fleet ``/status`` per-pool counter)."""
+        return len(self._fn_registry.digests())
+
+    def in_flight_modes(self) -> dict[str, str]:
+        """operation id -> dispatch mode for every in-flight electron."""
+        return {
+            op: str(state.get("mode", "launch"))
+            for op, state in list(self._op_status.items())
         }
 
     # ------------------------------------------------------------------ #
@@ -896,6 +977,10 @@ class TPUExecutor(RemoteExecutor):
             self._preflighted.discard(key)
             self._wire_codecs.pop(key, None)
             self._cas.forget(key)
+            # The resident runtime died with its channel: its in-process
+            # function registry is gone, so the next RPC dispatch must
+            # re-register (execute-by-digest self-heals like the CAS).
+            self._fn_registry.forget(key)
         # A recreated worker must be re-dialed by the next prewarm too.
         self._prewarmed = False
         # A mid-run control-plane failure may mean the TPU itself was
@@ -1631,12 +1716,14 @@ class TPUExecutor(RemoteExecutor):
         Heartbeats feed the liveness monitor; other worker events are
         re-emitted into the dispatcher's stream — except on the local
         transport, where the shared filesystem already delivered them
-        (the harness writes the dispatcher's JSONL directly).
+        (the harness writes the dispatcher's JSONL directly).  RPC-mode
+        events (``rpc`` marker) exist ONLY on the channel — no file sink
+        anywhere — so they re-emit regardless of transport.
         """
         if data.get("type") == "worker.heartbeat":
             self._record_heartbeat(operation_id, worker, data)
             return
-        if self.transport_kind == "local":
+        if self.transport_kind == "local" and not data.get("rpc"):
             return
         body = {k: v for k, v in data.items() if k not in ("type", "ts")}
         worker_ts = data.get("ts")
@@ -2467,8 +2554,11 @@ class TPUExecutor(RemoteExecutor):
         self._wire_codecs.clear()
         self._prewarmed = False
         # CASIndex holds loop-bound locks/futures; present-set knowledge is
-        # cheap to rebuild via one probe per redialed connection.
+        # cheap to rebuild via one probe per redialed connection.  The RPC
+        # registry's futures are loop-bound too, and its resident runtimes
+        # were abandoned with the agents above.
         self._cas = CASIndex()
+        self._fn_registry = FnRegistry()
         self._bound_loop = loop
 
     async def close(self) -> None:
@@ -2501,6 +2591,58 @@ class TPUExecutor(RemoteExecutor):
     # ------------------------------------------------------------------ #
     # Orchestrator                                                       #
     # ------------------------------------------------------------------ #
+
+    def _resolve_dispatch_mode(self, task_metadata: dict) -> str:
+        """Effective mode for one electron: metadata overrides config.
+
+        An invalid metadata value falls back to the executor's configured
+        (constructor-validated) mode with a warning — NOT silently to
+        "launch", which would quietly strip the fast path from an
+        executor pinned to ``rpc`` over a typo.
+        """
+        raw = task_metadata.get("dispatch_mode")
+        if raw is not None:
+            mode = str(raw).strip().lower()
+            if mode in ("launch", "auto", "rpc"):
+                return mode
+            app_log.warning(
+                "ignoring invalid electron dispatch_mode %r "
+                '(expected "launch", "auto" or "rpc"); using %r',
+                raw, self.dispatch_mode,
+            )
+        return self.dispatch_mode
+
+    def _rpc_preselect(self, task_metadata: dict) -> bool:
+        """Static RPC eligibility, decided before an attempt starts.
+
+        RPC mode runs the electron inside the resident worker process, so
+        it is reserved for the shapes that path can serve faithfully:
+        single-worker gangs (multi-host electrons need the per-process
+        ``jax.distributed`` bootstrap only the launch harness performs),
+        no pip installs or profiler traces (both are process-scoped), and
+        an agent policy that allows the pool runtime.  Under a chaos plan
+        ``auto`` defers to launch — fault budgets target the launch
+        protocol's round trips — while an explicit ``rpc`` pin keeps the
+        fast path so chaos tests can kill resident workers mid-invoke.
+        Dynamic conditions (no runtime on the worker) fall back later via
+        :class:`_RpcUnavailable`.
+        """
+        mode = self._resolve_dispatch_mode(task_metadata)
+        if mode == "launch":
+            return False
+        if self.use_agent not in (True, "auto", "pool"):
+            return False
+        if task_metadata.get("pip_deps"):
+            return False
+        if self.profile_dir:
+            return False
+        if self._chaos is not None and mode != "rpc":
+            return False
+        # Worker-count check without triggering discovery: pod slices
+        # (explicit multi-worker lists or tpu_name topologies) launch.
+        if self.tpu_name or len(self.workers) > 1:
+            return False
+        return True
 
     def _plan_retry(
         self,
@@ -2617,6 +2759,25 @@ class TPUExecutor(RemoteExecutor):
                     self._op_attempts.pop(next(iter(self._op_attempts)))
                 self._op_attempts[base_operation_id] = attempt + 1
                 try:
+                    if self._rpc_preselect(task_metadata):
+                        try:
+                            return await self._run_attempt_rpc(
+                                function, args, kwargs, task_metadata,
+                                operation_id, attempt, deadline,
+                            )
+                        except _RpcUnavailable as unavailable:
+                            # Same attempt, launch path: the gang has no
+                            # resident runtime to execute by digest.
+                            obs_events.emit(
+                                "task.rpc_fallback",
+                                operation_id=operation_id,
+                                reason=str(unavailable),
+                            )
+                            app_log.info(
+                                "task %s: RPC dispatch unavailable (%s); "
+                                "using the launch path",
+                                operation_id, unavailable,
+                            )
                     return await self._run_attempt(
                         function, args, kwargs, task_metadata,
                         operation_id, attempt, deadline,
@@ -2718,12 +2879,14 @@ class TPUExecutor(RemoteExecutor):
         # Live ops view (/status): stage advances at each lifecycle edge.
         self._op_status[operation_id] = {
             "stage": "starting",
+            "mode": "launch",
             "attempt": attempt + 1,
             "trace_id": root.trace_id,
             "dispatch_id": dispatch_id,
             "node_id": node_id,
             "since": time.time(),
         }
+        self.last_dispatch_mode = "launch"
         # Worker-side records join this attempt's trace (same trace id
         # across attempts — the parent executor.task span owns it).
         trace_context = context_of(root, attempt=attempt)
@@ -3111,54 +3274,588 @@ class TPUExecutor(RemoteExecutor):
         finally:
             # Terminal accounting runs on EVERY exit path — success,
             # failure, fallback, cancel — so overhead attribution and the
-            # outcome counter survive failed runs.
-            root.set_attribute("outcome", outcome)
-            if outcome not in ("completed", "fallback_local", "cached"):
-                root.record_error(outcome)
-            root.end()
-            self.last_timings = root.summary()
-            # Stage spans SUM concurrent work (pipelined upload/submit run
-            # per worker, staging overlaps the dial), so the wall-clock
-            # overhead the caller actually waited is reported separately:
-            # elapsed time minus the task's own runtime.
-            self.last_timings["wall_overhead"] = max(
-                0.0,
-                root.total() - root.stage_durations.get("execute", 0.0),
-            )
-            _ACTIVE_ELECTRONS.dec()
-            _TASKS_TOTAL.labels(outcome=outcome).inc()
-            _OVERHEAD_HIST.observe(root.overhead())
-            # The wall view (elapsed minus execute) is the number the
-            # overhead budget is asserted against — give it its own
-            # percentile-capable series, not just a per-run scalar.
-            _WALL_OVERHEAD_HIST.observe(self.last_timings["wall_overhead"])
-            self._op_status.pop(operation_id, None)
-            MONITOR.forget(operation_id)
-            obs_events.emit(
-                "task.state",
-                operation_id=operation_id,
-                state=outcome,
-                trace_id=root.trace_id,
-                overhead_s=round(root.overhead(), 6),
-                total_s=round(root.total(), 6),
-            )
-            self._active.pop(operation_id, None)
-            if attempt > 0:
-                # Attempt-scoped cancel marks die with the attempt; the
-                # BASE id's mark is cleared only by run()'s own finally —
-                # discarding it here would erase a user cancel() that
-                # raced a transient failure on attempt 0 (whose operation
-                # id IS the base id) and let the retry driver relaunch a
-                # cancelled electron.
-                self._cancelled_ops.discard(operation_id)
-            # Release per-task state retained by resident agent channels
-            # (e.g. straggler exit events whose waiters were cancelled).
-            for client in self._op_agents.pop(operation_id, []) or []:
-                if client is not None:
-                    client.forget(operation_id)
+            # outcome counter survive failed runs.  Shared with the RPC
+            # attempt path (_attempt_epilogue).
+            self._attempt_epilogue(root, outcome, operation_id, attempt)
             # Pooled transports stay open for the next electron; close()
             # tears them down.  Non-pooled (error) states are handled by
             # the pool itself.
+
+    def _attempt_epilogue(
+        self, root: Span, outcome: str, operation_id: str, attempt: int
+    ) -> None:
+        """Terminal accounting shared by the launch and RPC attempt paths."""
+        root.set_attribute("outcome", outcome)
+        if outcome not in ("completed", "fallback_local", "cached"):
+            root.record_error(outcome)
+        root.end()
+        self.last_timings = root.summary()
+        # Stage spans SUM concurrent work (pipelined upload/submit run
+        # per worker, staging overlaps the dial), so the wall-clock
+        # overhead the caller actually waited is reported separately:
+        # elapsed time minus the task's own runtime.
+        self.last_timings["wall_overhead"] = max(
+            0.0,
+            root.total() - root.stage_durations.get("execute", 0.0),
+        )
+        _ACTIVE_ELECTRONS.dec()
+        _TASKS_TOTAL.labels(outcome=outcome).inc()
+        _OVERHEAD_HIST.observe(root.overhead())
+        # The wall view (elapsed minus execute) is the number the
+        # overhead budget is asserted against — give it its own
+        # percentile-capable series, not just a per-run scalar.
+        _WALL_OVERHEAD_HIST.observe(self.last_timings["wall_overhead"])
+        self._op_status.pop(operation_id, None)
+        MONITOR.forget(operation_id)
+        obs_events.emit(
+            "task.state",
+            operation_id=operation_id,
+            state=outcome,
+            trace_id=root.trace_id,
+            overhead_s=round(root.overhead(), 6),
+            total_s=round(root.total(), 6),
+        )
+        self._active.pop(operation_id, None)
+        if attempt > 0:
+            # Attempt-scoped cancel marks die with the attempt; the
+            # BASE id's mark is cleared only by run()'s own finally —
+            # discarding it here would erase a user cancel() that
+            # raced a transient failure on attempt 0 (whose operation
+            # id IS the base id) and let the retry driver relaunch a
+            # cancelled electron.
+            self._cancelled_ops.discard(operation_id)
+        # Release per-task state retained by resident agent channels
+        # (e.g. straggler exit events whose waiters were cancelled, or an
+        # RPC result that arrived after its waiter gave up) — the leak
+        # audit's guarantee that EVERY exit path drops per-task state.
+        for client in self._op_agents.pop(operation_id, []) or []:
+            if client is not None:
+                client.forget(operation_id)
+
+    # ------------------------------------------------------------------ #
+    # RPC dispatch: execute-by-digest on the warm resident runtime        #
+    # ------------------------------------------------------------------ #
+
+    @staticmethod
+    def _write_payload_file(path: str, payload: bytes) -> None:
+        """Atomic write of a digest-named payload (immutable: skip if
+        present — concurrent electrons share function payload files)."""
+        if os.path.exists(path):
+            return
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, path)
+
+    @staticmethod
+    def _decode_rpc_result(event: dict) -> tuple[Any, BaseException | None]:
+        """``(result, exception)`` from a streamed result event — the same
+        pickle layout launch mode fetches from the result file."""
+        data = base64.b64decode(str(event.get("data") or ""))
+        return pickle.loads(data)
+
+    def _rpc_result_cache_key(
+        self,
+        fn: Callable,
+        fn_digest: str,
+        args_digest: str,
+        task_metadata: dict,
+    ) -> str | None:
+        """Memoization key for an RPC-mode electron.
+
+        Same shape as the launch key (payload digest, code digest, env
+        fingerprint) with the payload digest derived from the separately
+        pickled function + args, and the mode folded into the fingerprint
+        so the two paths never serve each other's entries.
+        """
+        fingerprint = json.dumps(
+            {
+                "transport": self.transport_kind,
+                "python_path": self.python_path,
+                "conda_env": self.conda_env,
+                "task_env": self.task_env,
+                "pip_deps": list(task_metadata.get("pip_deps", ()) or ()),
+                "workers": self.workers
+                or [self.tpu_name or self.hostname or "local"],
+                "workdir": self.remote_workdir,
+                "mode": "rpc",
+            },
+            sort_keys=True,
+            default=str,
+        )
+        return ResultCache.make_key(
+            bytes_digest(f"{fn_digest}:{args_digest}".encode()),
+            self._fn_code_digest(fn),
+            bytes_digest(fingerprint.encode()),
+        )
+
+    async def _await_rpc_result(
+        self, client: AgentClient, operation_id: str
+    ) -> tuple[str, Any]:
+        """Wait for one invocation's streamed result with liveness checks.
+
+        Returns a verdict pair: ``("result", event)`` on success,
+        ``("timeout", None)`` when ``task_timeout`` elapsed,
+        ``("stalled", None)`` when the liveness monitor flagged the
+        resident worker silent past its threshold, or
+        ``("channel", AgentError)`` when the agent channel died — a dead
+        resident worker and a dropped channel are indistinguishable here,
+        and both are the transient the caller tears the gang down for.
+        Wakes on a short tick to notice cancellation and stalls; with no
+        timeout set, logs the same still-running watchdog reminder the
+        polling path would.
+        """
+        timeout = self.task_timeout or None
+        stall_after = self._stall_after()
+        wake = (
+            min(1.0, max(0.25, stall_after / 4.0)) if stall_after else 0.5
+        )
+        loop = asyncio.get_running_loop()
+        started = loop.time()
+        deadline_t = started + timeout if timeout else None
+        last_watchdog = 0.0
+        waiter = asyncio.ensure_future(client.wait_result(operation_id))
+        try:
+            while True:
+                remaining = None
+                if deadline_t is not None:
+                    remaining = deadline_t - loop.time()
+                    if remaining <= 0:
+                        return "timeout", None
+                wait_for = wake if remaining is None else min(wake, remaining)
+                done, _pending = await asyncio.wait(
+                    {waiter}, timeout=wait_for
+                )
+                if done:
+                    try:
+                        return "result", waiter.result()
+                    except AgentError as err:
+                        return "channel", err
+                if self._is_cancelled(operation_id):
+                    raise asyncio.CancelledError(
+                        f"task {operation_id} cancelled"
+                    )
+                if stall_after and MONITOR.stalled(operation_id):
+                    return "stalled", None
+                waited = loop.time() - started
+                if (
+                    not timeout
+                    and waited - last_watchdog >= self.WATCHDOG_LOG_INTERVAL_S
+                ):
+                    last_watchdog = waited
+                    app_log.warning(
+                        "RPC task %s still running after %.0fs with no "
+                        "task_timeout set", operation_id, waited,
+                    )
+        finally:
+            waiter.cancel()
+            try:
+                await waiter
+            except (asyncio.CancelledError, AgentError):
+                pass
+
+    async def _run_attempt_rpc(
+        self,
+        function: Callable,
+        args: tuple,
+        kwargs: dict,
+        task_metadata: dict,
+        operation_id: str,
+        attempt: int,
+        deadline: Deadline,
+    ) -> Any:
+        """One dispatch attempt in RPC mode: execute-by-digest on the warm
+        resident runtime.
+
+        The per-electron cost collapses to (warm path): one ``invoke``
+        write on the agent channel with args inline, one pushed ``result``
+        event back — no per-electron process, no pid file, no status poll,
+        no remote disk for args or results.  The connection-scoped costs
+        (dial, pre-flight, agent start, CAS ship of the function pickle,
+        ``register_fn``) amortize across every electron sharing the
+        connection and digest, exactly like the CAS amortizes staging.
+
+        Failure routing matches the launch path's classification: a dead
+        resident worker or dropped channel is a transient (label
+        ``rpc_channel``) that tears the gang down for retry; a digest
+        mismatch at registration is PERMANENT (torn payload); timeouts
+        and stalls escalate by tearing down the resident runtime (an
+        in-process invocation cannot be killed any other way) and retry
+        under their existing labels.  :class:`_RpcUnavailable` (no pool
+        runtime on the gang) unwinds minimally — the retry driver re-runs
+        the same attempt through the launch path.
+        """
+        dispatch_id = task_metadata.get("dispatch_id", "dispatch")
+        node_id = task_metadata.get("node_id", 0)
+        self._guard_event_loop()
+
+        root = Span(
+            "executor.run",
+            {
+                "operation_id": operation_id,
+                "dispatch_id": dispatch_id,
+                "node_id": node_id,
+                "transport": self.transport_kind,
+                "attempt": attempt,
+                "mode": "rpc",
+            },
+        )
+        root.__enter__()
+        _ACTIVE_ELECTRONS.inc()
+        obs_events.emit(
+            "task.state",
+            operation_id=operation_id,
+            state="starting",
+            trace_id=root.trace_id,
+            mode="rpc",
+        )
+        self._op_status[operation_id] = {
+            "stage": "starting",
+            "mode": "rpc",
+            "attempt": attempt + 1,
+            "trace_id": root.trace_id,
+            "dispatch_id": dispatch_id,
+            "node_id": node_id,
+            "since": time.time(),
+        }
+        self.last_dispatch_mode = "rpc"
+        trace_context = context_of(root, attempt=attempt)
+        outcome = "failed"
+        fallback_to_launch = False
+        conns: list[Transport] = []
+        local_args: str | None = None
+        result_cache_key: str | None = None
+        try:
+            with Span("executor.stage"):
+                # Function and args pickle SEPARATELY (unlike the launch
+                # path's one (fn, args, kwargs) payload): the function's
+                # digest is the stable registry key electrons share, while
+                # args vary per call and ride the channel.
+                fn_payload, args_payload = await asyncio.to_thread(
+                    lambda: (
+                        cloudpickle.dumps(function),
+                        cloudpickle.dumps((tuple(args), dict(kwargs))),
+                    )
+                )
+                fn_digest = bytes_digest(fn_payload)
+                args_digest = bytes_digest(args_payload)
+                inline = len(args_payload) <= self.rpc_inline_args_max
+                local_fn = os.path.join(
+                    self.cache_dir, f"fn_rpc_{fn_digest}.pkl"
+                )
+                await asyncio.to_thread(
+                    self._write_payload_file, local_fn, fn_payload
+                )
+                if not inline:
+                    # Attempt-private name: concurrent electrons with
+                    # identical args must not share this file — each
+                    # attempt's finally unlinks its own copy, and a
+                    # digest-shared name would let one attempt's cleanup
+                    # race another's CAS upload (the CAS itself still
+                    # dedupes the remote bytes by digest).
+                    local_args = os.path.join(
+                        self.cache_dir,
+                        f"args_rpc_{args_digest}.{os.urandom(6).hex()}.pkl",
+                    )
+                    await asyncio.to_thread(
+                        self._write_payload_file, local_args, args_payload
+                    )
+
+            if self.cache_results:
+                with Span("executor.cache_lookup"):
+                    result_cache_key = self._rpc_result_cache_key(
+                        function, fn_digest, args_digest, task_metadata
+                    )
+                    hit, cached = await asyncio.to_thread(
+                        self._result_cache.get, result_cache_key
+                    )
+                    if hit:
+                        obs_events.emit(
+                            "task.result_cached",
+                            operation_id=operation_id,
+                            trace_id=root.trace_id,
+                        )
+                        outcome = "cached"
+                        return cached
+
+            with Span("executor.validate"):
+                await self._validate_credentials()
+
+            self._op_status[operation_id]["stage"] = "connecting"
+            try:
+                lease = await self.lease_gang(dialed=conns)
+                conns = lease.conns
+            except (TransportError, OSError, ValueError) as err:
+                retry = self._plan_retry(
+                    attempt, deadline, reason="connect", error=err,
+                    message=f"could not reach TPU workers: {err}",
+                    conns=conns,
+                )
+                if retry is not None:
+                    outcome = "retried"
+                    raise retry from err
+                result = await self._on_dispatch_fail_async(
+                    function, args, kwargs,
+                    f"could not reach TPU workers: {err}",
+                    operation_id=operation_id,
+                )
+                outcome = "fallback_local"
+                return result
+
+            addresses = self._worker_addresses()
+            address, conn = addresses[0], conns[0]
+            key = self._pool_key(address)
+            client = self._agents.get(conn.address)
+            if client is None or not client.alive or client.mode != "pool":
+                # The native C++ agent speaks the verbs but pays an
+                # interpreter start per invoke; the resident pool loop is
+                # the runtime that actually delivers the sub-100ms path,
+                # so anything else routes this electron through launch.
+                fallback_to_launch = True
+                raise _RpcUnavailable(
+                    f"no resident pool runtime on {address} "
+                    f"(agent: {getattr(client, 'mode', None)!r})"
+                )
+
+            self._op_status[operation_id]["stage"] = "launching"
+            remote_fn = cas_path(self.remote_cache, fn_digest, ".pkl")
+            spec: dict[str, Any] = {
+                "operation_id": operation_id,
+                "trace": trace_context,
+            }
+            if self.task_env:
+                # The resident runtime applies the same env contract a
+                # launch-mode harness child would (os.environ, PYTHONPATH
+                # sys.path mirror, jax platform pin) — task_env must not
+                # silently change meaning between dispatch modes.
+                spec["env"] = dict(self.task_env)
+            if self.heartbeat_interval > 0:
+                spec["heartbeat_s"] = self.heartbeat_interval
+            invoke_kwargs: dict[str, Any] = {}
+            try:
+                with Span("executor.upload"):
+                    # Ship-once: the CAS skips bytes the worker holds, the
+                    # registry skips digests the resident runtime loaded.
+                    codec = self._codec_for(key, conn)
+                    await self._cas.ensure_probed(
+                        key, conn, [(fn_digest, remote_fn)]
+                    )
+                    await self._cas.ensure(
+                        key, conn, fn_digest, local_fn, remote_fn,
+                        codec=codec, python_path=self.python_path,
+                    )
+                    await self._fn_registry.ensure(
+                        key, client, fn_digest, remote_fn
+                    )
+                    if inline:
+                        invoke_kwargs["args_b64"] = base64.b64encode(
+                            args_payload
+                        ).decode("ascii")
+                    else:
+                        # Oversized args take the CAS road (digest
+                        # verified remotely), results still stream back.
+                        remote_args = cas_path(
+                            self.remote_cache, args_digest, ".pkl"
+                        )
+                        await self._cas.ensure(
+                            key, conn, args_digest, local_args, remote_args,
+                            codec=codec, python_path=self.python_path,
+                        )
+                        invoke_kwargs["args_path"] = remote_args
+                        invoke_kwargs["args_digest"] = args_digest
+                        obs_events.emit(
+                            "task.rpc_args_staged",
+                            operation_id=operation_id,
+                            bytes=len(args_payload),
+                        )
+                with Span("executor.submit"):
+                    if client.on_telemetry is None:
+                        client.on_telemetry = (
+                            lambda task_id, data, _worker=address: (
+                                self._handle_backhaul(task_id, _worker, data)
+                            )
+                        )
+                    await client.invoke(
+                        operation_id, fn_digest, spec=spec,
+                        path=remote_fn, **invoke_kwargs,
+                    )
+            except AgentError as err:
+                # Registration/invoke failure.  classify_error reads the
+                # duck-typed permanent tag a digest mismatch carries; for
+                # everything transient the dead-resident-runtime remedy is
+                # NOT a redial — the transport may be fine — but the next
+                # attempt's lease re-pings the cached agent, rebuilds it,
+                # and the registry's owner check forces re-registration.
+                retry = self._plan_retry(
+                    attempt, deadline, reason="rpc_channel", error=err,
+                    message=f"RPC dispatch failed: {err}", conns=conns,
+                )
+                if retry is not None:
+                    outcome = "retried"
+                    raise retry from err
+                fault, _label = classify_error(err)
+                if fault is FaultClass.PERMANENT:
+                    # Torn payload (digest mismatch): fail loud — neither
+                    # a retry nor a local re-run can make these bytes
+                    # match their content address.
+                    raise
+                result = await self._on_dispatch_fail_async(
+                    function, args, kwargs,
+                    f"RPC dispatch failed: {err}",
+                    operation_id=operation_id,
+                )
+                outcome = "fallback_local"
+                return result
+            except (TransportError, OSError) as err:
+                # CAS ship of the function/args payload failed: the same
+                # channel transient the launch path's upload leg routes.
+                retry = self._plan_retry(
+                    attempt, deadline, reason="channel", error=err,
+                    message=f"artifact upload failed: {err}", conns=conns,
+                )
+                if retry is not None:
+                    outcome = "retried"
+                    await self._discard_workers(conns)
+                    raise retry from err
+                raise
+
+            obs_events.emit(
+                "task.state",
+                operation_id=operation_id,
+                state="submitted",
+                trace_id=root.trace_id,
+                mode="rpc",
+            )
+            self._op_status[operation_id]["stage"] = "executing"
+            self._op_agents[operation_id] = [client]
+            if self.heartbeat_interval > 0:
+                MONITOR.watch(
+                    operation_id,
+                    self._stall_after(),
+                    workers=[address],
+                    interval=self.heartbeat_interval,
+                )
+            with Span("executor.execute"):
+                verdict, payload = await self._await_rpc_result(
+                    client, operation_id
+                )
+
+            if verdict != "result":
+                if self._is_cancelled(operation_id):
+                    raise asyncio.CancelledError(
+                        f"task {operation_id} cancelled"
+                    )
+                if verdict == "stalled":
+                    STALLS_TOTAL.labels(worker=address).inc()
+                last_beats = MONITOR.last(operation_id)
+                if verdict == "timeout":
+                    failure_msg = (
+                        f"RPC task {operation_id} timed out after "
+                        f"{self.task_timeout:.1f}s on {address}; resident "
+                        "runtime torn down"
+                    )
+                elif verdict == "stalled":
+                    failure_msg = (
+                        f"RPC task {operation_id} stalled on {address}: no "
+                        f"heartbeat for {self._stall_after():.1f}s; resident "
+                        "runtime torn down"
+                    )
+                else:
+                    failure_msg = (
+                        f"resident worker died mid-invoke on {address}: "
+                        f"{payload}"
+                    )
+                obs_events.emit(
+                    "task.failed",
+                    operation_id=operation_id,
+                    trace_id=root.trace_id,
+                    worker=address,
+                    status=verdict,
+                    mode="rpc",
+                    **({"last_heartbeats": last_beats} if last_beats else {}),
+                )
+                # An in-process invocation has no pid to kill: tearing the
+                # gang down (agents closed, channels dropped, registry
+                # evicted) IS the escalation, for timeouts and stalls as
+                # much as for channel deaths.
+                await self._discard_workers(conns)
+                if verdict == "stalled":
+                    retry = self._plan_retry(
+                        attempt, deadline,
+                        error=WorkerStalledError(failure_msg),
+                        message=failure_msg, conns=conns,
+                    )
+                elif verdict == "timeout":
+                    retry = self._plan_retry(
+                        attempt, deadline, reason="timeout",
+                        message=failure_msg, conns=conns,
+                    )
+                else:
+                    retry = self._plan_retry(
+                        attempt, deadline, reason="rpc_channel",
+                        error=payload, message=failure_msg, conns=conns,
+                    )
+                if retry is not None:
+                    outcome = "retried"
+                    raise retry
+                if verdict == "channel" and payload is not None:
+                    raise payload
+                result = await self._on_dispatch_fail_async(
+                    function, args, kwargs, failure_msg,
+                    operation_id=operation_id,
+                )
+                outcome = "fallback_local"
+                return result
+
+            with Span("executor.fetch"):
+                result, exception = await asyncio.to_thread(
+                    self._decode_rpc_result, payload
+                )
+
+            if exception is not None:
+                outcome = "remote_exception"
+                raise exception
+            if result_cache_key is not None:
+                with Span("executor.cache_store"):
+                    await asyncio.to_thread(
+                        self._result_cache.put, result_cache_key, result
+                    )
+            outcome = "completed"
+            return result
+        except asyncio.CancelledError:
+            outcome = "cancelled"
+            # A cancelled invocation keeps running inside the shared
+            # resident interpreter — there is no per-task pid to kill, so
+            # dropping the runtime is the cancel escalation (launch mode
+            # kills the task's process group here instead).  Shielded so
+            # a second cancel cannot abandon the teardown half-done;
+            # concurrent electrons on this gang see a channel death and
+            # retry.
+            if conns:
+                try:
+                    await asyncio.shield(self._discard_workers(conns))
+                except (Exception, asyncio.CancelledError):  # noqa: BLE001
+                    pass
+            raise
+        finally:
+            if local_args is not None:
+                # One-off payload (args are call-specific); the function
+                # payload file stays — it is the local CAS source shared
+                # by every electron with this digest.
+                try:
+                    os.remove(local_args)
+                except OSError:
+                    pass
+            if fallback_to_launch:
+                # Minimal unwind: the launch attempt that follows owns the
+                # real accounting for this electron — a full epilogue here
+                # would double-count the outcome and overhead series.
+                root.set_attribute("outcome", "rpc_fallback")
+                root.end()
+                _ACTIVE_ELECTRONS.dec()
+                self._op_status.pop(operation_id, None)
+            else:
+                self._attempt_epilogue(root, outcome, operation_id, attempt)
 
     def _remove_local_staging(self, staged: StagedTask) -> None:
         """Unlink a dead attempt's local staging (pipelining stages them

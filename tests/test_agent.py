@@ -166,3 +166,105 @@ def test_agent_channel_death_surfaces_as_error(agent_binary, run_async):
         await client.close()
 
     run_async(flow())
+
+
+# ---------------------------------------------------------------------------
+# RPC execute-by-digest verbs (PR 8): the native agent's register_fn/invoke
+# protocol surface, exercised against the real compiled binary.  The
+# dispatcher's fast path prefers the Python pool runtime, so these verbs
+# are the native agent's protocol-uniformity guarantee — tested here so
+# they cannot bit-rot invisibly.
+# ---------------------------------------------------------------------------
+
+
+def test_agent_register_fn_verifies_digest_in_process(
+    agent_binary, tmp_path, run_async
+):
+    """The C++ agent sha256s the CAS artifact itself: a wrong digest is
+    refused (never stored) and classifies PERMANENT; the right digest
+    registers and lands in the client's registered set."""
+    import hashlib
+
+    from covalent_tpu_plugin.resilience import FaultClass, classify_error
+
+    async def flow():
+        conn = LocalTransport()
+        client = await AgentClient.start(conn, agent_binary)
+        try:
+            artifact = tmp_path / "fn.bin"
+            artifact.write_bytes(b"function payload bytes")
+            good = hashlib.sha256(b"function payload bytes").hexdigest()
+            bad = hashlib.sha256(b"different bytes").hexdigest()
+            with pytest.raises(AgentError) as excinfo:
+                await client.register_fn(bad, str(artifact), timeout=10.0)
+            await client.register_fn(good, str(artifact), timeout=10.0)
+            registered = client.registered_digests
+        finally:
+            await client.close()
+        return excinfo.value, good, registered
+
+    error, good, registered = run_async(flow())
+    fault, label = classify_error(error)
+    assert fault is FaultClass.PERMANENT
+    assert label == "rpc_digest_mismatch"
+    assert good in registered
+
+
+def test_agent_native_invoke_roundtrip_via_rpc_child(
+    agent_binary, tmp_path, run_async
+):
+    """register_fn with a runner argv, invoke with inline args: the agent
+    forks the harness --rpc-child runner, pipes the command to stdin, and
+    streams the started/result events back over the channel."""
+    import base64
+    import hashlib
+    import pickle
+    import sys
+
+    import cloudpickle
+
+    from covalent_tpu_plugin import harness as harness_mod
+
+    def _make_mul():
+        def mul(a, b):
+            return a * b
+
+        return mul
+
+    async def flow():
+        conn = LocalTransport()
+        client = await AgentClient.start(conn, agent_binary)
+        try:
+            payload = cloudpickle.dumps(_make_mul())
+            digest = hashlib.sha256(payload).hexdigest()
+            artifact = tmp_path / f"{digest}.pkl"
+            artifact.write_bytes(payload)
+            runner = [sys.executable, harness_mod.__file__, "--rpc-child"]
+            await client.register_fn(
+                digest, str(artifact), runner=runner, timeout=30.0
+            )
+            # Unregistered digest: rejected cleanly, channel stays alive.
+            with pytest.raises(AgentError):
+                await client.invoke(
+                    "nat-bad", "0" * 64, path=str(artifact), timeout=10.0
+                )
+            args_b64 = base64.b64encode(
+                cloudpickle.dumps(((6, 7), {}))
+            ).decode("ascii")
+            pid = await client.invoke(
+                "nat-1", digest, spec={"operation_id": "nat-1"},
+                path=str(artifact), args_b64=args_b64, timeout=30.0,
+            )
+            event = await client.wait_result("nat-1", timeout=30.0)
+        finally:
+            await client.close()
+        return pid, event
+
+    pid, event = run_async(flow())
+    assert isinstance(pid, int) and pid > 0
+    assert event.get("ok") is True
+    result, exception = pickle.loads(
+        base64.b64decode(str(event.get("data")))
+    )
+    assert exception is None
+    assert result == 42
