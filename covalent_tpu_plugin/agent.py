@@ -28,6 +28,7 @@ import shlex
 import uuid
 from functools import lru_cache
 from pathlib import Path
+from typing import Any
 
 from .obs import events as obs_events
 from .obs.metrics import REGISTRY
@@ -189,6 +190,14 @@ class AgentClient:
         #: reconnect re-tails from offset 0, so duplicates are expected and
         #: dropped here.
         self._telemetry_seq: dict[str, int] = {}
+        #: serving sessions: sid -> pushed serve_opened / serve_error /
+        #: serve_closed events, and sid -> per-session telemetry sink
+        #: (serve.token / serve.reject / serve.stats data routed here
+        #: instead of :attr:`on_telemetry`).
+        self._serve_opened: dict[str, dict] = {}
+        self._serve_errors: dict[str, dict] = {}
+        self._serve_closed: dict[str, dict] = {}
+        self._serve_sinks: dict[str, Any] = {}
         self._reader = asyncio.create_task(self._read_loop())
 
     # -- lifecycle -----------------------------------------------------------
@@ -249,6 +258,12 @@ class AgentClient:
                         continue  # side-band: no waiter state to notify
                     if kind == "started":
                         self._started[task_id] = int(event["pid"])
+                    elif kind == "serve_opened":
+                        self._serve_opened[task_id] = event
+                    elif kind == "serve_error":
+                        self._serve_errors[task_id] = event
+                    elif kind == "serve_closed":
+                        self._serve_closed[task_id] = event
                     elif kind == "exit":
                         self._exits[task_id] = (
                             int(event.get("code", -1)),
@@ -303,7 +318,10 @@ class AgentClient:
             if seq <= self._telemetry_seq.get(task_id, 0):
                 return
             self._telemetry_seq[task_id] = seq
-        callback = self.on_telemetry
+        # Serving sessions own their side-band traffic: every record for a
+        # watched sid (tokens, rejects, stats) routes to that session's
+        # sink instead of the executor's generic backhaul handler.
+        callback = self._serve_sinks.get(task_id) or self.on_telemetry
         if callback is None:
             return
         try:
@@ -484,6 +502,8 @@ class AgentClient:
         args_path: str = "",
         args_digest: str = "",
         path: str = "",
+        result_path: str = "",
+        result_max_inline: int | None = None,
         timeout: float = 30.0,
     ) -> int:
         """Invoke a registered function by digest; returns the worker pid.
@@ -491,8 +511,12 @@ class AgentClient:
         Args travel inline (``args_b64``) below the executor's size
         threshold, else by CAS path + digest.  ``path`` (the function's
         CAS artifact) rides along so a restarted runtime can self-heal a
-        lost registration, digest-verified.  The ``started`` ack bounds
-        this call; the result streams back separately (:meth:`wait_result`).
+        lost registration, digest-verified.  The same size policy applies
+        on the way back: given ``result_path`` + ``result_max_inline``,
+        a result pickle over the threshold is staged to that remote path
+        (announced by sha256 digest) instead of base64-inlined onto the
+        channel in one write.  The ``started`` ack bounds this call; the
+        result streams back separately (:meth:`wait_result`).
         """
         command: dict = {"cmd": "invoke", "id": task_id, "digest": digest}
         if path:
@@ -505,6 +529,9 @@ class AgentClient:
             command["args_path"] = args_path
             if args_digest:
                 command["args_digest"] = args_digest
+        if result_path and result_max_inline is not None:
+            command["result_path"] = result_path
+            command["result_max_inline"] = int(result_max_inline)
         submit_span = Span(
             "agent.invoke", {"address": self.address, "task_id": task_id}
         )
@@ -539,6 +566,137 @@ class AgentClient:
         self._results.pop(task_id, None)
         return event
 
+    # -- serving sessions ----------------------------------------------------
+
+    async def serve_open(
+        self,
+        sid: str,
+        digest: str,
+        path: str,
+        options: dict | None = None,
+        spec: dict | None = None,
+        runner: "list[str] | None" = None,
+        timeout: float = 120.0,
+    ) -> dict:
+        """Open a resident serving session; returns the ``serve_opened``
+        event (``slots``, worker ``pid``).
+
+        Ships a cloudpickled model-factory by CAS digest: the worker
+        verifies ``path``'s sha256 against ``digest`` BEFORE unpickling,
+        calls the factory ONCE (model load + compile — hence the generous
+        timeout), and serves request commands for the session's lifetime.
+        A refused open raises :class:`AgentError`; permanent refusals
+        (digest mismatch, a factory rejecting its model shape) carry the
+        duck-typed ``fault_label`` so the resilience layer never burns
+        gang retries re-opening them.  ``runner`` (native agent only)
+        names the argv forked to host the session
+        (``[python, harness, --serve-child]``).
+        """
+        command: dict = {
+            "cmd": "serve_open", "id": sid, "digest": digest, "path": path,
+        }
+        if options:
+            command["options"] = dict(options)
+        if spec:
+            command["spec"] = dict(spec)
+        if runner:
+            command["runner"] = [str(part) for part in runner]
+        await self._send(command)
+
+        def settled(c: "AgentClient"):
+            if sid in c._serve_errors:
+                event = c._serve_errors.pop(sid)
+                failure = AgentError(
+                    f"agent@{c.address}: serve_open {sid} failed "
+                    f"({event.get('code')}): {event.get('message')}"
+                )
+                if event.get("permanent"):
+                    failure.fault_label = str(  # type: ignore[attr-defined]
+                        event.get("label")
+                        or f"serve_{event.get('code') or 'error'}"
+                    )
+                    failure.fault_transient = False  # type: ignore[attr-defined]
+                raise failure
+            return c._serve_opened.pop(sid, None)
+
+        return await self._wait(settled, timeout)
+
+    async def serve_request(
+        self,
+        sid: str,
+        rid: str,
+        prompt,
+        params: dict | None = None,
+        deadline_s: float = 0.0,
+        tenant: str = "",
+    ) -> None:
+        """Submit one request to an open session (fire-and-stream).
+
+        The response streams back over the telemetry side-band as
+        ``serve.token`` records routed to the session's
+        :meth:`watch_serve` sink; backpressure and unknown sessions
+        arrive as ``serve.reject`` records the same way.
+        """
+        command: dict = {
+            "cmd": "serve_request", "id": sid, "rid": rid, "prompt": prompt,
+        }
+        if params:
+            command["params"] = dict(params)
+        if deadline_s:
+            command["deadline_s"] = float(deadline_s)
+        if tenant:
+            command["tenant"] = str(tenant)
+        await self._send(command)
+
+    async def serve_close(self, sid: str, timeout: float = 30.0) -> dict:
+        """Close a session; returns the ``serve_closed`` event (``served``
+        request count) after the worker drains admitted lanes."""
+        await self._send({"cmd": "serve_close", "id": sid})
+
+        def settled(c: "AgentClient"):
+            if sid in c._serve_errors:
+                event = c._serve_errors.pop(sid)
+                failure = AgentError(
+                    f"agent@{c.address}: serve_close {sid} failed "
+                    f"({event.get('code')}): {event.get('message')}"
+                )
+                if event.get("permanent"):
+                    # Same duck-tag propagation as serve_open: closing a
+                    # session that does not exist is deterministic — the
+                    # resilience layer must not burn retries on it.
+                    failure.fault_label = str(  # type: ignore[attr-defined]
+                        event.get("label")
+                        or f"serve_{event.get('code') or 'error'}"
+                    )
+                    failure.fault_transient = False  # type: ignore[attr-defined]
+                raise failure
+            return c._serve_closed.pop(sid, None)
+
+        return await self._wait(settled, timeout)
+
+    def watch_serve(self, sid: str, sink) -> None:
+        """Route session ``sid``'s side-band records to ``sink(sid, data)``
+        (instead of :attr:`on_telemetry`).  Register BEFORE the first
+        request so no token can slip past."""
+        self._serve_sinks[sid] = sink
+
+    def unwatch_serve(self, sid: str) -> None:
+        """Drop a closed session's sink and retained per-sid state."""
+        self._serve_sinks.pop(sid, None)
+        self._telemetry_seq.pop(sid, None)
+        self._serve_opened.pop(sid, None)
+        self._serve_errors.pop(sid, None)
+        self._serve_closed.pop(sid, None)
+
+    async def wait_dead(self) -> None:
+        """Block until this channel dies, then raise :class:`AgentError`.
+
+        The serving tier's supervisor awaits this to notice a dropped
+        channel (or dead resident worker) the moment the reader does,
+        triggering its reconnect instead of waiting on a stuck stream.
+        """
+        await self._wait(lambda c: None, None)
+
     def forget(self, task_id: str) -> None:
         """Drop any retained state for a finished/abandoned task.
 
@@ -552,7 +710,11 @@ class AgentClient:
         self._exits.pop(task_id, None)
         self._errors.pop(task_id, None)
         self._results.pop(task_id, None)
-        self._telemetry_seq.pop(task_id, None)
+        if task_id not in self._serve_sinks:
+            # Serving sessions outlive electron operations on the same
+            # channel: an electron's forget() must never reset a live
+            # session's seq high-water mark (token dedup depends on it).
+            self._telemetry_seq.pop(task_id, None)
 
     async def kill(self, task_id: str, sig: int = 15) -> None:
         await self._send({"cmd": "kill", "id": task_id, "sig": sig})

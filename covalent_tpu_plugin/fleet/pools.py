@@ -296,14 +296,28 @@ class Pool:
             # which dispatch mode each in-flight electron is riding.
             counter = getattr(self._executor, "rpc_digest_count", None)
             modes = getattr(self._executor, "in_flight_modes", None)
+            sessions = getattr(self._executor, "serve_sessions", None)
             try:
                 if counter is not None:
                     view["registered_digests"] = int(counter())
                 if modes is not None:
                     view["in_flight_modes"] = dict(modes())
+                if sessions is not None:
+                    # Serving sessions are long-lived capacity: each pins
+                    # one slot (already counted in in_use) and reports its
+                    # live queue depth and tokens/s here.
+                    view["serve_sessions"] = dict(sessions())
             except Exception:  # noqa: BLE001 - status must not crash a view
                 pass
         return view
+
+    async def open_session(self, factory: Any, **options: Any):
+        """Open a resident serving session pinned to one of this pool's
+        capacity slots (released when the handle closes).  Forwards to
+        :func:`covalent_tpu_plugin.serving.open_session`."""
+        from ..serving import open_session as _open_session
+
+        return await _open_session(self, factory, **options)
 
 
 def parse_pool_specs(text: str) -> list[PoolSpec]:

@@ -171,6 +171,12 @@ def _heartbeat_payload(metrics_file: str) -> dict:
                     payload["metrics"] = user
         except (OSError, ValueError):
             pass
+    serve = _serve_occupancy()
+    if serve:
+        # Resident serving sessions (if any) fold their slot occupancy into
+        # every beat, so a serving worker's liveness stream doubles as its
+        # load report on the dispatcher side.
+        payload["serve"] = serve
     if "jax" in sys.modules:
         try:
             import jax
@@ -574,6 +580,10 @@ def _spawn_task(command: dict, children: dict) -> None:
             global _worker_event_lock, _EMIT_LOCK
             _worker_event_lock = threading.Lock()
             _EMIT_LOCK = threading.Lock()
+            # The child is a task runner, not a session host: an inherited
+            # copy of the server's live sessions would make its heartbeats
+            # report a frozen fork-time serve occupancy forever.
+            _SERVE_SESSIONS.clear()
             import signal as _signal
 
             _signal.set_wakeup_fd(-1)
@@ -710,25 +720,68 @@ def _decode_rpc_args(command: dict) -> tuple:
     return tuple(args), dict(kwargs)
 
 
-def _encode_rpc_result(result, exception) -> str:
-    """Base64 of the ``(result, exception)`` pickle — byte-identical layout
-    to the result file launch mode writes, just streamed instead of
-    staged."""
-    import base64
-
+def _pickle_rpc_result(result, exception) -> bytes:
+    """The ``(result, exception)`` pickle — byte-identical layout to the
+    result file launch mode writes."""
     try:
         import cloudpickle as pick
     except ImportError:
         import pickle as pick
     try:
-        data = pick.dumps((result, exception))
+        return pick.dumps((result, exception))
     except BaseException as err:  # noqa: BLE001 - unpicklable user results
         import pickle
 
-        data = pickle.dumps(
+        return pickle.dumps(
             (None, RuntimeError(f"RPC result not picklable: {err!r}"))
         )
-    return base64.b64encode(data).decode("ascii")
+
+
+def _emit_rpc_result(task_id: str, result, exception, command: dict) -> None:
+    """Stream one invocation's result, inline or staged by size.
+
+    The dispatcher's ``rpc_inline_args_max`` policy applies symmetrically:
+    a result pickle at or below ``result_max_inline`` rides the channel
+    base64-inline; a larger one is written (atomically) to the
+    command-provided ``result_path`` and announced by path + sha256 digest
+    — a multi-MB pickle must not be base64-inlined onto the channel in
+    one write, for the same reason oversized args take the CAS road in.
+    No ``result_path`` (or no threshold) preserves the inline-always
+    contract.  A staging failure degrades to inline rather than losing
+    the result.
+    """
+    import base64
+
+    data = _pickle_rpc_result(result, exception)
+    result_path = command.get("result_path")
+    try:
+        max_inline = int(command.get("result_max_inline"))
+    except (TypeError, ValueError):
+        max_inline = -1
+    if result_path and 0 <= max_inline < len(data):
+        import hashlib
+
+        try:
+            tmp = f"{result_path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, result_path)
+        except OSError:
+            pass  # fall through to the inline road below
+        else:
+            _emit({
+                "event": "result", "id": task_id,
+                "ok": exception is None,
+                "data_path": result_path,
+                "data_digest": hashlib.sha256(data).hexdigest(),
+                "bytes": len(data),
+            })
+            return
+    _emit({
+        "event": "result", "id": task_id,
+        "ok": exception is None,
+        "data": base64.b64encode(data).decode("ascii"),
+    })
 
 
 def _emit_rpc_event(spec: dict, task_id: str, type: str, **fields) -> None:
@@ -806,11 +859,7 @@ def _run_rpc_task(command: dict, fn) -> None:
     finally:
         if heartbeat_stop is not None:
             heartbeat_stop.set()
-    _emit({
-        "event": "result", "id": task_id,
-        "ok": exception is None,
-        "data": _encode_rpc_result(result, exception),
-    })
+    _emit_rpc_result(task_id, result, exception, command)
     _emit_rpc_event(
         spec, task_id, "worker.task_finished", process_id=0,
         ok=exception is None,
@@ -869,6 +918,470 @@ def rpc_child() -> int:
         _emit({"event": "error", "message": "malformed invoke command"})
         return 1
     _rpc_invoke(command, {}, sync=True)
+    return 0
+
+
+# --------------------------------------------------------------------------
+# Serving sessions: resident model server with in-worker continuous batching.
+#
+# RPC mode (above) made single *calls* cheap; a serving session makes whole
+# REQUEST STREAMS cheap: `serve_open` loads a cloudpickled model-factory
+# from the CAS ONCE (digest verified, like register_fn), builds its engine
+# — params loaded, decode/prefill programs compiled — and then serves
+# request-level commands for the session's whole lifetime.  Tokens stream
+# back incrementally over the EXISTING telemetry side-band (same envelope,
+# seq counter, and dedup contract as heartbeats), so time-to-first-token is
+# real, not end-of-batch:
+#
+#   -> {"cmd":"serve_open","id":"<sid>","digest":"<sha256>",
+#       "path":"/cas/<sha256>.pkl","options":{"queue_max":64,
+#       "default_deadline_s":0,"stats_interval_s":1.0},"spec":{...}}
+#   <- {"event":"serve_opened","id":"<sid>","slots":4,"pid":123}
+#   <- {"event":"serve_error","id":"<sid>","code":"digest_mismatch"|
+#       "missing"|"load_failed"|"factory_failed","message":"...",
+#       "permanent":true|false,"label":"..."}              (on failure)
+#   -> {"cmd":"serve_request","id":"<sid>","rid":"<rid>","prompt":[...],
+#       "params":{...},"deadline_s":5.0,"tenant":"a"}
+#   <- {"event":"telemetry","id":"<sid>","data":{"type":"serve.token",
+#       "rid":"<rid>","idx":N,"tokens":[...],"done":false,...}}  (pushed)
+#   <- {"event":"telemetry","id":"<sid>","data":{"type":"serve.reject",
+#       "rid":"<rid>","code":"serve_admission_shed"|"unknown_session"|
+#       "deadline"|"engine_error","message":"..."}}       (backpressure)
+#   <- {"event":"telemetry","id":"<sid>","data":{"type":"serve.stats",
+#       "slots":4,"busy":2,"queued":7,"served":123,"tokens_per_s":...}}
+#   -> {"cmd":"serve_close","id":"<sid>"}
+#   <- {"event":"serve_closed","id":"<sid>","served":123}
+#
+# The factory returns an ENGINE the session thread drives through a small
+# duck-typed surface (no imports required on this side):
+#
+#   engine.slots          int, concurrent request lanes (default 1)
+#   engine.admit(rid, prompt, params)   occupy a free lane (host-side)
+#   engine.step() -> [{"rid", "tokens": [...], "done": bool, ...}, ...]
+#                         advance every busy lane one chunk
+#   engine.cancel(rid)    optional: free a lane early (deadline)
+#   engine.close()        optional: teardown at serve_close
+#
+# Inside the worker an admission queue feeds the engine's slot loop, so
+# concurrent requests share one static-shape batch (continuous batching —
+# models/serve.py's ContinuousEngine implements this surface for LMs).
+# Backpressure is a bounded queue: a request arriving on a full queue is
+# rejected immediately with code `serve_admission_shed`, which the
+# dispatcher classifies PERMANENT via the duck-typed fault-label hook
+# (retrying amplifies the very overload that shed the work).  Per-request
+# deadlines are enforced both in the queue and mid-generation.
+#
+# `serve.token` events carry `idx` — the request's cumulative token count
+# BEFORE the chunk — so a dispatcher replaying a deterministic request on a
+# fresh session after a mid-stream death can splice the streams with no
+# duplicate or lost tokens.
+# --------------------------------------------------------------------------
+
+
+#: sid -> live _ServeSession; read by the heartbeat payload so a serving
+#: worker's beats carry slot occupancy.
+_SERVE_SESSIONS: dict = {}
+
+
+def _serve_occupancy() -> dict:
+    """Aggregate slot occupancy across this process's live sessions."""
+    sessions = list(_SERVE_SESSIONS.values())
+    if not sessions:
+        return {}
+    return {
+        "sessions": len(sessions),
+        "slots": sum(s.slots for s in sessions),
+        "busy": sum(len(s.running) for s in sessions),
+        "queued": sum(s.queue.qsize() for s in sessions),
+    }
+
+
+class _ServeSession:
+    """One resident serving session: engine + admission queue + loop thread.
+
+    The command loop calls :meth:`submit` / :meth:`close` (cheap, non-
+    blocking); everything slow — the factory call (model load + compile),
+    admission, decode chunks — runs on the session's own daemon thread so
+    the protocol stays live while the engine works.
+    """
+
+    def __init__(self, sid: str, command: dict) -> None:
+        import queue as queue_mod
+
+        self.sid = sid
+        self.spec = dict(command.get("spec") or {})
+        self.spec.setdefault("operation_id", sid)
+        options = dict(command.get("options") or {})
+        try:
+            self.queue_max = max(1, int(options.get("queue_max", 64)))
+        except (TypeError, ValueError):
+            self.queue_max = 64
+        try:
+            self.default_deadline_s = float(
+                options.get("default_deadline_s") or 0.0
+            )
+        except (TypeError, ValueError):
+            self.default_deadline_s = 0.0
+        try:
+            self.stats_interval_s = float(
+                options.get("stats_interval_s") or 1.0
+            )
+        except (TypeError, ValueError):
+            self.stats_interval_s = 1.0
+        self.digest = str(command.get("digest") or "")
+        self.path = str(command.get("path") or "")
+        self.queue: "queue_mod.Queue" = queue_mod.Queue()
+        #: rid -> {"deadline": abs_ts|None, "emitted": n, "t_admit": ts}
+        self.running: dict = {}
+        self.slots = 1
+        self.served = 0
+        self.tokens_total = 0
+        self._t_open = time.time()
+        self._closed = threading.Event()
+        self._engine = None
+        self._thread = threading.Thread(
+            target=self._loop, name=f"covalent-tpu-serve-{sid}", daemon=True
+        )
+
+    # -- command-loop surface (must never block) ---------------------------
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def submit(self, command: dict) -> None:
+        """Admission control: bounded queue, immediate shed on overflow."""
+        rid = str(command.get("rid") or "")
+        if not rid:
+            self._emit_reject("", "bad_request", "serve_request requires rid")
+            return
+        if self._closed.is_set():
+            self._emit_reject(rid, "unknown_session", "session closed")
+            return
+        if self.queue.qsize() >= self.queue_max:
+            self._emit_reject(
+                rid, "serve_admission_shed",
+                f"admission queue full ({self.queue_max})",
+            )
+            return
+        command = dict(command)
+        command["_enqueued"] = time.monotonic()
+        self.queue.put(command)
+
+    def close(self) -> None:
+        self._closed.set()
+        self.queue.put(None)  # wake the loop
+
+    def join(self, timeout: float = 10.0) -> None:
+        self._thread.join(timeout)
+
+    # -- emission ----------------------------------------------------------
+
+    def _emit_serve(self, type: str, **fields) -> None:
+        """One session record over the telemetry side-band (seq-stamped)."""
+        _emit({
+            "event": "telemetry", "id": self.sid,
+            "data": _build_worker_event(self.spec, type, rpc=True, **fields),
+        })
+
+    def _emit_reject(self, rid: str, code: str, message: str) -> None:
+        self._emit_serve(
+            "serve.reject", rid=rid, code=code, message=message
+        )
+
+    def _emit_stats(self) -> None:
+        age = max(time.time() - self._t_open, 1e-9)
+        self._emit_serve(
+            "serve.stats",
+            slots=self.slots,
+            busy=len(self.running),
+            queued=self.queue.qsize(),
+            served=self.served,
+            tokens_total=self.tokens_total,
+            tokens_per_s=round(self.tokens_total / age, 3),
+        )
+
+    # -- session thread ----------------------------------------------------
+
+    def _open_engine(self) -> bool:
+        """Load + verify the factory payload, build the engine, ack open."""
+        code, loaded = _load_fn_payload(self.path, self.digest)
+        if code:
+            self._emit_open_error(code, loaded, permanent=(
+                code == "digest_mismatch"
+            ))
+            return False
+        try:
+            self._engine = loaded()
+        except BaseException as err:  # noqa: BLE001 - arbitrary factories
+            # Duck-typed permanence: a factory refusing its model shape
+            # (e.g. rolling_cache) tags fault_label/fault_transient; the
+            # dispatcher must NOT burn gang retries re-opening it.
+            label = getattr(err, "fault_label", "") or ""
+            permanent = bool(label) and not bool(
+                getattr(err, "fault_transient", False)
+            )
+            self._emit_open_error(
+                "factory_failed", err, permanent=permanent, label=label
+            )
+            return False
+        try:
+            self.slots = max(1, int(getattr(self._engine, "slots", 1)))
+        except (TypeError, ValueError):
+            self.slots = 1
+        _emit({
+            "event": "serve_opened", "id": self.sid,
+            "slots": self.slots, "pid": os.getpid(),
+        })
+        return True
+
+    def _emit_open_error(
+        self, code: str, err, permanent: bool = False, label: str = ""
+    ) -> None:
+        _emit({
+            "event": "serve_error", "id": self.sid, "code": code,
+            "message": repr(err), "permanent": bool(permanent),
+            **({"label": label} if label else {}),
+        })
+
+    def _admit_waiting(self) -> None:
+        """Move queued requests onto free engine lanes (deadline-checked)."""
+        import queue as queue_mod
+
+        while len(self.running) < self.slots:
+            try:
+                command = self.queue.get_nowait()
+            except queue_mod.Empty:
+                return
+            if command is None:
+                continue
+            rid = str(command.get("rid") or "")
+            deadline_s = command.get("deadline_s", self.default_deadline_s)
+            try:
+                deadline_s = float(deadline_s or 0.0)
+            except (TypeError, ValueError):
+                deadline_s = 0.0
+            if deadline_s > 0 and (
+                time.monotonic() - command["_enqueued"] >= deadline_s
+            ):
+                self._emit_reject(
+                    rid, "deadline",
+                    f"request spent its {deadline_s:.1f}s deadline queued",
+                )
+                continue
+            prompt = command.get("prompt")
+            params = dict(command.get("params") or {})
+            try:
+                self._engine.admit(rid, prompt, params)
+            except BaseException as err:  # noqa: BLE001 - engine rejections
+                self._emit_reject(rid, "engine_error", repr(err))
+                continue
+            self.running[rid] = {
+                "deadline": (
+                    command["_enqueued"] + deadline_s
+                    if deadline_s > 0 else None
+                ),
+                "emitted": 0,
+                "t_admit": time.monotonic(),
+            }
+
+    def _cancel_lane(self, rid: str) -> None:
+        cancel = getattr(self._engine, "cancel", None)
+        if cancel is not None:
+            try:
+                cancel(rid)
+            except BaseException:  # noqa: BLE001 - best-effort free
+                pass
+
+    def _pump_engine(self) -> None:
+        """One decode chunk for every busy lane; stream fresh tokens."""
+        try:
+            events = self._engine.step() or []
+        except BaseException as err:  # noqa: BLE001 - engine crash fails all
+            for rid in list(self.running):
+                self._emit_reject(rid, "engine_error", repr(err))
+                self._cancel_lane(rid)
+                self.running.pop(rid, None)
+            return
+        for event in events:
+            rid = str(event.get("rid") or "")
+            state = self.running.get(rid)
+            if state is None:
+                continue
+            tokens = list(event.get("tokens") or ())
+            done = bool(event.get("done"))
+            idx = state["emitted"]
+            state["emitted"] += len(tokens)
+            self.tokens_total += len(tokens)
+            extra = {
+                k: v for k, v in event.items()
+                if k not in ("rid", "tokens", "done")
+            }
+            if done:
+                extra.setdefault(
+                    "gen_s", round(time.monotonic() - state["t_admit"], 6)
+                )
+            self._emit_serve(
+                "serve.token", rid=rid, idx=idx, tokens=tokens, done=done,
+                **extra,
+            )
+            if done:
+                self.served += 1
+                self.running.pop(rid, None)
+        # Mid-generation deadline enforcement: a lane past its budget is
+        # cancelled and finalized with an error marker, freeing the slot.
+        now = time.monotonic()
+        for rid, state in list(self.running.items()):
+            if state["deadline"] is not None and now >= state["deadline"]:
+                self._cancel_lane(rid)
+                self._emit_serve(
+                    "serve.token", rid=rid, idx=state["emitted"],
+                    tokens=[], done=True, error="deadline_exceeded",
+                )
+                self.served += 1
+                self.running.pop(rid, None)
+
+    def _loop(self) -> None:
+        _apply_spec_env(self.spec)
+        if not self._open_engine():
+            # Failed open: mark closed so late requests reject cleanly
+            # instead of queueing into a thread that already exited.
+            self._closed.set()
+            _SERVE_SESSIONS.pop(self.sid, None)
+            return
+        last_stats = time.monotonic()
+        try:
+            while not (self._closed.is_set()
+                       and not self.running
+                       and self.queue.empty()):
+                self._admit_waiting()
+                if self.running:
+                    self._pump_engine()
+                else:
+                    # Idle: block on the queue with a short tick so stats
+                    # keep flowing and close() wakes promptly.
+                    import queue as queue_mod
+
+                    try:
+                        command = self.queue.get(timeout=0.1)
+                    except queue_mod.Empty:
+                        command = None
+                    if command is not None:
+                        self.queue.put(command)
+                if (
+                    self.stats_interval_s > 0
+                    and time.monotonic() - last_stats >= self.stats_interval_s
+                ):
+                    last_stats = time.monotonic()
+                    self._emit_stats()
+        finally:
+            closer = getattr(self._engine, "close", None)
+            if closer is not None:
+                try:
+                    closer()
+                except BaseException:  # noqa: BLE001 - teardown best-effort
+                    pass
+            self._emit_stats()
+            _SERVE_SESSIONS.pop(self.sid, None)
+            _emit({
+                "event": "serve_closed", "id": self.sid,
+                "served": self.served,
+            })
+
+
+def _serve_open(command: dict, sessions: dict) -> None:
+    sid = str(command.get("id") or "")
+    if not sid or not command.get("digest") or not command.get("path"):
+        _emit({"event": "serve_error", "id": sid, "code": "bad_request",
+               "message": "serve_open requires id, digest and path",
+               "permanent": True})
+        return
+    existing = sessions.get(sid)
+    if existing is not None:
+        if existing._closed.is_set() and not existing._thread.is_alive():
+            # A dead entry (failed factory open, or a drained close whose
+            # serve_close never arrived): evict so the sid is re-openable
+            # — the reconnect path retries the SAME sid on a live agent,
+            # and a stale tombstone must not refuse it as a duplicate.
+            sessions.pop(sid, None)
+        else:
+            _emit({"event": "serve_error", "id": sid, "code": "duplicate",
+                   "message": f"session {sid} already open",
+                   "permanent": True})
+            return
+    session = _ServeSession(sid, command)
+    sessions[sid] = session
+    _SERVE_SESSIONS[sid] = session
+    session.start()
+
+
+def _serve_request(command: dict, sessions: dict) -> None:
+    sid = str(command.get("id") or "")
+    session = sessions.get(sid)
+    if session is None:
+        # Streamed as a per-request reject so the caller's stream fails
+        # fast; the envelope needs no session spec (there is none).
+        _emit({
+            "event": "telemetry", "id": sid,
+            "data": _build_worker_event(
+                {}, "serve.reject", rpc=True,
+                rid=str(command.get("rid") or ""),
+                code="unknown_session",
+                message=f"no open session {sid!r}",
+            ),
+        })
+        return
+    session.submit(command)
+
+
+def _serve_close(command: dict, sessions: dict) -> None:
+    sid = str(command.get("id") or "")
+    session = sessions.pop(sid, None)
+    if session is None:
+        _emit({"event": "serve_error", "id": sid, "code": "unknown_session",
+               "message": f"no open session {sid!r}", "permanent": True})
+        return
+    session.close()
+    # The session thread emits serve_closed after its drain; nothing to
+    # block on here — the command loop must stay live.
+
+
+def serve_child() -> int:
+    """``harness.py --serve-child``: one serving session over stdin.
+
+    The native C++ agent's session support: it forks this runner at
+    ``serve_open`` with the pipe held open, forwards ``serve_request`` /
+    ``serve_close`` lines to stdin, and streams every stdout event back
+    over its channel verbatim — the protocol (and the engine contract)
+    stays uniform across both runtimes.  EOF closes the session.
+    """
+    sessions: dict = {}
+    opened: list = []  # every session ever opened, for the final drain
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            command = json.loads(line)
+        except ValueError:
+            _emit({"event": "error", "message": "malformed serve command"})
+            continue
+        name = command.get("cmd")
+        if name == "serve_open":
+            _serve_open(command, sessions)
+            session = sessions.get(str(command.get("id") or ""))
+            if session is not None and session not in opened:
+                opened.append(session)
+        elif name == "serve_request":
+            _serve_request(command, sessions)
+        elif name == "serve_close":
+            _serve_close(command, sessions)
+            break
+        else:
+            _emit({"event": "error", "message": f"unknown cmd: {name}"})
+    for session in sessions.values():
+        session.close()
+    for session in opened:
+        session.join()
     return 0
 
 
@@ -972,6 +1485,9 @@ def serve() -> int:
     #: process, which is exactly the lifetime the dispatcher's
     #: per-connection registered-set mirrors.
     rpc_registry: dict = {}
+    #: sid -> _ServeSession (serve_open cmd); sessions die with the
+    #: channel — a reconnecting dispatcher re-opens on a fresh server.
+    serve_sessions: dict = {}
     buffer = ""
     running = True
     stdin_open = True
@@ -993,8 +1509,15 @@ def serve() -> int:
             if not data:
                 # Channel dropped: children keep running in their own
                 # sessions; serve until they are all reaped, then exit.
+                # Serving sessions, by contrast, die with the channel: no
+                # client can reach them anymore (a reconnecting dispatcher
+                # re-opens on a fresh server), so stop their loops instead
+                # of holding model memory forever.
                 stdin_open = False
                 sel.unregister(0)
+                for session in list(serve_sessions.values()):
+                    session.close()
+                serve_sessions.clear()
                 continue
             buffer += data.decode(errors="replace")
             while "\n" in buffer:
@@ -1015,6 +1538,12 @@ def serve() -> int:
                     _rpc_register(command, rpc_registry)
                 elif name == "invoke":
                     _rpc_invoke(command, rpc_registry)
+                elif name == "serve_open":
+                    _serve_open(command, serve_sessions)
+                elif name == "serve_request":
+                    _serve_request(command, serve_sessions)
+                elif name == "serve_close":
+                    _serve_close(command, serve_sessions)
                 elif name == "kill":
                     target = command.get("id")
                     sig = int(command.get("sig", 15))
@@ -1068,9 +1597,12 @@ def main(argv: list[str]) -> int:
         return serve()
     if len(argv) >= 2 and argv[1] == "--rpc-child":
         return rpc_child()
+    if len(argv) >= 2 and argv[1] == "--serve-child":
+        return serve_child()
     if len(argv) != 2:
         print(
-            "usage: harness.py <task_spec.json> | --serve | --rpc-child",
+            "usage: harness.py <task_spec.json> | --serve | --rpc-child"
+            " | --serve-child",
             file=sys.stderr,
         )
         return 2

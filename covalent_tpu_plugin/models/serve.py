@@ -69,6 +69,30 @@ from .speculative import _set_cursor
 from .transformer import TransformerLM
 
 
+class RollingCacheUnsupported(ValueError):
+    """Typed refusal: continuous serving assumes the plain cache layout.
+
+    ``rolling_cache`` models ring-rotate their KV slots, and the slot-reset
+    trick at admission (zero the lane, rewind the cursor) assumes the plain
+    append-only layout.  A :class:`ValueError` subclass for back-compat,
+    duck-tagged for the dispatch layers: the serving RPC surfaces this as a
+    PERMANENT fault (``fault_label``/``fault_transient`` — the resilience
+    classifier's self-classification hook), so a misconfigured session is
+    refused once instead of burning gang retries on a deterministic error.
+    """
+
+    fault_label = "serve_model_unsupported"
+    fault_transient = False
+
+
+def _require_plain_cache(config, what: str) -> None:
+    if config.rolling_cache:
+        raise RollingCacheUnsupported(
+            f"{what} does not support rolling_cache models "
+            "(slot reset assumes the plain cache layout)"
+        )
+
+
 def _choose_tokens(logits, key, temperature, top_k):
     """Shared greedy/sampling rule for the loop and the prefill."""
     logits = logits.astype(jnp.float32)
@@ -293,11 +317,7 @@ def continuous_generate(
     dispatched).
     """
     config = _decode_model(model).config
-    if config.rolling_cache:
-        raise ValueError(
-            "continuous_generate does not support rolling_cache models "
-            "(slot reset assumes the plain cache layout)"
-        )
+    _require_plain_cache(config, "continuous_generate")
     caps = None
     if isinstance(max_new_tokens, (float, np.floating)):
         max_new_tokens = int(max_new_tokens)  # old int-like float contract
@@ -521,3 +541,278 @@ def continuous_generate(
         if not queue and all(r < 0 for r in slot_req):
             break
     return outputs  # type: ignore[return-value]
+
+
+class ContinuousEngine:
+    """Incremental continuous batching for a *resident* model server.
+
+    ``continuous_generate`` serves one closed batch of prompts and
+    returns; a serving session needs the same fixed-slot loop held open
+    indefinitely, with requests admitted and harvested as they come.
+    This class is that loop turned inside out, implementing the worker
+    harness's duck-typed serving-engine surface
+    (``slots`` / :meth:`admit` / :meth:`step` / :meth:`cancel`):
+
+    * construction loads ``params`` and builds the jitted admission and
+      decode programs ONCE (shared, via the same ``_make_admit`` /
+      ``_make_run_steps`` caches ``continuous_generate`` compiles
+      through, so a session and a batch call with the same shape reuse
+      one executable);
+    * :meth:`admit` queues a request for a free slot — admissions flush
+      in the same fused, bucketed prefill waves as ``continuous_generate``
+      (one compiled call per bucket per flush, first token included);
+    * :meth:`step` runs ONE ``sync_steps`` decode chunk across every busy
+      lane and returns the fresh tokens per request since the last chunk
+      — the incremental stream a serving session pushes to its callers,
+      so time-to-first-token is one chunk, not end-of-response.
+
+    Numerics are ``continuous_generate``'s exactly: each lane is a vmapped
+    batch-1 decode, greedy rows bit-identical to ``generate()`` on
+    batch-rounding-invariant backends, and sampled requests draw from the
+    dedicated admission key chain.  Buffer width is static
+    (``length``, default ``config.max_seq``) — the price of compiling
+    once for a session's whole lifetime.
+    """
+
+    def __init__(
+        self,
+        model: TransformerLM,
+        params: Any,
+        *,
+        max_batch: int = 4,
+        temperature: float = 0.0,
+        top_k: int | None = None,
+        rng: jax.Array | None = None,
+        eos_token_id: int | None = None,
+        pad_token_id: int | None = None,
+        sync_steps: int = 8,
+        max_new_tokens: int = 16,
+        length: int | None = None,
+    ) -> None:
+        decoder = _decode_model(model)
+        config = decoder.config
+        _require_plain_cache(config, "ContinuousEngine")
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if sync_steps < 1:
+            raise ValueError(f"sync_steps must be >= 1, got {sync_steps}")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}"
+            )
+        if temperature <= 0 and top_k is not None:
+            raise ValueError("top_k requires sampling (temperature > 0)")
+        if top_k is not None and not 1 <= top_k <= config.vocab_size:
+            raise ValueError(
+                f"top_k must be in [1, {config.vocab_size}], got {top_k}"
+            )
+        self._length = int(length or config.max_seq)
+        if not 2 <= self._length <= config.max_seq:
+            raise ValueError(
+                f"length must be in [2, {config.max_seq}], got {self._length}"
+            )
+        self._decoder = decoder
+        self._config = config
+        self._params = params
+        self._temperature = float(temperature)
+        self._top_k = top_k
+        self._eos = eos_token_id
+        pad = pad_token_id
+        if pad is None:
+            pad = eos_token_id if eos_token_id is not None else 0
+        self._pad = int(pad)
+        self._sync = int(sync_steps)
+        self._default_cap = int(max_new_tokens)
+        self.slots = batch = int(max_batch)
+
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        rng = jnp.array(rng, copy=True)
+        lane = init_cache(model, 1)
+        caches = jax.tree_util.tree_map(
+            lambda leaf: jnp.broadcast_to(
+                leaf[None], (batch,) + leaf.shape
+            ).copy(),
+            lane,
+        )
+        self._state = (
+            caches,
+            jnp.full((batch, self._length), self._pad, jnp.int32),
+            jnp.zeros(batch, jnp.int32),   # pos
+            jnp.ones(batch, jnp.int32),    # plen
+            jnp.ones(batch, jnp.int32),    # row_cap
+            jnp.zeros(batch, jnp.int32),   # n_gen
+            jnp.ones(batch, bool),         # done (empty slots are "done")
+            rng,
+        )
+        self._run_steps = _make_run_steps(
+            decoder, self._temperature, top_k, eos_token_id,
+            self._length, self._sync, batch,
+        )
+        self._adm_key = jax.random.fold_in(rng, 0x5E1)
+        #: slot -> rid (None = free), and generated tokens already streamed.
+        self._slot_rid: list[str | None] = [None] * batch
+        self._reported = [0] * batch
+        self._rid_slot: dict[str, int] = {}
+        #: admissions awaiting a flush: (rid, tokens, cap).
+        self._pending: list[tuple[str, np.ndarray, int]] = []
+
+    # -- serving-engine surface -------------------------------------------
+
+    def admit(self, rid: str, prompt, params: dict | None = None) -> None:
+        """Reserve a lane for one request (flushed at the next step).
+
+        ``params`` may carry ``max_new_tokens``; everything else
+        (temperature, top_k, EOS) is session-static — the compiled
+        programs key on them.  Raises on malformed prompts, so the
+        session rejects the request instead of wedging a lane.
+        """
+        params = params or {}
+        if rid in self._rid_slot or any(p[0] == rid for p in self._pending):
+            raise ValueError(f"request id {rid!r} already admitted")
+        tokens = np.asarray(prompt, np.int32).reshape(-1)
+        if tokens.size < 1:
+            raise ValueError("prompt needs at least one token")
+        cap = int(params.get("max_new_tokens", self._default_cap))
+        if cap < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {cap}")
+        if tokens.size + cap > self._length:
+            raise ValueError(
+                f"prompt + budget ({tokens.size + cap}) exceeds the "
+                f"session's static length ({self._length})"
+            )
+        if len(self._rid_slot) + len(self._pending) >= self.slots:
+            raise RuntimeError("no free lane (all slots busy)")
+        self._pending.append((rid, tokens, cap))
+
+    def step(self) -> list[dict]:
+        """Flush admissions, run one sync chunk, return fresh tokens.
+
+        One event per request with new output since the previous chunk:
+        ``{"rid", "tokens": [int, ...], "done": bool}`` — the first
+        event includes the admission-prefill token, the final one the
+        EOS (when configured), exactly the rows ``continuous_generate``
+        would return, just delivered incrementally.
+        """
+        self._flush_admissions()
+        if not self._rid_slot:
+            return []
+        self._state = self._run_steps(self._params, self._state)
+        buffer_h = np.asarray(self._state[1])
+        plen_h = np.asarray(self._state[3])
+        n_gen_h = np.asarray(self._state[5])
+        done_h = np.asarray(self._state[6])
+        events: list[dict] = []
+        for slot in range(self.slots):
+            rid = self._slot_rid[slot]
+            if rid is None:
+                continue
+            total = int(n_gen_h[slot])
+            start = int(plen_h[slot]) + self._reported[slot]
+            fresh = buffer_h[slot, start: int(plen_h[slot]) + total]
+            finished = bool(done_h[slot])
+            if fresh.size or finished:
+                events.append({
+                    "rid": rid,
+                    "tokens": [int(t) for t in fresh],
+                    "done": finished,
+                })
+            self._reported[slot] += int(fresh.size)
+            if finished:
+                self._slot_rid[slot] = None
+                self._rid_slot.pop(rid, None)
+        return events
+
+    def cancel(self, rid: str) -> None:
+        """Free a request's lane early (deadline/disconnect).
+
+        The lane is marked done device-side — the scan freezes it like any
+        finished row — and freed for re-admission (which resets the lane's
+        cache and buffer anyway).
+        """
+        self._pending = [p for p in self._pending if p[0] != rid]
+        slot = self._rid_slot.pop(rid, None)
+        if slot is None:
+            return
+        caches, buffer, pos, plen, row_cap, n_gen, done, rng = self._state
+        self._state = (
+            caches, buffer, pos, plen, row_cap, n_gen,
+            done.at[slot].set(True), rng,
+        )
+        self._slot_rid[slot] = None
+
+    def close(self) -> None:
+        """Drop device state so the backend can reclaim the cache lanes."""
+        self._state = None
+        self._pending.clear()
+        self._rid_slot.clear()
+        self._slot_rid = [None] * self.slots
+
+    @property
+    def busy(self) -> int:
+        return len(self._rid_slot) + len(self._pending)
+
+    # -- internals ---------------------------------------------------------
+
+    def _flush_admissions(self) -> None:
+        """Admit pending requests in fused bucketed waves (one compiled
+        call per bucket), mirroring ``continuous_generate``'s
+        ``admit_group`` exactly — including the per-admission key chain."""
+        if not self._pending:
+            return
+        free = [s for s in range(self.slots) if self._slot_rid[s] is None]
+        picked: list[tuple[int, np.ndarray, int, Any, int]] = []
+        while self._pending and free:
+            rid, tokens, cap = self._pending.pop(0)
+            slot = free.pop(0)
+            self._slot_rid[slot] = rid
+            self._rid_slot[rid] = slot
+            self._reported[slot] = 0
+            bucket = min(
+                1 << (int(tokens.size) - 1).bit_length(),
+                self._config.max_seq,
+            )
+            self._adm_key, key = jax.random.split(self._adm_key)
+            picked.append((slot, tokens, cap, key, bucket))
+        for bucket in sorted({p[4] for p in picked}):
+            group = [p for p in picked if p[4] == bucket]
+            g = 1 << (len(group) - 1).bit_length()
+            rows = np.full((g, self._length), self._pad, np.int32)
+            padded = np.full((g, bucket), self._pad, np.int32)
+            plens = np.ones(g, np.int32)
+            slots = np.full(g, self.slots, np.int32)  # OOB rows dropped
+            caps_in = np.ones(g, np.int32)
+            keys = [jax.random.PRNGKey(0)] * g
+            for r, (slot, tokens, cap, key, _) in enumerate(group):
+                rows[r, : tokens.size] = tokens
+                padded[r, : tokens.size] = tokens
+                plens[r] = tokens.size
+                slots[r] = slot
+                caps_in[r] = cap
+                keys[r] = key
+            wave = _make_admit(
+                self._decoder, self._temperature, self._top_k, self._eos,
+                int(self.slots), int(bucket), int(g),
+            )
+            self._state = wave(
+                self._params, self._state, jnp.asarray(rows),
+                jnp.asarray(padded), jnp.asarray(plens),
+                jnp.asarray(slots), jnp.asarray(caps_in), jnp.stack(keys),
+            )
+
+
+def lm_engine_factory(model: TransformerLM, params: Any, **engine_kwargs):
+    """A zero-arg serving-session factory for an LM.
+
+    The returned closure is what ``serving.open_session`` cloudpickles
+    into the CAS; called inside the resident worker it builds the
+    :class:`ContinuousEngine` (loading params and compiling the decode/
+    prefill programs ONCE for the session's lifetime).  Note cloudpickle
+    serializes this module by *reference* — workers must be able to
+    import the package (or the caller registers it by value via
+    ``cloudpickle.register_pickle_by_value``).
+    """
+    def factory() -> ContinuousEngine:
+        return ContinuousEngine(model, params, **engine_kwargs)
+
+    return factory

@@ -31,6 +31,14 @@
 //       "args":"<b64>"}
 //   <- {"event":"started","id":"<op>", ...}      (emitted by the runner)
 //   <- {"event":"result","id":"<op>","ok":true,"data":"<b64>"}  (runner)
+//   -> {"cmd":"serve_open","id":"<sid>","digest":"<sha256>","path":"...",
+//       "runner":["python3","/cache/covalent_tpu_harness.py",
+//       "--serve-child"],"options":{...},"spec":{...}}
+//   <- {"event":"serve_opened","id":"<sid>","slots":N}       (runner)
+//   -> {"cmd":"serve_request","id":"<sid>","rid":"<rid>",...} (forwarded)
+//   <- {"event":"telemetry","id":"<sid>","data":{...}}        (runner)
+//   -> {"cmd":"serve_close","id":"<sid>"}                     (forwarded)
+//   <- {"event":"serve_closed","id":"<sid>","served":N}       (runner)
 //   -> {"cmd":"shutdown"}
 //   <- {"event":"bye"}
 //   <- {"event":"error","message":"..."}  (malformed input, unknown id, ...)
@@ -642,6 +650,169 @@ static void invoke_task(const Json& cmd, const std::string& raw_line) {
   // actually executes the function.
 }
 
+// ---------------------------------------------------------------------------
+// Serving sessions: a resident runner child per session, stdin held open.
+//
+// Unlike invoke (one command, pipe closed, child exits after one result), a
+// session lives for many requests: serve_open forks the provided runner argv
+// (the Python harness in --serve-child mode) with its stdin pipe KEPT OPEN,
+// and every later serve_request/serve_close line for that sid is forwarded
+// verbatim.  The child's stdout rides the same validated pump as RPC
+// runners, so serve_opened / telemetry / serve_closed events flow back
+// unchanged.  The resident *model* lives in the child; this agent only
+// switches lines.
+// ---------------------------------------------------------------------------
+
+struct ServeChild {
+  pid_t pid;
+  int stdin_fd;
+};
+
+static std::map<std::string, ServeChild> g_serve_children;
+
+static bool write_all(int fd, const std::string& payload) {
+  size_t off = 0;
+  while (off < payload.size()) {
+    ssize_t n = write(fd, payload.data() + off, payload.size() - off);
+    if (n <= 0) return false;
+    off += (size_t)n;
+  }
+  return true;
+}
+
+// A serve_open refusal must arrive as serve_error (never a generic
+// "error"): the client's open waiter settles only on serve_opened /
+// serve_error, so anything else stalls it for the full open timeout.
+static void emit_serve_error(const std::string& sid, const std::string& code,
+                             const std::string& message, bool permanent) {
+  emit("{\"event\":\"serve_error\",\"id\":\"" + json_escape(sid) +
+       "\",\"code\":\"" + json_escape(code) + "\",\"message\":\"" +
+       json_escape(message) + "\"" +
+       (permanent ? ",\"permanent\":true" : "") + "}");
+}
+
+static void serve_open(const Json& cmd, const std::string& raw_line) {
+  const Json* id_field = cmd.get("id");
+  const Json* runner = cmd.get("runner");
+  if (!id_field || id_field->type != Json::Str || !runner ||
+      runner->type != Json::Arr || runner->arr.empty()) {
+    emit_serve_error(
+        id_field && id_field->type == Json::Str ? id_field->s : "",
+        "bad_request",
+        "serve_open requires string id and non-empty runner argv", true);
+    return;
+  }
+  const std::string& sid = id_field->s;
+  if (g_serve_children.count(sid)) {
+    emit("{\"event\":\"serve_error\",\"id\":\"" + json_escape(sid) +
+         "\",\"code\":\"duplicate\",\"message\":\"session already open\","
+         "\"permanent\":true}");
+    return;
+  }
+  int in_pipe[2] = {-1, -1}, out_pipe[2] = {-1, -1};
+  if (pipe(in_pipe) != 0 || pipe(out_pipe) != 0) {
+    if (in_pipe[0] >= 0) { close(in_pipe[0]); close(in_pipe[1]); }
+    emit_serve_error(sid, "spawn_failed",
+                     std::string("pipe failed: ") + strerror(errno), false);
+    return;
+  }
+  pid_t pid = fork();
+  if (pid < 0) {
+    close(in_pipe[0]); close(in_pipe[1]);
+    close(out_pipe[0]); close(out_pipe[1]);
+    emit_serve_error(sid, "spawn_failed",
+                     std::string("fork failed: ") + strerror(errno), false);
+    return;
+  }
+  if (pid == 0) {
+    setsid();
+    dup2(in_pipe[0], 0);
+    dup2(out_pipe[1], 1);
+    int devnull = open("/dev/null", O_WRONLY);
+    if (devnull >= 0) dup2(devnull, 2);
+    for (int fd = 3; fd < 256; fd++) close(fd);
+    std::vector<char*> argv;
+    argv.reserve(runner->arr.size() + 1);
+    for (const auto& a : runner->arr)
+      if (a.type == Json::Str) argv.push_back(const_cast<char*>(a.s.c_str()));
+    argv.push_back(nullptr);
+    execvp(argv[0], argv.data());
+    _exit(127);
+  }
+  close(in_pipe[0]);
+  close(out_pipe[1]);
+  // The serve_open line itself is the child's first command (it carries
+  // the CAS path + options); the pipe stays open for the session's life.
+  if (!write_all(in_pipe[1], raw_line + "\n")) {
+    // Child unreachable at birth: fail the open (transient — a fresh
+    // gang can retry), close both pipe ends so the child EOFs out, and
+    // register ONLY the pid (the reaper needs it) — a session entry
+    // holding this closed fd would make a later serve_request write
+    // into whatever descriptor the number gets reused for.
+    close(in_pipe[1]);
+    close(out_pipe[0]);
+    g_tasks[pid] = Task{pid, sid};
+    emit_serve_error(sid, "spawn_failed",
+                     "serve runner rejected its open command", false);
+    return;
+  }
+  g_tasks[pid] = Task{pid, sid};
+  g_serve_children[sid] = ServeChild{pid, in_pipe[1]};
+  g_rpc_streams[out_pipe[0]] = RpcStream{sid, ""};
+  // serve_opened (or serve_error) comes from the runner once the model
+  // factory settles — nothing synthesized here.
+}
+
+static void serve_forward(const Json& cmd, const std::string& raw_line,
+                          bool is_close) {
+  const Json* id_field = cmd.get("id");
+  const std::string sid =
+      (id_field && id_field->type == Json::Str) ? id_field->s : "";
+  auto it = g_serve_children.find(sid);
+  if (it == g_serve_children.end()) {
+    if (is_close) {
+      emit("{\"event\":\"serve_error\",\"id\":\"" + json_escape(sid) +
+           "\",\"code\":\"unknown_session\",\"message\":\"no open session\","
+           "\"permanent\":true}");
+    } else {
+      // Per-request reject, streamed like the pool server's: the caller's
+      // stream for this rid must fail fast, not hang.
+      const Json* rid = cmd.get("rid");
+      emit("{\"event\":\"telemetry\",\"id\":\"" + json_escape(sid) +
+           "\",\"data\":{\"type\":\"serve.reject\",\"rid\":\"" +
+           json_escape(rid && rid->type == Json::Str ? rid->s : "") +
+           "\",\"code\":\"unknown_session\",\"message\":\"no open "
+           "session\"}}");
+    }
+    return;
+  }
+  bool ok = write_all(it->second.stdin_fd, raw_line + "\n");
+  if (is_close || !ok) {
+    // Close (or a torn pipe): EOF the child's stdin; it drains admitted
+    // lanes, emits serve_closed, and exits — the reaper cleans the maps.
+    close(it->second.stdin_fd);
+    g_serve_children.erase(it);
+  }
+}
+
+static void reap_serve_child(pid_t pid) {
+  for (auto it = g_serve_children.begin(); it != g_serve_children.end(); ++it) {
+    if (it->second.pid == pid) {
+      // Still registered at death = the child exited WITHOUT a clean
+      // serve_close (exec failure before serve_opened, a crash
+      // mid-session).  Announce it so a pending open waiter fails fast
+      // (transient — a fresh gang can retry) instead of sitting out the
+      // whole open timeout on a runner that already _exit(127)ed.
+      emit_serve_error(it->first, "runner_exited",
+                       "serve runner exited without closing its session",
+                       false);
+      close(it->second.stdin_fd);
+      g_serve_children.erase(it);
+      return;
+    }
+  }
+}
+
 static void pump_rpc_stream(int fd) {
   auto it = g_rpc_streams.find(fd);
   if (it == g_rpc_streams.end()) return;
@@ -747,6 +918,7 @@ static void reap_children() {
     int status = 0;
     pid_t pid = waitpid(-1, &status, WNOHANG);
     if (pid <= 0) break;
+    reap_serve_child(pid);
     auto it = g_tasks.find(pid);
     if (it == g_tasks.end()) continue;
     int code = WIFEXITED(status) ? WEXITSTATUS(status) : -1;
@@ -786,6 +958,9 @@ static void handle_line(const std::string& line, bool& running) {
   else if (name == "run") spawn(cmd);
   else if (name == "register_fn") register_fn(cmd);
   else if (name == "invoke") invoke_task(cmd, line);
+  else if (name == "serve_open") serve_open(cmd, line);
+  else if (name == "serve_request") serve_forward(cmd, line, false);
+  else if (name == "serve_close") serve_forward(cmd, line, true);
   else if (name == "kill") kill_task(cmd);
   else if (name == "watch") watch_task(cmd);
   else if (name == "unwatch") unwatch_task(cmd);
@@ -846,7 +1021,12 @@ int main() {
         if (n <= 0) {
           // Channel dropped: children keep running in their own sessions;
           // the executor resumes supervision via the pid-file polling path.
+          // Serving children, by contrast, die with the channel (no client
+          // can reach them anymore): EOF their stdin so they drain and
+          // exit instead of holding model memory forever.
           stdin_open = false;
+          for (auto& kv : g_serve_children) close(kv.second.stdin_fd);
+          g_serve_children.clear();
           continue;
         }
         buffer.append(chunk, (size_t)n);

@@ -181,6 +181,12 @@ class OpsServer:
             in_flight = view.get("in_flight")
             if isinstance(in_flight, dict):
                 out["in_flight"].update(in_flight)
+            # Same for live serving sessions: "is session X open" must be
+            # one lookup whichever executor holds it (sids are uuid-unique
+            # across executors, so a flat merge cannot collide).
+            serving = view.get("serving")
+            if isinstance(serving, dict) and serving:
+                out.setdefault("serving", {}).update(serving)
             if name.partition(":")[0] == "fleet" and view:
                 # The scheduler's live view (queue depth, per-tenant
                 # backlog, per-pool capacity/in-use/breakers) is a

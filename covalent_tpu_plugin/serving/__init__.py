@@ -1,0 +1,50 @@
+"""Persistent serving tier: resident model servers with streaming RPCs.
+
+Load + compile once inside a warm gang, then serve request-level RPCs
+over the held-open agent channel for the session's whole lifetime — the
+dispatch plane's answer to interactive traffic (ROADMAP item 2).
+
+* :func:`open_session` — ship a model factory by CAS digest, open the
+  session, get a :class:`ServeHandle` back.
+* :class:`ServeHandle` — multiplex concurrent callers onto the session;
+  tokens stream back incrementally; channel death reconnects and
+  replays with exactly-once token delivery.
+* ``models/serve.ContinuousEngine`` — the in-worker continuous-batching
+  engine the worker harness drives (``slots``/``admit``/``step``).
+"""
+
+from .handle import (
+    ServeError,
+    ServeHandle,
+    ServeRequest,
+    ServeRequestRejected,
+    open_session,
+)
+from .metrics import (
+    SERVE_QUEUE_DEPTH,
+    SERVE_RECONNECTS_TOTAL,
+    SERVE_REQUEST_SECONDS,
+    SERVE_REQUESTS_TOTAL,
+    SERVE_SESSIONS,
+    SERVE_TOKENS_PER_S,
+    SERVE_TOKENS_TOTAL,
+    SERVE_TTFT_SECONDS,
+    SERVE_WORKER_SLOTS,
+)
+
+__all__ = [
+    "ServeError",
+    "ServeHandle",
+    "ServeRequest",
+    "ServeRequestRejected",
+    "open_session",
+    "SERVE_QUEUE_DEPTH",
+    "SERVE_RECONNECTS_TOTAL",
+    "SERVE_REQUEST_SECONDS",
+    "SERVE_REQUESTS_TOTAL",
+    "SERVE_SESSIONS",
+    "SERVE_TOKENS_PER_S",
+    "SERVE_TOKENS_TOTAL",
+    "SERVE_TTFT_SECONDS",
+    "SERVE_WORKER_SLOTS",
+]

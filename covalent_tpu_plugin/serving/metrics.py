@@ -1,0 +1,72 @@
+"""Serving-tier metrics: request outcomes, stream latency, session load.
+
+One module so the handle, the fleet status views, and the bench phase all
+move the same series.  Label cardinality is deliberately low: ``outcome``
+is a closed set, and per-session gauges key on the HANDLE sid (stable
+across reconnect generations), not the per-generation remote session id.
+"""
+
+from __future__ import annotations
+
+from ..obs.metrics import REGISTRY
+
+#: Terminal accounting for every request submitted through a handle.
+#: ``ok`` — full stream delivered; ``deadline`` — lane reclaimed at its
+#: deadline (partial stream, ``error`` marker on the final chunk);
+#: ``shed`` — refused at admission (bounded queue full); ``rejected`` —
+#: refused for any other reason (unknown session, engine refusal);
+#: ``error`` — stream failed (token gap, session death past its retry
+#: budget, close with requests in flight).
+SERVE_REQUESTS_TOTAL = REGISTRY.counter(
+    "covalent_tpu_serve_requests_total",
+    "Serving-session requests by terminal outcome",
+    ("outcome",),
+)
+
+SERVE_TOKENS_TOTAL = REGISTRY.counter(
+    "covalent_tpu_serve_tokens_total",
+    "Tokens streamed back to serving-session callers",
+)
+
+SERVE_SESSIONS = REGISTRY.gauge(
+    "covalent_tpu_serve_sessions",
+    "Live serving sessions held open by this dispatcher",
+)
+
+SERVE_QUEUE_DEPTH = REGISTRY.gauge(
+    "covalent_tpu_serve_queue_depth",
+    "Worker-side admission queue depth per serving session",
+    ("session",),
+)
+
+SERVE_TOKENS_PER_S = REGISTRY.gauge(
+    "covalent_tpu_serve_tokens_per_s",
+    "Worker-reported aggregate decode throughput per serving session",
+    ("session",),
+)
+
+SERVE_RECONNECTS_TOTAL = REGISTRY.counter(
+    "covalent_tpu_serve_reconnects_total",
+    "Serving sessions re-opened after a channel/worker death",
+)
+
+#: Time-to-first-token, submit -> first streamed chunk.  The streaming
+#: side-band's whole point: TTFT must sit near one decode chunk, not at
+#: end-of-response - the bench phase asserts exactly that.
+SERVE_TTFT_SECONDS = REGISTRY.histogram(
+    "covalent_tpu_serve_ttft_seconds",
+    "Serving-request time to first streamed token",
+)
+
+SERVE_REQUEST_SECONDS = REGISTRY.histogram(
+    "covalent_tpu_serve_request_seconds",
+    "Serving-request full-stream latency (submit -> final chunk)",
+)
+
+#: Dispatcher-side view of worker slot occupancy, fed by the heartbeat
+#: backhaul (a serving worker's beats carry its ``serve`` block).
+SERVE_WORKER_SLOTS = REGISTRY.gauge(
+    "covalent_tpu_serve_worker_slots",
+    "Serving slot occupancy reported by worker heartbeats",
+    ("worker", "state"),
+)

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import asyncio
 import base64
+import hashlib
 import json
 import os
 import pickle
@@ -74,6 +75,7 @@ from .obs.opsserver import (
 )
 from .obs.trace import Span, context_of
 from .parallel.distributed import coordinator_spec
+from .serving.metrics import SERVE_WORKER_SLOTS
 from .resilience import (
     TASK_RETRIES_TOTAL,
     CircuitBreakerRegistry,
@@ -694,6 +696,10 @@ class TPUExecutor(RemoteExecutor):
         self._op_agents: dict[str, list] = {}
         #: per-address locks making agent creation single-flight.
         self._agent_locks: dict[str, asyncio.Lock] = {}
+        #: sid -> live serving ServeHandle opened on this executor's gang
+        #: (serving.open_session registers/deregisters; /status and the
+        #: fleet pool view read it).
+        self._serve_handles: dict[str, Any] = {}
         self.last_timings: dict[str, float] = {}
 
         # Fleet ops plane: start the (env-gated) status endpoint and expose
@@ -744,6 +750,7 @@ class TPUExecutor(RemoteExecutor):
             "stall_after_s": self._stall_after(),
             "dispatch_mode": self.dispatch_mode,
             "rpc_registered": self._fn_registry.counts(),
+            "serving": self.serve_sessions(),
             "in_flight": in_flight,
             "circuit_breakers": self._breakers.states(),
             "agents": {
@@ -771,6 +778,17 @@ class TPUExecutor(RemoteExecutor):
             op: str(state.get("mode", "launch"))
             for op, state in list(self._op_status.items())
         }
+
+    def serve_sessions(self) -> dict[str, dict[str, Any]]:
+        """sid -> live serving-session view (state, slots, queue depth,
+        tokens/s) for ``/status`` and the fleet pool status."""
+        views: dict[str, dict[str, Any]] = {}
+        for sid, handle in list(self._serve_handles.items()):
+            try:
+                views[sid] = handle.status()
+            except Exception:  # noqa: BLE001 - status must not crash a view
+                pass
+        return views
 
     # ------------------------------------------------------------------ #
     # Worker topology                                                    #
@@ -1696,6 +1714,16 @@ class TPUExecutor(RemoteExecutor):
         fresh = MONITOR.record(operation_id, worker, heartbeat)
         if not fresh:
             return
+        serve = heartbeat.get("serve")
+        if isinstance(serve, dict):
+            # A serving worker's beats carry its slot occupancy: surface
+            # it as dispatcher gauges so load is visible per worker even
+            # before any per-session stats record lands.
+            for state in ("sessions", "slots", "busy", "queued"):
+                if state in serve:
+                    SERVE_WORKER_SLOTS.labels(
+                        worker=worker, state=state
+                    ).set(float(serve.get(state) or 0))
         body = {
             k: v for k, v in heartbeat.items()
             if k not in ("type", "pid", "ts")
@@ -3342,7 +3370,9 @@ class TPUExecutor(RemoteExecutor):
         present — concurrent electrons share function payload files)."""
         if os.path.exists(path):
             return
-        tmp = f"{path}.tmp.{os.getpid()}"
+        # Suffix must be unique per call: concurrent electrons in ONE
+        # process may race to publish the same digest.
+        tmp = f"{path}.tmp.{os.getpid()}.{os.urandom(4).hex()}"
         with open(tmp, "wb") as f:
             f.write(payload)
         os.replace(tmp, path)
@@ -3353,6 +3383,49 @@ class TPUExecutor(RemoteExecutor):
         pickle layout launch mode fetches from the result file."""
         data = base64.b64decode(str(event.get("data") or ""))
         return pickle.loads(data)
+
+    async def _fetch_staged_rpc_result(
+        self, conn: Transport, event: dict, operation_id: str
+    ) -> tuple[Any, BaseException | None]:
+        """Fetch an oversized result the worker staged instead of inlining.
+
+        The return leg of the ``rpc_inline_args_max`` policy: the result
+        event announces a remote path + sha256 instead of carrying the
+        pickle.  Bytes are digest-verified after the fetch (a mismatch is
+        a torn artifact — deterministic corruption, so the unrecognized-
+        exception default classifies it PERMANENT, like any torn CAS
+        payload); the remote file is unlinked either way.
+        """
+        remote = str(event["data_path"])
+        local = os.path.join(
+            self.cache_dir, f"result_rpc_{os.urandom(8).hex()}.pkl"
+        )
+        try:
+            await conn.get(remote, local)
+            data = await asyncio.to_thread(
+                lambda: open(local, "rb").read()
+            )
+            expected = str(event.get("data_digest") or "")
+            if expected and hashlib.sha256(data).hexdigest() != expected:
+                raise RuntimeError(
+                    f"staged RPC result for {operation_id} does not match "
+                    "its announced digest (torn artifact)"
+                )
+            obs_events.emit(
+                "task.rpc_result_staged",
+                operation_id=operation_id,
+                bytes=len(data),
+            )
+            return await asyncio.to_thread(pickle.loads, data)
+        finally:
+            try:
+                os.remove(local)
+            except OSError:
+                pass
+            try:
+                await conn.remove([remote])
+            except (TransportError, OSError):
+                pass
 
     def _rpc_result_cache_key(
         self,
@@ -3675,9 +3748,21 @@ class TPUExecutor(RemoteExecutor):
                                 self._handle_backhaul(task_id, _worker, data)
                             )
                         )
+                    # The inline-args size policy applies symmetrically on
+                    # the way back: a result pickle over the threshold is
+                    # staged remotely (attempt-private path, sha256
+                    # announced) instead of base64-inlined onto the
+                    # channel in one multi-MB write.
+                    remote_result = (
+                        f"{self.remote_cache}/result_rpc_"
+                        f"{os.urandom(8).hex()}.pkl"
+                    )
                     await client.invoke(
                         operation_id, fn_digest, spec=spec,
-                        path=remote_fn, **invoke_kwargs,
+                        path=remote_fn,
+                        result_path=remote_result,
+                        result_max_inline=self.rpc_inline_args_max,
+                        **invoke_kwargs,
                     )
             except AgentError as err:
                 # Registration/invoke failure.  classify_error reads the
@@ -3808,9 +3893,14 @@ class TPUExecutor(RemoteExecutor):
                 return result
 
             with Span("executor.fetch"):
-                result, exception = await asyncio.to_thread(
-                    self._decode_rpc_result, payload
-                )
+                if payload.get("data_path"):
+                    result, exception = await self._fetch_staged_rpc_result(
+                        conn, payload, operation_id
+                    )
+                else:
+                    result, exception = await asyncio.to_thread(
+                        self._decode_rpc_result, payload
+                    )
 
             if exception is not None:
                 outcome = "remote_exception"

@@ -116,7 +116,11 @@ class LocalTransport(Transport):
                 parent = os.path.dirname(remote)
                 if parent:
                     os.makedirs(parent, exist_ok=True)
-                tmp = f"{remote}.tmp-{os.getpid()}"
+                # Unique per call, not per pid: two gang members staging
+                # the same CAS digest concurrently from one dispatcher
+                # process must not share a tmp name (the first replace
+                # deletes it out from under the second copy).
+                tmp = f"{remote}.tmp-{os.getpid()}-{os.urandom(4).hex()}"
                 shutil.copyfile(local, tmp)
                 os.replace(tmp, remote)
                 total += os.path.getsize(remote)

@@ -268,3 +268,182 @@ def test_agent_native_invoke_roundtrip_via_rpc_child(
     )
     assert exception is None
     assert result == 42
+
+
+# ---------------------------------------------------------------------------
+# Serving sessions: the native agent's line-switching analog of the pool
+# server's session verbs (tests/test_serving.py).  The C++ agent forks the
+# harness --serve-child runner with its stdin pipe HELD OPEN, forwards every
+# serve_request/serve_close line verbatim, and pumps the child's stdout back
+# over the channel — so the protocol observed here must be bit-identical to
+# the pool server's.
+# ---------------------------------------------------------------------------
+
+
+def _native_serve_factory():
+    """A stub engine factory, cloudpickled BY VALUE (closure-local class:
+    the forked --serve-child runner cannot import the tests package)."""
+
+    def factory():
+        class Engine:
+            def __init__(self):
+                self.slots = 2
+                self.lanes = {}
+
+            def admit(self, rid, prompt, params):
+                cap = int((params or {}).get("max_new_tokens", 4))
+                base = int(prompt[-1])
+                self.lanes[rid] = [base + i + 1 for i in range(cap)]
+
+            def step(self):
+                events = []
+                for rid in list(self.lanes):
+                    taken = self.lanes[rid][:2]
+                    self.lanes[rid] = self.lanes[rid][2:]
+                    done = not self.lanes[rid]
+                    if done:
+                        del self.lanes[rid]
+                    events.append(
+                        {"rid": rid, "tokens": taken, "done": done}
+                    )
+                return events
+
+            def cancel(self, rid):
+                self.lanes.pop(rid, None)
+
+        return Engine()
+
+    return factory
+
+
+async def _drain_until(records, predicate, timeout=30.0):
+    import time as time_mod
+
+    deadline = time_mod.monotonic() + timeout
+    while time_mod.monotonic() < deadline:
+        for record in records:
+            if predicate(record):
+                return record
+        await asyncio.sleep(0.02)
+    raise AssertionError(f"no matching record in {records}")
+
+
+def test_agent_native_serve_open_request_close_roundtrip(
+    agent_binary, tmp_path, run_async
+):
+    """serve_open forks the --serve-child runner (stdin held open), a
+    serve_request streams cumulative-idx token chunks back over the
+    channel, and serve_close drains and acks with the served count."""
+    import hashlib
+    import sys
+
+    import cloudpickle
+
+    from covalent_tpu_plugin import harness as harness_mod
+
+    async def flow():
+        conn = LocalTransport()
+        client = await AgentClient.start(conn, agent_binary)
+        records: list = []
+        try:
+            payload = cloudpickle.dumps(_native_serve_factory())
+            digest = hashlib.sha256(payload).hexdigest()
+            artifact = tmp_path / f"{digest}.pkl"
+            artifact.write_bytes(payload)
+            runner = [sys.executable, harness_mod.__file__, "--serve-child"]
+            client.watch_serve(
+                "nsrv", lambda sid, data: records.append(data)
+            )
+            opened = await client.serve_open(
+                "nsrv", digest, str(artifact), runner=runner, timeout=60.0,
+            )
+            await client.serve_request(
+                "nsrv", "r1", [5], params={"max_new_tokens": 4}
+            )
+            final = await _drain_until(
+                records,
+                lambda r: r.get("type") == "serve.token" and r.get("done"),
+            )
+            closed = await client.serve_close("nsrv", timeout=30.0)
+        finally:
+            await client.close()
+        return opened, records, final, closed
+
+    opened, records, final, closed = run_async(flow())
+    assert opened["slots"] == 2 and opened["pid"] > 0
+    chunks = [r for r in records if r.get("type") == "serve.token"]
+    streamed: list = []
+    for chunk in chunks:
+        assert chunk["rid"] == "r1"
+        assert chunk["idx"] == len(streamed)  # cumulative-before-chunk
+        streamed.extend(chunk["tokens"])
+    assert streamed == [6, 7, 8, 9]
+    assert final["done"] is True
+    assert closed["served"] == 1
+
+
+def test_agent_native_serve_unknown_session_rejected(
+    agent_binary, run_async
+):
+    """A request against a sid that was never opened fails fast as a
+    streamed serve.reject; closing it is a clean serve_error — the agent
+    synthesizes both itself (no runner involved), channel stays alive."""
+    from covalent_tpu_plugin.resilience import FaultClass, classify_error
+
+    async def flow():
+        conn = LocalTransport()
+        client = await AgentClient.start(conn, agent_binary)
+        records: list = []
+        try:
+            client.watch_serve(
+                "ghost", lambda sid, data: records.append(data)
+            )
+            await client.serve_request("ghost", "r0", [1])
+            reject = await _drain_until(
+                records, lambda r: r.get("type") == "serve.reject"
+            )
+            with pytest.raises(AgentError, match="unknown_session") as ghost:
+                await client.serve_close("ghost", timeout=10.0)
+            # The channel survived both refusals: a ping still pongs.
+            await client.ping(timeout=10.0)
+        finally:
+            await client.close()
+        return reject, ghost.value
+
+    reject, ghost_error = run_async(flow())
+    assert reject["code"] == "unknown_session"
+    assert reject["rid"] == "r0"
+    fault, _ = classify_error(ghost_error)
+    assert fault is FaultClass.PERMANENT
+
+
+def test_agent_native_serve_open_failure_fails_fast(
+    agent_binary, tmp_path, run_async
+):
+    """A runner that cannot exec must fail the open as a streamed
+    serve_error within seconds (reaper announces the dead child) — not
+    stall the caller for the whole open timeout."""
+    import time
+
+    async def flow():
+        conn = LocalTransport()
+        client = await AgentClient.start(conn, agent_binary)
+        try:
+            artifact = tmp_path / "factory.pkl"
+            artifact.write_bytes(b"never unpickled")
+            t0 = time.monotonic()
+            with pytest.raises(AgentError, match="serve_open") as excinfo:
+                await client.serve_open(
+                    "doomed", "0" * 64, str(artifact),
+                    runner=["/nonexistent-serve-runner"], timeout=30.0,
+                )
+            elapsed = time.monotonic() - t0
+            # The channel survived the dead runner: a ping still pongs.
+            await client.ping(timeout=10.0)
+        finally:
+            await client.close()
+        return excinfo.value, elapsed
+
+    error, elapsed = run_async(flow())
+    assert "runner_exited" in str(error) or "spawn_failed" in str(error)
+    assert elapsed < 10.0, f"open took {elapsed:.1f}s — waited out the timeout"

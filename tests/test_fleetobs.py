@@ -527,10 +527,12 @@ def test_agent_stall_suspicion_confirmed_against_hb_file(
     monkeypatch.setattr(agent_mod.AgentClient, "watch", broken_watch)
     # Tighten the never-beat launch slack so the suspicion actually fires
     # within the electron's runtime.
-    monkeypatch.setattr(HeartbeatMonitor, "LAUNCH_SLACK_S", 0.6)
+    monkeypatch.setattr(HeartbeatMonitor, "LAUNCH_SLACK_S", 1.0)
+    # 8 missed beats before suspicion: 0.4s flaked under full-suite load
+    # (a transiently starved beat thread read as a stall).
     ex = make_local_executor(
         tmp_path, use_agent="pool", heartbeat_interval=0.1,
-        stall_threshold=0.4, max_task_retries=1, poll_freq=0.1,
+        stall_threshold=0.8, max_task_retries=1, poll_freq=0.1,
     )
 
     def slow(x):
