@@ -125,6 +125,47 @@ def spread_stats(values, prefix: str) -> dict:
     return out
 
 
+def introspection_view(metrics: list, window_s: float = 300.0) -> dict:
+    """Phase-boundary introspection: windowed history timelines + SLO
+    verdicts for a phase's emitted JSON.
+
+    BENCH artifacts previously carried point summaries only; the history
+    ring turns them into regression-comparable timelines (tokens/s and
+    queue depth over the phase, windowed latency percentiles), and the
+    SLO engine's verdicts say whether the phase burned any error budget
+    while it ran.  Best-effort: introspection being disabled (env) or
+    broken must never fail a bench phase.
+    """
+    view: dict = {"history": {}, "slo": {}}
+    try:
+        from covalent_tpu_plugin.obs import history as _history
+        from covalent_tpu_plugin.obs import slo as _slo
+
+        ring = _history.ensure_history()
+        if ring is not None:
+            ring.sample(force=True)  # pin the phase's final state
+            for name in metrics:
+                q = ring.query(name, window_s=window_s)
+                view["history"][name] = {
+                    "kind": q["kind"],
+                    "samples": q["samples"],
+                    "series": q["series"],
+                }
+        engine = _slo.ensure_slo_engine()
+        if engine is not None:
+            evaluated = engine.evaluate()
+            view["slo"] = {
+                name: {
+                    "state": info["state"],
+                    "burn_rate": info["burn_rate"],
+                }
+                for name, info in evaluated.get("slos", {}).items()
+            }
+    except Exception as error:  # noqa: BLE001 - observability never fatal
+        view["error"] = repr(error)
+    return view
+
+
 def percentile(values, q: float) -> float:
     """Linear-interpolated percentile of a small sample (q in [0, 1])."""
     ordered = sorted(values)
@@ -1542,6 +1583,22 @@ async def main() -> None:
         "tpu": TPU_BUDGET_S,
     }})
 
+    # Start the introspection plane before the first phase: the history
+    # sampler needs to be recording WHILE phases run for their emitted
+    # timelines to have points (0.25 s ticks — bench phases are seconds
+    # long), and the SLO engine evaluates on every sample.
+    try:
+        from covalent_tpu_plugin.obs.history import ensure_history
+        from covalent_tpu_plugin.obs.slo import ensure_slo_engine
+
+        if os.environ.get("COVALENT_TPU_HISTORY_S"):
+            ensure_history()  # env wins, incl. "0"/"off" to disable
+        else:
+            ensure_history(interval_s=0.25)
+        ensure_slo_engine()
+    except Exception as error:  # noqa: BLE001 - observability never fatal
+        emit({"phase": "introspection", "error": repr(error)})
+
     summary: dict = {}
 
     # ---- phase 1: dispatch overhead (the headline metric) ----------------
@@ -1611,6 +1668,7 @@ async def main() -> None:
             # last_timings: connect/stage/upload/submit/execute/fetch/...).
             "breakdown": {
                 k: round(v, 5) for k, v in executor.last_timings.items()
+                if isinstance(v, (int, float))
             },
             "wire_bytes": round(wire_up_bytes() - wire0, 1),
             **spread_stats(overheads, "overhead"),
@@ -2114,6 +2172,12 @@ async def main() -> None:
             "within_budget": summary["rpc_overhead_within_budget"],
             "results_byte_equal": summary["rpc_results_byte_equal"],
             "speedup": summary["rpc_overhead_speedup"],
+            # Regression-comparable timeline + budget verdicts, not just
+            # the point medians above.
+            "introspection": introspection_view([
+                "covalent_tpu_wall_overhead_seconds",
+                "covalent_tpu_tasks_total",
+            ]),
             **spread_stats(rpc_arm_run["overheads"], "rpc_overhead"),
         })
     except _PhaseSkipped:
@@ -2332,6 +2396,16 @@ async def main() -> None:
             "beats_per_electron": summary["serve_beats_per_electron"],
             "ttft_streams_early": summary["serve_ttft_streams_early"],
             "worker_stats": resident_arm_run["stats"],
+            # The serving timeline (tokens/s + queue depth per session,
+            # windowed latency/TTFT percentiles) + end-of-phase SLO
+            # verdicts: BENCH artifacts carry the whole shape of the
+            # phase, not just its point summary.
+            "introspection": introspection_view([
+                "covalent_tpu_serve_tokens_per_s",
+                "covalent_tpu_serve_queue_depth",
+                "covalent_tpu_serve_request_seconds",
+                "covalent_tpu_serve_ttft_seconds",
+            ]),
             **spread_stats(resident_arm_run["latencies"], "serve_latency"),
         })
     except _PhaseSkipped:

@@ -198,6 +198,11 @@ class AgentClient:
         self._serve_errors: dict[str, dict] = {}
         self._serve_closed: dict[str, dict] = {}
         self._serve_sinks: dict[str, Any] = {}
+        #: resident-mode profiling: profile id -> pushed profile_started /
+        #: profile_stopped / profile_error events.
+        self._profile_started: dict[str, dict] = {}
+        self._profile_stopped: dict[str, dict] = {}
+        self._profile_errors: dict[str, dict] = {}
         self._reader = asyncio.create_task(self._read_loop())
 
     # -- lifecycle -----------------------------------------------------------
@@ -264,6 +269,12 @@ class AgentClient:
                         self._serve_errors[task_id] = event
                     elif kind == "serve_closed":
                         self._serve_closed[task_id] = event
+                    elif kind == "profile_started":
+                        self._profile_started[task_id] = event
+                    elif kind == "profile_stopped":
+                        self._profile_stopped[task_id] = event
+                    elif kind == "profile_error":
+                        self._profile_errors[task_id] = event
                     elif kind == "exit":
                         self._exits[task_id] = (
                             int(event.get("code", -1)),
@@ -673,6 +684,87 @@ class AgentClient:
             return c._serve_closed.pop(sid, None)
 
         return await self._wait(settled, timeout)
+
+    # -- resident-mode profiling ---------------------------------------------
+
+    async def profile_start(
+        self,
+        profile_id: str,
+        trace_dir: str,
+        sid: str = "",
+        timeout: float = 60.0,
+    ) -> dict:
+        """Start a ``jax.profiler`` trace inside the resident runtime.
+
+        The pool server runs the trace in its own process (where RPC
+        invocations and pool-mode serving sessions execute); the native
+        C++ agent forwards the command into a live ``--serve-child``
+        session runner (``sid`` pins which one; otherwise the agent picks
+        any).  Exactly one trace runs per runtime — a second start is
+        refused ``busy``.  Returns the ``profile_started`` event.
+        """
+        command: dict = {
+            "cmd": "profile_start", "id": profile_id, "dir": trace_dir,
+        }
+        if sid:
+            command["sid"] = sid
+        await self._send(command)
+        return await self._wait(
+            self._profile_settled(profile_id, self._profile_started), timeout
+        )
+
+    async def profile_stop(
+        self,
+        profile_id: str,
+        artifact_dir: str = "",
+        sid: str = "",
+        timeout: float = 120.0,
+        discard: bool = False,
+    ) -> dict:
+        """Stop the active trace; returns the ``profile_stopped`` event.
+
+        The worker packages the trace directory into one content-addressed
+        ``<sha256>.profile.tgz`` under ``artifact_dir`` (the dispatcher
+        points this at the CAS dir) and announces ``path``/``digest``/
+        ``bytes`` — the caller fetches and digest-verifies before trusting
+        the artifact.  The generous timeout covers tarring a large trace.
+        ``discard=True`` (a compensating stop for an abandoned capture)
+        skips packaging entirely: the worker deletes the raw trace dir.
+        """
+        command: dict = {"cmd": "profile_stop", "id": profile_id}
+        if artifact_dir:
+            command["artifact_dir"] = artifact_dir
+        if sid:
+            command["sid"] = sid
+        if discard:
+            command["discard"] = True
+        await self._send(command)
+        return await self._wait(
+            self._profile_settled(profile_id, self._profile_stopped), timeout
+        )
+
+    async def profile_wait_stopped(
+        self, profile_id: str, timeout: float = 120.0
+    ) -> dict:
+        """Wait out an in-flight stop's ``profile_stopped`` WITHOUT
+        re-sending the command — the worker packages the trace on a
+        thread, and a resend during packaging is refused ("already
+        stopping"), abandoning the artifact it is about to announce."""
+        return await self._wait(
+            self._profile_settled(profile_id, self._profile_stopped), timeout
+        )
+
+    def _profile_settled(self, profile_id: str, table: dict):
+        def settled(c: "AgentClient"):
+            if profile_id in c._profile_errors:
+                event = c._profile_errors.pop(profile_id)
+                raise AgentError(
+                    f"agent@{c.address}: profile {profile_id} failed "
+                    f"({event.get('code')}): {event.get('message')}"
+                )
+            return table.pop(profile_id, None)
+
+        return settled
 
     def watch_serve(self, sid: str, sink) -> None:
         """Route session ``sid``'s side-band records to ``sink(sid, data)``

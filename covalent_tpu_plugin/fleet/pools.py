@@ -319,6 +319,25 @@ class Pool:
 
         return await _open_session(self, factory, **options)
 
+    async def capture_profile(
+        self, duration_s: float = 2.0, sid: str = ""
+    ) -> "dict[str, Any] | None":
+        """Capture a resident-runtime profiler trace on this pool's gang.
+
+        Forwards to ``TPUExecutor.capture_profile`` — the fleet-level
+        surface for on-demand introspection of a pool carrying live RPC
+        or serving traffic.  None when the pool holds no warm resident
+        runtime (or its executor type has no profiling support)."""
+        if self._executor is None:
+            # A never-built executor has no resident runtime to profile;
+            # observability probes must not cold-start one (same guard
+            # as is_warm/gang_state/holds_fn_digest).
+            return None
+        capture = getattr(self.executor, "capture_profile", None)
+        if capture is None:
+            return None
+        return await capture(duration_s=duration_s, sid=sid)
+
 
 def parse_pool_specs(text: str) -> list[PoolSpec]:
     """Parse ``COVALENT_TPU_POOLS`` / ``fleet.pools`` into specs.

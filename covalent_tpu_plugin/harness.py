@@ -584,6 +584,12 @@ def _spawn_task(command: dict, children: dict) -> None:
             # copy of the server's live sessions would make its heartbeats
             # report a frozen fork-time serve occupancy forever.
             _SERVE_SESSIONS.clear()
+            # Same for an in-flight profiler capture: the trace belongs to
+            # the server process; a child must neither think one is active
+            # nor inherit a lock held at fork time.
+            global _PROFILE_LOCK
+            _PROFILE_LOCK = threading.Lock()
+            _PROFILE_ACTIVE.clear()
             import signal as _signal
 
             _signal.set_wakeup_fd(-1)
@@ -919,6 +925,188 @@ def rpc_child() -> int:
         return 1
     _rpc_invoke(command, {}, sync=True)
     return 0
+
+
+# --------------------------------------------------------------------------
+# Resident-mode profiling: drive jax.profiler inside the resident runtime.
+#
+# Launch-mode profiling wraps one harness process per task; the warm
+# resident runtimes (RPC invocations, serving sessions) used to be
+# unprofilable — setting profile_dir forced launch mode.  These verbs
+# capture the resident process itself:
+#
+#   -> {"cmd":"profile_start","id":"<pid>","dir":"/path/trace_dir"}
+#   <- {"event":"profile_started","id":"<pid>","pid":123}
+#   <- {"event":"profile_error","id":"<pid>","code":"busy"|"unavailable"|
+#       "bad_request"|"not_running"|"stop_failed"|"package_failed",
+#       "message":"..."}                                     (on failure)
+#   -> {"cmd":"profile_stop","id":"<pid>","artifact_dir":"/cache/cas"}
+#   <- {"event":"profile_stopped","id":"<pid>",
+#       "path":"/cache/cas/<sha256>.profile.tgz",
+#       "digest":"<sha256>","bytes":N}
+#
+# `profile_stop` packages the trace directory into ONE tar.gz artifact
+# named by its own sha256 under `artifact_dir` (the dispatcher points this
+# at the CAS dir, so the artifact is content-addressed like every other
+# staged payload) and announces path + digest; the dispatcher fetches and
+# digest-verifies before trusting the bytes.  jax.profiler is
+# process-wide, so exactly one trace runs at a time — a second start is
+# refused `busy` rather than corrupting the active capture.  The pool
+# server handles the verbs directly (RPC invocations and pool-mode
+# serving sessions execute in its process); `--serve-child` handles them
+# too so the native agent can forward a capture into the session child
+# that actually holds the model.
+# --------------------------------------------------------------------------
+
+
+_PROFILE_LOCK = threading.Lock()
+#: {"id", "dir"} while a trace is active (jax.profiler is process-wide).
+_PROFILE_ACTIVE: dict = {}
+
+
+def _profile_start(command: dict) -> None:
+    profile_id = str(command.get("id") or "")
+    trace_dir = command.get("dir")
+    if not profile_id or not trace_dir:
+        _emit({"event": "profile_error", "id": profile_id,
+               "code": "bad_request",
+               "message": "profile_start requires id and dir"})
+        return
+    sid = str(command.get("sid") or "")
+    if sid and sid not in _SERVE_SESSIONS:
+        # A sid-pinned capture must land on the runtime hosting that
+        # session; tracing whichever process got the command first would
+        # return a digest-valid artifact of the WRONG runtime.  Refuse so
+        # the dispatcher's target loop moves on to the right worker.
+        _emit({"event": "profile_error", "id": profile_id,
+               "code": "unknown_session",
+               "message": f"no live serving session {sid!r} here"})
+        return
+    with _PROFILE_LOCK:
+        if _PROFILE_ACTIVE:
+            _emit({"event": "profile_error", "id": profile_id,
+                   "code": "busy",
+                   "message": (
+                       f"trace {_PROFILE_ACTIVE.get('id')!r} already "
+                       "active (the profiler is process-wide)"
+                   )})
+            return
+        try:
+            import jax
+
+            os.makedirs(trace_dir, exist_ok=True)
+            jax.profiler.start_trace(trace_dir)
+        except Exception as err:  # noqa: BLE001 - any profiler failure
+            _emit({"event": "profile_error", "id": profile_id,
+                   "code": "unavailable", "message": repr(err)})
+            return
+        _PROFILE_ACTIVE.update({"id": profile_id, "dir": trace_dir})
+    _emit({"event": "profile_started", "id": profile_id, "pid": os.getpid()})
+
+
+def _package_trace(trace_dir: str, artifact_dir: str) -> tuple:
+    """``(path, digest, bytes)``: one content-addressed trace artifact.
+
+    Digest is computed over the exact tar bytes shipped, then the file is
+    renamed to ``<digest>.profile.tgz`` — the same publish-by-content
+    contract as every CAS artifact, so the dispatcher's fetch can verify
+    end to end.  The raw trace directory is consumed (removed) so repeat
+    captures never accrete worker disk.
+    """
+    import hashlib
+    import shutil
+    import tarfile
+
+    os.makedirs(artifact_dir, exist_ok=True)
+    tmp = os.path.join(
+        artifact_dir, f".profile.tmp.{os.getpid()}.{time.time_ns()}.tgz"
+    )
+    with tarfile.open(tmp, "w:gz") as tar:
+        tar.add(trace_dir, arcname=".")
+    sha = hashlib.sha256()
+    with open(tmp, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            sha.update(chunk)
+    digest = sha.hexdigest()
+    final = os.path.join(artifact_dir, f"{digest}.profile.tgz")
+    os.replace(tmp, final)
+    shutil.rmtree(trace_dir, ignore_errors=True)
+    return final, digest, os.path.getsize(final)
+
+
+def _profile_stop(command: dict) -> None:
+    """Validate + hand off; the heavy work runs on a daemon thread.
+
+    Stopping the profiler and tarring/hashing a trace (routinely tens to
+    hundreds of MB) must not run inline in the pool server's command
+    loop: a capture on a busy server would otherwise freeze ping /
+    serve_request / invoke admission for the whole packaging time — long
+    enough for the dispatcher's stall detector to tear down the very
+    runtime being profiled.
+    """
+    profile_id = str(command.get("id") or "")
+    with _PROFILE_LOCK:
+        active = dict(_PROFILE_ACTIVE)
+        if not active or (profile_id and active.get("id") != profile_id):
+            _emit({"event": "profile_error", "id": profile_id,
+                   "code": "not_running",
+                   "message": f"no active trace for {profile_id!r}"})
+            return
+        if _PROFILE_ACTIVE.get("stopping"):
+            _emit({"event": "profile_error", "id": profile_id,
+                   "code": "not_running",
+                   "message": f"trace {profile_id!r} is already stopping"})
+            return
+        _PROFILE_ACTIVE["stopping"] = True
+    artifact_dir = command.get("artifact_dir") or os.path.dirname(
+        str(active["dir"]).rstrip("/")
+    )
+    threading.Thread(
+        target=_profile_finish,
+        args=(profile_id, str(active["dir"]), artifact_dir,
+              bool(command.get("discard"))),
+        daemon=True,
+        name="profile-stop",
+    ).start()
+
+
+def _profile_finish(
+    profile_id: str, trace_dir: str, artifact_dir: str, discard: bool = False
+) -> None:
+    try:
+        import jax
+
+        jax.profiler.stop_trace()
+    except Exception as err:  # noqa: BLE001
+        # The trace may still be running: KEEP the active record (minus
+        # the stopping mark) so the caller can retry the stop — clearing
+        # it here would wedge profiling on this runtime forever (every
+        # later start would hit jax's own trace-in-progress error).
+        with _PROFILE_LOCK:
+            _PROFILE_ACTIVE.pop("stopping", None)
+        _emit({"event": "profile_error", "id": profile_id,
+               "code": "stop_failed", "message": repr(err)})
+        return
+    with _PROFILE_LOCK:
+        _PROFILE_ACTIVE.clear()
+    if discard:
+        # A compensating stop for an abandoned capture (cancelled
+        # mid-sleep, lost start ack): no caller will ever fetch the
+        # artifact, so skip the tar+hash entirely and reclaim the disk.
+        import shutil
+
+        shutil.rmtree(trace_dir, ignore_errors=True)
+        _emit({"event": "profile_stopped", "id": profile_id,
+               "discarded": True})
+        return
+    try:
+        path, digest, size = _package_trace(trace_dir, artifact_dir)
+    except Exception as err:  # noqa: BLE001 - tar/disk failures
+        _emit({"event": "profile_error", "id": profile_id,
+               "code": "package_failed", "message": repr(err)})
+        return
+    _emit({"event": "profile_stopped", "id": profile_id,
+           "path": path, "digest": digest, "bytes": size})
 
 
 # --------------------------------------------------------------------------
@@ -1373,6 +1561,10 @@ def serve_child() -> int:
                 opened.append(session)
         elif name == "serve_request":
             _serve_request(command, sessions)
+        elif name == "profile_start":
+            _profile_start(command)
+        elif name == "profile_stop":
+            _profile_stop(command)
         elif name == "serve_close":
             _serve_close(command, sessions)
             break
@@ -1544,6 +1736,10 @@ def serve() -> int:
                     _serve_request(command, serve_sessions)
                 elif name == "serve_close":
                     _serve_close(command, serve_sessions)
+                elif name == "profile_start":
+                    _profile_start(command)
+                elif name == "profile_stop":
+                    _profile_stop(command)
                 elif name == "kill":
                     target = command.get("id")
                     sig = int(command.get("sig", 15))

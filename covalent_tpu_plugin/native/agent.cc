@@ -39,6 +39,12 @@
 //   <- {"event":"telemetry","id":"<sid>","data":{...}}        (runner)
 //   -> {"cmd":"serve_close","id":"<sid>"}                     (forwarded)
 //   <- {"event":"serve_closed","id":"<sid>","served":N}       (runner)
+//   -> {"cmd":"profile_start","id":"<pid>","dir":"...","sid":"<sid>"}
+//   <- {"event":"profile_started","id":"<pid>","pid":123}     (runner)
+//   -> {"cmd":"profile_stop","id":"<pid>","artifact_dir":"..."}
+//   <- {"event":"profile_stopped","id":"<pid>","path":"...",
+//       "digest":"<sha256>","bytes":N}                        (runner)
+//   <- {"event":"profile_error","id":"<pid>","code":"...",...}
 //   -> {"cmd":"shutdown"}
 //   <- {"event":"bye"}
 //   <- {"event":"error","message":"..."}  (malformed input, unknown id, ...)
@@ -795,6 +801,60 @@ static void serve_forward(const Json& cmd, const std::string& raw_line,
   }
 }
 
+// Resident-mode profiling: the native agent holds no Python/jax runtime of
+// its own — the resident state worth profiling lives in its serve-child
+// session runners.  profile_start/profile_stop forward verbatim into a live
+// session child ("sid" pins which one; otherwise any), whose --serve-child
+// loop drives jax.profiler and answers profile_started / profile_stopped /
+// profile_error back over the same stream pump.  With no live session there
+// is nothing to profile: refuse fast so the client's waiter doesn't sit out
+// its whole timeout.  The start's target is remembered per profile id so a
+// sid-less stop lands on the SAME child — begin() can change between the
+// two commands (a new session sorting earlier), and routing the stop
+// elsewhere would orphan an active trace in the original child forever.
+
+//: profile id -> sid of the serve child that received its profile_start.
+static std::map<std::string, std::string> g_profile_targets;
+
+static void profile_forward(const Json& cmd, const std::string& raw_line,
+                            bool is_stop) {
+  const Json* id_field = cmd.get("id");
+  const std::string profile_id =
+      (id_field && id_field->type == Json::Str) ? id_field->s : "";
+  const Json* sid_field = cmd.get("sid");
+  std::string sid =
+      (sid_field && sid_field->type == Json::Str) ? sid_field->s : "";
+  if (sid.empty() && is_stop) {
+    auto route = g_profile_targets.find(profile_id);
+    if (route != g_profile_targets.end()) sid = route->second;
+  }
+  auto it = sid.empty() ? g_serve_children.begin()
+                        : g_serve_children.find(sid);
+  if (it == g_serve_children.end()) {
+    g_profile_targets.erase(profile_id);
+    emit("{\"event\":\"profile_error\",\"id\":\"" + json_escape(profile_id) +
+         "\",\"code\":\"unavailable\",\"message\":\"no live serving session "
+         "to profile\"}");
+    return;
+  }
+  if (!write_all(it->second.stdin_fd, raw_line + "\n")) {
+    close(it->second.stdin_fd);
+    g_serve_children.erase(it);
+    g_profile_targets.erase(profile_id);
+    emit("{\"event\":\"profile_error\",\"id\":\"" + json_escape(profile_id) +
+         "\",\"code\":\"unavailable\",\"message\":\"session runner pipe "
+         "broken\"}");
+    return;
+  }
+  // The route lives until the child answers terminally (profile_stopped,
+  // or any profile_error except the retryable stop_failed) — erasing at
+  // stop-forward time would send a RETRIED stop after a stop_failed to
+  // begin()'s child instead of the one still holding the active trace.
+  // Terminal cleanup happens in pump_rpc_stream; dead children reap
+  // their routes in reap_serve_child.
+  if (!is_stop) g_profile_targets[profile_id] = it->first;
+}
+
 static void reap_serve_child(pid_t pid) {
   for (auto it = g_serve_children.begin(); it != g_serve_children.end(); ++it) {
     if (it->second.pid == pid) {
@@ -807,6 +867,12 @@ static void reap_serve_child(pid_t pid) {
                        "serve runner exited without closing its session",
                        false);
       close(it->second.stdin_fd);
+      // Any in-flight profile routed at this child died with it.
+      for (auto route = g_profile_targets.begin();
+           route != g_profile_targets.end();) {
+        if (route->second == it->first) route = g_profile_targets.erase(route);
+        else ++route;
+      }
       g_serve_children.erase(it);
       return;
     }
@@ -834,6 +900,21 @@ static void pump_rpc_stream(int fd) {
     // Validate before forwarding; valid runner lines ARE protocol events
     // (started/telemetry/result) and pass through verbatim.
     if (!parse_json(line, parsed) || parsed.type != Json::Obj) continue;
+    // Profile route lifecycle: a terminal answer retires the profile
+    // id -> serve child mapping profile_forward remembered.  stop_failed
+    // keeps it — the trace is still active in THAT child and a retried
+    // sid-less stop must land there.
+    const Json* ev = parsed.get("event");
+    if (ev && ev->type == Json::Str &&
+        (ev->s == "profile_stopped" || ev->s == "profile_error")) {
+      const Json* code = parsed.get("code");
+      const bool retryable = ev->s == "profile_error" && code &&
+                             code->type == Json::Str &&
+                             code->s == "stop_failed";
+      const Json* pid_field = parsed.get("id");
+      if (!retryable && pid_field && pid_field->type == Json::Str)
+        g_profile_targets.erase(pid_field->s);
+    }
     emit(line);
   }
 }
@@ -961,6 +1042,8 @@ static void handle_line(const std::string& line, bool& running) {
   else if (name == "serve_open") serve_open(cmd, line);
   else if (name == "serve_request") serve_forward(cmd, line, false);
   else if (name == "serve_close") serve_forward(cmd, line, true);
+  else if (name == "profile_start") profile_forward(cmd, line, false);
+  else if (name == "profile_stop") profile_forward(cmd, line, true);
   else if (name == "kill") kill_task(cmd);
   else if (name == "watch") watch_task(cmd);
   else if (name == "unwatch") unwatch_task(cmd);

@@ -32,7 +32,9 @@ import os
 
 from .events import EventSink, configure as configure_events, emit as emit_event
 from .events import get_sink
+from .flightrec import FLIGHT_RECORDER, FlightRecorder, ensure_flight_recorder
 from .heartbeat import MONITOR, HeartbeatMonitor
+from .history import HISTORY, MetricsHistory, ensure_history
 from .metrics import (
     DEFAULT_LATENCY_BUCKETS,
     REGISTRY,
@@ -44,8 +46,17 @@ from .metrics import (
 from .opsserver import (
     OpsServer,
     ensure_ops_server,
+    register_profile_provider,
     register_status_provider,
+    unregister_profile_provider,
     unregister_status_provider,
+)
+from .slo import (
+    DEFAULT_SLOS,
+    SLOEngine,
+    SLOSpec,
+    ensure_slo_engine,
+    load_slo_specs,
 )
 from .trace import (
     SPAN_HISTOGRAM,
@@ -80,6 +91,19 @@ __all__ = [
     "ensure_ops_server",
     "register_status_provider",
     "unregister_status_provider",
+    "register_profile_provider",
+    "unregister_profile_provider",
+    "MetricsHistory",
+    "HISTORY",
+    "ensure_history",
+    "SLOSpec",
+    "SLOEngine",
+    "DEFAULT_SLOS",
+    "load_slo_specs",
+    "ensure_slo_engine",
+    "FlightRecorder",
+    "FLIGHT_RECORDER",
+    "ensure_flight_recorder",
 ]
 
 _METRICS_ENV = "COVALENT_TPU_METRICS"

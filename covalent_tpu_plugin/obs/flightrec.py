@@ -1,0 +1,232 @@
+"""Failure flight recorder: a per-task black box of recent telemetry.
+
+When an electron dies after minutes of heartbeats, the question is never
+"what was the last error" — the event stream has that — it is "what was
+this task *doing* in the run-up".  The flight recorder keeps a bounded
+ring of recent records per task (lifecycle events, worker heartbeats,
+dispatcher stage transitions), keyed by the task's *base* operation id so
+one ring spans the whole retry lineage (``op``, ``op.r1``, ...).  On a
+terminal dispatch failure the executor dumps the ring as a black-box JSON
+artifact next to its cache, and the ops server serves the live rings at
+``GET /tasks`` / ``GET /tasks/<operation_id>`` while the task still runs.
+
+Feeding is passive: :func:`ensure_flight_recorder` registers one listener
+on the event stream and files every event that carries an
+``operation_id`` — no instrumentation site changes, and the per-event cost
+is one dict copy and a deque append.  Oversized string fields (log tails)
+are truncated so a single failure report cannot blow the ring's memory
+bound.  ``COVALENT_TPU_FLIGHTREC=0`` disables the recorder;
+``COVALENT_TPU_FLIGHTREC_EVENTS`` / ``_TASKS`` size the rings (defaults
+256 records for each of the 64 most-recently-active tasks).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import re
+import threading
+import time
+from typing import Any
+
+from . import events as _events
+
+__all__ = ["FlightRecorder", "FLIGHT_RECORDER", "ensure_flight_recorder"]
+
+_ENABLE_ENV = "COVALENT_TPU_FLIGHTREC"
+_EVENTS_ENV = "COVALENT_TPU_FLIGHTREC_EVENTS"
+_TASKS_ENV = "COVALENT_TPU_FLIGHTREC_TASKS"
+_DEFAULT_EVENTS = 256
+_DEFAULT_TASKS = 64
+#: Longest string any recorded field keeps (log tails get truncated).
+_FIELD_CAP = 2048
+
+_RETRY_SUFFIX = re.compile(r"\.r\d+$")
+
+
+def base_operation_id(operation_id: str) -> str:
+    """Strip the retry suffix so one ring spans the whole lineage."""
+    return _RETRY_SUFFIX.sub("", operation_id)
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return max(1, int(os.environ.get(name, "") or default))
+    except ValueError:
+        return default
+
+
+def _disabled() -> bool:
+    """``COVALENT_TPU_FLIGHTREC=0`` disables recording everywhere.
+
+    Checked per call (one env read), not just at wiring time: the
+    executor feeds stage transitions and failure dumps into the
+    process-wide recorder directly, and those sites must honor the flag
+    too — not only the event-listener registration.
+    """
+    return os.environ.get(_ENABLE_ENV, "").strip().lower() in (
+        "0", "off", "false", "no", "none"
+    )
+
+
+class FlightRecorder:
+    """Bounded per-task rings of recent records, LRU-evicted across tasks."""
+
+    def __init__(
+        self,
+        per_task: int | None = None,
+        max_tasks: int | None = None,
+    ) -> None:
+        self.per_task = (
+            _env_int(_EVENTS_ENV, _DEFAULT_EVENTS)
+            if per_task is None
+            else max(1, int(per_task))
+        )
+        self.max_tasks = (
+            _env_int(_TASKS_ENV, _DEFAULT_TASKS)
+            if max_tasks is None
+            else max(1, int(max_tasks))
+        )
+        self._lock = threading.Lock()
+        #: base operation id -> deque of compact records (newest last).
+        self._rings: "collections.OrderedDict[str, collections.deque]" = (
+            collections.OrderedDict()
+        )
+
+    # -- feeding -----------------------------------------------------------
+
+    @staticmethod
+    def _compact(record: dict[str, Any]) -> dict[str, Any]:
+        out: dict[str, Any] = {}
+        for key, value in record.items():
+            if isinstance(value, str) and len(value) > _FIELD_CAP:
+                value = value[:_FIELD_CAP] + "…[truncated]"
+            out[key] = value
+        return out
+
+    def _ring_for(self, base: str) -> collections.deque:
+        ring = self._rings.get(base)
+        if ring is None:
+            ring = collections.deque(maxlen=self.per_task)
+            self._rings[base] = ring
+            while len(self._rings) > self.max_tasks:
+                self._rings.popitem(last=False)
+        else:
+            self._rings.move_to_end(base)
+        return ring
+
+    def record_event(self, event: dict[str, Any]) -> None:
+        """Events-stream listener: file anything tied to an operation.
+
+        Never raises (observer contract) and never keeps a reference to
+        the caller's dict — listeners share one event object.
+        """
+        try:
+            if _disabled():
+                return
+            operation_id = event.get("operation_id")
+            if not operation_id:
+                return
+            base = base_operation_id(str(operation_id))
+            compact = self._compact(event)
+            with self._lock:
+                self._ring_for(base).append(compact)
+        except Exception:  # noqa: BLE001 - observers must not break flow
+            pass
+
+    def record_stage(self, operation_id: str, stage: str) -> None:
+        """Dispatcher stage transition (these are /status state, not
+        events — the recorder is where they become history)."""
+        if _disabled():
+            return
+        record = {
+            "ts": round(time.time(), 6),
+            "type": "stage",
+            "operation_id": operation_id,
+            "stage": stage,
+        }
+        with self._lock:
+            self._ring_for(base_operation_id(operation_id)).append(record)
+
+    def forget(self, operation_id: str) -> None:
+        with self._lock:
+            self._rings.pop(base_operation_id(operation_id), None)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._rings.clear()
+
+    # -- views / dumps -----------------------------------------------------
+
+    def tasks(self) -> dict[str, int]:
+        """base operation id -> record count (the ``/tasks`` index)."""
+        with self._lock:
+            return {base: len(ring) for base, ring in self._rings.items()}
+
+    def view(self, operation_id: str) -> dict[str, Any] | None:
+        """The live ring for one task, or None (``/tasks/<op>``)."""
+        base = base_operation_id(operation_id)
+        with self._lock:
+            ring = self._rings.get(base)
+            if ring is None:
+                return None
+            records = list(ring)
+        return {
+            "operation_id": base,
+            "records": records,
+            "count": len(records),
+        }
+
+    def dump(self, operation_id: str, reason: str) -> dict[str, Any]:
+        """Black-box payload for one task (empty ring still dumps)."""
+        view = self.view(operation_id) or {
+            "operation_id": base_operation_id(operation_id),
+            "records": [],
+            "count": 0,
+        }
+        view["reason"] = reason
+        view["dumped_at"] = round(time.time(), 6)
+        return view
+
+    def dump_to_file(
+        self, operation_id: str, reason: str, directory: str
+    ) -> str | None:
+        """Write the black box as JSON; returns the path (None on failure).
+
+        Best-effort by contract: a full disk must not turn one failed
+        electron into two failures.
+        """
+        if _disabled():
+            return None
+        payload = self.dump(operation_id, reason)
+        safe = re.sub(r"[^A-Za-z0-9._-]", "_", payload["operation_id"])
+        path = os.path.join(directory, f"blackbox_{safe}.json")
+        try:
+            os.makedirs(directory, exist_ok=True)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(payload, f, default=repr, indent=2)
+            os.replace(tmp, path)
+        except (OSError, TypeError, ValueError):
+            return None
+        return path
+
+
+#: Process-wide recorder (fed once :func:`ensure_flight_recorder` ran).
+FLIGHT_RECORDER = FlightRecorder()
+
+_wired_lock = threading.Lock()
+_wired = False
+
+
+def ensure_flight_recorder() -> FlightRecorder | None:
+    """Register the recorder on the event stream once; None if disabled."""
+    global _wired
+    if _disabled():
+        return None
+    with _wired_lock:
+        if not _wired:
+            _events.add_listener(FLIGHT_RECORDER.record_event)
+            _wired = True
+    return FLIGHT_RECORDER

@@ -39,6 +39,9 @@ from typing import Any, Callable
 from urllib.parse import parse_qs, urlparse
 
 from . import events as _events
+from . import flightrec as _flightrec
+from . import history as _history
+from . import slo as _slo
 from .heartbeat import MONITOR
 from .metrics import REGISTRY
 
@@ -47,6 +50,8 @@ __all__ = [
     "ensure_ops_server",
     "register_status_provider",
     "unregister_status_provider",
+    "register_profile_provider",
+    "unregister_profile_provider",
 ]
 
 _PORT_ENV = "COVALENT_TPU_OPS_PORT"
@@ -55,6 +60,7 @@ _TAIL_ENV = "COVALENT_TPU_EVENTS_TAIL"
 
 _providers_lock = threading.Lock()
 _providers: dict[str, Callable[[], dict]] = {}
+_profile_providers: dict[str, Callable[[dict], "dict | None"]] = {}
 
 
 def register_status_provider(name: str, provider: Callable[[], dict]) -> None:
@@ -66,6 +72,26 @@ def register_status_provider(name: str, provider: Callable[[], dict]) -> None:
 def unregister_status_provider(name: str) -> None:
     with _providers_lock:
         _providers.pop(name, None)
+
+
+def register_profile_provider(
+    name: str, provider: Callable[[dict], "dict | None"]
+) -> None:
+    """Contribute a ``POST /profile`` target.
+
+    ``provider(params)`` runs on the HTTP request thread and returns the
+    capture's artifact info (path, digest, bytes), or None when its owner
+    currently has no resident runtime to profile (the handler then tries
+    the next provider).  Same weakref-by-convention contract as status
+    providers: return None forever once the owner is gone.
+    """
+    with _providers_lock:
+        _profile_providers[name] = provider
+
+
+def unregister_profile_provider(name: str) -> None:
+    with _providers_lock:
+        _profile_providers.pop(name, None)
 
 
 def _tail_size() -> int:
@@ -98,25 +124,26 @@ class OpsServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _send_json(self, payload, code: int = 200) -> None:
+                self._send(
+                    code,
+                    json.dumps(payload, default=repr, indent=2).encode(),
+                    "application/json",
+                )
+
             def do_GET(self) -> None:  # noqa: N802 - http.server contract
                 try:
                     url = urlparse(self.path)
                     route = url.path.rstrip("/") or "/"
+                    params = parse_qs(url.query)
                     if route == "/metrics":
                         self._send(
                             200, REGISTRY.prometheus_text().encode(),
                             "text/plain; version=0.0.4",
                         )
                     elif route == "/status":
-                        self._send(
-                            200,
-                            json.dumps(
-                                server.status(), default=repr, indent=2
-                            ).encode(),
-                            "application/json",
-                        )
+                        self._send_json(server.status())
                     elif route == "/events":
-                        params = parse_qs(url.query)
                         try:
                             n = int(params.get("n", ["0"])[0])
                         except ValueError:
@@ -125,6 +152,24 @@ class OpsServer:
                             200, server.events_tail(n).encode(),
                             "application/x-ndjson",
                         )
+                    elif route == "/history":
+                        self._send_json(server.history(params))
+                    elif route == "/slo":
+                        self._send_json(server.slo())
+                    elif route == "/tasks":
+                        self._send_json(
+                            {"tasks": _flightrec.FLIGHT_RECORDER.tasks()}
+                        )
+                    elif route.startswith("/tasks/"):
+                        view = _flightrec.FLIGHT_RECORDER.view(
+                            route[len("/tasks/"):]
+                        )
+                        if view is None:
+                            self._send_json(
+                                {"error": "no flight record"}, 404
+                            )
+                        else:
+                            self._send_json(view)
                     elif route in ("/", "/healthz"):
                         self._send(200, b"ok\n", "text/plain")
                     else:
@@ -139,9 +184,49 @@ class OpsServer:
                     except Exception:  # noqa: BLE001
                         pass
 
+            def do_POST(self) -> None:  # noqa: N802 - http.server contract
+                try:
+                    url = urlparse(self.path)
+                    route = url.path.rstrip("/") or "/"
+                    if route != "/profile":
+                        self._send(404, b"not found\n", "text/plain")
+                        return
+                    length = int(self.headers.get("Content-Length") or 0)
+                    body = self.rfile.read(length) if length else b""
+                    params: dict = {}
+                    if body.strip():
+                        try:
+                            parsed = json.loads(body)
+                            if isinstance(parsed, dict):
+                                params = parsed
+                        except ValueError:
+                            self._send_json(
+                                {"error": "body must be a JSON object"}, 400
+                            )
+                            return
+                    for key, values in parse_qs(url.query).items():
+                        params.setdefault(key, values[0])
+                    self._send_json(*server.profile(params))
+                except BrokenPipeError:
+                    pass
+                except Exception as err:  # noqa: BLE001 - ops must not crash
+                    try:
+                        self._send(
+                            500, f"error: {err!r}\n".encode(), "text/plain"
+                        )
+                    except Exception:  # noqa: BLE001
+                        pass
+
         self._httpd = ThreadingHTTPServer((self.host, port), Handler)
         self._httpd.daemon_threads = True
         self.port = int(self._httpd.server_address[1])
+        # A live ops endpoint implies the whole introspection plane: the
+        # history sampler (backing /history and the SLO windows), the SLO
+        # engine (evaluating per sample), and the flight recorder (backing
+        # /tasks).  Each is individually env-disableable and idempotent.
+        _history.ensure_history()
+        _slo.ensure_slo_engine()
+        _flightrec.ensure_flight_recorder()
         # Only after the bind succeeded: a failed construction must not
         # leave an orphaned listener on the event stream (ensure_ops_server
         # retries on every executor init, which would accumulate them).
@@ -202,6 +287,57 @@ class OpsServer:
         elif fleet_views:
             out["fleet"] = fleet_views
         return out
+
+    def history(self, params: dict) -> dict[str, Any]:
+        """The /history payload: ring index, or one metric's windowed view.
+
+        ``?metric=<name>&window=<seconds>`` answers the kind-aware query
+        (rates for counters, percentiles for histograms, timelines for
+        gauges); without ``metric`` the ring describes itself so
+        dashboards can discover what is queryable.
+        """
+        metric = (params.get("metric") or [""])[0]
+        if not metric:
+            return _history.HISTORY.describe()
+        try:
+            window_s = float((params.get("window") or ["60"])[0])
+        except ValueError:
+            window_s = 60.0
+        return _history.HISTORY.query(metric, window_s=window_s)
+
+    def slo(self) -> dict[str, Any]:
+        """The /slo payload: a fresh evaluation of every configured SLO."""
+        engine = _slo.get_engine() or _slo.ensure_slo_engine()
+        if engine is None:
+            return {"disabled": True, "slos": {}}
+        return engine.evaluate()
+
+    def profile(self, params: dict) -> "tuple[dict[str, Any], int]":
+        """The POST /profile action: capture a resident-runtime trace.
+
+        Tries every registered profile provider (each owns one executor's
+        resident runtimes) until one captures; 503 when none can — no
+        executor alive, or none with a warm resident runtime.
+        """
+        with _providers_lock:
+            providers = dict(_profile_providers)
+        errors: dict[str, str] = {}
+        for name, provider in providers.items():
+            try:
+                info = provider(dict(params))
+            except Exception as err:  # noqa: BLE001 - one bad provider
+                errors[name] = repr(err)
+                continue
+            if info:
+                return {"provider": name, **info}, 200
+        return (
+            {
+                "error": "no resident runtime available to profile",
+                "providers": len(providers),
+                **({"failures": errors} if errors else {}),
+            },
+            503,
+        )
 
     def events_tail(self, n: int = 0) -> str:
         """Last ``n`` (default: all buffered) events as JSONL."""

@@ -60,6 +60,7 @@ from .metrics import (
     SERVE_TOKENS_PER_S,
     SERVE_TOKENS_TOTAL,
     SERVE_TTFT_SECONDS,
+    SERVE_WORKER_SLOTS,
 )
 
 __all__ = [
@@ -739,6 +740,67 @@ class ServeHandle:
             SERVE_SESSIONS.dec()
             if self._pool is not None:
                 self._pool.release()
+        # Stale-series reap: a retired session's gauges must leave the
+        # registry with it, or /metrics grows one orphan series pair per
+        # session for the process lifetime under session churn.  The
+        # worker-occupancy series go too once no other live session
+        # shares the worker (its heartbeats stop carrying a serve block
+        # the moment the last session closes, freezing stale values).
+        # One forced history sample FIRST: a short-lived session could
+        # otherwise live and die entirely between two sampler ticks,
+        # leaving no trace of its gauges in the /history timeline.
+        try:
+            from ..obs.history import HISTORY
+
+            HISTORY.sample(force=True)
+        except Exception:  # noqa: BLE001 - observability never fatal
+            pass
+        SERVE_QUEUE_DEPTH.remove(session=self.sid)
+        SERVE_TOKENS_PER_S.remove(session=self.sid)
+        handles = getattr(self.executor, "_serve_handles", None) or {}
+        if self.address and not any(
+            h is not self and getattr(h, "address", "") == self.address
+            for h in list(handles.values())
+        ):
+            for state in ("sessions", "slots", "busy", "queued"):
+                SERVE_WORKER_SLOTS.remove(worker=self.address, state=state)
+
+    # -- profiling ----------------------------------------------------------
+
+    async def capture_profile(self, duration_s: float = 2.0) -> dict:
+        """Capture a ``jax.profiler`` trace of this session's resident
+        runtime while it serves live traffic.
+
+        Records for ``duration_s`` inside the worker process holding the
+        model (the pool server, or the native agent's ``--serve-child``
+        runner), stages the trace back as a content-addressed artifact and
+        digest-verifies it — no launch fallback, no second process.
+        Raises :class:`ServeError` when the capture fails (session down,
+        another trace already active, jax unavailable on the worker).
+        """
+        await self._await_ready()
+        client, conns = self._client, self._conns
+        if client is None or not conns:
+            raise ServeError(f"session {self.sid} has no live runtime")
+        profile_id = f"{self.sid}-prof{uuid.uuid4().hex[:6]}"
+        sid = self._sid_g if client.mode != "pool" else ""
+        started = await self.executor._start_resident_profile(
+            client, profile_id, sid=sid
+        )
+        if not started:
+            raise ServeError(
+                f"profiler start refused on session {self.sid} (busy or "
+                "unavailable)"
+            )
+        info = await self.executor._finish_capture(
+            client, conns[0], profile_id, duration_s, sid=sid
+        )
+        if not info:
+            raise ServeError(
+                f"profile capture on session {self.sid} produced no "
+                "artifact"
+            )
+        return {"sid": self.sid, "duration_s": float(duration_s), **info}
 
 
 async def open_session(

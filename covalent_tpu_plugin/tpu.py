@@ -66,11 +66,14 @@ from .cache import (
 from .executor_base import RemoteExecutor
 from .fleet.lease import GangLease
 from .obs import events as obs_events
+from .obs.flightrec import FLIGHT_RECORDER, ensure_flight_recorder
 from .obs.heartbeat import MONITOR, STALLS_TOTAL
 from .obs.metrics import REGISTRY
 from .obs.opsserver import (
     ensure_ops_server,
+    register_profile_provider,
     register_status_provider,
+    unregister_profile_provider,
     unregister_status_provider,
 )
 from .obs.trace import Span, context_of
@@ -700,17 +703,27 @@ class TPUExecutor(RemoteExecutor):
         #: (serving.open_session registers/deregisters; /status and the
         #: fleet pool view read it).
         self._serve_handles: dict[str, Any] = {}
-        self.last_timings: dict[str, float] = {}
+        self.last_timings: dict[str, Any] = {}
+        #: operation id -> fetched, digest-verified local profile artifact
+        #: (merged into ``last_timings["profile_trace"]`` by the epilogue).
+        self._profile_artifacts: dict[str, str] = {}
 
         # Fleet ops plane: start the (env-gated) status endpoint and expose
         # this executor's live view on it.  The provider holds only a
         # weakref — a dropped executor answers None and the server prunes
-        # the registration instead of keeping the instance alive.
+        # the registration instead of keeping the instance alive.  The
+        # flight recorder rides along: executors are where task lifecycles
+        # happen, so the black-box rings must be fed before the first one.
         ensure_ops_server()
+        ensure_flight_recorder()
         self._ops_provider_name = f"executor:{id(self):x}"
         provider_name = self._ops_provider_name
         self_ref = weakref.ref(
-            self, lambda _ref: unregister_status_provider(provider_name)
+            self,
+            lambda _ref: (
+                unregister_status_provider(provider_name),
+                unregister_profile_provider(provider_name),
+            ),
         )
 
         def _ops_provider():
@@ -720,6 +733,14 @@ class TPUExecutor(RemoteExecutor):
             )
 
         register_status_provider(provider_name, _ops_provider)
+
+        def _profile_provider(params: dict):
+            executor = self_ref()
+            if executor is None:
+                return None
+            return executor._capture_profile_blocking(params)
+
+        register_profile_provider(provider_name, _profile_provider)
 
     def _stall_after(self) -> float:
         """Seconds of heartbeat silence that declare a worker stalled."""
@@ -758,6 +779,15 @@ class TPUExecutor(RemoteExecutor):
                 for address, client in self._agents.items()
             },
         }
+
+    def _set_stage(self, operation_id: str, stage: str) -> None:
+        """Move one in-flight op's stage: the live ``/status`` view plus
+        the flight recorder's history (stage transitions are state, not
+        events — the recorder is where they become browsable later)."""
+        state = self._op_status.get(operation_id)
+        if state is not None:
+            state["stage"] = stage
+        FLIGHT_RECORDER.record_stage(operation_id, stage)
 
     # -- RPC registry views (fleet placement + ops /status) ----------------
 
@@ -2595,6 +2625,7 @@ class TPUExecutor(RemoteExecutor):
         # task scheduled after this drain begins would race the pool close.
         self._closing = True
         unregister_status_provider(self._ops_provider_name)
+        unregister_profile_provider(self._ops_provider_name)
         pending = [t for t in self._cleanup_tasks if not t.done()]
         loop = asyncio.get_running_loop()
         foreign = [t for t in pending if t.get_loop() is not loop]
@@ -2647,8 +2678,12 @@ class TPUExecutor(RemoteExecutor):
         it is reserved for the shapes that path can serve faithfully:
         single-worker gangs (multi-host electrons need the per-process
         ``jax.distributed`` bootstrap only the launch harness performs),
-        no pip installs or profiler traces (both are process-scoped), and
-        an agent policy that allows the pool runtime.  Under a chaos plan
+        no pip installs (process-scoped), and an agent policy that allows
+        the pool runtime.  ``profile_dir`` no longer disqualifies: the
+        resident runtime drives ``jax.profiler`` itself via the
+        profile_start/profile_stop verbs, so the warm fast path — the one
+        carrying the interesting traffic — is exactly what gets profiled.
+        Under a chaos plan
         ``auto`` defers to launch — fault budgets target the launch
         protocol's round trips — while an explicit ``rpc`` pin keeps the
         fast path so chaos tests can kill resident workers mid-invoke.
@@ -2661,8 +2696,6 @@ class TPUExecutor(RemoteExecutor):
         if self.use_agent not in (True, "auto", "pool"):
             return False
         if task_metadata.get("pip_deps"):
-            return False
-        if self.profile_dir:
             return False
         if self._chaos is not None and mode != "rpc":
             return False
@@ -2988,7 +3021,7 @@ class TPUExecutor(RemoteExecutor):
             stage_task.add_done_callback(
                 lambda t: None if t.cancelled() else t.exception()
             )
-            self._op_status[operation_id]["stage"] = "connecting"
+            self._set_stage(operation_id, "connecting")
             try:
                 # Gang acquisition goes through the ownership seam: the
                 # attempt machine consumes a warm lease and never touches
@@ -3042,7 +3075,7 @@ class TPUExecutor(RemoteExecutor):
             # after a successful connect — same precedence as before.
             staged = await stage_task
 
-            self._op_status[operation_id]["stage"] = "launching"
+            self._set_stage(operation_id, "launching")
             try:
                 # Leg 2: per-worker upload -> launch pipelines with no
                 # global barrier between the stages (worker 0 can launch
@@ -3100,7 +3133,7 @@ class TPUExecutor(RemoteExecutor):
                 pids=pids,
             )
             addresses = self._worker_addresses()
-            self._op_status[operation_id]["stage"] = "executing"
+            self._set_stage(operation_id, "executing")
             if self.heartbeat_interval > 0:
                 # Liveness bookkeeping for this attempt, then the telemetry
                 # side-band on every agent-launched worker (best-effort).
@@ -3243,11 +3276,20 @@ class TPUExecutor(RemoteExecutor):
                     with Span("executor.reap"):
                         await self._await_stragglers(conns, staged, pids)
 
-                self._op_status[operation_id]["stage"] = "fetching"
+                self._set_stage(operation_id, "fetching")
                 with Span("executor.fetch"):
                     result, exception = await self.query_result(
                         conns[0], staged, key=self._pool_key(addresses[0])
                     )
+
+                if self.profile_dir:
+                    # Trace retrieval (best-effort, swallows its own
+                    # transport faults): the harness wrote the profiler
+                    # trace on the WORKER; nothing fetched it before.
+                    with Span("executor.profile"):
+                        await self._fetch_launch_profile(
+                            conns[0], operation_id
+                        )
             except (TransportError, OSError) as err:
                 # A control-plane channel died mid-task: drop the pooled
                 # transports so the next electron redials (the reference
@@ -3321,18 +3363,30 @@ class TPUExecutor(RemoteExecutor):
         # Stage spans SUM concurrent work (pipelined upload/submit run
         # per worker, staging overlaps the dial), so the wall-clock
         # overhead the caller actually waited is reported separately:
-        # elapsed time minus the task's own runtime.
+        # elapsed time minus the task's own runtime.  Profile capture
+        # (trace stop + tar + fetch, potentially seconds) observes the
+        # dispatch rather than being part of it — charging it as
+        # overhead would burn the dispatch_overhead SLO and bench
+        # budgets on profiled-but-healthy traffic.
+        not_overhead = ("execute", "profile")
         self.last_timings["wall_overhead"] = max(
             0.0,
-            root.total() - root.stage_durations.get("execute", 0.0),
+            root.total() - sum(
+                root.stage_durations.get(stage, 0.0)
+                for stage in not_overhead
+            ),
         )
+        self.last_timings["overhead"] = root.overhead(exclude=not_overhead)
         _ACTIVE_ELECTRONS.dec()
         _TASKS_TOTAL.labels(outcome=outcome).inc()
-        _OVERHEAD_HIST.observe(root.overhead())
+        _OVERHEAD_HIST.observe(root.overhead(exclude=not_overhead))
         # The wall view (elapsed minus execute) is the number the
         # overhead budget is asserted against — give it its own
         # percentile-capable series, not just a per-run scalar.
         _WALL_OVERHEAD_HIST.observe(self.last_timings["wall_overhead"])
+        artifact = self._profile_artifacts.pop(operation_id, None)
+        if artifact:
+            self.last_timings["profile_trace"] = artifact
         self._op_status.pop(operation_id, None)
         MONITOR.forget(operation_id)
         obs_events.emit(
@@ -3340,9 +3394,27 @@ class TPUExecutor(RemoteExecutor):
             operation_id=operation_id,
             state=outcome,
             trace_id=root.trace_id,
-            overhead_s=round(root.overhead(), 6),
+            overhead_s=round(root.overhead(exclude=not_overhead), 6),
             total_s=round(root.total(), 6),
         )
+        # Flight recorder: a terminal failure dumps the task's black box
+        # (events + heartbeats + stage transitions across the whole retry
+        # lineage) next to the cache; a clean completion retires the ring.
+        # "retried" keeps recording — the lineage is still in flight.
+        if outcome in ("failed", "fallback_local", "remote_exception"):
+            box = FLIGHT_RECORDER.dump_to_file(
+                operation_id, outcome,
+                os.path.join(self.cache_dir, "blackbox"),
+            )
+            if box:
+                obs_events.emit(
+                    "task.blackbox",
+                    operation_id=operation_id,
+                    reason=outcome,
+                    path=box,
+                )
+        elif outcome in ("completed", "cached"):
+            FLIGHT_RECORDER.forget(operation_id)
         self._active.pop(operation_id, None)
         if attempt > 0:
             # Attempt-scoped cancel marks die with the attempt; the
@@ -3426,6 +3498,376 @@ class TPUExecutor(RemoteExecutor):
                 await conn.remove([remote])
             except (TransportError, OSError):
                 pass
+
+    # ------------------------------------------------------------------ #
+    # Profiling: resident-mode capture + launch-mode trace retrieval      #
+    # ------------------------------------------------------------------ #
+
+    async def _start_resident_profile(
+        self, client: AgentClient, profile_id: str, sid: str = ""
+    ) -> bool:
+        """Start a ``jax.profiler`` trace inside a resident runtime.
+
+        Best-effort by contract: profiling observes the dispatch, so a
+        refused start (``busy`` — one process-wide trace at a time — or a
+        worker without jax) is an event, never a failed electron.
+        """
+        try:
+            await client.profile_start(
+                profile_id,
+                f"{self.remote_cache}/profile_{profile_id}",
+                sid=sid,
+            )
+        except (AgentError, asyncio.TimeoutError) as err:
+            if isinstance(err, asyncio.TimeoutError):
+                # The worker may have ACTIVATED the trace and lost only
+                # the ack — without a compensating stop it records
+                # forever and refuses every later start as busy.
+                self._detach_profile_abort(client, profile_id, sid)
+            obs_events.emit(
+                "task.profile_error",
+                operation_id=profile_id,
+                stage="start",
+                error=str(err),
+            )
+            app_log.warning(
+                "resident profile start for %s failed: %s", profile_id, err
+            )
+            return False
+        return True
+
+    def _detach_profile_abort(
+        self, client: AgentClient, profile_id: str, sid: str
+    ) -> None:
+        """Best-effort compensating stop, detached from the caller.
+
+        Used when a capture loses track of a possibly-active trace (start
+        ack timed out, capture cancelled mid-sleep): the artifact is
+        abandoned but the runtime's one process-wide profiler slot is
+        freed.  A stop landing on a never-started trace answers
+        ``not_running`` — harmless.
+        """
+        async def _abort() -> None:
+            try:
+                await client.profile_stop(
+                    profile_id, sid=sid, timeout=30.0, discard=True
+                )
+            except (AgentError, asyncio.TimeoutError, OSError):
+                pass
+
+        task = asyncio.create_task(_abort())
+        self._cleanup_tasks.add(task)
+        task.add_done_callback(self._cleanup_tasks.discard)
+
+    def _profile_stop_failed(
+        self, operation_id: str, profile_id: str, err: Exception
+    ) -> None:
+        obs_events.emit(
+            "task.profile_error",
+            operation_id=operation_id,
+            stage="stop",
+            error=str(err),
+        )
+        app_log.warning(
+            "resident profile stop for %s failed: %s", profile_id, err
+        )
+        return None
+
+    async def _finish_resident_profile(
+        self,
+        client: AgentClient,
+        conn: Transport,
+        profile_id: str,
+        operation_id: str,
+        sid: str = "",
+    ) -> dict[str, Any] | None:
+        """Stop the trace, stage the artifact back, digest-verify it.
+
+        The worker packages the trace into ONE content-addressed
+        ``<sha256>.profile.tgz`` under the CAS dir; the fetch re-hashes
+        the bytes locally before trusting them — the same end-to-end
+        publish-by-content contract every staged payload rides.
+        """
+        artifact_dir = cas_path(self.remote_cache, "").rstrip("/")
+        try:
+            event = await client.profile_stop(
+                profile_id, artifact_dir=artifact_dir, sid=sid
+            )
+        except asyncio.TimeoutError:
+            # The worker packages on a thread and a slow tar can outlive
+            # the waiter; a RESEND now would be refused "already
+            # stopping" and orphan the artifact it is about to announce
+            # — wait out one more settle window on the same event.
+            try:
+                event = await client.profile_wait_stopped(profile_id)
+            except (AgentError, asyncio.TimeoutError) as err:
+                return self._profile_stop_failed(
+                    operation_id, profile_id, err
+                )
+        except AgentError:
+            # A failed stop (stop_failed) KEEPS the trace active on the
+            # worker so the stop is retryable — without a retry that
+            # runtime would refuse every later start as busy for the
+            # rest of its life.
+            await asyncio.sleep(0.5)
+            try:
+                event = await client.profile_stop(
+                    profile_id, artifact_dir=artifact_dir, sid=sid
+                )
+            except (AgentError, asyncio.TimeoutError) as err:
+                return self._profile_stop_failed(
+                    operation_id, profile_id, err
+                )
+        return await self._retrieve_profile_artifact(
+            conn,
+            str(event.get("path") or ""),
+            str(event.get("digest") or ""),
+            operation_id,
+        )
+
+    async def _retrieve_profile_artifact(
+        self,
+        conn: Transport,
+        remote_path: str,
+        digest: str,
+        operation_id: str,
+    ) -> dict[str, Any] | None:
+        """Fetch one announced trace artifact, verify, record, clean up."""
+        if not remote_path or not digest:
+            return None
+        profiles_dir = os.path.join(self.cache_dir, "profiles")
+        local = os.path.join(
+            profiles_dir, f"{operation_id}_{digest[:12]}.profile.tgz"
+        )
+        tmp = f"{local}.tmp.{os.getpid()}.{os.urandom(4).hex()}"
+        try:
+            os.makedirs(profiles_dir, exist_ok=True)
+            await conn.get(remote_path, tmp)
+            if file_digest(tmp) != digest:
+                raise RuntimeError(
+                    f"profile artifact for {operation_id} does not match "
+                    "its announced digest (torn artifact)"
+                )
+            size = os.path.getsize(tmp)
+            os.replace(tmp, local)
+        except (TransportError, OSError, RuntimeError) as err:
+            obs_events.emit(
+                "task.profile_error",
+                operation_id=operation_id,
+                stage="fetch",
+                error=str(err),
+            )
+            app_log.warning(
+                "profile artifact fetch for %s failed: %s", operation_id, err
+            )
+            return None
+        finally:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            try:
+                await conn.remove([remote_path])
+            except (TransportError, OSError):
+                pass
+        self._profile_artifacts[operation_id] = local
+        obs_events.emit(
+            "task.profile_captured",
+            operation_id=operation_id,
+            path=local,
+            digest=digest,
+            bytes=size,
+            worker=conn.address,
+        )
+        return {"path": local, "digest": digest, "bytes": size}
+
+    async def _fetch_launch_profile(
+        self, conn: Transport, operation_id: str
+    ) -> None:
+        """Satellite: pull launch-mode profiler traces back automatically.
+
+        The launch harness writes its ``jax.profiler`` trace to
+        ``{profile_dir}/{operation_id}`` on the WORKER's filesystem; before
+        this, nothing ever retrieved it — on a remote transport the trace
+        was effectively lost.  On completion the trace dir is tarred
+        remotely, hashed (same interpreter the harness ran under), fetched,
+        digest-verified and recorded in ``last_timings["profile_trace"]``
+        + a ``task.profile_captured`` event.  Best-effort: a missing trace
+        (profiler unavailable) or a failed fetch never fails the electron.
+        """
+        remote_dir = f"{self.profile_dir}/{operation_id}"
+        remote_tmp = (
+            f"{self.remote_cache}/profile_{operation_id}."
+            f"{os.urandom(4).hex()}.tgz"
+        )
+        q_dir, q_tmp = shlex.quote(remote_dir), shlex.quote(remote_tmp)
+        # Streamed hash: a tarred trace routinely reaches hundreds of MB
+        # and the worker may be memory-tight right after the task ran.
+        hash_snippet = (
+            "import hashlib,sys\n"
+            "h = hashlib.sha256()\n"
+            "with open(sys.argv[1], 'rb') as f:\n"
+            "    for chunk in iter(lambda: f.read(1 << 20), b''):\n"
+            "        h.update(chunk)\n"
+            "print(h.hexdigest())"
+        )
+        try:
+            probe = await conn.run(
+                f"if [ -d {q_dir} ]; then tar -C {q_dir} -czf {q_tmp} . && "
+                f"{self.python_path} -E -S -c {shlex.quote(hash_snippet)} "
+                f"{q_tmp} && rm -rf {q_dir}; else echo MISSING; fi"
+            )
+            token = (
+                probe.stdout.strip().split()[-1]
+                if probe.stdout.strip()
+                else ""
+            )
+            if probe.exit_status != 0 or not token or token == "MISSING":
+                return  # no trace written (profiler unavailable on worker)
+            await self._retrieve_profile_artifact(
+                conn, remote_tmp, token, operation_id
+            )
+        except (TransportError, OSError) as err:
+            obs_events.emit(
+                "task.profile_error",
+                operation_id=operation_id,
+                stage="fetch",
+                error=str(err),
+            )
+            app_log.warning(
+                "launch profile fetch for %s failed: %s", operation_id, err
+            )
+
+    def _profile_targets(
+        self, sid: str
+    ) -> tuple[str, list[tuple[str, AgentClient]]]:
+        """Resolve a capture's ``(remote sid, candidate agents)``.
+
+        Pool servers sort first (they host RPC invocations AND pool-mode
+        serving sessions in-process).  A sid naming a local
+        :class:`ServeHandle` is translated to the current generation's
+        remote id and pins the candidates to the agent hosting that
+        session — every other worker would profile the wrong process.
+        (Raw remote sids without a local handle rely on the worker-side
+        ``unknown_session`` refusal instead.)
+        """
+        handle = self._serve_handles.get(sid)
+        pinned_client = None
+        if handle is not None:
+            sid = getattr(handle, "_sid_g", sid)
+            pinned_client = getattr(handle, "_client", None)
+        targets = [
+            (address, client)
+            for address, client in list(self._agents.items())
+            if client is not None and client.alive
+        ]
+        targets.sort(key=lambda t: t[1].mode != "pool")
+        if pinned_client is not None:
+            hosted = [t for t in targets if t[1] is pinned_client]
+            if hosted:
+                targets = hosted
+        return sid, targets
+
+    async def capture_profile(
+        self, duration_s: float = 2.0, sid: str = ""
+    ) -> dict[str, Any] | None:
+        """On-demand capture of a resident runtime's ``jax.profiler`` trace.
+
+        The ``POST /profile`` action (and a public API): picks a live
+        resident runtime — pool servers first (they host RPC invocations
+        AND pool-mode serving sessions in-process), then native agents
+        (which forward into a ``--serve-child`` session runner) — records
+        for ``duration_s``, stages the artifact back through the CAS with
+        digest verification, and returns its info.  ``sid`` pins a serving
+        session (a :class:`ServeHandle` sid or a remote session id).
+        Returns None when no resident runtime is available to profile.
+        """
+        self._guard_event_loop()
+        sid, targets = self._profile_targets(sid)
+        profile_id = f"prof-{os.urandom(4).hex()}"
+        for address, client in targets:
+            if client.mode != "pool" and not sid and not self._serve_handles:
+                # A native agent holds no Python runtime of its own; with
+                # no serving session there is nothing it can profile.
+                continue
+            try:
+                conn = await self._client_connect(address)
+            except (TransportError, OSError) as err:
+                app_log.debug("profile connect %s failed: %s", address, err)
+                continue
+            if not await self._start_resident_profile(
+                client, profile_id, sid=sid
+            ):
+                continue
+            info = await self._finish_capture(
+                client, conn, profile_id, duration_s, sid=sid
+            )
+            if info:
+                return {
+                    "worker": address,
+                    "duration_s": float(duration_s),
+                    **info,
+                }
+        return None
+
+    async def _finish_capture(
+        self,
+        client: AgentClient,
+        conn: Transport,
+        profile_id: str,
+        duration_s: float,
+        sid: str = "",
+    ) -> dict[str, Any] | None:
+        """Shared on-demand tail: record for ``duration_s``, stop, fetch.
+
+        Used by :meth:`capture_profile` and ``ServeHandle.capture_profile``
+        after a successful start.  Cancellation mid-capture (the HTTP
+        deadline, a dropped caller) detaches a compensating stop so the
+        runtime's one profiler slot is freed; the synthetic profile id
+        never reaches the task epilogue, so its ``_profile_artifacts``
+        entry is popped here.
+        """
+        try:
+            await asyncio.sleep(max(0.0, float(duration_s)))
+            return await self._finish_resident_profile(
+                client, conn, profile_id, profile_id, sid=sid
+            )
+        except asyncio.CancelledError:
+            self._detach_profile_abort(client, profile_id, sid)
+            raise
+        finally:
+            self._profile_artifacts.pop(profile_id, None)
+
+    def _capture_profile_blocking(
+        self, params: dict
+    ) -> dict[str, Any] | None:
+        """``POST /profile`` provider body (runs on the HTTP thread).
+
+        Bridges onto the executor's bound event loop — agent channels are
+        loop-bound, so the capture must run where they live.  None when
+        no loop is running (no dispatch in progress) or no resident
+        runtime exists; the ops server then tries the next provider.
+        """
+        loop = getattr(self, "_bound_loop", None)
+        if loop is None or loop.is_closed() or not loop.is_running():
+            return None
+        try:
+            duration = float(params.get("duration_s") or 2.0)
+        except (TypeError, ValueError):
+            duration = 2.0
+        duration = min(max(duration, 0.1), 60.0)
+        sid = str(params.get("sid") or "")
+        future = asyncio.run_coroutine_threadsafe(
+            self.capture_profile(duration_s=duration, sid=sid), loop
+        )
+        import concurrent.futures
+
+        try:
+            return future.result(timeout=duration + 180.0)
+        except concurrent.futures.TimeoutError:
+            # Distinct from builtin TimeoutError on py3.10.
+            future.cancel()
+            raise
 
     def _rpc_result_cache_key(
         self,
@@ -3654,7 +4096,7 @@ class TPUExecutor(RemoteExecutor):
             with Span("executor.validate"):
                 await self._validate_credentials()
 
-            self._op_status[operation_id]["stage"] = "connecting"
+            self._set_stage(operation_id, "connecting")
             try:
                 lease = await self.lease_gang(dialed=conns)
                 conns = lease.conns
@@ -3690,7 +4132,7 @@ class TPUExecutor(RemoteExecutor):
                     f"(agent: {getattr(client, 'mode', None)!r})"
                 )
 
-            self._op_status[operation_id]["stage"] = "launching"
+            self._set_stage(operation_id, "launching")
             remote_fn = cas_path(self.remote_cache, fn_digest, ".pkl")
             spec: dict[str, Any] = {
                 "operation_id": operation_id,
@@ -3811,8 +4253,21 @@ class TPUExecutor(RemoteExecutor):
                 trace_id=root.trace_id,
                 mode="rpc",
             )
-            self._op_status[operation_id]["stage"] = "executing"
+            self._set_stage(operation_id, "executing")
             self._op_agents[operation_id] = [client]
+            profiling = False
+            if self.profile_dir:
+                # Resident-mode capture: the trace runs INSIDE the warm
+                # runtime executing this invocation (profile_dir used to
+                # force the launch path — the profiled dispatch was never
+                # the fast one anyone cared about).  Started after the
+                # invoke ack so a refused start (busy/unavailable) can't
+                # leave an orphan trace when submit fails; failure paths
+                # below tear the runtime down, which ends any trace with
+                # it.
+                profiling = await self._start_resident_profile(
+                    client, operation_id
+                )
             if self.heartbeat_interval > 0:
                 MONITOR.watch(
                     operation_id,
@@ -3891,6 +4346,12 @@ class TPUExecutor(RemoteExecutor):
                 )
                 outcome = "fallback_local"
                 return result
+
+            if profiling:
+                with Span("executor.profile"):
+                    await self._finish_resident_profile(
+                        client, conn, operation_id, operation_id
+                    )
 
             with Span("executor.fetch"):
                 if payload.get("data_path"):

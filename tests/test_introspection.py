@@ -1,0 +1,899 @@
+"""Performance-introspection plane: metrics exposition edges, the history
+ring's downsampling + windowed queries under a fake clock, SLO burn-rate
+evaluation and transition events, the failure flight recorder, the new ops
+routes (/history, /slo, /tasks, POST /profile), serving stale-series reap,
+and resident-mode profiling end to end (ISSUE 10 acceptance)."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tarfile
+import urllib.request
+
+import pytest
+
+from covalent_tpu_plugin.obs import events as obs_events
+from covalent_tpu_plugin.obs.flightrec import FlightRecorder, base_operation_id
+from covalent_tpu_plugin.obs.history import MetricsHistory
+from covalent_tpu_plugin.obs.metrics import Registry
+from covalent_tpu_plugin.obs.slo import SLOEngine, SLOSpec, load_slo_specs
+
+
+class FakeClock:
+    def __init__(self, start: float = 1000.0) -> None:
+        self.now = start
+
+    def __call__(self) -> float:
+        return self.now
+
+    def tick(self, dt: float = 1.0) -> None:
+        self.now += dt
+
+
+# --------------------------------------------------------------------- #
+# Metrics exposition edges (satellite)
+# --------------------------------------------------------------------- #
+
+
+def test_prometheus_label_value_escaping():
+    reg = Registry()
+    c = reg.counter("edges_total", "edge cases", ("path",))
+    c.labels(path='a"b\\c\nd').inc()
+    text = reg.prometheus_text()
+    # Quote, backslash and newline must all be escaped per the text
+    # format, or one weird label value corrupts the whole scrape.
+    assert 'path="a\\"b\\\\c\\nd"' in text
+    assert "\nd" not in text.split("edges_total{")[1].split("}")[0]
+
+
+def test_prometheus_inf_bucket_is_last_and_cumulative():
+    reg = Registry()
+    h = reg.histogram("lat_seconds", "latency", buckets=(0.1, 1.0))
+    for value in (0.05, 0.5, 5.0):
+        h.observe(value)
+    lines = [
+        line for line in reg.prometheus_text().splitlines()
+        if line.startswith("lat_seconds_bucket")
+    ]
+    assert [line.split(" ")[-1] for line in lines] == ["1", "2", "3"]
+    assert 'le="+Inf"' in lines[-1]  # +Inf closes the family, count = total
+    assert 'le="0.1"' in lines[0]
+
+
+def test_remove_then_relabel_starts_fresh():
+    reg = Registry()
+    g = reg.gauge("depth", "queue depth", ("q",))
+    g.labels(q="a").set(7)
+    g.remove(q="a")
+    assert 'q="a"' not in reg.prometheus_text()
+    # Re-creating the same series starts at zero, not the removed value.
+    assert g.labels(q="a").value == 0.0
+    g.remove(q="never-existed")  # absent series: no-op, no raise
+    with pytest.raises(ValueError, match="expected labels"):
+        g.remove(wrong="a")
+
+
+# --------------------------------------------------------------------- #
+# Metrics history: ring, downsampling, windowed queries
+# --------------------------------------------------------------------- #
+
+
+def make_history(capacity: int = 16):
+    clock = FakeClock()
+    reg = Registry()
+    hist = MetricsHistory(
+        registry=reg, interval_s=1.0, capacity=capacity, clock=clock
+    )
+    return hist, reg, clock
+
+
+def test_history_downsamples_and_bounds_memory():
+    hist, reg, clock = make_history(capacity=16)
+    reg.counter("ticks_total").inc()
+    for _ in range(100):
+        clock.tick(1.0)
+        hist.sample()
+    # Bounded forever: the ring never exceeds its capacity, the stride
+    # doubles on each compaction, and the observable span keeps growing.
+    assert len(hist) <= 16
+    assert hist.stride > 1
+    assert hist.span_s() > 16  # covers more wall-clock than capacity*1s
+
+
+def test_history_counter_window_rate():
+    hist, reg, clock = make_history()
+    c = reg.counter("reqs_total", "", ("code",))
+    c.labels(code="200").inc(5)
+    hist.sample(force=True)
+    for _ in range(10):
+        clock.tick(1.0)
+        c.labels(code="200").inc(2)
+        hist.sample(force=True)
+    q = hist.query("reqs_total", window_s=5.0)
+    assert q["kind"] == "counter"
+    stats = q["series"][json.dumps({"code": "200"})]
+    assert stats["increase"] == pytest.approx(10.0)  # 5 in-window ticks x 2
+    assert stats["rate_per_s"] == pytest.approx(2.0)
+
+
+def test_history_series_born_mid_window_counts_from_zero():
+    hist, reg, clock = make_history()
+    hist.sample(force=True)  # window baseline BEFORE the series exists
+    clock.tick(1.0)
+    c = reg.counter("late_total")
+    c.inc(16)  # all observations land between two ticks
+    hist.sample(force=True)
+    q = hist.query("late_total", window_s=60.0)
+    # A cumulative series starts at zero when created: its first captured
+    # value must count as increase, not vanish into the baseline.
+    assert q["series"][""]["increase"] == pytest.approx(16.0)
+    h = reg.histogram("late_seconds", buckets=(0.1, 1.0))
+    for _ in range(8):
+        h.observe(0.05)
+    clock.tick(1.0)
+    hist.sample(force=True)
+    hq = hist.query("late_seconds", window_s=60.0)
+    assert hq["series"][""]["count"] == 8
+
+
+def test_history_histogram_window_percentiles():
+    hist, reg, clock = make_history()
+    h = reg.histogram("lat_seconds", buckets=(0.01, 0.1, 1.0))
+    for _ in range(99):
+        h.observe(0.005)  # old traffic: fast
+    hist.sample(force=True)
+    clock.tick(100.0)  # push the old sample out of the window
+    hist.sample(force=True)
+    for _ in range(10):
+        h.observe(0.5)  # the window's traffic: slow
+    clock.tick(1.0)
+    hist.sample(force=True)
+    q = hist.query("lat_seconds", window_s=10.0)
+    stats = q["series"][""]
+    # Windowed, not lifetime: the 99 fast lifetime observations must not
+    # drown the window's 10 slow ones.
+    assert stats["count"] == 10
+    assert stats["p50"] == pytest.approx(1.0)  # upper-bound bucket estimate
+
+
+def test_history_gauge_timeline_and_describe():
+    hist, reg, clock = make_history()
+    g = reg.gauge("depth")
+    for value in (1, 5, 3):
+        g.set(value)
+        clock.tick(1.0)
+        hist.sample(force=True)
+    q = hist.query("depth", window_s=60.0)
+    stats = q["series"][""]
+    assert [point[1] for point in stats["points"]] == [1.0, 5.0, 3.0]
+    assert stats["min"] == 1.0 and stats["max"] == 5.0 and stats["last"] == 3.0
+    described = hist.describe()
+    assert described["samples"] == 3
+    assert "depth" in described["metrics"]
+    assert hist.query("no_such_metric", window_s=60.0)["samples"] >= 0
+
+
+def test_history_good_fraction_latency_sli():
+    hist, reg, clock = make_history()
+    h = reg.histogram("lat_seconds", buckets=(0.1, 1.0, 5.0))
+    hist.sample(force=True)
+    for _ in range(9):
+        h.observe(0.05)
+    h.observe(3.0)  # one slow outlier
+    clock.tick(1.0)
+    hist.sample(force=True)
+    count, good = hist.good_fraction("lat_seconds", 0.1, window_s=60.0)
+    assert count == 10
+    assert good == pytest.approx(0.9)
+
+
+def test_history_bad_ratio_denominatorless_is_tick_normalized():
+    """An empty ``bad`` spec ("this counter should not move at all")
+    normalizes by the window's elapsed sample ticks — one lone increment
+    in a wide window is a small rate, not an instantly-saturated burn."""
+    hist, reg, clock = make_history()
+    c = reg.counter("retries_total")
+    hist.sample(force=True)
+    for _ in range(10):
+        clock.tick(1.0)
+        hist.sample(force=True)
+    c.inc()  # one lone retry in the whole window
+    clock.tick(1.0)
+    hist.sample(force=True)
+    total, frac = hist.bad_ratio("retries_total", None, window_s=60.0)
+    assert total == 1.0
+    assert frac == pytest.approx(1.0 / 11.0)
+
+
+def test_ensure_history_tightens_interval_while_running():
+    from covalent_tpu_plugin.obs import history as hist_mod
+
+    ring = hist_mod.ensure_history(1.0)
+    prev = ring.interval_s
+    try:
+        assert hist_mod.ensure_history(0.25) is ring
+        assert ring.interval_s == 0.25  # explicit finer interval wins
+        hist_mod.ensure_history(5.0)  # coarsening is ignored
+        assert ring.interval_s == 0.25
+    finally:
+        ring.interval_s = prev
+
+
+def test_history_good_fraction_threshold_above_every_bucket():
+    """A threshold beyond the largest finite bound snaps to +Inf: the
+    buckets cannot observe a violation there, so observations landing
+    past the last finite bound must count GOOD — counting them bad pages
+    on a service that is meeting its objective."""
+    hist, reg, clock = make_history()
+    h = reg.histogram("lat_seconds", buckets=(0.1, 1.0, 5.0))
+    hist.sample(force=True)
+    for _ in range(5):
+        h.observe(7.0)  # past every finite bound, under the threshold
+    clock.tick(1.0)
+    hist.sample(force=True)
+    count, good = hist.good_fraction("lat_seconds", 600.0, window_s=60.0)
+    assert count == 5
+    assert good == pytest.approx(1.0)
+
+
+# --------------------------------------------------------------------- #
+# SLO engine
+# --------------------------------------------------------------------- #
+
+
+def test_slo_spec_layering_and_validation(monkeypatch):
+    defaults = {spec.name for spec in load_slo_specs(env="")}
+    assert {"serve_p95_latency", "serve_ttft", "task_error_rate",
+            "dispatch_overhead"} <= defaults
+    assert load_slo_specs(env="off") == []
+    overridden = load_slo_specs(env=json.dumps([
+        {"name": "serve_p95_latency", "metric": "covalent_tpu_serve_request_seconds",
+         "kind": "latency", "threshold_s": 0.5, "objective": 0.9},
+        {"name": "serve_ttft", "disabled": True},
+        {"name": "custom", "metric": "m", "kind": "ratio", "objective": 0.5},
+    ]))
+    by_name = {spec.name: spec for spec in overridden}
+    assert by_name["serve_p95_latency"].threshold_s == 0.5
+    assert "serve_ttft" not in by_name
+    assert "custom" in by_name
+    # A PARTIAL override tunes the same-name default field-level; a
+    # whole-spec replace would drop the required fields and silently
+    # delete the SLO at from_dict time.
+    partial = {
+        spec.name: spec for spec in load_slo_specs(
+            env=json.dumps([{"name": "serve_ttft", "threshold_s": 2.0}])
+        )
+    }
+    assert partial["serve_ttft"].threshold_s == 2.0
+    assert partial["serve_ttft"].metric  # inherited from the default
+    # Malformed layers are skipped, never fatal.
+    assert load_slo_specs(env="not json[") and load_slo_specs(env='[{"no":1}]')
+    with pytest.raises(ValueError, match="objective"):
+        SLOSpec(name="bad", metric="m", kind="latency", threshold_s=1,
+                objective=1.5)
+    with pytest.raises(ValueError, match="unknown SLO spec field"):
+        SLOSpec.from_dict({"name": "x", "metric": "m", "typo": 1})
+
+
+def burn_setup(threshold_s: float = 0.1):
+    hist, reg, clock = make_history()
+    h = reg.histogram("lat_seconds", buckets=(0.1, 1.0, 5.0))
+    spec = SLOSpec(
+        name="lat_p95", metric="lat_seconds", kind="latency",
+        threshold_s=threshold_s, objective=0.95, windows=(5.0, 30.0),
+    )
+    engine = SLOEngine(hist, specs=[spec])
+    return hist, reg, clock, h, engine
+
+
+def test_slo_burn_fires_and_recovers():
+    hist, reg, clock, h, engine = burn_setup()
+    events: list[dict] = []
+    hooks: list[tuple] = []
+    engine.add_alert_hook(lambda name, state, info: hooks.append((name, state)))
+    listener = events.append
+    obs_events.add_listener(listener)
+    try:
+        # Healthy traffic: under threshold, no burn.
+        for _ in range(3):
+            for _ in range(10):
+                h.observe(0.05)
+            clock.tick(1.0)
+            hist.sample(force=True)
+        view = engine.evaluate()
+        assert view["slos"]["lat_p95"]["state"] == "ok"
+        # Latency regression: everything lands over the threshold; burn
+        # must exceed 1 in every window and fire ONE slo.burn.
+        for _ in range(6):
+            for _ in range(10):
+                h.observe(0.5)
+            clock.tick(1.0)
+            hist.sample(force=True)
+        view = engine.evaluate()
+        info = view["slos"]["lat_p95"]
+        assert info["state"] == "burning"
+        assert info["burn_rate"] > 1.0
+        engine.evaluate()  # still burning: no duplicate transition
+        assert [e["slo"] for e in events if e["type"] == "slo.burn"] == [
+            "lat_p95"
+        ]
+        assert ("lat_p95", "burning") in hooks
+        from covalent_tpu_plugin.obs.slo import SLO_BURN_RATE
+
+        assert SLO_BURN_RATE.labels(slo="lat_p95").value > 1.0
+        # Recovery: good traffic pushes every window back under threshold.
+        for _ in range(40):
+            for _ in range(50):
+                h.observe(0.05)
+            clock.tick(1.0)
+            hist.sample(force=True)
+        view = engine.evaluate()
+        assert view["slos"]["lat_p95"]["state"] == "ok"
+        assert [e["slo"] for e in events if e["type"] == "slo.recovered"] == [
+            "lat_p95"
+        ]
+    finally:
+        obs_events.remove_listener(listener)
+
+
+def test_slo_multiwindow_gate_needs_every_window_burning():
+    hist, reg, clock, h, engine = burn_setup()
+    # A long healthy history, then a 2-second blip: the short window
+    # burns, the long one does not — the classic gate holds the alert.
+    for _ in range(25):
+        for _ in range(20):
+            h.observe(0.05)
+        clock.tick(1.0)
+        hist.sample(force=True)
+    for _ in range(2):
+        for _ in range(5):
+            h.observe(0.5)
+        clock.tick(1.0)
+        hist.sample(force=True)
+    view = engine.evaluate()
+    info = view["slos"]["lat_p95"]
+    windows = {w["window_s"]: w for w in info["windows"]}
+    assert windows[5.0]["burn"] > 1.0
+    assert windows[30.0]["burn"] <= 1.0
+    assert info["state"] == "ok"
+
+
+def test_slo_no_data_is_not_a_recovery():
+    hist, reg, clock, h, engine = burn_setup()
+    for _ in range(6):
+        for _ in range(10):
+            h.observe(0.5)
+        clock.tick(1.0)
+        hist.sample(force=True)
+    assert engine.evaluate()["slos"]["lat_p95"]["state"] == "burning"
+    clock.tick(500.0)  # traffic stops entirely; windows go empty
+    hist.sample(force=True)
+    view = engine.evaluate()
+    assert view["slos"]["lat_p95"]["state"] == "no_data"
+    assert engine._states["lat_p95"] == "burning"  # alert NOT cleared
+
+
+def test_slo_ratio_kind_over_counter_family():
+    hist, reg, clock = make_history()
+    c = reg.counter("tasks_total", "", ("outcome",))
+    spec = SLOSpec(
+        name="errors", metric="tasks_total", kind="ratio",
+        bad={"outcome": ["failed"]}, objective=0.9, windows=(10.0,),
+    )
+    engine = SLOEngine(hist, specs=[spec])
+    hist.sample(force=True)
+    c.labels(outcome="completed").inc(6)
+    c.labels(outcome="failed").inc(4)  # 40% bad >> 10% budget
+    clock.tick(1.0)
+    hist.sample(force=True)
+    info = engine.evaluate()["slos"]["errors"]
+    assert info["state"] == "burning"
+    assert info["burn_rate"] == pytest.approx(4.0)
+
+
+# --------------------------------------------------------------------- #
+# Flight recorder
+# --------------------------------------------------------------------- #
+
+
+def test_flightrec_lineage_truncation_and_eviction():
+    rec = FlightRecorder(per_task=4, max_tasks=2)
+    assert base_operation_id("d_0.r2") == "d_0"
+    rec.record_event({"type": "task.state", "operation_id": "d_0", "n": 1})
+    rec.record_event({"type": "task.retry", "operation_id": "d_0.r1", "n": 2})
+    view = rec.view("d_0.r3")  # any lineage member resolves the ring
+    assert view is not None and view["count"] == 2  # one ring, whole lineage
+    rec.record_event({
+        "type": "task.failed", "operation_id": "d_0",
+        "log_tail": "x" * 10_000,
+    })
+    stored = rec.view("d_0")["records"][-1]["log_tail"]
+    assert len(stored) < 10_000 and stored.endswith("[truncated]")
+    for i in range(5):
+        rec.record_event({"type": "t", "operation_id": "d_0", "n": i})
+    assert rec.view("d_0")["count"] == 4  # per-task ring bound
+    rec.record_event({"type": "t", "operation_id": "other_1"})
+    rec.record_event({"type": "t", "operation_id": "other_2"})
+    assert rec.view("d_0") is None  # LRU across tasks: oldest evicted
+    rec.record_event({"type": "t"})  # no operation_id: ignored, no raise
+
+
+def test_flightrec_stage_records_and_dump(tmp_path):
+    rec = FlightRecorder()
+    rec.record_stage("d_0", "connecting")
+    rec.record_stage("d_0.r1", "launching")
+    rec.record_event({"type": "task.failed", "operation_id": "d_0.r1"})
+    path = rec.dump_to_file("d_0.r1", "failed", str(tmp_path / "boxes"))
+    assert path is not None
+    payload = json.loads(open(path).read())
+    assert payload["operation_id"] == "d_0"
+    assert payload["reason"] == "failed"
+    stages = [r["stage"] for r in payload["records"] if r.get("type") == "stage"]
+    assert stages == ["connecting", "launching"]
+    assert payload["records"][-1]["type"] == "task.failed"
+    assert rec.tasks() == {"d_0": 3}
+    rec.forget("d_0.r1")
+    assert rec.view("d_0") is None
+
+
+def test_flightrec_disable_honored_at_every_site(tmp_path, monkeypatch):
+    """COVALENT_TPU_FLIGHTREC=0 must stop the executor's direct feeding
+    (stage records, failure dumps) too, not just the listener wiring."""
+    monkeypatch.setenv("COVALENT_TPU_FLIGHTREC", "0")
+    rec = FlightRecorder()
+    rec.record_stage("op_0", "connecting")
+    rec.record_event({"type": "t", "operation_id": "op_0"})
+    assert rec.tasks() == {}
+    assert rec.dump_to_file("op_0", "failed", str(tmp_path / "boxes")) is None
+    assert not (tmp_path / "boxes").exists()
+
+
+def test_failed_electron_dumps_black_box(tmp_path, run_async):
+    """Executor integration: a permanent failure leaves a browsable
+    black-box JSON next to the cache, spanning stages and events."""
+    from covalent_tpu_plugin.obs.flightrec import ensure_flight_recorder
+
+    from .helpers import make_local_executor
+
+    ensure_flight_recorder()
+    executor = make_local_executor(
+        tmp_path, run_local_on_dispatch_fail=False, max_task_retries=0
+    )
+
+    def exploding():
+        raise RuntimeError("user code boom")
+
+    async def flow():
+        try:
+            with pytest.raises(RuntimeError, match="user code boom"):
+                await executor.run(
+                    exploding, [], {},
+                    {"dispatch_id": "boxed", "node_id": 0},
+                )
+        finally:
+            await executor.close()
+
+    run_async(flow())
+    boxes = list((tmp_path / "cache" / "blackbox").glob("blackbox_*.json"))
+    assert len(boxes) == 1
+    payload = json.loads(boxes[0].read_text())
+    assert payload["operation_id"] == "boxed_0"
+    stages = [r["stage"] for r in payload["records"] if r.get("type") == "stage"]
+    assert "connecting" in stages and "fetching" in stages
+
+
+# --------------------------------------------------------------------- #
+# Ops routes: /history, /slo, /tasks, POST /profile
+# --------------------------------------------------------------------- #
+
+
+@pytest.fixture()
+def ops_server(monkeypatch):
+    from covalent_tpu_plugin.obs import opsserver as ops_mod
+
+    monkeypatch.setenv("COVALENT_TPU_OPS_PORT", "0")
+    server = ops_mod.OpsServer(port=0)
+    yield server
+    server.close()
+
+
+def http_get(port: int, path: str):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10
+    ) as response:
+        return response.status, response.read()
+
+
+def test_ops_history_slo_tasks_routes(ops_server):
+    from covalent_tpu_plugin.obs.flightrec import FLIGHT_RECORDER
+    from covalent_tpu_plugin.obs.history import HISTORY
+
+    HISTORY.sample(force=True)
+    status, body = http_get(ops_server.port, "/history")
+    assert status == 200
+    described = json.loads(body)
+    assert "metrics" in described and described["samples"] >= 1
+    status, body = http_get(
+        ops_server.port, "/history?metric=covalent_tpu_tasks_total&window=60"
+    )
+    assert status == 200
+    assert json.loads(body)["metric"] == "covalent_tpu_tasks_total"
+    status, body = http_get(ops_server.port, "/slo")
+    assert status == 200
+    slo_view = json.loads(body)
+    assert "slos" in slo_view
+    FLIGHT_RECORDER.record_stage("ops_route_op", "executing")
+    status, body = http_get(ops_server.port, "/tasks")
+    assert status == 200
+    assert "ops_route_op" in json.loads(body)["tasks"]
+    status, body = http_get(ops_server.port, "/tasks/ops_route_op")
+    assert status == 200
+    assert json.loads(body)["count"] >= 1
+    with pytest.raises(urllib.error.HTTPError) as err:
+        http_get(ops_server.port, "/tasks/never_ran")
+    assert err.value.code == 404
+    FLIGHT_RECORDER.forget("ops_route_op")
+
+
+def http_post(port: int, path: str, payload: dict):
+    request = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(request, timeout=30) as response:
+            return response.status, json.loads(response.read())
+    except urllib.error.HTTPError as err:
+        return err.code, json.loads(err.read())
+
+
+def test_ops_profile_route_providers(ops_server):
+    from covalent_tpu_plugin.obs.opsserver import (
+        register_profile_provider,
+        unregister_profile_provider,
+    )
+
+    status, body = http_post(ops_server.port, "/profile", {})
+    assert status == 503  # no provider: nothing resident to profile
+    seen: list[dict] = []
+
+    def provider(params):
+        seen.append(params)
+        return {"path": "/tmp/trace.tgz", "digest": "d" * 64, "bytes": 10}
+
+    register_profile_provider("test-exec", provider)
+    try:
+        status, body = http_post(
+            ops_server.port, "/profile", {"duration_s": 0.5}
+        )
+        assert status == 200
+        assert body["provider"] == "test-exec"
+        assert body["digest"] == "d" * 64
+        assert seen[0]["duration_s"] == 0.5
+        register_profile_provider("gone", lambda params: None)
+        # A provider answering None (owner gone / nothing resident) is
+        # skipped; the capture still lands on the live one.
+        status, body = http_post(ops_server.port, "/profile", {})
+        assert status == 200
+    finally:
+        unregister_profile_provider("test-exec")
+        unregister_profile_provider("gone")
+
+
+# --------------------------------------------------------------------- #
+# Serving stale-series reap (satellite)
+# --------------------------------------------------------------------- #
+
+
+def test_serve_session_close_reaps_gauge_series(run_async):
+    from covalent_tpu_plugin.obs.metrics import REGISTRY
+    from covalent_tpu_plugin.serving.handle import ServeHandle
+    from covalent_tpu_plugin.serving.metrics import (
+        SERVE_QUEUE_DEPTH,
+        SERVE_TOKENS_PER_S,
+        SERVE_WORKER_SLOTS,
+    )
+
+    class StubExecutor:
+        _serve_handles: dict = {}
+        cache_dir = "/tmp"
+
+    async def flow():
+        handle = ServeHandle(StubExecutor(), factory=None, name="reap-sid")
+        handle.address = "w1"
+        other = ServeHandle(StubExecutor(), factory=None, name="other-sid")
+        other.address = "w1"
+        StubExecutor._serve_handles = {"other-sid": other}
+        SERVE_QUEUE_DEPTH.labels(session="reap-sid").set(3)
+        SERVE_TOKENS_PER_S.labels(session="reap-sid").set(100.0)
+        for state in ("sessions", "slots", "busy", "queued"):
+            SERVE_WORKER_SLOTS.labels(worker="w1", state=state).set(1)
+        def slot_lines():
+            return [
+                line for line in REGISTRY.prometheus_text().splitlines()
+                if line.startswith("covalent_tpu_serve_worker_slots")
+            ]
+
+        handle._drop_live()
+        text = REGISTRY.prometheus_text()
+        # Per-session series die with the session...
+        assert 'session="reap-sid"' not in text
+        # ...but the worker's occupancy survives while another live
+        # session still shares the worker.
+        assert any('worker="w1"' in line for line in slot_lines())
+        StubExecutor._serve_handles = {}
+        other._drop_live()
+        assert not any('worker="w1"' in line for line in slot_lines())
+
+    run_async(flow())
+
+
+# --------------------------------------------------------------------- #
+# Resident-mode profiling
+# --------------------------------------------------------------------- #
+
+
+@pytest.fixture()
+def harness_emits(monkeypatch):
+    """Capture harness _emit output (the agent-channel protocol lines)."""
+    from covalent_tpu_plugin import harness
+
+    lines: list[dict] = []
+    monkeypatch.setattr(harness, "_emit", lines.append)
+    harness._PROFILE_ACTIVE.clear()
+    yield lines
+    harness._PROFILE_ACTIVE.clear()
+
+
+def test_harness_profile_verbs_roundtrip(tmp_path, harness_emits):
+    from covalent_tpu_plugin import harness
+
+    trace_dir = str(tmp_path / "trace")
+    harness._profile_start({"cmd": "profile_start", "id": "p1",
+                            "dir": trace_dir})
+    assert harness_emits[-1]["event"] == "profile_started"
+    # Second start while one is active: refused busy, trace not corrupted.
+    harness._profile_start({"cmd": "profile_start", "id": "p2",
+                            "dir": trace_dir})
+    assert harness_emits[-1] == {
+        "event": "profile_error", "id": "p2", "code": "busy",
+        "message": harness_emits[-1]["message"],
+    }
+    harness._profile_stop({"cmd": "profile_stop", "id": "p1",
+                           "artifact_dir": str(tmp_path / "cas")})
+    # Stop + packaging run on a daemon thread (the command loop must stay
+    # responsive under multi-MB traces): wait for the threaded emit.
+    import time
+
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if any(e.get("event") == "profile_stopped" for e in harness_emits):
+            break
+        time.sleep(0.02)
+    stopped = harness_emits[-1]
+    assert stopped["event"] == "profile_stopped"
+    assert os.path.basename(stopped["path"]) == (
+        f"{stopped['digest']}.profile.tgz"
+    )
+    import hashlib
+
+    assert hashlib.sha256(
+        open(stopped["path"], "rb").read()
+    ).hexdigest() == stopped["digest"]
+    assert not os.path.exists(trace_dir)  # raw trace consumed
+    # Stop with nothing active: not_running, never a crash.
+    harness._profile_stop({"cmd": "profile_stop", "id": "p1"})
+    assert harness_emits[-1]["code"] == "not_running"
+    harness._profile_start({"cmd": "profile_start", "id": ""})
+    assert harness_emits[-1]["code"] == "bad_request"
+
+
+def test_harness_profile_stop_discard_skips_packaging(tmp_path, harness_emits):
+    """A compensating stop (abandoned capture) must not tar+hash a trace
+    nobody will fetch: the raw dir is deleted and no artifact written."""
+    import time as _time
+
+    from covalent_tpu_plugin import harness
+
+    trace_dir = str(tmp_path / "trace")
+    harness._profile_start({"cmd": "profile_start", "id": "pd",
+                            "dir": trace_dir})
+    assert harness_emits[-1]["event"] == "profile_started"
+    harness._profile_stop({"cmd": "profile_stop", "id": "pd",
+                           "discard": True})
+    deadline = _time.time() + 30
+    while _time.time() < deadline:
+        if any(e.get("event") == "profile_stopped" for e in harness_emits):
+            break
+        _time.sleep(0.02)
+    stopped = harness_emits[-1]
+    assert stopped["event"] == "profile_stopped"
+    assert stopped.get("discarded") is True and "path" not in stopped
+    assert not os.path.exists(trace_dir)
+    assert not list(tmp_path.rglob("*.profile.tgz"))
+    assert not harness._PROFILE_ACTIVE  # slot freed for the next capture
+
+
+def test_harness_profile_start_refuses_foreign_sid(tmp_path, harness_emits):
+    """A sid-pinned start on a runtime NOT hosting that session must be
+    refused — tracing whichever process saw the command first returns a
+    digest-valid artifact of the wrong runtime."""
+    from covalent_tpu_plugin import harness
+
+    harness._profile_start({"cmd": "profile_start", "id": "p1",
+                            "dir": str(tmp_path / "t"), "sid": "s-elsewhere"})
+    assert harness_emits[-1]["event"] == "profile_error"
+    assert harness_emits[-1]["code"] == "unknown_session"
+    assert not harness._PROFILE_ACTIVE  # nothing started
+    # The same start succeeds once this runtime hosts the session.
+    harness._SERVE_SESSIONS["s-here"] = object()
+    try:
+        harness._profile_start({"cmd": "profile_start", "id": "p2",
+                                "dir": str(tmp_path / "t"), "sid": "s-here"})
+        assert harness_emits[-1]["event"] == "profile_started"
+    finally:
+        harness._SERVE_SESSIONS.pop("s-here", None)
+        if harness._PROFILE_ACTIVE:
+            import jax
+
+            jax.profiler.stop_trace()
+            harness._PROFILE_ACTIVE.clear()
+
+
+def test_epilogue_excludes_profile_capture_from_overhead(tmp_path):
+    """Trace stop + tar + fetch observes the dispatch, it is not part of
+    it: charging capture seconds as wall_overhead would burn the shipped
+    dispatch_overhead SLO on profiled-but-healthy traffic."""
+    import time as _time
+
+    from covalent_tpu_plugin import TPUExecutor
+    from covalent_tpu_plugin.obs.trace import Span
+
+    ex = TPUExecutor(
+        transport="local", cache_dir=str(tmp_path / "c"),
+        remote_cache=str(tmp_path / "r"), python_path=sys.executable,
+    )
+    root = Span("executor.task", activate=False)
+    root.__enter__()
+    root._t0 = _time.perf_counter() - 3.0  # elapsed ~3s
+    root.stage_durations.update({"execute": 0.5, "profile": 2.0})
+    ex._attempt_epilogue(root, "completed", "op-prof-oh", 0)
+    wall = ex.last_timings["wall_overhead"]
+    assert 0.3 < wall < 0.7, wall  # 3.0 - execute - profile, NOT 2.5
+    assert ex.last_timings["overhead"] == pytest.approx(0.0)
+
+
+def test_capture_profile_targets_pin_to_session_host(tmp_path):
+    """The dispatcher side of the same contract: a sid naming a local
+    ServeHandle restricts candidate agents to the one hosting it, with
+    the sid translated to the current generation's remote id."""
+    from covalent_tpu_plugin import TPUExecutor
+
+    class _FakeClient:
+        def __init__(self, mode):
+            self.mode = mode
+            self.alive = True
+
+    class _FakeHandle:
+        def __init__(self, client):
+            self._sid_g = "s1.g0"
+            self._client = client
+
+    executor = TPUExecutor(
+        transport="local", cache_dir=str(tmp_path / "c"),
+        remote_cache=str(tmp_path / "r"), python_path=sys.executable,
+    )
+    pool_a, pool_b = _FakeClient("pool"), _FakeClient("pool")
+    executor._agents = {"a": pool_a, "b": pool_b}
+    executor._serve_handles = {"s1": _FakeHandle(pool_b)}
+    sid, targets = executor._profile_targets("s1")
+    assert sid == "s1.g0"
+    assert targets == [("b", pool_b)]
+    # No sid: every live agent is a candidate, pool servers first.
+    native = _FakeClient("native")
+    executor._agents["n"] = native
+    _, targets = executor._profile_targets("")
+    assert [t[1].mode for t in targets] == ["pool", "pool", "native"]
+    # A dead pinned client falls back to the worker-side refusal road.
+    pool_b.alive = False
+    _, targets = executor._profile_targets("s1")
+    assert pool_b not in [t[1] for t in targets] and targets
+
+
+def make_rpc_profile_executor(tmp_path, **kwargs):
+    from .test_rpc import make_rpc_executor
+
+    kwargs.setdefault("profile_dir", str(tmp_path / "remote_profiles"))
+    return make_rpc_executor(tmp_path, **kwargs)
+
+
+def test_rpc_preselect_accepts_profiling(tmp_path):
+    executor = make_rpc_profile_executor(tmp_path)
+    # The PR's acceptance line: profile_dir no longer disqualifies the
+    # electron from the RPC fast path.
+    assert executor._rpc_preselect({}) is True
+
+
+def test_rpc_electron_profiles_resident_runtime(tmp_path, run_async):
+    """Acceptance: a profile_dir capture against a live RPC electron —
+    no launch fallback, artifact staged back via CAS, digest-verified."""
+    executor = make_rpc_profile_executor(tmp_path)
+
+    def jaxwork(n):
+        import jax.numpy as jnp
+
+        return float(jnp.sum(jnp.arange(n)))
+
+    async def flow():
+        try:
+            result = await executor.run(
+                jaxwork, [10], {}, {"dispatch_id": "prof", "node_id": 0}
+            )
+            assert result == 45.0
+            assert executor.last_dispatch_mode == "rpc"
+            trace = executor.last_timings.get("profile_trace")
+            assert trace and os.path.exists(trace)
+            with tarfile.open(trace) as tar:
+                names = tar.getnames()
+            assert any("plugins/profile" in name for name in names)
+            # On-demand capture against the still-warm runtime (the
+            # POST /profile body) works too.
+            info = await executor.capture_profile(duration_s=0.2)
+            assert info is not None and os.path.exists(info["path"])
+            import hashlib
+
+            assert hashlib.sha256(
+                open(info["path"], "rb").read()
+            ).hexdigest() == info["digest"]
+            # Neither the per-electron nor the on-demand capture may
+            # leave an _profile_artifacts entry behind (the epilogue
+            # pops real op ids; capture_profile pops synthetic ones).
+            assert executor._profile_artifacts == {}
+        finally:
+            await executor.close()
+
+    run_async(flow())
+
+
+def test_launch_profile_trace_fetched_back(tmp_path, run_async):
+    """Satellite: launch-mode traces are pulled back to the dispatcher
+    and recorded in last_timings, and the remote trace dir is consumed."""
+    executor = make_rpc_profile_executor(tmp_path, dispatch_mode="launch")
+
+    def jaxwork(n):
+        import jax.numpy as jnp
+
+        return float(jnp.sum(jnp.arange(n)))
+
+    async def flow():
+        try:
+            await executor.run(
+                jaxwork, [10], {}, {"dispatch_id": "launchprof", "node_id": 0}
+            )
+            assert executor.last_dispatch_mode == "launch"
+            trace = executor.last_timings.get("profile_trace")
+            assert trace and os.path.exists(trace)
+            assert not os.path.exists(
+                str(tmp_path / "remote_profiles" / "launchprof_0")
+            )
+        finally:
+            await executor.close()
+
+    run_async(flow())
+
+
+def test_capture_profile_without_runtime_returns_none(tmp_path, run_async):
+    executor = make_rpc_profile_executor(tmp_path)
+
+    async def flow():
+        try:
+            # No electron ever ran: no agents, nothing to profile.
+            assert await executor.capture_profile(duration_s=0.1) is None
+        finally:
+            await executor.close()
+
+    run_async(flow())

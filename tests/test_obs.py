@@ -266,10 +266,16 @@ def test_event_sink_roundtrip(events_file):
 
 
 def test_event_sink_disabled_is_noop(tmp_path):
+    # The no-op contract is "disabled AND unobserved": once any executor
+    # has wired the flight recorder's process-wide listener, events are
+    # observed and must be built even with no JSONL path configured.
     obs_events.configure(None)
+    listeners = obs_events._listeners[:]
+    obs_events._listeners[:] = []
     try:
         assert obs_events.emit("ignored") is None
     finally:
+        obs_events._listeners[:] = listeners
         obs_events.reset()
 
 
