@@ -45,7 +45,8 @@ BENCH_PHASES = {
     for phase in os.environ.get(
         "BENCH_PHASES",
         "overhead,obs_tax,fanout,cached_fanout,bundled_fanout,"
-        "rpc_overhead,serve_traffic,chaos_fanout,sched_fanout,tpu",
+        "rpc_overhead,serve_traffic,serve_scale,chaos_fanout,"
+        "sched_fanout,tpu",
     ).split(",")
     if phase.strip()
 }
@@ -80,6 +81,25 @@ SERVE_STEP_S = float(os.environ.get("BENCH_SERVE_STEP_S", "0.01"))
 SERVE_TOKENS = int(os.environ.get("BENCH_SERVE_TOKENS", "8"))
 SERVE_SPEEDUP_MIN = float(os.environ.get("BENCH_SERVE_SPEEDUP_MIN", "1.5"))
 SERVE_BUDGET_S = float(os.environ.get("BENCH_SERVE_BUDGET_S", "90"))
+#: serve_scale phase knobs: replica count for the scaled arm, offered
+#: load (held constant across arms), per-decode-chunk step time, and the
+#: SLOs — aggregate tokens/s must scale by >= SERVE_SCALE_MIN from 1 to
+#: SERVE_SCALE_REPLICAS replicas, p99 at N must not regress vs N=1 under
+#: the same offered load, and the router's median per-request decision
+#: must stay under ROUTER_DECISION_BUDGET_S.
+SERVE_SCALE_REPLICAS = int(os.environ.get("BENCH_SERVE_SCALE_REPLICAS", "4"))
+SERVE_SCALE_REQUESTS = int(os.environ.get("BENCH_SERVE_SCALE_REQUESTS", "32"))
+SERVE_SCALE_TOKENS = int(os.environ.get("BENCH_SERVE_SCALE_TOKENS", "12"))
+SERVE_SCALE_STEP_S = float(
+    os.environ.get("BENCH_SERVE_SCALE_STEP_S", "0.08")
+)
+SERVE_SCALE_MIN = float(os.environ.get("BENCH_SERVE_SCALE_MIN", "3.0"))
+SERVE_SCALE_BUDGET_S = float(
+    os.environ.get("BENCH_SERVE_SCALE_BUDGET_S", "150")
+)
+ROUTER_DECISION_BUDGET_S = float(
+    os.environ.get("BENCH_ROUTER_DECISION_BUDGET_S", "0.001")
+)
 # 570 (was 360, 480, then 540): the r4 TPU run showed the phase list
 # needs ~450 s cold (tunnel compiles dominate; the persistent cache
 # roughly halves a warm run) — 360 skipped lm_spec, and 480 left a warm
@@ -2412,6 +2432,300 @@ async def main() -> None:
         emit({"phase": "serve_traffic", "skipped": "BENCH_PHASES"})
     except Exception as error:  # noqa: BLE001
         emit({"phase": "serve_traffic", "error": repr(error)})
+
+    # ---- phase 2b3: horizontal serving scale (replica sets) --------------
+    # ONE resident session's ceiling is one engine's slot count; this
+    # phase offers the SAME concurrent load to a 1-replica set and an
+    # N-replica set (each replica its own pool-server process, so the
+    # step_s decode sleeps genuinely parallelize) and asserts the three
+    # scaling SLOs: aggregate tokens/s grows >= SERVE_SCALE_MIN from
+    # 1 -> N replicas, p99 request latency at N is no worse than at 1,
+    # and the router's median per-request decision stays under
+    # ROUTER_DECISION_BUDGET_S — scaling out must not re-tax the dispatch
+    # path.  A final arm proves the engine-side half of the ISSUE:
+    # shared-prefix prefill reuse on the REAL ContinuousEngine, bit-equal
+    # greedy streams with measurably fewer prefill positions.
+    try:
+        if "serve_scale" not in BENCH_PHASES:
+            raise _PhaseSkipped
+        from covalent_tpu_plugin.serving import open_replica_set
+
+        def make_scale_factory(step_s: float, slots: int = 4):
+            # Same closure-local stub shape as serve_traffic: streams are
+            # deterministic per prompt, one step_s sleep per decode chunk
+            # across all busy lanes — the per-process serial resource a
+            # replica adds a copy of.
+            def factory():
+                import time as _time
+
+                class Engine:
+                    def __init__(self):
+                        self.slots = slots
+                        self.lanes = {}
+
+                    def admit(self, rid, prompt, params):
+                        seed = int(prompt[-1])
+                        cap = int((params or {}).get(
+                            "max_new_tokens", SERVE_SCALE_TOKENS
+                        ))
+                        self.lanes[rid] = [
+                            seed * 100 + j + 1 for j in range(cap)
+                        ]
+
+                    def step(self):
+                        _time.sleep(step_s)
+                        events = []
+                        for rid in list(self.lanes):
+                            chunk = self.lanes[rid][:4]
+                            self.lanes[rid] = self.lanes[rid][4:]
+                            done = not self.lanes[rid]
+                            if done:
+                                del self.lanes[rid]
+                            events.append({
+                                "rid": rid, "tokens": chunk, "done": done,
+                            })
+                        return events
+
+                    def cancel(self, rid):
+                        self.lanes.pop(rid, None)
+
+                return Engine()
+
+            return factory
+
+        def scale_executor(tag: str):
+            return TPUExecutor(
+                transport="local",
+                cache_dir=f"{workdir}/cache_scale_{tag}",
+                remote_cache=f"{workdir}/remote_scale_{tag}",
+                python_path=sys.executable,
+                poll_freq=0.2,
+                use_agent="pool",
+                pool_preload="cloudpickle",
+                prewarm=False,
+                heartbeat_interval=0.0,
+                task_env={
+                    "PYTHONPATH": repo_root + os.pathsep
+                    + os.environ.get("PYTHONPATH", ""),
+                },
+            )
+
+        async def scale_arm(n_replicas: int) -> dict:
+            executors = [
+                scale_executor(f"n{n_replicas}_{i}")
+                for i in range(n_replicas)
+            ]
+            try:
+                rset = await open_replica_set(
+                    executors,
+                    make_scale_factory(SERVE_SCALE_STEP_S),
+                    name=f"scale{n_replicas}",
+                    stats_interval_s=0.2,
+                )
+                t0 = time.perf_counter()
+                requests = [
+                    await rset.request(
+                        [i],
+                        params={"max_new_tokens": SERVE_SCALE_TOKENS},
+                        tenant=f"t{i % 2}",
+                    )
+                    for i in range(SERVE_SCALE_REQUESTS)
+                ]
+                results = await asyncio.gather(
+                    *(
+                        r.result(timeout=SERVE_SCALE_BUDGET_S)
+                        for r in requests
+                    )
+                )
+                wall = time.perf_counter() - t0
+                latencies = [r.latency_s for r in requests]
+                decisions = sorted(rset.decision_s)
+                status = rset.status()
+                await rset.close()
+            finally:
+                for ex in executors:
+                    await ex.close()
+            return {
+                "wall_s": wall,
+                "latencies": latencies,
+                "results": list(results),
+                "decisions": decisions,
+                "per_replica_served": {
+                    rid: view["served"]
+                    for rid, view in status["replicas"].items()
+                },
+            }
+
+        def prefix_probe(prefix_len, n_requests, cap):
+            # Runs INSIDE a worker process (the bench parent never
+            # imports jax): the real ContinuousEngine, driven with and
+            # without shared-prefix reuse over identical prompts.
+            import time as _time
+
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            from covalent_tpu_plugin.models import (
+                TransformerConfig,
+                TransformerLM,
+            )
+            from covalent_tpu_plugin.models.serve import ContinuousEngine
+
+            cfg = TransformerConfig(
+                vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+                d_ff=64, max_seq=64, dtype=jnp.float32,
+                attention="reference",
+            )
+            model = TransformerLM(cfg)
+            params = model.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+            )["params"]
+            rng = np.random.default_rng(0)
+            prefix = rng.integers(0, 64, prefix_len).astype(np.int32)
+            prompts = [
+                np.concatenate([
+                    prefix,
+                    rng.integers(0, 64, 2 + i % 3).astype(np.int32),
+                ])
+                for i in range(n_requests)
+            ]
+
+            def run(shared):
+                engine = ContinuousEngine(
+                    model, params, max_batch=2, sync_steps=4,
+                    max_new_tokens=cap,
+                    shared_prefix=prefix if shared else None,
+                )
+                streams = {}
+                queue = [(f"r{i}", p) for i, p in enumerate(prompts)]
+                done = set()
+                t0 = _time.perf_counter()
+                for _ in range(500):
+                    while queue and engine.busy < engine.slots:
+                        rid, p = queue.pop(0)
+                        engine.admit(rid, p, {"max_new_tokens": cap})
+                        streams[rid] = []
+                    for event in engine.step():
+                        streams[event["rid"]].extend(event["tokens"])
+                        if event["done"]:
+                            done.add(event["rid"])
+                    if len(done) == len(prompts) and not queue:
+                        break
+                wall = _time.perf_counter() - t0
+                stats = dict(engine.stats)
+                engine.close()
+                return streams, stats, wall
+
+            reuse_streams, reuse_stats, reuse_wall = run(True)
+            full_streams, full_stats, full_wall = run(False)
+            return {
+                "equal": reuse_streams == full_streams,
+                "requests": n_requests,
+                "prefix_hits": reuse_stats["prefix_hits"],
+                "prefill_positions_reuse":
+                    reuse_stats["prefill_positions"],
+                "prefill_positions_full":
+                    full_stats["prefill_positions"],
+                "wall_reuse_s": round(reuse_wall, 4),
+                "wall_full_s": round(full_wall, 4),
+            }
+
+        async def prefix_arm() -> dict:
+            ex = scale_executor("prefix")
+            try:
+                return await ex.run(
+                    prefix_probe, [12, 6, 6], {},
+                    {"dispatch_id": "prefixprobe", "node_id": 0},
+                )
+            finally:
+                await ex.close()
+
+        async def scale_phase():
+            one = await scale_arm(1)
+            many = await scale_arm(SERVE_SCALE_REPLICAS)
+            prefix = await prefix_arm()
+            return one, many, prefix
+
+        one_arm, many_arm, prefix_info = await asyncio.wait_for(
+            scale_phase(), SERVE_SCALE_BUDGET_S
+        )
+        expected = [
+            [i * 100 + j + 1 for j in range(SERVE_SCALE_TOKENS)]
+            for i in range(SERVE_SCALE_REQUESTS)
+        ]
+        assert one_arm["results"] == expected, one_arm["results"]
+        assert many_arm["results"] == expected, many_arm["results"]
+        total_tokens = SERVE_SCALE_REQUESTS * SERVE_SCALE_TOKENS
+        tps_one = total_tokens / max(one_arm["wall_s"], 1e-9)
+        tps_many = total_tokens / max(many_arm["wall_s"], 1e-9)
+        scale = tps_many / max(tps_one, 1e-9)
+        p99_one = percentile(one_arm["latencies"], 0.99)
+        p99_many = percentile(many_arm["latencies"], 0.99)
+        decisions = sorted(one_arm["decisions"] + many_arm["decisions"])
+        router_p50 = (
+            decisions[len(decisions) // 2] if decisions else 0.0
+        )
+        assert prefix_info["equal"] is True, prefix_info
+        prefix_reuse_ok = bool(
+            prefix_info["prefill_positions_reuse"]
+            < prefix_info["prefill_positions_full"]
+        )
+        summary["serve_scale_replicas"] = SERVE_SCALE_REPLICAS
+        summary["serve_scale_tokens_per_s_1"] = round(tps_one, 1)
+        summary["serve_scale_tokens_per_s_n"] = round(tps_many, 1)
+        summary["serve_scale_speedup"] = round(scale, 2)
+        summary["serve_scale_min"] = SERVE_SCALE_MIN
+        summary["serve_scale_linear_ok"] = bool(scale >= SERVE_SCALE_MIN)
+        summary["serve_scale_p99_1_s"] = round(p99_one, 4)
+        summary["serve_scale_p99_n_s"] = round(p99_many, 4)
+        summary["serve_scale_p99_ok"] = bool(p99_many <= p99_one)
+        summary["serve_scale_router_p50_ms"] = round(router_p50 * 1e3, 4)
+        summary["serve_scale_router_ok"] = bool(
+            router_p50 < ROUTER_DECISION_BUDGET_S
+        )
+        summary["serve_prefix_reuse_ok"] = prefix_reuse_ok
+        summary["serve_prefix_prefill_full"] = (
+            prefix_info["prefill_positions_full"]
+        )
+        summary["serve_prefix_prefill_reuse"] = (
+            prefix_info["prefill_positions_reuse"]
+        )
+        emit({
+            "phase": "serve_scale",
+            "replicas": SERVE_SCALE_REPLICAS,
+            "requests": SERVE_SCALE_REQUESTS,
+            "tokens_per_request": SERVE_SCALE_TOKENS,
+            "step_s": SERVE_SCALE_STEP_S,
+            "wall_1_s": round(one_arm["wall_s"], 3),
+            "wall_n_s": round(many_arm["wall_s"], 3),
+            "tokens_per_s_1": summary["serve_scale_tokens_per_s_1"],
+            "tokens_per_s_n": summary["serve_scale_tokens_per_s_n"],
+            "speedup": summary["serve_scale_speedup"],
+            "speedup_min": SERVE_SCALE_MIN,
+            "linear_ok": summary["serve_scale_linear_ok"],
+            "p99_1_s": summary["serve_scale_p99_1_s"],
+            "p99_n_s": summary["serve_scale_p99_n_s"],
+            "p99_ok": summary["serve_scale_p99_ok"],
+            "router_decision_p50_ms":
+                summary["serve_scale_router_p50_ms"],
+            "router_decision_budget_ms":
+                round(ROUTER_DECISION_BUDGET_S * 1e3, 3),
+            "router_ok": summary["serve_scale_router_ok"],
+            "per_replica_served": many_arm["per_replica_served"],
+            "prefix_reuse": prefix_info,
+            "prefix_reuse_ok": prefix_reuse_ok,
+            "introspection": introspection_view([
+                "covalent_tpu_serve_replicas",
+                "covalent_tpu_serve_replica_in_flight",
+                "covalent_tpu_serve_router_decision_seconds",
+            ]),
+            **spread_stats(many_arm["latencies"], "serve_scale_latency"),
+        })
+    except _PhaseSkipped:
+        emit({"phase": "serve_scale", "skipped": "BENCH_PHASES"})
+    except Exception as error:  # noqa: BLE001
+        emit({"phase": "serve_scale", "error": repr(error)})
 
     # ---- phase 2c: recovery overhead under one injected channel death ----
     # A 4-electron fan-out through a ChaosTransport that kills exactly ONE

@@ -142,6 +142,14 @@ class CASIndex:
     def known(self, key: str, digest: str) -> bool:
         return digest in self._present.get(key, ())
 
+    def holds(self, digest: str) -> bool:
+        """Whether ANY live connection's present set holds ``digest`` —
+        the replica-placement affinity probe (a holding gang re-stages
+        nothing when a serving session of that factory re-opens)."""
+        return bool(digest) and any(
+            digest in present for present in self._present.values()
+        )
+
     async def ensure_probed(
         self, key: str, conn: Transport, entries: list[tuple[str, str]]
     ) -> None:

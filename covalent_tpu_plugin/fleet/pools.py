@@ -227,6 +227,20 @@ class Pool:
         except Exception:  # noqa: BLE001 - placement must not crash on a view
             return False
 
+    def holds_serve_digest(self, digest: str) -> bool:
+        """Whether this pool's gang already staged a serving factory's
+        CAS payload — replica warm-up affinity: placement prefers pools
+        that re-open a session of that factory with zero staging."""
+        if self._executor is None or not digest:
+            return False
+        probe = getattr(self._executor, "holds_serve_digest", None)
+        if probe is None:
+            return False
+        try:
+            return bool(probe(digest))
+        except Exception:  # noqa: BLE001 - placement must not crash on a view
+            return False
+
     def rpc_digest_count(self) -> int:
         """Distinct function digests this pool's resident runtimes hold
         (0 on stub/cold executors) — the scheduler's cheap pre-check that

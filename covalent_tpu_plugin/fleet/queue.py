@@ -105,11 +105,20 @@ class FairWorkQueue:
         weights: dict[str, float] | None = None,
         quantum: float = 1.0,
         clock: Callable[[], float] = time.monotonic,
+        depth_gauge: Any = None,
     ) -> None:
         if policy not in ("reject", "shed_oldest"):
             raise ValueError(
                 f'policy must be "reject" or "shed_oldest", got {policy!r}'
             )
+        #: which gauge this queue's per-tenant depth moves.  The fleet
+        #: scheduler queue (the default) owns covalent_tpu_queue_depth;
+        #: other DRR reusers (the serving replica router) MUST pass their
+        #: own series — two queues writing one gauge would overwrite and
+        #: even delete each other's tenant depths.
+        self._depth_gauge = depth_gauge if depth_gauge is not None else (
+            QUEUE_DEPTH
+        )
         self.max_depth = max(0, int(max_depth))
         self.policy = policy
         if quantum <= 0:
@@ -179,7 +188,7 @@ class FairWorkQueue:
         strings are user-derived and unbounded, so empty lanes must not
         accumulate for the process lifetime."""
         self._lanes.pop(tenant, None)
-        QUEUE_DEPTH.remove(tenant=tenant)
+        self._depth_gauge.remove(tenant=tenant)
         try:
             self._active.remove(tenant)
         except ValueError:
@@ -221,7 +230,7 @@ class FairWorkQueue:
             self._active.append(item.tenant)
         lane.items.append(item)
         self._depth += 1
-        QUEUE_DEPTH.labels(tenant=item.tenant).set(len(lane.items))
+        self._depth_gauge.labels(tenant=item.tenant).set(len(lane.items))
         return shed
 
     def _shed_oldest(self) -> WorkItem | None:
@@ -240,7 +249,7 @@ class FairWorkQueue:
         lane = self._lanes[oldest_tenant]
         victim = lane.items.popleft()
         self._depth -= 1
-        QUEUE_DEPTH.labels(tenant=oldest_tenant).set(len(lane.items))
+        self._depth_gauge.labels(tenant=oldest_tenant).set(len(lane.items))
         if not lane.items:
             self._drop_lane(oldest_tenant)
         return victim
@@ -271,7 +280,7 @@ class FairWorkQueue:
             lane.deficit -= 1.0
             item = lane.items.popleft()
             self._depth -= 1
-            QUEUE_DEPTH.labels(tenant=tenant).set(len(lane.items))
+            self._depth_gauge.labels(tenant=tenant).set(len(lane.items))
             if not lane.items:
                 # An emptied lane retires whole (deficit included — DRR
                 # never banks credit across idle periods) so tenant churn
@@ -294,7 +303,7 @@ class FairWorkQueue:
                     kept.append(item)
             if len(kept) != len(lane.items):
                 lane.items = kept
-                QUEUE_DEPTH.labels(tenant=tenant).set(len(kept))
+                self._depth_gauge.labels(tenant=tenant).set(len(kept))
                 if not kept:
                     self._drop_lane(tenant)
         self._depth -= len(removed)

@@ -173,6 +173,72 @@ def _make_admit(decoder, temperature, top_k, eos_token_id, batch, bucket, g):
     return admit_wave
 
 
+@functools.lru_cache(maxsize=64)
+def _make_prefix_admit(decoder, temperature, top_k, eos_token_id, batch,
+                       bucket, g, prefix_len):
+    """Fused admission wave for prompts sharing the session's prefilled
+    prefix: every lane starts from the SHARED prefix cache lane (computed
+    once per engine) and prefills only its suffix, padded to ``bucket``.
+
+    This is the shared-prefix fast path: on the dominant traffic shape —
+    a common system prompt ahead of a short user turn — per-request
+    prefill work drops from ``bucket(prompt)`` to ``bucket(suffix)``
+    positions.  Exactness is the same two tricks the full-prefill wave
+    uses, shifted by ``prefix_len``: the suffix pass appends K/V at the
+    prefix cursor (queries at absolute position ``prefix_len + j`` see
+    the cached prefix plus the causal suffix — exactly what one full
+    pass computes for those positions), and pad K/V land at slots
+    ``>= prefix_len + suffix_len`` where the rewound cursor keeps them
+    dead until the decode loop overwrites them.  ``prefix_lane`` rides
+    as a traced argument (broadcast across the vmapped lanes), so one
+    compiled wave serves every prefix of the same length.
+    """
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def admit_wave(params, state, prefix_lane, rows, padded, slens, slots,
+                   caps_in, keys):
+        # rows (g, length) full buffer rows (prefix + suffix); padded
+        # (g, bucket) SUFFIX tokens; slens (g,) suffix lengths;
+        # slots/caps_in (g,); keys (g, 2) admission keys.
+        caches, buffer, pos, plen, row_cap, n_gen, done, rng = state
+
+        def lane_prefill(tokens, sl, key):
+            logits, mutated = decoder.apply(
+                {"params": params, "cache": prefix_lane}, tokens[None],
+                mutable=["cache"],
+            )
+            cache = _set_cursor(mutated["cache"], prefix_len + sl)
+            last = jnp.take_along_axis(
+                logits, (sl - 1)[None, None, None], axis=1
+            )[0, 0]  # (V,)
+            first = _choose_tokens(
+                last[None, :], key, temperature, top_k
+            )[0]
+            return cache, first
+
+        new_lanes, firsts = jax.vmap(lane_prefill)(padded, slens, keys)
+        plens = prefix_len + slens
+        caches = jax.tree_util.tree_map(
+            lambda c, nl: c.at[slots].set(nl, mode="drop"),
+            caches, new_lanes,
+        )
+        rows = rows.at[jnp.arange(g), plens].set(firsts)
+        buffer = buffer.at[slots].set(rows, mode="drop")
+        pos = pos.at[slots].set(plens, mode="drop")
+        plen = plen.at[slots].set(plens, mode="drop")
+        row_cap = row_cap.at[slots].set(caps_in, mode="drop")
+        n_gen = n_gen.at[slots].set(
+            jnp.ones((g,), jnp.int32), mode="drop"
+        )
+        fin = caps_in <= 1
+        if eos_token_id is not None:
+            fin = fin | (firsts == eos_token_id)
+        done = done.at[slots].set(fin, mode="drop")
+        return caches, buffer, pos, plen, row_cap, n_gen, done, rng
+
+    return admit_wave
+
+
 @functools.lru_cache(maxsize=32)
 def _make_run_steps(decoder, temperature, top_k, eos_token_id,
                     length, sync_steps, batch):
@@ -572,6 +638,16 @@ class ContinuousEngine:
     dedicated admission key chain.  Buffer width is static
     (``length``, default ``config.max_seq``) — the price of compiling
     once for a session's whole lifetime.
+
+    ``shared_prefix`` turns on shared-prefix prefill reuse for the
+    dominant serving shape (a common system prompt ahead of every user
+    turn): the prefix is prefilled ONCE at construction into a template
+    cache lane, and an admitted prompt that starts with it prefills only
+    its suffix on top of that lane — same numerics (greedy outputs stay
+    bit-identical to the full-prefill road, asserted against the oracle
+    in ``tests/test_continuous.py``), strictly less prefill work
+    (``stats["prefill_positions"]``).  A prompt NOT extending the prefix
+    silently takes the full-prefill path (``stats["prefix_misses"]``).
     """
 
     def __init__(
@@ -588,6 +664,7 @@ class ContinuousEngine:
         sync_steps: int = 8,
         max_new_tokens: int = 16,
         length: int | None = None,
+        shared_prefix: Sequence[int] | None = None,
     ) -> None:
         decoder = _decode_model(model)
         config = decoder.config
@@ -656,6 +733,39 @@ class ContinuousEngine:
         self._rid_slot: dict[str, int] = {}
         #: admissions awaiting a flush: (rid, tokens, cap).
         self._pending: list[tuple[str, np.ndarray, int]] = []
+        #: host-loop counters: shared-prefix hit/miss accounting plus the
+        #: prefill positions each admission paid (full-prompt bucket on
+        #: the slow path, suffix bucket on a prefix hit) — the measurable
+        #: "prefill work" the serve_scale bench arm asserts shrinks.
+        self.stats: dict[str, int] = {
+            "prefix_hits": 0, "prefix_misses": 0, "prefill_positions": 0,
+        }
+        self._prefix_tokens: np.ndarray | None = None
+        self._prefix_lane = None
+        if shared_prefix is not None:
+            ptoks = np.asarray(shared_prefix, np.int32).reshape(-1)
+            if ptoks.size < 1:
+                raise ValueError("shared_prefix needs at least one token")
+            if ptoks.size + 2 > self._length:
+                raise ValueError(
+                    f"shared_prefix ({ptoks.size} tokens) leaves no room "
+                    f"for a suffix + generation inside the session's "
+                    f"static length ({self._length})"
+                )
+            self._prefix_tokens = ptoks
+            # Prefill the shared prefix ONCE per engine (per replica):
+            # one exact-length pass on a zero lane, cursor parked at the
+            # prefix boundary.  Every prefix-matching admission copies
+            # this lane instead of re-running the prefix positions.
+            zero = jax.tree_util.tree_map(jnp.zeros_like, lane)
+            _logits, mutated = decoder.apply(
+                {"params": params, "cache": zero},
+                jnp.asarray(ptoks)[None],
+                mutable=["cache"],
+            )
+            self._prefix_lane = _set_cursor(
+                mutated["cache"], int(ptoks.size)
+            )
 
     # -- serving-engine surface -------------------------------------------
 
@@ -754,26 +864,65 @@ class ContinuousEngine:
 
     # -- internals ---------------------------------------------------------
 
+    def _shares_prefix(self, tokens: np.ndarray) -> bool:
+        """Whether this prompt rides the shared-prefix fast path: it must
+        extend the session prefix by at least one token (the suffix pass
+        needs a position to read first-token logits from); an equal or
+        mismatched prompt falls back to the full-prefill road."""
+        prefix = self._prefix_tokens
+        return (
+            prefix is not None
+            and tokens.size > prefix.size
+            and bool(np.array_equal(tokens[: prefix.size], prefix))
+        )
+
     def _flush_admissions(self) -> None:
         """Admit pending requests in fused bucketed waves (one compiled
-        call per bucket), mirroring ``continuous_generate``'s
-        ``admit_group`` exactly — including the per-admission key chain."""
+        call per bucket per path), mirroring ``continuous_generate``'s
+        ``admit_group`` — including the per-admission key chain, which is
+        split in admission order BEFORE the prefix partition so sampled
+        streams draw identically whichever prefill road they take.
+
+        Prompts sharing the session's ``shared_prefix`` prefill only
+        their suffix on top of the once-computed prefix lane
+        (``_make_prefix_admit``); everything else — including a
+        mismatched prefix — takes the full-prompt wave unchanged.
+        """
         if not self._pending:
             return
         free = [s for s in range(self.slots) if self._slot_rid[s] is None]
         picked: list[tuple[int, np.ndarray, int, Any, int]] = []
+        picked_prefix: list[tuple[int, np.ndarray, int, Any, int]] = []
+        prefix_len = (
+            0 if self._prefix_tokens is None else self._prefix_tokens.size
+        )
         while self._pending and free:
             rid, tokens, cap = self._pending.pop(0)
             slot = free.pop(0)
             self._slot_rid[slot] = rid
             self._rid_slot[rid] = slot
             self._reported[slot] = 0
-            bucket = min(
-                1 << (int(tokens.size) - 1).bit_length(),
-                self._config.max_seq,
-            )
             self._adm_key, key = jax.random.split(self._adm_key)
-            picked.append((slot, tokens, cap, key, bucket))
+            if self._shares_prefix(tokens):
+                # Pad K/V land at cache slots >= prefix_len + suffix
+                # length, so the bucket is capped to what fits BEYOND the
+                # prefix (admit() already bounded prompt + budget).
+                bucket = min(
+                    1 << (int(tokens.size) - prefix_len - 1).bit_length(),
+                    self._config.max_seq - prefix_len,
+                )
+                self.stats["prefix_hits"] += 1
+                self.stats["prefill_positions"] += bucket
+                picked_prefix.append((slot, tokens, cap, key, bucket))
+            else:
+                bucket = min(
+                    1 << (int(tokens.size) - 1).bit_length(),
+                    self._config.max_seq,
+                )
+                if self._prefix_tokens is not None:
+                    self.stats["prefix_misses"] += 1
+                self.stats["prefill_positions"] += bucket
+                picked.append((slot, tokens, cap, key, bucket))
         for bucket in sorted({p[4] for p in picked}):
             group = [p for p in picked if p[4] == bucket]
             g = 1 << (len(group) - 1).bit_length()
@@ -798,6 +947,33 @@ class ContinuousEngine:
                 self._params, self._state, jnp.asarray(rows),
                 jnp.asarray(padded), jnp.asarray(plens),
                 jnp.asarray(slots), jnp.asarray(caps_in), jnp.stack(keys),
+            )
+        for bucket in sorted({p[4] for p in picked_prefix}):
+            group = [p for p in picked_prefix if p[4] == bucket]
+            g = 1 << (len(group) - 1).bit_length()
+            rows = np.full((g, self._length), self._pad, np.int32)
+            padded = np.full((g, bucket), self._pad, np.int32)
+            slens = np.ones(g, np.int32)
+            slots = np.full(g, self.slots, np.int32)  # OOB rows dropped
+            caps_in = np.ones(g, np.int32)
+            keys = [jax.random.PRNGKey(0)] * g
+            for r, (slot, tokens, cap, key, _) in enumerate(group):
+                suffix = tokens[prefix_len:]
+                rows[r, : tokens.size] = tokens
+                padded[r, : suffix.size] = suffix
+                slens[r] = suffix.size
+                slots[r] = slot
+                caps_in[r] = cap
+                keys[r] = key
+            wave = _make_prefix_admit(
+                self._decoder, self._temperature, self._top_k, self._eos,
+                int(self.slots), int(bucket), int(g), int(prefix_len),
+            )
+            self._state = wave(
+                self._params, self._state, self._prefix_lane,
+                jnp.asarray(rows), jnp.asarray(padded),
+                jnp.asarray(slens), jnp.asarray(slots),
+                jnp.asarray(caps_in), jnp.stack(keys),
             )
 
 

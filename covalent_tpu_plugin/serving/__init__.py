@@ -4,13 +4,18 @@ Load + compile once inside a warm gang, then serve request-level RPCs
 over the held-open agent channel for the session's whole lifetime — the
 dispatch plane's answer to interactive traffic (ROADMAP item 2).
 
-* :func:`open_session` — ship a model factory by CAS digest, open the
+* :func:`open_session` — ship a model factory by CAS digest, open ONE
   session, get a :class:`ServeHandle` back.
-* :class:`ServeHandle` — multiplex concurrent callers onto the session;
-  tokens stream back incrementally; channel death reconnects and
-  replays with exactly-once token delivery.
+* :func:`open_replica_set` — open N sessions of the same factory across
+  fleet pools behind a session-aware router (:class:`ReplicaSet`):
+  least-loaded placement with per-tenant DRR fairness, sticky session
+  ids, per-replica health with drain-on-death onto survivors.
+* :class:`~.supervisor.SessionSupervisor` — one supervised session:
+  reconnect after channel death, exactly-once ``idx``-spliced stream
+  replay; both fronts share it, so neither duplicates replay machinery.
 * ``models/serve.ContinuousEngine`` — the in-worker continuous-batching
-  engine the worker harness drives (``slots``/``admit``/``step``).
+  engine the worker harness drives (``slots``/``admit``/``step``), with
+  shared-prefix prefill reuse for common system prompts.
 """
 
 from .handle import (
@@ -23,25 +28,47 @@ from .handle import (
 from .metrics import (
     SERVE_QUEUE_DEPTH,
     SERVE_RECONNECTS_TOTAL,
+    SERVE_REPLICA_IN_FLIGHT,
+    SERVE_REPLICA_REQUESTS_TOTAL,
+    SERVE_REPLICAS,
     SERVE_REQUEST_SECONDS,
     SERVE_REQUESTS_TOTAL,
+    SERVE_ROUTER_DECISION_SECONDS,
+    SERVE_ROUTER_DECISIONS_TOTAL,
     SERVE_SESSIONS,
     SERVE_TOKENS_PER_S,
     SERVE_TOKENS_TOTAL,
     SERVE_TTFT_SECONDS,
     SERVE_WORKER_SLOTS,
 )
+from .replicas import (
+    ReplicaRouter,
+    ReplicaSet,
+    ReplicaView,
+    open_replica_set,
+)
+from .supervisor import SessionSupervisor
 
 __all__ = [
     "ServeError",
     "ServeHandle",
     "ServeRequest",
     "ServeRequestRejected",
+    "SessionSupervisor",
+    "ReplicaRouter",
+    "ReplicaSet",
+    "ReplicaView",
     "open_session",
+    "open_replica_set",
     "SERVE_QUEUE_DEPTH",
     "SERVE_RECONNECTS_TOTAL",
+    "SERVE_REPLICA_IN_FLIGHT",
+    "SERVE_REPLICA_REQUESTS_TOTAL",
+    "SERVE_REPLICAS",
     "SERVE_REQUEST_SECONDS",
     "SERVE_REQUESTS_TOTAL",
+    "SERVE_ROUTER_DECISION_SECONDS",
+    "SERVE_ROUTER_DECISIONS_TOTAL",
     "SERVE_SESSIONS",
     "SERVE_TOKENS_PER_S",
     "SERVE_TOKENS_TOTAL",

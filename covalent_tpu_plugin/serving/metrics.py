@@ -70,3 +70,59 @@ SERVE_WORKER_SLOTS = REGISTRY.gauge(
     "Serving slot occupancy reported by worker heartbeats",
     ("worker", "state"),
 )
+
+# -- replica sets -----------------------------------------------------------
+# Per-replica series key on (set, replica) — the replica index is stable
+# across reconnect generations, like the session sid — and are removed by
+# the supervisor's ``_drop_live`` when the replica retires, so a scaled-
+# down set leaves no stale series behind (the same reap contract the
+# per-session gauges follow).  ``outcome`` on the router counter is a
+# closed set: ``sticky`` (pinned sid honored), ``least_loaded`` (fresh
+# placement), ``queued`` (no open replica had headroom — DRR queue),
+# ``shed`` (router admission bound hit), ``failover`` (re-routed off a
+# dead replica).
+
+SERVE_REPLICAS = REGISTRY.gauge(
+    "covalent_tpu_serve_replicas",
+    "Replica-set member sessions by state",
+    ("set", "state"),
+)
+
+SERVE_REPLICA_REQUESTS_TOTAL = REGISTRY.counter(
+    "covalent_tpu_serve_replica_requests_total",
+    "Requests submitted to each replica of a serving replica set",
+    ("set", "replica"),
+)
+
+SERVE_REPLICA_IN_FLIGHT = REGISTRY.gauge(
+    "covalent_tpu_serve_replica_in_flight",
+    "In-flight requests assigned to each replica of a serving replica set",
+    ("set", "replica"),
+)
+
+SERVE_ROUTER_DECISIONS_TOTAL = REGISTRY.counter(
+    "covalent_tpu_serve_router_decisions_total",
+    "Replica-set router placement decisions by outcome",
+    ("outcome",),
+)
+
+#: The router's own DRR queue depth.  Deliberately NOT the fleet
+#: scheduler's covalent_tpu_queue_depth: the underlying FairWorkQueue is
+#: shared code, and two queues writing one gauge would overwrite (and on
+#: lane retirement, delete) each other's per-tenant series.
+SERVE_ROUTER_QUEUE_DEPTH = REGISTRY.gauge(
+    "covalent_tpu_serve_router_queue_depth",
+    "Requests waiting in a replica-set router's per-tenant DRR queue",
+    ("tenant",),
+)
+
+#: The router's whole per-request cost: the ``serve_scale`` bench phase
+#: asserts its median under 1 ms — scaling out must not move the
+#: dispatch tax it removed back into the routing layer.
+SERVE_ROUTER_DECISION_SECONDS = REGISTRY.histogram(
+    "covalent_tpu_serve_router_decision_seconds",
+    "Replica-set router per-request decision latency",
+    buckets=(
+        0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.05, 0.25,
+    ),
+)

@@ -1,0 +1,860 @@
+"""Horizontally scaled serving: N replica sessions behind one handle.
+
+One resident session's throughput ceiling is one engine's slot count; a
+:class:`ReplicaSet` raises it by opening N sessions of the SAME engine
+factory across fleet pools and fronting them with a session-aware
+router.  Each replica is one :class:`~.supervisor.SessionSupervisor` —
+the exact reconnect/exactly-once-replay machinery a single
+:class:`~.handle.ServeHandle` runs — so horizontal scale adds no new
+failure semantics, only placement:
+
+* **Least-loaded placement, DRR tie-break.**  Every request passes
+  through a per-tenant :class:`~..fleet.queue.FairWorkQueue` (the fleet
+  scheduler's deficit-round-robin, reused verbatim): under contention
+  the DRR decides *whose* request dispatches next, and the least-loaded
+  open replica receives it (rotation breaks exact load ties).  With
+  free capacity the queue is pass-through — submit, pop, place — so the
+  uncontended path stays a dict lookup and a compare, not a scheduler.
+* **Sticky session ids.**  ``request(..., sticky="user-42")`` pins a
+  multi-turn caller to one replica (engine-side prefix caches are
+  per-replica), refreshed on use and expired after ``sticky_ttl_s``.  A
+  pin survives its replica's reconnect (the supervisor keeps the
+  replica's identity across generations); only a replica death past its
+  retry budget re-pins.
+* **Per-replica health + drain-on-death.**  New requests only route to
+  ``open`` replicas; a reconnecting replica's backlog waits for it
+  (sticky) or flows to survivors (unpinned).  A replica that dies past
+  its retry budget hands its in-flight requests back
+  (``detach_requests``) and the router re-routes them onto survivors —
+  the requests' own token high-water marks make the cross-replica
+  replay exactly-once, the same ``idx`` splice a same-replica reconnect
+  uses.
+* **Warm-up affinity.**  Replica placement prefers pools already
+  holding the factory's CAS digest (zero re-staging), then warm gangs,
+  then free capacity — the serving analog of the scheduler's fn-digest
+  affinity.
+
+``open_replica_set(targets, factory, replicas=1)`` with one target
+degenerates to today's single-session behavior (one supervisor, pass-
+through router); ``open_session`` remains the unchanged one-session API.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import time
+import uuid
+from typing import Any, Callable
+
+import cloudpickle
+
+from ..cache import bytes_digest
+from ..fleet.queue import DEFAULT_TENANT, FairWorkQueue, QueueFullError, WorkItem
+from ..obs import events as obs_events
+from ..obs.trace import Span
+from ..utils.log import app_log
+from .metrics import (
+    SERVE_REPLICAS,
+    SERVE_ROUTER_DECISION_SECONDS,
+    SERVE_ROUTER_DECISIONS_TOTAL,
+    SERVE_ROUTER_QUEUE_DEPTH,
+)
+from .supervisor import (
+    ServeError,
+    ServeRequest,
+    ServeRequestRejected,
+    SessionSupervisor,
+)
+
+__all__ = [
+    "ReplicaView",
+    "ReplicaRouter",
+    "ReplicaSet",
+    "open_replica_set",
+]
+
+#: Router states a replica-set member can be in (the SERVE_REPLICAS
+#: gauge's closed label set).
+_REPLICA_STATES = ("open", "reconnecting", "failed", "closed")
+
+
+class ReplicaView:
+    """One replica's routing-relevant shape: id, health, load, capacity.
+
+    Deliberately tiny and data-only so the router is unit-testable with
+    fake fleets and a fake clock — no supervisor, no I/O.
+    """
+
+    __slots__ = ("rid", "open", "alive", "load", "capacity")
+
+    def __init__(
+        self, rid: str, *, open: bool, load: int, capacity: int,
+        alive: bool | None = None,
+    ) -> None:
+        self.rid = rid
+        self.open = bool(open)
+        #: open OR recovering: a sticky pin to this replica still holds.
+        self.alive = bool(open if alive is None else alive)
+        self.load = int(load)
+        self.capacity = max(1, int(capacity))
+
+
+class ReplicaRouter:
+    """Session-aware request router over a set of replica views.
+
+    Synchronous and clock-injectable: :meth:`submit` admits one request
+    item (bounded — a full queue sheds, the same capacity verdict the
+    worker-side admission queue renders), :meth:`pump` drains the DRR
+    queue onto whatever open replicas have headroom and returns the
+    ``(item, replica_id, outcome)`` assignments.  The caller (the
+    replica set) performs the actual submissions and re-pumps on every
+    completion or health transition.
+
+    Sticky semantics: a pinned item only ever places on its pinned
+    replica while that replica is *alive* (open or reconnecting) —
+    waiting out a reconnect rather than abandoning the replica's warm
+    state — and re-pins to a fresh least-loaded choice once the replica
+    is gone.  Pins expire ``sticky_ttl_s`` after their last use.
+    """
+
+    def __init__(
+        self,
+        *,
+        weights: dict[str, float] | None = None,
+        sticky_ttl_s: float = 300.0,
+        queue_max: int = 0,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self._clock = clock
+        self.sticky_ttl_s = float(sticky_ttl_s)
+        self._queue = FairWorkQueue(
+            max_depth=queue_max, policy="reject",
+            weights=weights, clock=clock,
+            # The router's backlog moves its OWN gauge, never the fleet
+            # scheduler's (two queues on one series would fight).
+            depth_gauge=SERVE_ROUTER_QUEUE_DEPTH,
+        )
+        #: sticky key -> [replica_id, last_used] (TTL-expired lazily).
+        self._sticky: dict[str, list] = {}
+        #: rotation cursor for exact load ties, so equal replicas share.
+        self._rr = 0
+
+    # -- introspection ------------------------------------------------------
+
+    @property
+    def queued(self) -> int:
+        return len(self._queue)
+
+    def backlog(self) -> dict[str, int]:
+        return self._queue.backlog()
+
+    def sticky_count(self) -> int:
+        self._expire_sticky()
+        return len(self._sticky)
+
+    def sticky_target(self, key: str) -> str | None:
+        """The live pin for ``key`` (refreshes nothing; expires lazily)."""
+        entry = self._sticky.get(key)
+        if entry is None:
+            return None
+        if self._clock() - entry[1] > self.sticky_ttl_s:
+            del self._sticky[key]
+            return None
+        return entry[0]
+
+    def _expire_sticky(self) -> None:
+        now = self._clock()
+        for key in [
+            k for k, (_, used) in self._sticky.items()
+            if now - used > self.sticky_ttl_s
+        ]:
+            del self._sticky[key]
+
+    def pin(self, key: str, replica_id: str) -> None:
+        self._sticky[key] = [replica_id, self._clock()]
+
+    def set_queue_max(self, depth: int) -> None:
+        """Resize the admission bound (the set does this once replica
+        capacity is known; 0 = unbounded)."""
+        self._queue.max_depth = max(0, int(depth))
+
+    def forget_replica(self, replica_id: str) -> None:
+        """Drop every pin to a retired replica (its pins re-place)."""
+        for key in [
+            k for k, (rid, _) in self._sticky.items() if rid == replica_id
+        ]:
+            del self._sticky[key]
+
+    # -- admission + placement ----------------------------------------------
+
+    def submit(self, item: WorkItem) -> None:
+        """Admit one request item; raises :class:`QueueFullError` at the
+        bound (the caller sheds it as ``serve_admission_shed``)."""
+        self._queue.put(item)
+
+    def remove(self, predicate) -> list[WorkItem]:
+        return self._queue.remove(predicate)
+
+    def drain(self) -> list[WorkItem]:
+        return self._queue.drain()
+
+    def pump(
+        self, views: dict[str, ReplicaView]
+    ) -> list[tuple[WorkItem, str, str]]:
+        """Assign queued items to replicas with headroom, DRR-fairly.
+
+        Pops at most the current depth (one DRR visit per queued item per
+        pump): an item whose target has no headroom — or whose sticky
+        replica is mid-reconnect — requeues with its original enqueue
+        stamp, so fairness age and ``queued`` accounting survive the
+        deferral.  Returns ``(item, replica_id, outcome)`` per placement,
+        ``outcome`` in ``{"sticky", "least_loaded"}``.
+        """
+        headroom = {
+            rid: view.capacity - view.load
+            for rid, view in views.items()
+            if view.open
+        }
+        assigned: list[tuple[WorkItem, str, str]] = []
+        if not headroom:
+            return assigned
+        deferred: list[WorkItem] = []
+        for _ in range(len(self._queue)):
+            if not any(free > 0 for free in headroom.values()):
+                # Out of lanes: STOP popping.  Draining the rest just to
+                # requeue it would reset the DRR lanes' deficit state
+                # every pump and hand the head tenant the whole trickle.
+                break
+            item = self._queue.pop()
+            if item is None:
+                break
+            sticky = str(item.task_metadata.get("sticky") or "")
+            target = None
+            outcome = "least_loaded"
+            if sticky:
+                pinned = self.sticky_target(sticky)
+                if pinned is not None:
+                    view = views.get(pinned)
+                    if view is not None and view.alive:
+                        if headroom.get(pinned, 0) > 0:
+                            target, outcome = pinned, "sticky"
+                        else:
+                            # Pinned replica full or reconnecting: wait
+                            # for IT (warm per-replica state is the whole
+                            # point of the pin) instead of re-placing.
+                            deferred.append(item)
+                            continue
+                    # else: the pin points at a dead replica — fall
+                    # through to a fresh placement and re-pin below.
+            if target is None:
+                target = self._least_loaded(views, headroom)
+                if target is None:
+                    deferred.append(item)
+                    continue
+                if sticky:
+                    self.pin(sticky, target)
+            if outcome == "sticky":
+                # Refresh the pin's TTL on use: a multi-turn caller stays
+                # put as long as its turns keep landing.
+                self.pin(sticky, target)
+            headroom[target] -= 1
+            assigned.append((item, target, outcome))
+        for item in deferred:
+            # enqueued_at survives a requeue (FairWorkQueue keeps the
+            # first stamp), so deferral never resets fairness age.
+            self._queue.put(item)
+        return assigned
+
+    def _least_loaded(
+        self, views: dict[str, ReplicaView], headroom: dict[str, int]
+    ) -> str | None:
+        """The open replica with the most free lanes (ties rotate)."""
+        candidates = [
+            rid for rid, free in headroom.items() if free > 0
+        ]
+        if not candidates:
+            return None
+        # Effective load folds in this pump's own assignments (headroom
+        # already decremented), so one burst spreads instead of piling
+        # onto the momentarily-least-loaded replica.
+        best = min(
+            views[rid].capacity - headroom[rid] for rid in candidates
+        )
+        tied = [
+            rid for rid in candidates
+            if views[rid].capacity - headroom[rid] == best
+        ]
+        self._rr += 1
+        return tied[self._rr % len(tied)]
+
+
+class ReplicaSet:
+    """N supervised serving sessions of one engine factory, one front.
+
+    Build through :func:`open_replica_set`.  The request surface mirrors
+    :class:`~.handle.ServeHandle.request` plus ``sticky=`` (the
+    multi-turn session id); streams, results, deadlines, rejection
+    classification, and exactly-once delivery are all the supervisor's —
+    identical to the single-session tier.
+    """
+
+    def __init__(
+        self,
+        targets: list[Any],
+        factory: Any,
+        *,
+        replicas: int | None = None,
+        name: str = "",
+        sticky_ttl_s: float | None = None,
+        router_queue_max: int | None = None,
+        tenant_weights: dict[str, float] | None = None,
+        **session_options: Any,
+    ) -> None:
+        if not targets:
+            raise ValueError("a replica set needs at least one target")
+        self.name = name or f"rset-{uuid.uuid4().hex[:8]}"
+        self.factory = factory
+        self._targets = [self._split_target(t) for t in targets]
+        self.replicas_wanted = int(
+            replicas if replicas is not None else len(self._targets)
+        )
+        if self.replicas_wanted < 1:
+            raise ValueError(
+                f"replicas must be >= 1, got {self.replicas_wanted}"
+            )
+        self._session_options = dict(session_options)
+        self._router_queue_max = router_queue_max
+        self.router = ReplicaRouter(
+            weights=tenant_weights,
+            sticky_ttl_s=(
+                300.0 if sticky_ttl_s is None else float(sticky_ttl_s)
+            ),
+            queue_max=0,  # resized once replica capacity is known
+        )
+        #: replica id -> supervisor (dead replicas leave; closed leave).
+        self._replicas: dict[str, SessionSupervisor] = {}
+        #: replica id -> (executor, pool) it was placed on.
+        self._placements: dict[str, tuple[Any, Any]] = {}
+        self._payload: bytes | None = None
+        self._digest = ""
+        self._next_rid = 0
+        self._next_replica = 0
+        self._closed = False
+        self._pump_tasks: set[asyncio.Task] = set()
+        #: recent router decision walls (the <1ms bench assertion reads
+        #: the same numbers the histogram observes).
+        self.decision_s: collections.deque = collections.deque(maxlen=4096)
+
+    @staticmethod
+    def _split_target(target: Any) -> tuple[Any, Any]:
+        """(executor, pool-or-None) from a Pool or a bare executor."""
+        if hasattr(target, "spec") and hasattr(target, "executor"):
+            return target.executor, target
+        return target, None
+
+    # -- views --------------------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        if self._closed:
+            return "closed"
+        states = {sup.state for sup in self._replicas.values()}
+        if "open" in states:
+            return "open"
+        if "reconnecting" in states:
+            return "reconnecting"
+        return "failed"
+
+    @property
+    def supervisors(self) -> dict[str, SessionSupervisor]:
+        return dict(self._replicas)
+
+    @property
+    def in_flight(self) -> int:
+        return sum(sup.in_flight for sup in self._replicas.values())
+
+    @property
+    def served(self) -> int:
+        return sum(sup.served for sup in self._replicas.values())
+
+    @property
+    def reconnects(self) -> int:
+        return sum(sup.reconnects for sup in self._replicas.values())
+
+    def _views(self) -> dict[str, ReplicaView]:
+        views: dict[str, ReplicaView] = {}
+        for rid, sup in self._replicas.items():
+            # A replica's routable capacity mirrors the worker's own
+            # bound (engine slots + admission queue): the router sheds
+            # before the worker would, so worker-side sheds only happen
+            # to callers bypassing the set.
+            capacity = max(1, sup.slots) + max(0, sup.queue_max)
+            views[rid] = ReplicaView(
+                rid,
+                open=sup.routable,
+                alive=sup.alive,
+                load=sup.in_flight,
+                capacity=capacity,
+            )
+        return views
+
+    def status(self) -> dict[str, Any]:
+        """The set's contribution to operator views (bench + smoke)."""
+        decisions = sorted(self.decision_s)
+        p50 = decisions[len(decisions) // 2] if decisions else 0.0
+        return {
+            "name": self.name,
+            "state": self.state,
+            "replicas": {
+                rid: sup.status() for rid, sup in self._replicas.items()
+            },
+            "in_flight": self.in_flight,
+            "served": self.served,
+            "reconnects": self.reconnects,
+            "queued": self.router.queued,
+            "sticky": self.router.sticky_count(),
+            "router_decision_p50_ms": round(p50 * 1e3, 4),
+        }
+
+    def _publish_replica_states(self) -> None:
+        counts = {state: 0 for state in _REPLICA_STATES}
+        for sup in self._replicas.values():
+            counts[sup.state] = counts.get(sup.state, 0) + 1
+        for state in _REPLICA_STATES:
+            SERVE_REPLICAS.labels(set=self.name, state=state).set(
+                counts[state]
+            )
+
+    # -- open / placement ---------------------------------------------------
+
+    async def _open(self) -> "ReplicaSet":
+        with Span("serve.replica_set_open", {"set": self.name}):
+            self._payload = await asyncio.to_thread(
+                cloudpickle.dumps, self.factory
+            )
+            self._digest = bytes_digest(self._payload)
+            opened = await asyncio.gather(
+                *(self._open_replica() for _ in range(self.replicas_wanted)),
+                return_exceptions=True,
+            )
+        failures = [r for r in opened if isinstance(r, BaseException)]
+        if len(failures) == len(opened):
+            raise ServeError(
+                f"replica set {self.name}: every replica open failed"
+            ) from failures[0]
+        for failure in failures:
+            app_log.warning(
+                "replica set %s: a replica failed to open (%r); "
+                "continuing degraded", self.name, failure,
+            )
+        if self._router_queue_max is None:
+            # Default admission bound: the whole set's worker-side
+            # capacity again as router backlog — past that, shedding is
+            # the honest verdict (same rationale as the worker queue).
+            total = sum(
+                view.capacity for view in self._views().values()
+            )
+            self.router.set_queue_max(max(1, total))
+        else:
+            self.router.set_queue_max(self._router_queue_max)
+        self._publish_replica_states()
+        obs_events.emit(
+            "serve.replica_set_opened",
+            set=self.name,
+            replicas=len(self._replicas),
+            wanted=self.replicas_wanted,
+        )
+        return self
+
+    def _rank_targets(self) -> list[tuple[Any, Any]]:
+        """Placement order for the next replica.
+
+        Spread first (fewest replicas of THIS set already on the
+        target), then the serving analog of fn-digest affinity: a target
+        whose gang already holds the factory's CAS digest re-opens with
+        zero staging, then warm gangs over cold, then free pool slots.
+        """
+        assigned: dict[int, int] = {}
+        for executor, _pool in self._placements.values():
+            assigned[id(executor)] = assigned.get(id(executor), 0) + 1
+
+        def rank(entry: tuple[Any, Any]):
+            executor, pool = entry
+            # Pool targets go through the Pool's own probe (it guards
+            # cold/stub executors); bare executors are probed directly.
+            holds = getattr(
+                pool if pool is not None else executor,
+                "holds_serve_digest", None,
+            )
+            affinity = False
+            if holds is not None:
+                try:
+                    affinity = bool(holds(self._digest))
+                except Exception:  # noqa: BLE001 - ranking is best-effort
+                    affinity = False
+            warm = bool(getattr(executor, "is_warm", False))
+            free = pool.free_slots if pool is not None else 0
+            return (
+                assigned.get(id(executor), 0),
+                not affinity,
+                not warm,
+                -free,
+            )
+
+        return sorted(self._targets, key=rank)
+
+    async def _open_replica(self) -> SessionSupervisor:
+        index = self._next_replica
+        self._next_replica += 1
+        replica_id = f"r{index}"
+        executor, pool = self._rank_targets()[0]
+        self._placements[replica_id] = (executor, pool)
+        supervisor = SessionSupervisor(
+            executor,
+            sid=f"{self.name}:{replica_id}",
+            pool=pool,
+            replica_of=(self.name, replica_id),
+            on_change=self._on_replica_change,
+            on_failed=self._on_replica_failed,
+            **self._session_options,
+        )
+        self._replicas[replica_id] = supervisor
+        try:
+            assert self._payload is not None
+            await supervisor.open(self._payload, self._digest)
+        except BaseException:
+            self._replicas.pop(replica_id, None)
+            self._placements.pop(replica_id, None)
+            raise
+        self._publish_replica_states()
+        return supervisor
+
+    # -- requests -----------------------------------------------------------
+
+    async def request(
+        self,
+        prompt,
+        params: dict | None = None,
+        deadline_s: float | None = None,
+        tenant: str = "",
+        sticky: str = "",
+    ) -> ServeRequest:
+        """Submit one request through the router; returns its stream.
+
+        ``sticky`` names the caller's multi-turn session: its requests
+        pin to one replica until ``sticky_ttl_s`` of silence (or the
+        replica's death).  A request the router cannot place immediately
+        waits in the per-tenant DRR queue and dispatches as lanes free —
+        its stream just starts later.  A full router queue sheds with
+        :class:`ServeRequestRejected` (``serve_admission_shed``).
+        """
+        if self._closed:
+            raise ServeError(f"replica set {self.name} is closed")
+        live = [s for s in self._replicas.values() if s.alive]
+        if not live:
+            raise ServeError(
+                f"replica set {self.name} has no live replicas"
+            )
+        self._next_rid += 1
+        rid = f"{self.name}-r{self._next_rid}"
+        request = ServeRequest(
+            rid,
+            [int(t) for t in prompt],
+            params,
+            (
+                self._default_deadline_s()
+                if deadline_s is None
+                else deadline_s
+            ),
+            tenant,
+        )
+        request.sticky = sticky
+        item = WorkItem(
+            fn=None, args=(), kwargs={},
+            task_metadata={"request": request, "sticky": sticky},
+            tenant=tenant or DEFAULT_TENANT,
+        )
+        t0 = time.perf_counter()
+        try:
+            self.router.submit(item)
+        except QueueFullError as err:
+            SERVE_ROUTER_DECISIONS_TOTAL.labels(outcome="shed").inc()
+            rejection = ServeRequestRejected(
+                rid, "serve_admission_shed", str(err)
+            )
+            request._fail(rejection)
+            raise rejection from None
+        assignments = self.router.pump(self._views())
+        elapsed = time.perf_counter() - t0
+        self.decision_s.append(elapsed)
+        SERVE_ROUTER_DECISION_SECONDS.observe(elapsed)
+        placed = {id(i) for i, _, _ in assignments}
+        if id(item) not in placed:
+            SERVE_ROUTER_DECISIONS_TOTAL.labels(outcome="queued").inc()
+        await self._dispatch_assignments(assignments)
+        return request
+
+    def _default_deadline_s(self) -> float:
+        for sup in self._replicas.values():
+            return sup.default_deadline_s
+        return 0.0
+
+    async def _dispatch_assignments(
+        self, assignments: list[tuple[WorkItem, str, str]]
+    ) -> None:
+        for item, replica_id, outcome in assignments:
+            SERVE_ROUTER_DECISIONS_TOTAL.labels(outcome=outcome).inc()
+            request = item.task_metadata["request"]
+            supervisor = self._replicas.get(replica_id)
+            if supervisor is None or not supervisor.alive:
+                self._reroute(request, item.task_metadata.get("sticky", ""))
+                continue
+            try:
+                await supervisor.submit(
+                    request, fail_on_error=False, wait_ready=False,
+                )
+            except Exception as err:  # noqa: BLE001 - re-route, not fail
+                if request.done:
+                    continue
+                app_log.debug(
+                    "replica %s submit failed (%s); re-routing %s",
+                    replica_id, err, request.rid,
+                )
+                self._reroute(
+                    request, item.task_metadata.get("sticky", "")
+                )
+
+    def _reroute(self, request: ServeRequest, sticky: str = "") -> None:
+        """Queue a request again after its replica died under it.
+
+        The sticky key defaults to the one the request was submitted
+        with, so a drain-on-death re-route keeps (or re-establishes) the
+        caller's pin on whatever survivor takes the stream.
+        """
+        sticky = sticky or request.sticky
+        if request.done:
+            return
+        live = [s for s in self._replicas.values() if s.alive]
+        if not live or self._closed:
+            request._fail(ServeError(
+                f"replica set {self.name}: no live replica to re-route "
+                f"{request.rid} onto"
+            ))
+            return
+        SERVE_ROUTER_DECISIONS_TOTAL.labels(outcome="failover").inc()
+        item = WorkItem(
+            fn=None, args=(), kwargs={},
+            task_metadata={"request": request, "sticky": sticky},
+            tenant=request.tenant or DEFAULT_TENANT,
+        )
+        try:
+            self.router.submit(item)
+        except QueueFullError as err:
+            request._fail(ServeRequestRejected(
+                request.rid, "serve_admission_shed", str(err)
+            ))
+            return
+        self._schedule_pump()
+
+    # -- health hooks (supervisor callbacks, event-loop context) ------------
+
+    def _on_replica_change(self, _supervisor: SessionSupervisor) -> None:
+        self._publish_replica_states()
+        if not self._closed and self.router.queued:
+            self._schedule_pump()
+
+    def _on_replica_failed(
+        self, supervisor: SessionSupervisor, failure: BaseException
+    ) -> bool:
+        """Drain-on-death: a replica past its retry budget hands its
+        in-flight requests here; survivors absorb them exactly-once (the
+        requests keep their token high-water marks, so the fresh
+        replica's from-zero streams splice with no duplicate and no
+        hole).  Returns True — the supervisor must not fail them."""
+        replica_id = (
+            supervisor.replica_of[1]
+            if supervisor.replica_of
+            else supervisor.sid
+        )
+        detached = supervisor.detach_requests()
+        self.router.forget_replica(replica_id)
+        obs_events.emit(
+            "serve.replica_failed",
+            set=self.name,
+            replica=replica_id,
+            error=repr(failure),
+            rerouted=len(detached),
+        )
+        for request in detached:
+            self._reroute(request)
+        if not any(s.alive for s in self._replicas.values()):
+            # The LAST replica just died: nothing will ever pump the
+            # router queue again, so its waiters fail now with the cause
+            # instead of hanging until the set closes.
+            for item in self.router.drain():
+                request = item.task_metadata.get("request")
+                if request is not None and not request.done:
+                    request._fail(ServeError(
+                        f"replica set {self.name} has no live replicas: "
+                        f"{failure}"
+                    ))
+        self._publish_replica_states()
+        return True
+
+    def _schedule_pump(self) -> None:
+        task = asyncio.ensure_future(self._pump())
+        self._pump_tasks.add(task)
+        task.add_done_callback(
+            lambda t: (
+                self._pump_tasks.discard(t),
+                None if t.cancelled() else t.exception(),
+            )
+        )
+
+    async def _pump(self) -> None:
+        if self._closed:
+            return
+        t0 = time.perf_counter()
+        assignments = self.router.pump(self._views())
+        if assignments:
+            elapsed = time.perf_counter() - t0
+            self.decision_s.append(elapsed / len(assignments))
+            SERVE_ROUTER_DECISION_SECONDS.observe(
+                elapsed / len(assignments)
+            )
+            await self._dispatch_assignments(assignments)
+
+    # -- scaling ------------------------------------------------------------
+
+    async def scale_to(self, replicas: int) -> int:
+        """Grow or shrink the live replica count; returns the new count.
+
+        Scale-up opens fresh sessions on affinity-ranked targets
+        (concurrently); scale-down retires the least-loaded replicas —
+        each stops receiving new work, drain-closes (the worker finishes
+        every admitted and queued request first), releases its fleet
+        capacity pin, and reaps its per-session AND per-replica metric
+        series through the supervisor's ``_drop_live``.
+        """
+        if self._closed:
+            raise ServeError(f"replica set {self.name} is closed")
+        replicas = int(replicas)
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        live = {
+            rid: sup for rid, sup in self._replicas.items() if sup.alive
+        }
+        if replicas > len(live):
+            grow = replicas - len(live)
+            results = await asyncio.gather(
+                *(self._open_replica() for _ in range(grow)),
+                return_exceptions=True,
+            )
+            for failure in results:
+                if isinstance(failure, BaseException):
+                    app_log.warning(
+                        "replica set %s scale-up open failed: %r",
+                        self.name, failure,
+                    )
+            self._schedule_pump()
+        elif replicas < len(live):
+            victims = sorted(
+                live, key=lambda rid: live[rid].in_flight
+            )[: len(live) - replicas]
+            for rid in victims:
+                await self._retire_replica(rid)
+        self.replicas_wanted = replicas
+        self._publish_replica_states()
+        obs_events.emit(
+            "serve.replica_set_scaled",
+            set=self.name,
+            replicas=len([
+                s for s in self._replicas.values() if s.alive
+            ]),
+        )
+        return len([s for s in self._replicas.values() if s.alive])
+
+    async def _retire_replica(self, replica_id: str) -> None:
+        supervisor = self._replicas.pop(replica_id, None)
+        self._placements.pop(replica_id, None)
+        if supervisor is None:
+            return
+        self.router.forget_replica(replica_id)
+        try:
+            await supervisor.close()
+        except Exception as err:  # noqa: BLE001 - teardown is best-effort
+            app_log.warning(
+                "replica %s:%s close failed: %s",
+                self.name, replica_id, err,
+            )
+
+    # -- close --------------------------------------------------------------
+
+    async def close(self, timeout: float = 30.0) -> dict:
+        """Drain and close every replica; returns merged closed stats."""
+        if self._closed:
+            return {"served": self.served}
+        self._closed = True
+        for task in list(self._pump_tasks):
+            task.cancel()
+        for item in self.router.drain():
+            request = item.task_metadata.get("request")
+            if request is not None and not request.done:
+                request._fail(
+                    ServeError(f"replica set {self.name} closed")
+                )
+        served = 0
+        closes = await asyncio.gather(
+            *(
+                sup.close(timeout)
+                for sup in list(self._replicas.values())
+            ),
+            return_exceptions=True,
+        )
+        for closed in closes:
+            if isinstance(closed, dict):
+                served += int(closed.get("served") or 0)
+        for state in _REPLICA_STATES:
+            SERVE_REPLICAS.remove(set=self.name, state=state)
+        obs_events.emit(
+            "serve.replica_set_closed", set=self.name, served=served
+        )
+        return {"served": served}
+
+
+async def open_replica_set(
+    targets: Any,
+    factory: Any,
+    *,
+    replicas: int | None = None,
+    name: str = "",
+    sticky_ttl_s: float | None = None,
+    router_queue_max: int | None = None,
+    tenant_weights: dict[str, float] | None = None,
+    **session_options: Any,
+) -> ReplicaSet:
+    """Open ``replicas`` sessions of one factory behind a routing front.
+
+    ``targets`` is a list of fleet ``Pool``\\ s and/or ``TPUExecutor``\\ s
+    (one entry also works); ``replicas`` defaults to ``len(targets)``.
+    Replicas place onto targets spread-first, then by factory-digest
+    affinity / warmth / free slots; a pool-backed replica pins one of its
+    pool's capacity slots for its lifetime.  ``session_options`` are the
+    per-session knobs ``open_session`` takes (``queue_max``,
+    ``default_deadline_s``, ``stats_interval_s``, ``open_timeout_s``,
+    ``retries``).
+    """
+    if not isinstance(targets, (list, tuple)):
+        targets = [targets]
+    replica_set = ReplicaSet(
+        list(targets),
+        factory,
+        replicas=replicas,
+        name=name,
+        sticky_ttl_s=sticky_ttl_s,
+        router_queue_max=router_queue_max,
+        tenant_weights=tenant_weights,
+        **session_options,
+    )
+    return await replica_set._open()

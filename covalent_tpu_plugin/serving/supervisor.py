@@ -1,0 +1,895 @@
+"""One supervised serving session: open, stream, reconnect, replay.
+
+The serving tier splits into two concerns that PR 9 originally fused
+inside ``ServeHandle``:
+
+* **Supervision** (this module) — owning ONE remote session generation:
+  lease a gang, ship the factory by CAS digest, watch the side-band,
+  reconnect on channel death with jittered bounded retries, and replay
+  in-flight requests with the exactly-once ``idx`` splice.
+* **Routing / multiplexing** (``handle.py``, ``replicas.py``) — deciding
+  WHICH supervised session a caller's request lands on.  A
+  :class:`~.handle.ServeHandle` fronts one supervisor; a
+  :class:`~.replicas.ReplicaSet` fronts N of them behind a
+  session-aware router — neither re-implements any replay machinery.
+
+A :class:`SessionSupervisor` registers itself in the executor's
+``_serve_handles`` book (so ``/status``, ``pool.status()`` and the
+profile-target pinning see every live session, replica or not), pins one
+fleet capacity slot when opened through a pool, and reaps its gauge
+series through ``_drop_live`` on every terminal path.
+
+Because a replayed (or re-routed) stream restarts from token 0 and is
+spliced on the request's token high-water mark, any supervisor can pick
+up any :class:`ServeRequest` mid-stream: the request object carries the
+splice state, not the session.  That is what lets a replica set drain a
+dying session's callers onto survivors without duplicating a token.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+import uuid
+from typing import Any, AsyncIterator, Callable
+
+from ..agent import HARNESS_BASENAME, AgentClient, AgentError
+from ..cache import bytes_digest, cas_path
+from ..obs import events as obs_events
+from ..resilience import FaultClass, RetryPolicy, classify_error
+from ..transport.base import TransportError
+from ..utils.log import app_log
+from .metrics import (
+    SERVE_QUEUE_DEPTH,
+    SERVE_RECONNECTS_TOTAL,
+    SERVE_REPLICA_IN_FLIGHT,
+    SERVE_REPLICA_REQUESTS_TOTAL,
+    SERVE_REQUEST_SECONDS,
+    SERVE_REQUESTS_TOTAL,
+    SERVE_SESSIONS,
+    SERVE_TOKENS_PER_S,
+    SERVE_TOKENS_TOTAL,
+    SERVE_TTFT_SECONDS,
+    SERVE_WORKER_SLOTS,
+)
+
+__all__ = [
+    "ServeError",
+    "ServeRequest",
+    "ServeRequestRejected",
+    "SessionSupervisor",
+]
+
+
+def _env_number(name: str, default: float, cast=float):
+    value = os.environ.get(name)
+    if value is None:
+        return default
+    try:
+        return cast(value)
+    except (TypeError, ValueError):
+        app_log.warning("ignoring non-numeric %s=%r", name, value)
+        return default
+
+
+class ServeError(RuntimeError):
+    """Session-level failure (open refused, stream torn, handle closed)."""
+
+
+class ServeRequestRejected(ServeError):
+    """One request refused by the worker (shed, unknown session, engine).
+
+    Duck-tagged for :func:`~..resilience.classify_error`: an admission
+    shed is PERMANENT under the ``serve_admission_shed`` label — the
+    bounded queue refused the work *because* the session is overloaded,
+    and a gang retry would amplify exactly that.  A lost session
+    (``unknown_session`` racing a worker restart) stays transient: the
+    handle's reconnect re-opens it.
+    """
+
+    def __init__(self, rid: str, code: str, message: str) -> None:
+        super().__init__(f"request {rid} rejected ({code}): {message}")
+        self.rid = rid
+        self.code = code
+        if code == "serve_admission_shed":
+            self.fault_label = "serve_admission_shed"
+            self.fault_transient = False
+        elif code == "unknown_session":
+            self.fault_label = "serve_session_lost"
+            self.fault_transient = True
+        else:
+            self.fault_label = f"serve_{code or 'rejected'}"
+            self.fault_transient = False
+
+
+class ServeRequest:
+    """One in-flight request's stream state (created by the front-end).
+
+    ``stream()`` yields token chunks as they arrive; ``result()`` awaits
+    the final token list.  A request that hit its deadline completes
+    normally with the partial stream and ``error == "deadline_exceeded"``
+    (the tokens generated before the reclaim are real); a *rejected*
+    request raises :class:`ServeRequestRejected` from both surfaces.
+
+    The request carries its own splice state (the ``tokens`` high-water
+    mark), so a replayed — or re-routed — stream can be picked up by a
+    different supervisor with exactly-once delivery intact.
+    """
+
+    def __init__(
+        self,
+        rid: str,
+        prompt: list[int],
+        params: dict | None,
+        deadline_s: float,
+        tenant: str,
+    ) -> None:
+        self.rid = rid
+        self.prompt = prompt
+        self.params = dict(params or {})
+        self.deadline_s = float(deadline_s)
+        self.tenant = tenant
+        #: the caller's multi-turn session key (set by a replica set);
+        #: rides the request so a drain-on-death re-route keeps the pin.
+        self.sticky = ""
+        self.tokens: list[int] = []
+        self.error: str = ""
+        self.t_submit = time.monotonic()
+        self.t_first: float | None = None
+        self.t_done: float | None = None
+        self._chunks: asyncio.Queue = asyncio.Queue()
+        self._done: asyncio.Future = asyncio.get_event_loop().create_future()
+        # Unawaited failures must not warn at GC: a caller may only ever
+        # consume stream(), or fire-and-forget a best-effort request.
+        self._done.add_done_callback(
+            lambda f: None if f.cancelled() else f.exception()
+        )
+
+    @property
+    def done(self) -> bool:
+        return self._done.done()
+
+    @property
+    def ttft_s(self) -> float | None:
+        """Submit -> first streamed token (None until one arrived)."""
+        if self.t_first is None:
+            return None
+        return self.t_first - self.t_submit
+
+    @property
+    def latency_s(self) -> float | None:
+        if self.t_done is None:
+            return None
+        return self.t_done - self.t_submit
+
+    async def result(self, timeout: float | None = None) -> list[int]:
+        """The full token stream (prompt excluded); raises on rejection."""
+        return await asyncio.wait_for(asyncio.shield(self._done), timeout)
+
+    async def stream(self) -> AsyncIterator[list[int]]:
+        """Yield token chunks in arrival order until the stream closes."""
+        while True:
+            item = await self._chunks.get()
+            if item is None:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+
+    # -- supervisor-side feeding (event-loop context only) -----------------
+
+    def _feed(self, tokens: list[int], done: bool, error: str = "") -> None:
+        if self._done.done():
+            return
+        if tokens:
+            if self.t_first is None:
+                self.t_first = time.monotonic()
+            self.tokens.extend(tokens)
+            self._chunks.put_nowait(list(tokens))
+        if done:
+            self.t_done = time.monotonic()
+            self.error = error
+            self._chunks.put_nowait(None)
+            self._done.set_result(list(self.tokens))
+
+    def _fail(self, err: BaseException) -> None:
+        if self._done.done():
+            return
+        self.t_done = time.monotonic()
+        self._chunks.put_nowait(err)
+        self._done.set_exception(err)
+
+
+class SessionSupervisor:
+    """One resident serving session, supervised for its whole life.
+
+    Owns the session's remote generations (lease, open, watch, reconnect,
+    replay, drain-close), the in-flight requests ASSIGNED to it, and the
+    per-session accounting (metrics series, fleet capacity pin, the
+    executor ``_serve_handles`` registration).  It does NOT decide which
+    requests it gets — that is the front-end's job (a handle's trivial
+    routing, or a replica set's router).
+
+    ``on_change(supervisor)`` fires on every state transition and request
+    completion (a router's pump signal); ``on_failed(supervisor, error)``
+    fires when the session dies past its retry budget — a front-end that
+    returns ``True`` from it has taken ownership of the in-flight
+    requests (via :meth:`detach_requests`) and re-routes them itself;
+    otherwise the supervisor fails them with the cause.
+
+    All methods must run on the executor's event loop.
+    """
+
+    def __init__(
+        self,
+        executor: Any,
+        *,
+        sid: str = "",
+        queue_max: int | None = None,
+        default_deadline_s: float | None = None,
+        stats_interval_s: float | None = None,
+        open_timeout_s: float | None = None,
+        retries: int | None = None,
+        pool: Any = None,
+        replica_of: tuple[str, str] | None = None,
+        on_change: Callable[["SessionSupervisor"], None] | None = None,
+        on_failed: Callable[
+            ["SessionSupervisor", BaseException], bool
+        ] | None = None,
+    ) -> None:
+        self.executor = executor
+        self.sid = sid or f"serve-{uuid.uuid4().hex[:10]}"
+        self.queue_max = int(
+            queue_max
+            if queue_max is not None
+            else _env_number("COVALENT_TPU_SERVE_QUEUE_MAX", 64, int)
+        )
+        self.default_deadline_s = float(
+            default_deadline_s
+            if default_deadline_s is not None
+            else _env_number("COVALENT_TPU_SERVE_DEADLINE_S", 0.0)
+        )
+        self.stats_interval_s = float(
+            stats_interval_s
+            if stats_interval_s is not None
+            else _env_number("COVALENT_TPU_SERVE_STATS_INTERVAL_S", 1.0)
+        )
+        self.open_timeout_s = float(
+            open_timeout_s
+            if open_timeout_s is not None
+            else _env_number("COVALENT_TPU_SERVE_OPEN_TIMEOUT_S", 120.0)
+        )
+        self.retries = int(
+            retries
+            if retries is not None
+            else _env_number("COVALENT_TPU_SERVE_RETRIES", 2, int)
+        )
+        self._pool = pool
+        #: (set name, replica id) when owned by a ReplicaSet — keys the
+        #: per-replica metric series; None for a standalone handle.
+        self.replica_of = replica_of
+        self._on_change = on_change
+        self._on_failed = on_failed
+        self.slots = 0
+        self.generation = 0
+        self.served = 0
+        self.reconnects = 0
+        self.opened_at = 0.0
+        self.stats: dict[str, Any] = {}
+        self.address = ""
+        self._payload: bytes | None = None
+        self._digest = ""
+        self._local_payload = ""
+        self._client: AgentClient | None = None
+        self._conns: list = []
+        self._sid_g = ""
+        self._requests: dict[str, ServeRequest] = {}
+        self._closed = False
+        self._failed: BaseException | None = None
+        self._ready = asyncio.Event()
+        self._supervisor: asyncio.Task | None = None
+        self._counted_live = False
+
+    # -- identity / views ---------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        if self._failed is not None:
+            return "failed"
+        if self._closed:
+            return "closed"
+        if not self._ready.is_set():
+            return "reconnecting"
+        return "open"
+
+    @property
+    def in_flight(self) -> int:
+        return len(self._requests)
+
+    @property
+    def routable(self) -> bool:
+        """Whether a router may assign NEW requests here right now."""
+        return self.state == "open"
+
+    @property
+    def alive(self) -> bool:
+        """Open or recovering — a sticky pin to this session still holds."""
+        return self.state in ("open", "reconnecting")
+
+    def status(self) -> dict[str, Any]:
+        """This session's contribution to ``/status`` / ``pool.status()``."""
+        view: dict[str, Any] = {
+            "state": self.state,
+            "address": self.address,
+            "slots": self.slots,
+            "generation": self.generation,
+            "served": self.served,
+            "in_flight": self.in_flight,
+            "reconnects": self.reconnects,
+            "age_s": (
+                round(time.time() - self.opened_at, 3) if self.opened_at else 0
+            ),
+        }
+        if self.replica_of is not None:
+            view["replica_set"] = self.replica_of[0]
+            view["replica"] = self.replica_of[1]
+        for field in ("busy", "queued", "tokens_per_s", "tokens_total"):
+            if field in self.stats:
+                view[field] = self.stats[field]
+        return view
+
+    def _changed(self) -> None:
+        if self._on_change is not None:
+            try:
+                self._on_change(self)
+            except Exception:  # noqa: BLE001 - router hooks never fatal
+                app_log.exception("serve on_change hook failed")
+
+    # -- open ---------------------------------------------------------------
+
+    async def open(
+        self, payload: bytes, digest: str = ""
+    ) -> "SessionSupervisor":
+        """First open: stage the factory payload, lease a gang, supervise.
+
+        ``payload`` is the cloudpickled factory; ``digest`` (its sha256)
+        may be precomputed by a replica set staging the same bytes N
+        times.
+        """
+        self._payload = payload
+        self._digest = digest or bytes_digest(payload)
+        self._local_payload = os.path.join(
+            self.executor.cache_dir, f"serve_{self._digest}.pkl"
+        )
+        await asyncio.to_thread(
+            self._write_payload, self._local_payload, self._payload
+        )
+        await self._open_generation()
+        self.opened_at = time.time()
+        handles = getattr(self.executor, "_serve_handles", None)
+        if handles is not None:
+            handles[self.sid] = self
+        if self._pool is not None:
+            # A session IS long-lived load: pin one capacity slot so the
+            # fleet scheduler bin-packs electrons around it, not into it.
+            self._pool.place()
+        SERVE_SESSIONS.inc()
+        self._counted_live = True
+        if self.replica_of is not None:
+            SERVE_REPLICA_IN_FLIGHT.labels(
+                set=self.replica_of[0], replica=self.replica_of[1]
+            ).set(0)
+        self._supervisor = asyncio.ensure_future(self._supervise())
+        self._ready.set()
+        obs_events.emit(
+            "serve.session_opened",
+            sid=self.sid,
+            address=self.address,
+            slots=self.slots,
+        )
+        return self
+
+    @staticmethod
+    def _write_payload(path: str, payload: bytes) -> None:
+        if os.path.exists(path):
+            return
+        tmp = f"{path}.tmp.{os.getpid()}.{os.urandom(4).hex()}"
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, path)
+
+    async def _open_generation(self) -> None:
+        """Open one remote session generation on a freshly leased gang.
+
+        Failures discard whatever channels the attempt dialed (the
+        ``lease_gang(dialed=)`` contract): a pre-flight or ``serve_open``
+        refusal would otherwise leave the just-proved-broken transports
+        pooled, and every reconnect retry would silently reuse them.
+        """
+        dialed: list = []
+        try:
+            await self._open_generation_on(dialed)
+        except BaseException:
+            if dialed:
+                try:
+                    await self.executor._discard_workers(dialed)
+                except Exception:  # noqa: BLE001 - teardown is best-effort
+                    pass
+            raise
+
+    async def _open_generation_on(self, dialed: list) -> None:
+        executor = self.executor
+        lease = await executor.lease_gang(dialed=dialed)
+        conns, addresses = lease.conns, lease.addresses
+        if len(conns) != 1:
+            raise ServeError(
+                f"serving sessions target single-worker gangs, got "
+                f"{len(conns)} workers (shard inside the engine instead)"
+            )
+        conn, address = conns[0], addresses[0]
+        client = executor._agents.get(conn.address)
+        if client is None or not client.alive:
+            raise AgentError(
+                f"no resident agent runtime on {address} "
+                "(serving needs use_agent enabled)"
+            )
+        key = executor._pool_key(address)
+        remote = cas_path(executor.remote_cache, self._digest, ".pkl")
+        codec = executor._codec_for(key, conn)
+        await executor._cas.ensure_probed(
+            key, conn, [(self._digest, remote)]
+        )
+        await executor._cas.ensure(
+            key, conn, self._digest, self._local_payload, remote,
+            codec=codec, python_path=executor.python_path,
+        )
+        runner = None
+        if client.mode != "pool":
+            # The native C++ agent only switches lines: it forks this
+            # runner argv to host the session (stdin pipe held open).
+            from .. import harness as harness_module
+
+            remote_harness = f"{executor.remote_cache}/{HARNESS_BASENAME}"
+            await conn.put(harness_module.__file__, remote_harness)
+            runner = [
+                executor.python_path, remote_harness, "--serve-child",
+            ]
+        sid_g = f"{self.sid}.g{self.generation}"
+        spec: dict[str, Any] = {"operation_id": sid_g}
+        if executor.task_env:
+            spec["env"] = dict(executor.task_env)
+        client.watch_serve(sid_g, self._sink)
+        try:
+            opened = await client.serve_open(
+                sid_g,
+                self._digest,
+                remote,
+                options={
+                    "queue_max": self.queue_max,
+                    "default_deadline_s": self.default_deadline_s,
+                    "stats_interval_s": self.stats_interval_s,
+                },
+                spec=spec,
+                runner=runner,
+                timeout=self.open_timeout_s,
+            )
+        except BaseException:
+            client.unwatch_serve(sid_g)
+            raise
+        self._client = client
+        self._conns = list(conns)
+        self._sid_g = sid_g
+        self.address = address
+        self.slots = int(opened.get("slots") or 1)
+        self.generation += 1
+
+    # -- requests -----------------------------------------------------------
+
+    async def submit(
+        self,
+        request: ServeRequest,
+        *,
+        fail_on_error: bool = True,
+        wait_ready: bool = True,
+    ) -> ServeRequest:
+        """Assign one request to this session and write its wire line.
+
+        Fire-and-stream: tokens arrive on the side-band.  Raises when
+        the write cannot be made (waiting out an in-progress reconnect
+        first by default); ``wait_ready=False`` refuses a non-routable
+        session IMMEDIATELY instead — a router must not head-of-line
+        block a whole assignment batch behind one replica's reconnect
+        when survivors are idle.  ``fail_on_error=False`` leaves the
+        request itself unfailed so that router can re-route it instead
+        of surfacing the error to the caller.
+        """
+        try:
+            if wait_ready:
+                await self._await_ready()
+            elif not self.routable:
+                raise ServeError(
+                    f"session {self.sid} is not routable ({self.state})"
+                )
+            self._requests[request.rid] = request
+            self._publish_in_flight()
+            try:
+                await self._send_request(request)
+            except BaseException:
+                self._requests.pop(request.rid, None)
+                self._publish_in_flight()
+                raise
+        except BaseException as err:
+            if fail_on_error:
+                SERVE_REQUESTS_TOTAL.labels(outcome="error").inc()
+                request._fail(
+                    err
+                    if isinstance(err, ServeError)
+                    else ServeError(f"request submit failed: {err!r}")
+                )
+            raise
+        if self.replica_of is not None:
+            SERVE_REPLICA_REQUESTS_TOTAL.labels(
+                set=self.replica_of[0], replica=self.replica_of[1]
+            ).inc()
+        return request
+
+    def detach_requests(self) -> list[ServeRequest]:
+        """Hand every in-flight request back WITHOUT failing or counting
+        it — the drain-on-death path: a replica set re-routes these onto
+        surviving sessions, and the requests' own token high-water marks
+        keep the splice exactly-once across the move."""
+        detached = list(self._requests.values())
+        self._requests.clear()
+        self._publish_in_flight()
+        return detached
+
+    async def _send_request(self, request: ServeRequest) -> None:
+        assert self._client is not None
+        await self._client.serve_request(
+            self._sid_g,
+            request.rid,
+            request.prompt,
+            params=request.params,
+            deadline_s=request.deadline_s,
+            tenant=request.tenant,
+        )
+
+    async def _await_ready(self) -> None:
+        if self._closed:
+            raise ServeError(f"session {self.sid} is closed")
+        while not self._ready.is_set():
+            await self._ready.wait()
+        if self._failed is not None:
+            raise ServeError(
+                f"session {self.sid} failed: {self._failed}"
+            ) from self._failed
+        if self._closed:
+            raise ServeError(f"session {self.sid} is closed")
+
+    def _publish_in_flight(self) -> None:
+        if self.replica_of is not None:
+            SERVE_REPLICA_IN_FLIGHT.labels(
+                set=self.replica_of[0], replica=self.replica_of[1]
+            ).set(float(len(self._requests)))
+
+    # -- side-band routing --------------------------------------------------
+
+    def _sink(self, _sid: str, data: dict) -> None:
+        """One telemetry record for this session (event-loop context)."""
+        kind = data.get("type")
+        if kind == "serve.token":
+            self._on_token(data)
+        elif kind == "serve.reject":
+            self._on_reject(data)
+        elif kind == "serve.stats":
+            self._on_stats(data)
+
+    def _on_token(self, data: dict) -> None:
+        rid = str(data.get("rid") or "")
+        request = self._requests.get(rid)
+        if request is None:
+            return
+        idx = int(data.get("idx") or 0)
+        tokens = list(data.get("tokens") or ())
+        have = len(request.tokens)
+        if idx > have:
+            # A chunk went missing (idx jumped past our high-water mark):
+            # the exactly-once contract is broken for this stream, fail
+            # it loudly rather than splice around a hole.
+            self._finish(rid, "error")
+            request._fail(ServeError(
+                f"token stream gap for {rid}: chunk starts at {idx}, "
+                f"have {have}"
+            ))
+            return
+        # Replay splice: after a reconnect (or a re-route onto another
+        # replica) the fresh session re-streams from idx 0; everything
+        # at-or-below our high-water mark is a duplicate and drops here,
+        # so callers see each token exactly once.
+        fresh = tokens[have - idx:] if idx < have else tokens
+        first = request.t_first is None and bool(fresh)
+        done = bool(data.get("done"))
+        error = str(data.get("error") or "")
+        request._feed(fresh, done, error=error)
+        if fresh:
+            SERVE_TOKENS_TOTAL.inc(len(fresh))
+        if first and request.ttft_s is not None:
+            SERVE_TTFT_SECONDS.observe(request.ttft_s)
+        if done:
+            outcome = "ok"
+            if error == "deadline_exceeded":
+                outcome = "deadline"
+            elif error:
+                outcome = "error"
+            self._finish(rid, outcome)
+            if request.latency_s is not None:
+                SERVE_REQUEST_SECONDS.observe(request.latency_s)
+
+    def _on_reject(self, data: dict) -> None:
+        rid = str(data.get("rid") or "")
+        request = self._requests.get(rid)
+        if request is None:
+            return
+        code = str(data.get("code") or "rejected")
+        if code == "unknown_session" and not self._ready.is_set():
+            # Raced a dying generation; the reconnect replay will re-send
+            # this request on the fresh session.
+            return
+        self._finish(
+            rid, "shed" if code == "serve_admission_shed" else "rejected"
+        )
+        request._fail(ServeRequestRejected(
+            rid, code, str(data.get("message") or "")
+        ))
+
+    def _on_stats(self, data: dict) -> None:
+        self.stats = {
+            k: v for k, v in data.items()
+            if k in (
+                "slots", "busy", "queued", "served",
+                "tokens_total", "tokens_per_s",
+            )
+        }
+        SERVE_QUEUE_DEPTH.labels(session=self.sid).set(
+            float(self.stats.get("queued") or 0)
+        )
+        SERVE_TOKENS_PER_S.labels(session=self.sid).set(
+            float(self.stats.get("tokens_per_s") or 0.0)
+        )
+
+    def _finish(self, rid: str, outcome: str) -> None:
+        if self._requests.pop(rid, None) is not None:
+            self.served += 1
+            SERVE_REQUESTS_TOTAL.labels(outcome=outcome).inc()
+            self._publish_in_flight()
+            self._changed()
+
+    # -- supervision / reconnect --------------------------------------------
+
+    async def _supervise(self) -> None:
+        """Re-open the session on a fresh gang when its channel dies."""
+        while True:
+            client = self._client
+            if client is None:
+                return
+            try:
+                await client.wait_dead()
+            except asyncio.CancelledError:
+                raise
+            except BaseException as err:  # noqa: BLE001 - AgentError et al.
+                death = err
+            else:  # pragma: no cover - wait_dead only returns by raising
+                death = AgentError("agent channel closed")
+            if self._closed:
+                return
+            obs_events.emit(
+                "serve.session_lost",
+                sid=self.sid,
+                address=self.address,
+                error=repr(death),
+            )
+            if not await self._reconnect(death):
+                return
+
+    async def _reconnect(self, death: BaseException) -> bool:
+        """Tear down, re-lease, re-open, replay — or fail every stream."""
+        self._ready.clear()
+        self._changed()
+        old_client, old_sid = self._client, self._sid_g
+        if old_client is not None:
+            old_client.unwatch_serve(old_sid)
+        try:
+            await self.executor._discard_workers(self._conns)
+        except Exception:  # noqa: BLE001 - teardown is best-effort
+            pass
+        fault, _label = classify_error(death)
+        failure: BaseException = death
+        if fault is FaultClass.TRANSIENT:
+            policy = RetryPolicy(
+                max_retries=self.retries,
+                base_delay=getattr(self.executor, "retry_base_delay", 0.25),
+                max_delay=getattr(self.executor, "retry_max_delay", 10.0),
+            )
+            for attempt in range(self.retries + 1):
+                if self._closed:
+                    return False
+                try:
+                    await self._open_generation()
+                except asyncio.CancelledError:
+                    raise
+                except (
+                    AgentError, TransportError, ServeError, OSError,
+                    ValueError,
+                ) as err:
+                    failure = err
+                    fault, _label = classify_error(err)
+                    if fault is not FaultClass.TRANSIENT:
+                        break
+                    if attempt < self.retries:
+                        await asyncio.sleep(policy.delay(attempt))
+                else:
+                    self.reconnects += 1
+                    SERVE_RECONNECTS_TOTAL.inc()
+                    obs_events.emit(
+                        "serve.session_reopened",
+                        sid=self.sid,
+                        address=self.address,
+                        generation=self.generation,
+                        replayed=len(self._requests),
+                    )
+                    await self._replay_in_flight()
+                    self._ready.set()
+                    self._changed()
+                    return True
+        # Permanent refusal or retry budget spent: the front-end may take
+        # the in-flight requests (a replica set drains them onto
+        # survivors); otherwise every stream fails with the cause.  New
+        # requests are refused either way until the caller closes.
+        self._failed = failure
+        handled = False
+        if self._on_failed is not None:
+            try:
+                handled = bool(self._on_failed(self, failure))
+            except Exception:  # noqa: BLE001 - router hooks never fatal
+                app_log.exception("serve on_failed hook failed")
+        if not handled:
+            for rid, request in list(self._requests.items()):
+                self._finish(rid, "error")
+                request._fail(ServeError(
+                    f"session {self.sid} died and could not be re-opened: "
+                    f"{failure}"
+                ))
+        self._ready.set()
+        self._drop_live()
+        self._changed()
+        return False
+
+    async def _replay_in_flight(self) -> None:
+        """Re-send unfinished requests on the fresh generation.
+
+        The new session streams each from idx 0; the splice in
+        :meth:`_on_token` drops the already-delivered prefix, so callers
+        observe every token exactly once with none lost.
+        """
+        for request in list(self._requests.values()):
+            try:
+                await self._send_request(request)
+            except BaseException as err:  # noqa: BLE001 - fail just this one
+                self._finish(request.rid, "error")
+                request._fail(ServeError(
+                    f"replay of {request.rid} failed: {err!r}"
+                ))
+
+    # -- close --------------------------------------------------------------
+
+    async def close(self, timeout: float = 30.0) -> dict:
+        """Drain and close the session; returns the ``serve_closed`` stats.
+
+        The worker finishes every admitted AND queued request before
+        acking (their tokens keep streaming during the drain); requests
+        that raced a dead channel past the retry budget have already
+        failed.  Idempotent.
+        """
+        if self._closed:
+            return {"served": self.served}
+        self._closed = True
+        if self._supervisor is not None:
+            self._supervisor.cancel()
+        closed_event: dict = {"served": self.served}
+        client, sid_g = self._client, self._sid_g
+        if client is not None and self._failed is None:
+            try:
+                closed_event = await client.serve_close(sid_g, timeout)
+            except (AgentError, TransportError, asyncio.TimeoutError) as err:
+                app_log.debug("serve_close %s failed: %s", sid_g, err)
+            client.unwatch_serve(sid_g)
+        for rid, request in list(self._requests.items()):
+            self._finish(rid, "error")
+            request._fail(ServeError(f"session {self.sid} closed"))
+        handles = getattr(self.executor, "_serve_handles", None)
+        if handles is not None:
+            handles.pop(self.sid, None)
+        self._drop_live()
+        obs_events.emit(
+            "serve.session_closed",
+            sid=self.sid,
+            served=int(closed_event.get("served") or 0),
+        )
+        self._changed()
+        return closed_event
+
+    def _drop_live(self) -> None:
+        if self._counted_live:
+            self._counted_live = False
+            SERVE_SESSIONS.dec()
+            if self._pool is not None:
+                self._pool.release()
+        # Stale-series reap: a retired session's gauges must leave the
+        # registry with it, or /metrics grows one orphan series pair per
+        # session for the process lifetime under session churn.  The
+        # worker-occupancy series go too once no other live session
+        # shares the worker (its heartbeats stop carrying a serve block
+        # the moment the last session closes, freezing stale values).
+        # One forced history sample FIRST: a short-lived session could
+        # otherwise live and die entirely between two sampler ticks,
+        # leaving no trace of its gauges in the /history timeline.
+        try:
+            from ..obs.history import HISTORY
+
+            HISTORY.sample(force=True)
+        except Exception:  # noqa: BLE001 - observability never fatal
+            pass
+        SERVE_QUEUE_DEPTH.remove(session=self.sid)
+        SERVE_TOKENS_PER_S.remove(session=self.sid)
+        if self.replica_of is not None:
+            SERVE_REPLICA_IN_FLIGHT.remove(
+                set=self.replica_of[0], replica=self.replica_of[1]
+            )
+            SERVE_REPLICA_REQUESTS_TOTAL.remove(
+                set=self.replica_of[0], replica=self.replica_of[1]
+            )
+        handles = getattr(self.executor, "_serve_handles", None) or {}
+        if self.address and not any(
+            h is not self and getattr(h, "address", "") == self.address
+            for h in list(handles.values())
+        ):
+            for state in ("sessions", "slots", "busy", "queued"):
+                SERVE_WORKER_SLOTS.remove(worker=self.address, state=state)
+
+    # -- profiling ----------------------------------------------------------
+
+    async def capture_profile(self, duration_s: float = 2.0) -> dict:
+        """Capture a ``jax.profiler`` trace of this session's resident
+        runtime while it serves live traffic.
+
+        Records for ``duration_s`` inside the worker process holding the
+        model (the pool server, or the native agent's ``--serve-child``
+        runner), stages the trace back as a content-addressed artifact and
+        digest-verifies it — no launch fallback, no second process.
+        Raises :class:`ServeError` when the capture fails (session down,
+        another trace already active, jax unavailable on the worker).
+        """
+        await self._await_ready()
+        client, conns = self._client, self._conns
+        if client is None or not conns:
+            raise ServeError(f"session {self.sid} has no live runtime")
+        profile_id = f"{self.sid}-prof{uuid.uuid4().hex[:6]}"
+        sid = self._sid_g if client.mode != "pool" else ""
+        started = await self.executor._start_resident_profile(
+            client, profile_id, sid=sid
+        )
+        if not started:
+            raise ServeError(
+                f"profiler start refused on session {self.sid} (busy or "
+                "unavailable)"
+            )
+        info = await self.executor._finish_capture(
+            client, conns[0], profile_id, duration_s, sid=sid
+        )
+        if not info:
+            raise ServeError(
+                f"profile capture on session {self.sid} produced no "
+                "artifact"
+            )
+        return {"sid": self.sid, "duration_s": float(duration_s), **info}

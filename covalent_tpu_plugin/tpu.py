@@ -802,6 +802,13 @@ class TPUExecutor(RemoteExecutor):
         connections (the fleet ``/status`` per-pool counter)."""
         return len(self._fn_registry.digests())
 
+    def holds_serve_digest(self, digest: str) -> bool:
+        """Whether this executor's gang already staged the given CAS
+        artifact (a serving factory payload) — replica warm-up affinity:
+        a holding gang re-opens a session of that factory with zero
+        staging round trips, the serving analog of fn-digest affinity."""
+        return self._cas.holds(digest)
+
     def in_flight_modes(self) -> dict[str, str]:
         """operation id -> dispatch mode for every in-flight electron."""
         return {

@@ -337,3 +337,133 @@ def test_engine_validation_and_typed_rolling_refusal():
     assert fault is FaultClass.PERMANENT
     assert label == "serve_model_unsupported"
     assert isinstance(refusal.value, ValueError)  # back-compat surface
+
+
+# ---------------------------------------------------------------------------
+# Shared-prefix prefill reuse (ISSUE 11): the prefix is prefilled once
+# per engine and its KV reused across requests that share it — greedy
+# streams must stay BIT-equal to both the no-reuse engine and the
+# batch-1 oracle, with strictly less prefill work, and a mismatched
+# prefix must fall back to the full-prefill path silently.
+# ---------------------------------------------------------------------------
+
+
+def test_engine_shared_prefix_streams_bit_equal():
+    """Greedy streams with and without prefix reuse are bit-equal on
+    ContinuousEngine (and equal to the generate() oracle), while the
+    reuse engine pays measurably fewer prefill positions."""
+    from covalent_tpu_plugin.models.serve import ContinuousEngine
+
+    model, params = shared()
+    prefix = np.asarray([5, 9, 2, 7, 11, 3, 8, 1, 4, 6], np.int32)
+    suffixes = [[12, 13], [20], [31, 32, 33], [40, 41]]
+    prompts = [
+        np.concatenate([prefix, np.asarray(s, np.int32)])
+        for s in suffixes
+    ]
+    requests = {f"r{i}": (p, 8) for i, p in enumerate(prompts)}
+
+    plain = ContinuousEngine(
+        model, params, max_batch=2, sync_steps=3, max_new_tokens=8,
+    )
+    plain_streams, _ = drive_engine(plain, dict(requests))
+    plain.close()
+
+    reuse = ContinuousEngine(
+        model, params, max_batch=2, sync_steps=3, max_new_tokens=8,
+        shared_prefix=prefix,
+    )
+    reuse_streams, _ = drive_engine(reuse, dict(requests))
+    reuse.close()
+
+    for i, p in enumerate(prompts):
+        want = oracle(model, params, p, 8)[p.size:]
+        np.testing.assert_array_equal(plain_streams[f"r{i}"], want)
+        np.testing.assert_array_equal(reuse_streams[f"r{i}"], want)
+    assert reuse.stats["prefix_hits"] == len(prompts)
+    assert reuse.stats["prefix_misses"] == 0
+    # The whole point: suffix-bucket prefill, not full-prompt prefill.
+    assert 0 < reuse.stats["prefill_positions"] < (
+        plain.stats["prefill_positions"]
+    ), (reuse.stats, plain.stats)
+
+
+def test_engine_shared_prefix_mismatch_falls_back():
+    """A prompt NOT extending the prefix — diverging content, equal to
+    the prefix, or shorter — takes the full-prefill road (miss counted)
+    and still matches the oracle bit-for-bit; hits and misses mix freely
+    in one admission flush."""
+    from covalent_tpu_plugin.models.serve import ContinuousEngine
+
+    model, params = shared()
+    prefix = np.asarray([5, 9, 2, 7, 11, 3], np.int32)
+    hit = np.concatenate([prefix, np.asarray([21, 22], np.int32)])
+    diverged = np.concatenate(
+        [prefix[:-1], np.asarray([60, 21, 22], np.int32)]
+    )
+    exact = prefix.copy()          # equal prompt: no suffix to prefill
+    short = prefix[:3].copy()      # shorter than the prefix
+    requests = {
+        "hit": (hit, 6), "div": (diverged, 6),
+        "exact": (exact, 6), "short": (short, 6),
+    }
+    engine = ContinuousEngine(
+        model, params, max_batch=2, sync_steps=2, max_new_tokens=6,
+        shared_prefix=prefix,
+    )
+    streams, _ = drive_engine(engine, dict(requests))
+    engine.close()
+    for rid, (prompt, cap) in requests.items():
+        want = oracle(model, params, prompt, cap)[prompt.size:]
+        np.testing.assert_array_equal(streams[rid], want)
+    assert engine.stats["prefix_hits"] == 1
+    assert engine.stats["prefix_misses"] == 3
+
+
+def test_engine_shared_prefix_sampling_deterministic():
+    """Sampled streams draw from the per-admission key chain split in
+    admission order BEFORE the prefix partition: a reuse engine and a
+    plain engine with the same rng emit identical sampled tokens."""
+    from covalent_tpu_plugin.models.serve import ContinuousEngine
+
+    model, params = shared()
+    prefix = np.asarray([5, 9, 2, 7], np.int32)
+    prompts = [
+        np.concatenate([prefix, np.asarray([12, 13], np.int32)]),
+        np.asarray([9, 9, 9], np.int32),  # miss, interleaved with a hit
+        np.concatenate([prefix, np.asarray([30], np.int32)]),
+    ]
+    requests = {f"r{i}": (p, 5) for i, p in enumerate(prompts)}
+    kwargs = dict(
+        max_batch=2, sync_steps=2, max_new_tokens=5,
+        temperature=0.8, top_k=16, rng=jax.random.PRNGKey(11),
+    )
+    plain = ContinuousEngine(model, params, **kwargs)
+    plain_streams, _ = drive_engine(plain, dict(requests))
+    plain.close()
+    reuse = ContinuousEngine(
+        model, params, shared_prefix=prefix, **kwargs
+    )
+    reuse_streams, _ = drive_engine(reuse, dict(requests))
+    reuse.close()
+    for rid in requests:
+        np.testing.assert_array_equal(
+            plain_streams[rid], reuse_streams[rid]
+        )
+
+
+def test_engine_shared_prefix_validation():
+    """An empty prefix and one leaving no suffix/generation room are
+    refused at construction, not at first admission."""
+    from covalent_tpu_plugin.models.serve import ContinuousEngine
+
+    model, params = shared()
+    with pytest.raises(ValueError, match="at least one token"):
+        ContinuousEngine(
+            model, params, max_batch=1, shared_prefix=np.zeros(0, np.int32)
+        )
+    with pytest.raises(ValueError, match="no room"):
+        ContinuousEngine(
+            model, params, max_batch=1, length=8,
+            shared_prefix=np.arange(1, 8, dtype=np.int32),
+        )

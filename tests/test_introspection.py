@@ -591,21 +591,24 @@ def test_ops_profile_route_providers(ops_server):
 
 def test_serve_session_close_reaps_gauge_series(run_async):
     from covalent_tpu_plugin.obs.metrics import REGISTRY
-    from covalent_tpu_plugin.serving.handle import ServeHandle
     from covalent_tpu_plugin.serving.metrics import (
         SERVE_QUEUE_DEPTH,
         SERVE_TOKENS_PER_S,
         SERVE_WORKER_SLOTS,
     )
+    from covalent_tpu_plugin.serving.supervisor import SessionSupervisor
 
     class StubExecutor:
         _serve_handles: dict = {}
         cache_dir = "/tmp"
 
     async def flow():
-        handle = ServeHandle(StubExecutor(), factory=None, name="reap-sid")
+        # The reap lives in SessionSupervisor since the PR 11 handle/
+        # supervisor split (ServeHandle and ReplicaSet replicas both
+        # retire sessions through this one path).
+        handle = SessionSupervisor(StubExecutor(), sid="reap-sid")
         handle.address = "w1"
-        other = ServeHandle(StubExecutor(), factory=None, name="other-sid")
+        other = SessionSupervisor(StubExecutor(), sid="other-sid")
         other.address = "w1"
         StubExecutor._serve_handles = {"other-sid": other}
         SERVE_QUEUE_DEPTH.labels(session="reap-sid").set(3)

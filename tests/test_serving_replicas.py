@@ -1,0 +1,680 @@
+"""Replica sets: session-aware routing, drain-on-death, scale-down reaps.
+
+Two tiers:
+
+* **Router units** — :class:`ReplicaRouter` driven with fake replica
+  views and a fake clock: least-loaded choice with rotating tie-breaks,
+  per-tenant DRR fairness at the configured weight ratio, sticky-sid
+  pinning that survives a replica reconnect and re-pins only after a
+  death, and bounded-queue shedding.
+* **Set integration** — real pool servers behind 2-replica
+  :class:`ReplicaSet`\\ s: streams land exactly across replicas, a
+  SIGKILLed replica reconnects and replays while the survivor absorbs
+  fresh load, a replica dead PAST its retry budget drains its in-flight
+  callers onto the survivor with the exactly-once ``idx`` splice, and a
+  scale-down releases fleet capacity pins and reaps every per-session /
+  per-replica / worker-occupancy metric series through ``_drop_live``.
+"""
+
+import asyncio
+import sys
+
+import pytest
+
+from covalent_tpu_plugin import TPUExecutor
+from covalent_tpu_plugin.agent import AgentError
+from covalent_tpu_plugin.fleet.pools import Pool, PoolSpec
+from covalent_tpu_plugin.fleet.queue import QueueFullError, WorkItem
+from covalent_tpu_plugin.obs.metrics import REGISTRY
+from covalent_tpu_plugin.serving import (
+    ReplicaRouter,
+    ReplicaView,
+    ServeError,
+    ServeRequest,
+    open_replica_set,
+)
+from covalent_tpu_plugin.serving.supervisor import SessionSupervisor
+
+from .helpers import pin_cpu_task_env
+from .test_serving import gauge_value, make_factory
+
+
+class FakeClock:
+    def __init__(self, start: float = 100.0) -> None:
+        self.now = start
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float) -> None:
+        self.now += dt
+
+
+def item(tenant="default", sticky="", request=None):
+    return WorkItem(
+        fn=None, args=(), kwargs={},
+        task_metadata={"request": request, "sticky": sticky},
+        tenant=tenant,
+    )
+
+
+def series_labels(name: str) -> list[dict]:
+    metric = REGISTRY.get(name)
+    if metric is None:
+        return []
+    return [dict(labels) for labels, _value in metric._series()]
+
+
+def make_replica_executor(tmp_path, tag, **kwargs):
+    kwargs.setdefault("transport", "local")
+    kwargs.setdefault("cache_dir", str(tmp_path / f"cache-{tag}"))
+    kwargs.setdefault("remote_cache", str(tmp_path / f"remote-{tag}"))
+    kwargs.setdefault("python_path", sys.executable)
+    kwargs.setdefault("poll_freq", 0.2)
+    kwargs.setdefault("use_agent", "pool")
+    kwargs.setdefault("heartbeat_interval", 0.0)
+    kwargs.setdefault("prewarm", False)
+    return TPUExecutor(**pin_cpu_task_env(kwargs))
+
+
+# ---------------------------------------------------------------------------
+# Router units (fake clock, fake views — no I/O)
+# ---------------------------------------------------------------------------
+
+
+def test_router_least_loaded_choice():
+    """An unpinned request lands on the open replica with the most free
+    lanes; closed (reconnecting/failed) replicas are never candidates."""
+    router = ReplicaRouter(clock=FakeClock())
+    views = {
+        "r0": ReplicaView("r0", open=True, load=3, capacity=4),
+        "r1": ReplicaView("r1", open=True, load=0, capacity=4),
+        "r2": ReplicaView("r2", open=False, load=0, capacity=4),
+    }
+    router.submit(item())
+    [(_, replica, outcome)] = router.pump(views)
+    assert replica == "r1"
+    assert outcome == "least_loaded"
+
+
+def test_router_tie_break_rotates():
+    """Exact load ties rotate across the tied replicas instead of piling
+    onto one; a burst in ONE pump also spreads (headroom folds back into
+    the effective load)."""
+    router = ReplicaRouter(clock=FakeClock())
+    views = {
+        "r0": ReplicaView("r0", open=True, load=0, capacity=8),
+        "r1": ReplicaView("r1", open=True, load=0, capacity=8),
+    }
+    for _ in range(6):
+        router.submit(item())
+    assigned = router.pump(views)
+    counts = {"r0": 0, "r1": 0}
+    for _, replica, _ in assigned:
+        counts[replica] += 1
+    assert counts == {"r0": 3, "r1": 3}
+
+
+def test_router_drr_fairness_ratio_across_tenants():
+    """Under contention (one slot trickling free), dispatch order follows
+    the deficit-round-robin weights: a 3:1 weighted tenant drains 3x the
+    requests over any window, and the light tenant is never starved."""
+    clock = FakeClock()
+    router = ReplicaRouter(
+        weights={"heavy": 3.0, "light": 1.0}, clock=clock
+    )
+    for i in range(40):
+        router.submit(item(tenant="heavy"))
+        router.submit(item(tenant="light"))
+        clock.advance(0.001)
+    order = []
+    for _ in range(32):  # one freed lane at a time
+        views = {"r0": ReplicaView("r0", open=True, load=0, capacity=1)}
+        assigned = router.pump(views)
+        assert len(assigned) == 1
+        order.append(assigned[0][0].tenant)
+    heavy = order.count("heavy")
+    light = order.count("light")
+    assert light > 0  # no starvation
+    assert 2.5 <= heavy / light <= 3.5, order
+
+
+def test_router_sticky_pins_and_ttl_expiry():
+    """A sticky key keeps landing on its pinned replica even when others
+    are emptier; after sticky_ttl_s of silence the pin expires and the
+    next request re-places least-loaded."""
+    clock = FakeClock()
+    router = ReplicaRouter(sticky_ttl_s=10.0, clock=clock)
+    views = {
+        "r0": ReplicaView("r0", open=True, load=0, capacity=8),
+        "r1": ReplicaView("r1", open=True, load=0, capacity=8),
+    }
+    router.submit(item(sticky="user-1"))
+    [(_, first, _)] = router.pump(views)
+    # Make the pinned replica the WORSE choice; the pin must still win.
+    views[first].load = 6
+    other = "r1" if first == "r0" else "r0"
+    router.submit(item(sticky="user-1"))
+    [(_, second, outcome)] = router.pump(views)
+    assert second == first
+    assert outcome == "sticky"
+    clock.advance(11.0)
+    router.submit(item(sticky="user-1"))
+    [(_, third, outcome)] = router.pump(views)
+    assert third == other  # expired pin: fresh least-loaded placement
+    assert outcome == "least_loaded"
+    assert router.sticky_target("user-1") == third  # re-pinned
+
+
+def test_router_sticky_waits_for_reconnecting_replica():
+    """A pin to a replica that is ALIVE but mid-reconnect defers (the
+    warm per-replica state is the point of the pin) instead of
+    re-placing; the deferred item dispatches there once it re-opens —
+    sticky pinning survives a replica reconnect."""
+    clock = FakeClock()
+    router = ReplicaRouter(sticky_ttl_s=300.0, clock=clock)
+    open_views = {
+        "r0": ReplicaView("r0", open=True, load=0, capacity=4),
+        "r1": ReplicaView("r1", open=True, load=0, capacity=4),
+    }
+    router.submit(item(sticky="user-7"))
+    [(_, pinned, _)] = router.pump(open_views)
+    # The pinned replica goes into reconnect (alive, not open).
+    views = dict(open_views)
+    views[pinned] = ReplicaView(
+        pinned, open=False, alive=True, load=0, capacity=4
+    )
+    router.submit(item(sticky="user-7"))
+    assert router.pump(views) == []  # deferred, NOT moved to the other
+    assert router.queued == 1
+    # Reconnect completes: the deferred turn lands on the same replica.
+    [(_, replica, outcome)] = router.pump(open_views)
+    assert replica == pinned
+    assert outcome == "sticky"
+
+
+def test_router_sticky_repins_after_replica_death():
+    """A pin to a DEAD replica (not alive) is abandoned: the request
+    re-places least-loaded and the key re-pins to the survivor."""
+    clock = FakeClock()
+    router = ReplicaRouter(sticky_ttl_s=300.0, clock=clock)
+    views = {
+        "r0": ReplicaView("r0", open=True, load=0, capacity=4),
+        "r1": ReplicaView("r1", open=True, load=0, capacity=4),
+    }
+    router.submit(item(sticky="user-9"))
+    [(_, pinned, _)] = router.pump(views)
+    survivor = "r1" if pinned == "r0" else "r0"
+    router.forget_replica(pinned)
+    views[pinned] = ReplicaView(
+        pinned, open=False, alive=False, load=0, capacity=4
+    )
+    router.submit(item(sticky="user-9"))
+    [(_, replica, _)] = router.pump(views)
+    assert replica == survivor
+    assert router.sticky_target("user-9") == survivor
+
+
+def test_router_queue_moves_its_own_depth_gauge():
+    """The router's DRR backlog must move covalent_tpu_serve_router_
+    queue_depth, never the fleet scheduler's covalent_tpu_queue_depth —
+    two queues on one gauge would overwrite and delete each other's
+    tenant series."""
+    router = ReplicaRouter(clock=FakeClock())
+    router.submit(item(tenant="gsep-tenant"))
+    assert not any(
+        labels.get("tenant") == "gsep-tenant"
+        for labels in series_labels("covalent_tpu_queue_depth")
+    )
+    assert any(
+        labels.get("tenant") == "gsep-tenant"
+        for labels in series_labels("covalent_tpu_serve_router_queue_depth")
+    )
+    router.drain()
+    assert not any(
+        labels.get("tenant") == "gsep-tenant"
+        for labels in series_labels("covalent_tpu_serve_router_queue_depth")
+    )
+
+
+def test_router_queue_bound_sheds():
+    """Past the admission bound the router refuses new work with the
+    fleet queue's own QueueFullError (classified PERMANENT upstream)."""
+    router = ReplicaRouter(queue_max=2, clock=FakeClock())
+    router.submit(item())
+    router.submit(item())
+    with pytest.raises(QueueFullError):
+        router.submit(item())
+
+
+def test_router_defers_when_no_headroom():
+    """Items stay queued (original enqueue stamp kept) while every open
+    replica is at capacity, and flow the moment lanes free."""
+    clock = FakeClock()
+    router = ReplicaRouter(clock=clock)
+    busy = {"r0": ReplicaView("r0", open=True, load=2, capacity=2)}
+    router.submit(item())
+    assert router.pump(busy) == []
+    assert router.queued == 1
+    free = {"r0": ReplicaView("r0", open=True, load=1, capacity=2)}
+    [(_, replica, _)] = router.pump(free)
+    assert replica == "r0"
+    assert router.queued == 0
+
+
+# ---------------------------------------------------------------------------
+# Supervisor-level: the exactly-once splice fails loud on a gap
+# ---------------------------------------------------------------------------
+
+
+def test_token_stream_gap_fails_loud(run_async):
+    """An idx jumping past the request's high-water mark means a chunk
+    was lost: the stream must fail with the gap spelled out, never
+    splice around a hole."""
+
+    class DummyExecutor:
+        _serve_handles: dict = {}
+
+    async def flow():
+        sup = SessionSupervisor(DummyExecutor(), sid="gap")
+        request = ServeRequest("gap-r1", [1], None, 0.0, "")
+        sup._requests["gap-r1"] = request
+        sup._on_token({"rid": "gap-r1", "idx": 0, "tokens": [7, 8]})
+        assert request.tokens == [7, 8]
+        # Duplicate splice: replay from 0 drops the delivered prefix.
+        sup._on_token({"rid": "gap-r1", "idx": 0, "tokens": [7, 8, 9]})
+        assert request.tokens == [7, 8, 9]
+        # Gap: idx 5 with only 3 held — fail loud.
+        sup._on_token({"rid": "gap-r1", "idx": 5, "tokens": [99]})
+        with pytest.raises(ServeError, match="token stream gap"):
+            await request.result(timeout=1)
+
+    run_async(flow())
+
+
+# ---------------------------------------------------------------------------
+# Set integration: real pool servers, two replicas
+# ---------------------------------------------------------------------------
+
+
+def test_replica_set_streams_across_replicas(tmp_path, run_async):
+    """Eight requests through a 2-replica set: every stream exact, BOTH
+    replicas served traffic (least-loaded spread), per-replica sessions
+    visible on each executor's serving view, router decisions cheap."""
+
+    async def flow():
+        ex1 = make_replica_executor(tmp_path, "a")
+        ex2 = make_replica_executor(tmp_path, "b")
+        try:
+            rset = await open_replica_set(
+                [ex1, ex2], make_factory(), name="spread",
+                stats_interval_s=0.1,
+            )
+            requests = [
+                await rset.request(
+                    [10 * i], params={"max_new_tokens": 4},
+                    tenant=f"t{i % 2}",
+                )
+                for i in range(8)
+            ]
+            results = [await r.result(timeout=30) for r in requests]
+            status = rset.status()
+            views1 = dict(ex1.serve_sessions())
+            views2 = dict(ex2.serve_sessions())
+            closed = await rset.close()
+        finally:
+            await ex1.close()
+            await ex2.close()
+        return results, status, views1, views2, closed
+
+    results, status, views1, views2, closed = run_async(flow())
+    for i, tokens in enumerate(results):
+        assert tokens == [10 * i + j + 1 for j in range(4)]
+    assert status["state"] == "open"
+    per_replica = {
+        rid: view["served"] for rid, view in status["replicas"].items()
+    }
+    assert set(per_replica) == {"r0", "r1"}
+    assert all(served > 0 for served in per_replica.values()), per_replica
+    assert closed["served"] == 8
+    # Each executor's /status serving section carries its replica session,
+    # tagged with the set identity.
+    assert "spread:r0" in views1
+    assert views1["spread:r0"]["replica_set"] == "spread"
+    assert "spread:r1" in views2
+
+
+def test_single_replica_set_degenerates(tmp_path, run_async):
+    """replicas=1 is exactly today's one-session behavior: pass-through
+    router, one supervised session, same stream semantics."""
+
+    async def flow():
+        ex = make_replica_executor(tmp_path, "solo")
+        try:
+            rset = await open_replica_set(
+                ex, make_factory(), name="solo",
+            )
+            request = await rset.request(
+                [100], params={"max_new_tokens": 4}
+            )
+            tokens = await request.result(timeout=30)
+            state = rset.state
+            closed = await rset.close()
+        finally:
+            await ex.close()
+        return tokens, state, closed
+
+    tokens, state, closed = run_async(flow())
+    assert tokens == [101, 102, 103, 104]
+    assert state == "open"
+    assert closed["served"] == 1
+
+
+def test_replica_kill_mid_stream_drains_onto_survivor(tmp_path, run_async):
+    """SIGKILL one replica's resident server mid-traffic: its supervisor
+    reconnects and replays (exactly-once splice), fresh requests keep
+    flowing through the survivor the whole time, and every stream —
+    killed replica's included — completes byte-exact."""
+
+    async def flow():
+        ex1 = make_replica_executor(
+            tmp_path, "k1", retry_base_delay=0.05, retry_max_delay=0.2
+        )
+        ex2 = make_replica_executor(
+            tmp_path, "k2", retry_base_delay=0.05, retry_max_delay=0.2
+        )
+        try:
+            rset = await open_replica_set(
+                [ex1, ex2],
+                make_factory(step_delay=0.1, default_cap=12),
+                name="chaos", retries=2,
+            )
+            requests = [await rset.request([100 * i]) for i in range(6)]
+            for _ in range(200):
+                if all(len(r.tokens) >= 4 for r in requests):
+                    break
+                await asyncio.sleep(0.05)
+            assert all(len(r.tokens) >= 4 for r in requests)
+            ex1._agents["localhost"]._process._proc.kill()
+            # Fresh load lands on the survivor while r0 reconnects.
+            late = await rset.request(
+                [9000], params={"max_new_tokens": 3}
+            )
+            results = [await r.result(timeout=60) for r in requests]
+            late_result = await late.result(timeout=30)
+            reconnects = rset.reconnects
+            state = rset.state
+            await rset.close()
+        finally:
+            await ex1.close()
+            await ex2.close()
+        return results, late_result, reconnects, state
+
+    results, late_result, reconnects, state = run_async(flow())
+    for i, tokens in enumerate(results):
+        assert tokens == [100 * i + j + 1 for j in range(12)], (i, tokens)
+    assert late_result == [9001, 9002, 9003]
+    assert reconnects >= 1
+    assert state == "open"
+
+
+def test_replica_past_retry_budget_reroutes_in_flight(tmp_path, run_async):
+    """A replica dead PAST its retry budget hands its in-flight requests
+    to the set, which re-routes them onto the survivor: streams complete
+    byte-exact with no duplicate (the cross-replica splice), the dead
+    replica reports failed, the set stays open, and the failover
+    decision is counted."""
+
+    def counter_value(name: str, **labels) -> float:
+        metric = REGISTRY.get(name)
+        if metric is None:
+            return 0.0
+        return sum(
+            value.value for lbls, value in metric._series()
+            if all(lbls.get(k) == v for k, v in labels.items())
+        )
+
+    async def flow():
+        ex1 = make_replica_executor(
+            tmp_path, "p1", retry_base_delay=0.05, retry_max_delay=0.2
+        )
+        ex2 = make_replica_executor(
+            tmp_path, "p2", retry_base_delay=0.05, retry_max_delay=0.2
+        )
+        failover0 = counter_value(
+            "covalent_tpu_serve_router_decisions_total",
+            outcome="failover",
+        )
+        try:
+            # 24-token streams on 4 engine slots: every request streams
+            # CONCURRENTLY and is still in flight when the kill lands (a
+            # request COMPLETED on the dead replica correctly loses its
+            # pin — only in-flight ones re-route and re-pin).
+            rset = await open_replica_set(
+                [ex1, ex2],
+                make_factory(step_delay=0.1, default_cap=24, slots=4),
+                name="drain", retries=1,
+            )
+            requests = [
+                await rset.request([100 * i], sticky=f"u{i}")
+                for i in range(6)
+            ]
+            for _ in range(200):
+                if all(len(r.tokens) >= 4 for r in requests):
+                    break
+                await asyncio.sleep(0.05)
+            assert all(len(r.tokens) >= 4 for r in requests)
+            # Doom r0's reconnect: every re-open attempt refuses, so the
+            # retry budget spends and the permanent path drains.
+            victim = rset.supervisors["r0"]
+
+            async def refuse():
+                raise AgentError("re-open refused (test)")
+
+            victim._open_generation = refuse
+            victim.retries = 0
+            ex1._agents["localhost"]._process._proc.kill()
+            results = [await r.result(timeout=60) for r in requests]
+            victim_state = victim.state
+            set_state = rset.state
+            failover = counter_value(
+                "covalent_tpu_serve_router_decisions_total",
+                outcome="failover",
+            ) - failover0
+            # Drain-on-death keeps the callers' pins: every sticky key
+            # now targets the SURVIVOR, so follow-up turns land where
+            # the re-routed streams did.
+            pins = {
+                rset.router.sticky_target(f"u{i}") for i in range(6)
+            }
+            await rset.close()
+        finally:
+            await ex1.close()
+            await ex2.close()
+        return results, victim_state, set_state, failover, pins
+
+    results, victim_state, set_state, failover, pins = run_async(flow())
+    for i, tokens in enumerate(results):
+        assert tokens == [100 * i + j + 1 for j in range(24)], (i, tokens)
+    assert victim_state == "failed"
+    assert set_state == "open"
+    assert failover >= 1
+    assert pins == {"r1"}, pins
+
+
+def test_scale_down_releases_capacity_and_reaps_gauges(
+    tmp_path, run_async
+):
+    """The N-replica teardown satellite: scaling 2 -> 1 releases the
+    retired replica's fleet capacity pin and drops its per-session AND
+    per-replica series through ``_drop_live`` — including the worker
+    occupancy series once no live session shares that executor's worker
+    — and a full close leaves NO covalent_tpu_serve_* series behind."""
+
+    async def flow():
+        ex1 = make_replica_executor(tmp_path, "s1")
+        ex2 = make_replica_executor(tmp_path, "s2")
+        pool1 = Pool(
+            PoolSpec(name="sp1", capacity=2, transport="local"),
+            executor=ex1,
+        )
+        pool2 = Pool(
+            PoolSpec(name="sp2", capacity=2, transport="local"),
+            executor=ex2,
+        )
+        try:
+            rset = await open_replica_set(
+                [pool1, pool2], make_factory(), name="shrink",
+                stats_interval_s=0.05,
+            )
+            in_use_open = (pool1.in_use, pool2.in_use)
+            # A request + a stats tick so the per-session gauges exist.
+            request = await rset.request(
+                [5], params={"max_new_tokens": 2}
+            )
+            await request.result(timeout=30)
+            await asyncio.sleep(0.2)
+            # Worker-occupancy series as the heartbeat backhaul would
+            # set them (heartbeats are disabled in tests).
+            for ex in (ex1, ex2):
+                ex._record_heartbeat(
+                    "op-x", "localhost",
+                    {"type": "worker.heartbeat", "seq": 1, "pid": 1,
+                     "ts": 1.0,
+                     "serve": {"sessions": 1, "slots": 2, "busy": 0,
+                               "queued": 0}},
+                )
+            assert gauge_value(
+                "covalent_tpu_serve_worker_slots",
+                worker="localhost", state="slots",
+            ) == 2.0
+            live = await rset.scale_to(1)
+            in_use_scaled = (pool1.in_use, pool2.in_use)
+            replica_series_after_scale = series_labels(
+                "covalent_tpu_serve_replica_in_flight"
+            )
+            session_series_after_scale = [
+                labels["session"]
+                for labels in series_labels(
+                    "covalent_tpu_serve_queue_depth"
+                )
+                if labels["session"].startswith("shrink:")
+            ]
+            await rset.close()
+            in_use_closed = (pool1.in_use, pool2.in_use)
+        finally:
+            await ex1.close()
+            await ex2.close()
+        return (
+            live, in_use_open, in_use_scaled, in_use_closed,
+            replica_series_after_scale, session_series_after_scale,
+        )
+
+    (live, in_use_open, in_use_scaled, in_use_closed,
+     replica_series, session_series) = run_async(flow())
+    assert live == 1
+    assert in_use_open == (1, 1)
+    assert sum(in_use_scaled) == 1  # the retired replica's pin released
+    assert in_use_closed == (0, 0)
+    # Exactly one replica's series survive the scale-down.
+    shrink_series = [
+        labels for labels in replica_series if labels["set"] == "shrink"
+    ]
+    assert len(shrink_series) == 1, replica_series
+    assert len(session_series) == 1, session_series
+    # Full close: nothing left.
+    assert not [
+        labels
+        for labels in series_labels("covalent_tpu_serve_replica_in_flight")
+        if labels["set"] == "shrink"
+    ]
+    assert not [
+        labels
+        for labels in series_labels("covalent_tpu_serve_replicas")
+        if labels["set"] == "shrink"
+    ]
+    assert not [
+        labels
+        for labels in series_labels("covalent_tpu_serve_queue_depth")
+        if labels["session"].startswith("shrink:")
+    ]
+    assert not [
+        labels
+        for labels in series_labels("covalent_tpu_serve_worker_slots")
+        if labels["worker"] == "localhost"
+    ]
+
+
+def test_sticky_requests_land_on_one_replica(tmp_path, run_async):
+    """Every turn of a sticky session serves on the SAME replica."""
+
+    async def flow():
+        ex1 = make_replica_executor(tmp_path, "st1")
+        ex2 = make_replica_executor(tmp_path, "st2")
+        try:
+            rset = await open_replica_set(
+                [ex1, ex2], make_factory(), name="pin",
+            )
+            for turn in range(6):
+                request = await rset.request(
+                    [10 * turn], params={"max_new_tokens": 2},
+                    sticky="chat-1",
+                )
+                await request.result(timeout=30)
+            served = {
+                rid: sup.served
+                for rid, sup in rset.supervisors.items()
+            }
+            await rset.close()
+        finally:
+            await ex1.close()
+            await ex2.close()
+        return served
+
+    served = run_async(flow())
+    assert sorted(served.values()) == [0, 6], served
+
+
+def test_rank_targets_prefers_digest_affinity():
+    """Replica placement order: spread first, then targets already
+    holding the factory's CAS digest, then warm gangs, then free slots —
+    the serving analog of the scheduler's fn-digest affinity."""
+    from covalent_tpu_plugin.serving.replicas import ReplicaSet
+
+    class StubExecutor:
+        def __init__(self, holds=False, warm=False):
+            self._holds = holds
+            self.is_warm = warm
+
+        def holds_serve_digest(self, digest):
+            return self._holds
+
+    class StubPool:
+        def __init__(self, holds=False, free=0):
+            self._holds = holds
+            self.free_slots = free
+
+        def holds_serve_digest(self, digest):
+            return self._holds
+
+    cold = StubExecutor()
+    holder = StubExecutor(holds=True)
+    warm = StubExecutor(warm=True)
+    rset = ReplicaSet.__new__(ReplicaSet)
+    rset._targets = [(cold, None), (holder, None), (warm, None)]
+    rset._placements = {}
+    rset._digest = "d" * 64
+    ranked = rset._rank_targets()
+    assert ranked[0][0] is holder
+    assert ranked[1][0] is warm
+    assert ranked[2][0] is cold
+    # Spread beats affinity: once the holder hosts a replica, the next
+    # one goes elsewhere.
+    rset._placements["r0"] = (holder, None)
+    assert rset._rank_targets()[0][0] is warm
+    # Pool targets are probed through the Pool's own wrapper (it guards
+    # cold/stub executors), not the executor attribute directly.
+    pool_holder = StubPool(holds=True, free=1)
+    rset._targets = [(cold, StubPool()), (cold, pool_holder)]
+    rset._placements = {}
+    assert rset._rank_targets()[0][1] is pool_holder
