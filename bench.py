@@ -347,6 +347,24 @@ def staging_ops() -> float:
     )
 
 
+def agent_wire_bytes(encoding: str = "") -> float:
+    """Total agent-channel bytes so far (optionally one encoding)."""
+    return sum(
+        v for k, v in metrics_totals().items()
+        if k.startswith("covalent_tpu_agent_wire_bytes_total{")
+        and (not encoding or f"encoding={encoding}" in k)
+    )
+
+
+def agent_frames(verb: str, encoding: str = "binary") -> float:
+    """Per-verb agent-channel message count from the frame accounting."""
+    return sum(
+        v for k, v in metrics_totals().items()
+        if k.startswith("covalent_tpu_agent_frames_total{")
+        and f"verb={verb}" in k and f"encoding={encoding}" in k
+    )
+
+
 def upload_span_sum() -> float:
     """Cumulative seconds spent inside executor.upload spans."""
     from covalent_tpu_plugin.obs.metrics import REGISTRY
@@ -2088,7 +2106,7 @@ async def main() -> None:
 
         RPC_ELECTRONS = 8
 
-        def rpc_arm_executor(tag: str, mode: str):
+        def rpc_arm_executor(tag: str, mode: str, frames: bool = True):
             return TPUExecutor(
                 transport="local",
                 cache_dir=f"{workdir}/cache_rpc_{tag}",
@@ -2098,6 +2116,7 @@ async def main() -> None:
                 use_agent="pool",
                 pool_preload="cloudpickle",
                 dispatch_mode=mode,
+                agent_frames=frames,
                 prewarm=False,
                 heartbeat_interval=0.0,
                 # 30 ms simulated RTT per control-plane op; the agent
@@ -2112,16 +2131,26 @@ async def main() -> None:
                 },
             )
 
-        async def rpc_arm(tag: str, mode: str) -> dict:
-            ex = rpc_arm_executor(tag, mode)
+        async def rpc_arm(tag: str, mode: str, frames: bool = True) -> dict:
+            ex = rpc_arm_executor(tag, mode, frames)
             overheads, results, modes = [], [], []
+            wire0 = agent_wire_bytes()
+            framed0 = agent_frames("invoke") + agent_frames("multi_invoke")
             try:
                 # Warm-up electron pays the connection-scoped costs (pool
                 # server start, harness/function staging, register_fn) so
-                # the measured electrons show the steady state.
+                # the measured electrons show the steady state.  It runs
+                # the MEASURED function so its digest registration (CAS
+                # put + register round trips under the injected RTT) is
+                # amortized too — otherwise the first measured electron
+                # carries a ~100ms outlier into both wire arms' spreads.
                 await ex.run(
-                    trivial_electron, [99], {},
+                    payload_electron, [99, BUNDLE_PAYLOAD], {},
                     {"dispatch_id": f"rpcwarm{tag}", "node_id": 0},
+                )
+                wire0 = agent_wire_bytes()  # exclude warm-up traffic
+                framed0 = (
+                    agent_frames("invoke") + agent_frames("multi_invoke")
                 )
                 t0 = time.perf_counter()
                 for i in range(RPC_ELECTRONS):
@@ -2141,31 +2170,56 @@ async def main() -> None:
                 "overheads": overheads,
                 "results": results,
                 "modes": modes,
+                "wire_bytes": agent_wire_bytes() - wire0,
+                "framed_invokes": (
+                    agent_frames("invoke") + agent_frames("multi_invoke")
+                    - framed0
+                ),
             }
 
         async def rpc_phase():
             launch = await rpc_arm("launch", "launch")
-            rpc = await rpc_arm("rpc", "rpc")
-            return launch, rpc
+            # Both wire arms in the SAME run: the binary-frame claim is a
+            # measured speedup over the JSONL fallback, not an assertion
+            # against history.
+            jsonl = await rpc_arm("jsonl", "rpc", frames=False)
+            rpc = await rpc_arm("rpc", "rpc", frames=True)
+            return launch, jsonl, rpc
 
-        launch_arm, rpc_arm_run = await asyncio.wait_for(
-            rpc_phase(), FANOUT_BUDGET_S * 2
+        launch_arm, jsonl_arm, rpc_arm_run = await asyncio.wait_for(
+            rpc_phase(), FANOUT_BUDGET_S * 3
         )
         # The fast path must have actually engaged — a silent fallback to
-        # launch would "pass" the budget by measuring the wrong thing.
+        # launch would "pass" the budget by measuring the wrong thing —
+        # and the binary arm must have actually shipped frames (a silent
+        # JSONL fallback would "pass" by measuring the wrong protocol).
         assert all(m == "rpc" for m in rpc_arm_run["modes"]), (
             rpc_arm_run["modes"])
+        assert all(m == "rpc" for m in jsonl_arm["modes"]), (
+            jsonl_arm["modes"])
         assert all(m == "launch" for m in launch_arm["modes"]), (
             launch_arm["modes"])
-        # Byte-equal results: the streamed (result, exception) pickle must
-        # carry exactly what the staged result file does.
-        byte_equal = _cloudpickle.dumps(rpc_arm_run["results"]) == (
-            _cloudpickle.dumps(launch_arm["results"]))
+        assert rpc_arm_run["framed_invokes"] >= RPC_ELECTRONS, (
+            rpc_arm_run["framed_invokes"])
+        assert jsonl_arm["framed_invokes"] == 0, (
+            jsonl_arm["framed_invokes"])
+        # Byte-equal results across ALL arms: the streamed (result,
+        # exception) pickle must carry exactly what the staged result file
+        # does, whichever encoding the channel negotiated.
+        byte_equal = (
+            _cloudpickle.dumps(rpc_arm_run["results"])
+            == _cloudpickle.dumps(launch_arm["results"])
+            == _cloudpickle.dumps(jsonl_arm["results"])
+        )
         assert rpc_arm_run["results"] == launch_arm["results"], (
             rpc_arm_run["results"], launch_arm["results"])
+        assert rpc_arm_run["results"] == jsonl_arm["results"], (
+            rpc_arm_run["results"], jsonl_arm["results"])
         rpc_median = statistics.median(rpc_arm_run["overheads"])
+        jsonl_median = statistics.median(jsonl_arm["overheads"])
         launch_median = statistics.median(launch_arm["overheads"])
         summary["rpc_overhead_s"] = round(rpc_median, 4)
+        summary["rpc_overhead_jsonl_s"] = round(jsonl_median, 4)
         summary["rpc_overhead_launch_s"] = round(launch_median, 4)
         summary["rpc_overhead_budget_s"] = RPC_OVERHEAD_BUDGET_S
         summary["rpc_overhead_within_budget"] = bool(
@@ -2175,13 +2229,41 @@ async def main() -> None:
         summary["rpc_overhead_speedup"] = round(
             launch_median / max(rpc_median, 1e-9), 2
         )
+        # The binary-frame claims, asserted against the JSONL arm of the
+        # SAME run: no slower on median wall overhead (timing — speedup
+        # reported), strictly fewer bytes on the agent channel for the
+        # same electrons (deterministic — base64 alone is a 33% tax).
+        summary["rpc_frames_speedup"] = round(
+            jsonl_median / max(rpc_median, 1e-9), 2
+        )
+        summary["rpc_frames_no_slower"] = bool(rpc_median <= jsonl_median)
+        summary["rpc_wire_bytes_per_electron"] = round(
+            rpc_arm_run["wire_bytes"] / RPC_ELECTRONS, 1
+        )
+        summary["rpc_jsonl_wire_bytes_per_electron"] = round(
+            jsonl_arm["wire_bytes"] / RPC_ELECTRONS, 1
+        )
+        summary["rpc_frames_fewer_wire_bytes"] = bool(
+            rpc_arm_run["wire_bytes"] < jsonl_arm["wire_bytes"]
+        )
         emit({
             "phase": "rpc_overhead",
             "electrons": RPC_ELECTRONS,
             "rpc_overhead_s": summary["rpc_overhead_s"],
+            "jsonl_overhead_s": summary["rpc_overhead_jsonl_s"],
             "launch_overhead_s": summary["rpc_overhead_launch_s"],
             "rpc_wall_s": round(rpc_arm_run["wall_s"], 3),
+            "jsonl_wall_s": round(jsonl_arm["wall_s"], 3),
             "launch_wall_s": round(launch_arm["wall_s"], 3),
+            "frames_speedup": summary["rpc_frames_speedup"],
+            "frames_no_slower": summary["rpc_frames_no_slower"],
+            "wire_bytes_per_electron":
+                summary["rpc_wire_bytes_per_electron"],
+            "jsonl_wire_bytes_per_electron":
+                summary["rpc_jsonl_wire_bytes_per_electron"],
+            "frames_fewer_wire_bytes":
+                summary["rpc_frames_fewer_wire_bytes"],
+            "framed_invokes": rpc_arm_run["framed_invokes"],
             "per_electron_rpc_s": [
                 round(o, 4) for o in rpc_arm_run["overheads"]
             ],
@@ -2328,6 +2410,8 @@ async def main() -> None:
 
         async def resident_arm() -> dict:
             ex = serve_arm_executor("resident")
+            batches0 = agent_frames("telemetry_batch")
+            wire_down0 = agent_wire_bytes()
             try:
                 t_open0 = time.perf_counter()
                 handle = await _serving.open_session(
@@ -2357,6 +2441,10 @@ async def main() -> None:
             return {
                 "wall_s": wall, "open_s": open_s, "latencies": latencies,
                 "ttfts": ttfts, "results": list(results), "stats": stats,
+                "coalesced_batches": (
+                    agent_frames("telemetry_batch") - batches0
+                ),
+                "wire_bytes": agent_wire_bytes() - wire_down0,
             }
 
         async def serve_phase():
@@ -2395,6 +2483,18 @@ async def main() -> None:
         # Streaming must be real: first tokens land while the stream is
         # still going, not at end-of-batch.
         summary["serve_ttft_streams_early"] = bool(ttft_p50 < resident_p50)
+        # Token coalescing: the resident arm's streams — already asserted
+        # token-identical above — must have ridden batched binary frames,
+        # and the per-token wire cost is a first-class observable.
+        summary["serve_coalesced_batches"] = round(
+            resident_arm_run["coalesced_batches"], 1
+        )
+        summary["serve_coalescing_engaged"] = bool(
+            resident_arm_run["coalesced_batches"] >= 1
+        )
+        summary["serve_wire_bytes_per_token"] = round(
+            resident_arm_run["wire_bytes"] / max(total_tokens, 1), 1
+        )
         emit({
             "phase": "serve_traffic",
             "requests": SERVE_REQUESTS,
@@ -2415,6 +2515,9 @@ async def main() -> None:
             "speedup_min": SERVE_SPEEDUP_MIN,
             "beats_per_electron": summary["serve_beats_per_electron"],
             "ttft_streams_early": summary["serve_ttft_streams_early"],
+            "coalesced_batches": summary["serve_coalesced_batches"],
+            "coalescing_engaged": summary["serve_coalescing_engaged"],
+            "wire_bytes_per_token": summary["serve_wire_bytes_per_token"],
             "worker_stats": resident_arm_run["stats"],
             # The serving timeline (tokens/s + queue depth per session,
             # windowed latency/TTFT percentiles) + end-of-phase SLO
@@ -3000,12 +3103,17 @@ async def main() -> None:
     try:
         healthy = False
         skipped_tpu = "tpu" not in BENCH_PHASES
+        preflight_attempts = 0
+        preflight_last_error = ""
         for attempt in range(0 if skipped_tpu else 64):
             ok, took, err = await asyncio.get_event_loop().run_in_executor(
                 None, tpu_preflight, min(45.0, max(phase3_left() - 5, 5.0))
             )
             emit({"phase": "tpu.preflight", "attempt": attempt, "ok": ok,
                   "probe_s": round(took, 1), **({"error": err} if err else {})})
+            preflight_attempts = attempt + 1
+            if err:
+                preflight_last_error = err
             if ok:
                 healthy = True
                 break
@@ -3016,8 +3124,20 @@ async def main() -> None:
         if skipped_tpu:
             emit({"phase": "tpu", "skipped": "BENCH_PHASES"})
         elif not healthy:
+            # The failure REASON rides into the summary (and from there
+            # the final combined line): the preflight has been silently
+            # down since r03, with the stale last_known_good block riding
+            # along undiagnosed — an artifact must say WHY its live TPU
+            # fields are null, not just that they are.
+            summary["tpu_preflight_failure"] = {
+                "attempts": preflight_attempts,
+                "last_error": preflight_last_error or "no probe ran "
+                "(deadline exhausted before the first attempt)",
+            }
             emit({"phase": "tpu", "error": "preflight never passed; "
-                  "electron skipped (tunnel down)"})
+                  "electron skipped (tunnel down)",
+                  "preflight_attempts": preflight_attempts,
+                  "preflight_last_error": preflight_last_error})
         attempt = 0
         while healthy:
             # First electron gets the full remaining deadline; a retry only

@@ -22,8 +22,10 @@ supervision using the PID from the ``started`` event.
 from __future__ import annotations
 
 import asyncio
+import base64
 import hashlib
 import json
+import os
 import shlex
 import uuid
 from functools import lru_cache
@@ -33,6 +35,7 @@ from typing import Any
 from .obs import events as obs_events
 from .obs.metrics import REGISTRY
 from .obs.trace import Span
+from .transport import frames
 from .transport.base import Transport, TransportError
 from .utils.log import app_log
 
@@ -50,6 +53,40 @@ AGENT_RESTARTS_TOTAL = REGISTRY.counter(
     "covalent_tpu_agent_restarts_total",
     "Cached agent channels discarded and restarted after a failed ping",
 )
+AGENT_FRAMES_TOTAL = REGISTRY.counter(
+    "covalent_tpu_agent_frames_total",
+    "Protocol messages on agent channels by verb and encoding "
+    "(jsonl lines vs negotiated binary frames)",
+    ("verb", "encoding"),
+)
+AGENT_WIRE_BYTES_TOTAL = REGISTRY.counter(
+    "covalent_tpu_agent_wire_bytes_total",
+    "Bytes on agent channels by direction (up/down) and encoding",
+    ("direction", "encoding"),
+)
+
+
+def frames_env_enabled() -> bool:
+    """Process-wide kill switch: COVALENT_TPU_AGENT_FRAMES=0 pins JSONL."""
+    return os.environ.get(
+        "COVALENT_TPU_AGENT_FRAMES", ""
+    ).strip().lower() not in ("0", "off", "false", "no")
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+#: Same-event-loop-turn invoke batching by default (window 0: zero added
+#: latency — only invokes already queued in the current turn coalesce);
+#: a positive window trades a bounded wait for bigger batches.
+_BATCH_WINDOW_S = max(0.0, _env_float(
+    "COVALENT_TPU_RPC_BATCH_WINDOW_MS", 0.0
+) / 1000.0)
+_BATCH_MAX_OPS = max(1, int(_env_float("COVALENT_TPU_RPC_BATCH_MAX", 16)))
 
 AGENT_SOURCE = Path(__file__).parent / "native" / "agent.cc"
 
@@ -113,6 +150,8 @@ async def start_pool_server(
     conda_env: str = "",
     preload: str = "cloudpickle",
     timeout: float = 90.0,
+    frames_enabled: bool | None = None,
+    frames_codec: str = "",
 ) -> "AgentClient":
     """Start the harness forkserver (``harness.py --serve``) on a worker.
 
@@ -148,6 +187,9 @@ async def start_pool_server(
     client.mode = "pool"
     try:
         await client.ping(timeout)
+        await client.negotiate_frames(
+            enabled=frames_enabled, codec=frames_codec
+        )
     except AgentError:
         await client.close()
         raise
@@ -203,13 +245,37 @@ class AgentClient:
         self._profile_started: dict[str, dict] = {}
         self._profile_stopped: dict[str, dict] = {}
         self._profile_errors: dict[str, dict] = {}
+        #: binary frame negotiation: the runtime's ready banner (capability
+        #: advertisement), the pushed `frames` ack, and the active state.
+        self._banner: dict = {}
+        self._frames_ack: dict | None = None
+        self.frames_active = False
+        self._frame_codec = ""
+        #: task id -> structured code from an `error` event (bad_frame is
+        #: torn content — the rejection must classify PERMANENT, not burn
+        #: gang retries re-sending identical corrupt bytes).
+        self._error_codes: dict[str, str] = {}
+        #: invoke micro-batching: digest -> [(command, args_bytes)] queued
+        #: this window; flushed as ONE multi_invoke frame per digest.
+        self._pending_invokes: dict[str, list] = {}
+        self._flush_scheduled = False
+        self._flush_now = False
+        #: live flusher tasks: the loop keeps only weak refs to tasks, so
+        #: an unreferenced flusher could be GC'd mid-flight, stranding its
+        #: waiters on their started timeouts.
+        self._flush_tasks: set = set()
         self._reader = asyncio.create_task(self._read_loop())
 
     # -- lifecycle -----------------------------------------------------------
 
     @classmethod
     async def start(
-        cls, conn: Transport, binary: str, timeout: float = 15.0
+        cls,
+        conn: Transport,
+        binary: str,
+        timeout: float = 15.0,
+        frames_enabled: bool | None = None,
+        frames_codec: str = "",
     ) -> "AgentClient":
         try:
             process = await conn.start_process(
@@ -222,6 +288,9 @@ class AgentClient:
             # A ping round-trip both consumes the ready banner and proves the
             # channel is live before any task is entrusted to it.
             await client.ping(timeout)
+            await client.negotiate_frames(
+                enabled=frames_enabled, codec=frames_codec
+            )
         except AgentError:
             await client.close()
             raise
@@ -249,11 +318,53 @@ class AgentClient:
     async def _read_loop(self) -> None:
         try:
             while True:
-                line = await self._process.read_line()
-                try:
-                    event = json.loads(line)
-                except ValueError:
-                    continue  # stray non-protocol output; ignore
+                message = await self._process.read_event()
+                if message[0] == "frame":
+                    _kind, verb, flags, header, body = message
+                    AGENT_FRAMES_TOTAL.labels(
+                        verb=frames.VERB_NAMES.get(verb, str(verb)),
+                        encoding="binary",
+                    ).inc()
+                    AGENT_WIRE_BYTES_TOTAL.labels(
+                        direction="down", encoding="binary"
+                    ).inc(frames.HEADER_LEN + len(header) + len(body))
+                    try:
+                        event = frames.decode_payload(flags, header, body)
+                    except frames.FrameIntegrityError as err:
+                        # The frame arrived length-intact, so this is torn
+                        # CONTENT, not a channel fault: deliver a marked
+                        # event so the waiter fails PERMANENT instead of
+                        # the whole channel dying transient.
+                        try:
+                            event = json.loads(header.decode("utf-8"))
+                        except ValueError:
+                            raise TransportError(
+                                f"agent@{self.address}: undecodable torn "
+                                f"frame: {err}"
+                            ) from err
+                        event.pop("_body", None)
+                        event["torn"] = repr(err)
+                    # FrameError (bad header JSON) falls through to the
+                    # generic handler below: the stream itself cannot be
+                    # trusted past it, so the reader dies and waiters see
+                    # a channel death.
+                else:
+                    line = message[1]
+                    try:
+                        event = json.loads(line)
+                    except ValueError:
+                        continue  # stray non-protocol output; ignore
+                    kind0 = str(event.get("event")) if isinstance(
+                        event, dict
+                    ) else "?"
+                    AGENT_FRAMES_TOTAL.labels(
+                        verb=kind0, encoding="jsonl"
+                    ).inc()
+                    AGENT_WIRE_BYTES_TOTAL.labels(
+                        direction="down", encoding="jsonl"
+                    ).inc(len(line) + 1)
+                if not isinstance(event, dict):
+                    continue
                 async with self._cond:
                     kind = event.get("event")
                     task_id = event.get("id", "")
@@ -261,8 +372,52 @@ class AgentClient:
                     if kind == "telemetry":
                         self._handle_telemetry(task_id, event.get("data"))
                         continue  # side-band: no waiter state to notify
+                    if kind == "telemetry_batch":
+                        if event.get("torn"):
+                            # Torn batch body: the records (and their
+                            # rids) are unrecoverable — say so loudly
+                            # instead of silently dropping what may be a
+                            # stream's done marker.
+                            app_log.warning(
+                                "agent@%s: dropped torn telemetry batch "
+                                "for %s: %s",
+                                self.address, task_id, event["torn"],
+                            )
+                            obs_events.emit(
+                                "agent.torn_telemetry_batch",
+                                address=self.address,
+                                task_id=str(task_id),
+                                error=str(event["torn"]),
+                            )
+                            continue
+                        # Coalesced side-band frame: unpack and feed each
+                        # record through the exact per-record path — seq
+                        # dedup, serve sinks, and the exactly-once idx
+                        # splice downstream are untouched by batching.
+                        records = event.get("records") or b"[]"
+                        try:
+                            parsed = json.loads(
+                                records.decode("utf-8")
+                                if isinstance(records, (bytes, bytearray))
+                                else records
+                            )
+                        except (ValueError, UnicodeDecodeError):
+                            parsed = []
+                        for record in parsed if isinstance(
+                            parsed, list
+                        ) else []:
+                            self._handle_telemetry(task_id, record)
+                        continue
                     if kind == "started":
                         self._started[task_id] = int(event["pid"])
+                    elif kind == "multi_started":
+                        pid = int(event.get("pid") or 0)
+                        for tid in event.get("ids") or []:
+                            self._started[str(tid)] = pid
+                    elif kind == "ready":
+                        self._banner = event
+                    elif kind == "frames":
+                        self._frames_ack = event
                     elif kind == "serve_opened":
                         self._serve_opened[task_id] = event
                     elif kind == "serve_error":
@@ -296,6 +451,8 @@ class AgentClient:
                     elif kind == "error":
                         if task_id:  # id-less errors are log-only, not stored
                             self._errors[task_id] = str(event.get("message", "?"))
+                            if event.get("code"):
+                                self._error_codes[task_id] = str(event["code"])
                         app_log.warning(
                             "agent@%s error: %s", self.address, event.get("message")
                         )
@@ -379,6 +536,72 @@ class AgentClient:
         await self._send({"cmd": "ping"})
         await self._wait(lambda c: c._pongs > before, timeout)
 
+    async def negotiate_frames(
+        self,
+        timeout: float = 15.0,
+        enabled: bool | None = None,
+        codec: str = "",
+    ) -> bool:
+        """Switch the channel to binary frames when both ends are capable.
+
+        Rides the ready-banner handshake (the same one-round-trip shape as
+        the ``COVALENT_TPU_CODECS=`` pre-flight probe): a frame-capable
+        runtime advertised ``frames`` in its banner — consumed before the
+        ping ack, so this never races — and answers the ``frames`` command
+        with an ack carrying the accepted body codec.  A silent banner (old
+        or JSON-only runtime), a ``version: 0`` refusal (remote kill
+        switch), or ``enabled=False`` (local kill switch /
+        COVALENT_TPU_AGENT_FRAMES=0) all leave the channel on JSONL — the
+        fallback is byte-equal, just slower.
+
+        ``codec`` asks for per-frame BODY compression (zlib, the one codec
+        every stdlib-only worker has).  Like the staging codec's download
+        leg, it engages only when the operator pinned a codec: deflating a
+        mid-size payload costs more CPU time than the base64+JSON parse it
+        replaces, so it pays only where the wire (not the CPU) is the
+        bottleneck — raw frames already drop the ~33% base64 inflation and
+        both JSON legs for free.
+        """
+        if enabled is None:
+            enabled = frames_env_enabled()
+        if not enabled or not self._banner.get("frames"):
+            return False
+        codecs = self._banner.get("codecs") or []
+        codec = "zlib" if codec == "zlib" and "zlib" in codecs else ""
+        await self._send({
+            "cmd": "frames", "version": frames.VERSION, "codec": codec,
+        })
+        ack = await self._wait(lambda c: c._frames_ack, timeout)
+        if int(ack.get("version") or 0) >= 1:
+            self.frames_active = True
+            self._frame_codec = str(ack.get("codec") or "")
+            obs_events.emit(
+                "agent.frames_negotiated", address=self.address,
+                codec=self._frame_codec,
+            )
+        return self.frames_active
+
+    def _pop_rejection(self, task_id: str, what: str) -> AgentError | None:
+        """Stored error event -> a rejection exception (or None).
+
+        A definitive rejection means the task never started, so relaunch
+        through the fallback path is safe.  A ``bad_frame`` code is torn
+        content — identical bytes can never be re-sent successfully — so
+        the rejection carries the duck-typed PERMANENT tag.
+        """
+        if task_id not in self._errors:
+            return None
+        message = self._errors.pop(task_id)
+        code = self._error_codes.pop(task_id, "")
+        rejection = AgentError(
+            f"agent@{self.address} rejected {what} {task_id}: {message}"
+        )
+        rejection.rejected = True  # type: ignore[attr-defined]
+        if code == "bad_frame":
+            rejection.fault_label = "agent_bad_frame"  # type: ignore[attr-defined]
+            rejection.fault_transient = False  # type: ignore[attr-defined]
+        return rejection
+
     async def run_task(
         self,
         task_id: str,
@@ -419,14 +642,10 @@ class AgentClient:
             sent = True
 
             def ready(c: "AgentClient"):
-                if task_id in c._errors:
-                    rejection = AgentError(
-                        f"agent@{c.address} rejected {task_id}: "
-                        f"{c._errors.pop(task_id)}"
-                    )
+                rejection = c._pop_rejection(task_id, "run")
+                if rejection is not None:
                     # A definitive rejection means the task never forked:
                     # relaunching through the fallback path is safe.
-                    rejection.rejected = True  # type: ignore[attr-defined]
                     raise rejection
                 return c._started.get(task_id)
 
@@ -510,6 +729,7 @@ class AgentClient:
         digest: str,
         spec: dict | None = None,
         args_b64: str | None = None,
+        args_bytes: bytes | None = None,
         args_path: str = "",
         args_digest: str = "",
         path: str = "",
@@ -519,27 +739,42 @@ class AgentClient:
     ) -> int:
         """Invoke a registered function by digest; returns the worker pid.
 
-        Args travel inline (``args_b64``) below the executor's size
-        threshold, else by CAS path + digest.  ``path`` (the function's
-        CAS artifact) rides along so a restarted runtime can self-heal a
-        lost registration, digest-verified.  The same size policy applies
-        on the way back: given ``result_path`` + ``result_max_inline``,
-        a result pickle over the threshold is staged to that remote path
-        (announced by sha256 digest) instead of base64-inlined onto the
-        channel in one write.  The ``started`` ack bounds this call; the
-        result streams back separately (:meth:`wait_result`).
+        Args travel inline below the executor's size threshold — as raw
+        bytes in a binary frame when the channel negotiated frames
+        (``args_bytes``), else base64-in-JSON (``args_b64``, derived from
+        ``args_bytes`` automatically) — or by CAS path + digest when
+        oversized.  On a frame-negotiated pool channel, inline invokes
+        additionally micro-batch: every invoke enqueued in the same event-
+        loop turn (window configurable via COVALENT_TPU_RPC_BATCH_WINDOW_MS)
+        for the same digest ships as ONE ``multi_invoke`` frame, acked by
+        one ``multi_started``, with results fanning back out by op id —
+        the shape the fleet scheduler's digest-affinity placement produces.
+        ``path`` (the function's CAS artifact) rides along so a restarted
+        runtime can self-heal a lost registration, digest-verified.  The
+        same size policy applies on the way back: given ``result_path`` +
+        ``result_max_inline``, a result pickle over the threshold is
+        staged to that remote path (announced by sha256 digest) instead of
+        inlined onto the channel in one write.  The ``started`` ack bounds
+        this call; the result streams back separately
+        (:meth:`wait_result`).
         """
         command: dict = {"cmd": "invoke", "id": task_id, "digest": digest}
         if path:
             command["path"] = path
         if spec:
             command["spec"] = dict(spec)
-        if args_b64 is not None:
-            command["args"] = args_b64
-        elif args_path:
-            command["args_path"] = args_path
-            if args_digest:
-                command["args_digest"] = args_digest
+        framed = (
+            self.frames_active and args_bytes is not None and not args_path
+        )
+        if not framed:
+            if args_b64 is None and args_bytes is not None:
+                args_b64 = base64.b64encode(args_bytes).decode("ascii")
+            if args_b64 is not None:
+                command["args"] = args_b64
+            elif args_path:
+                command["args_path"] = args_path
+                if args_digest:
+                    command["args_digest"] = args_digest
         if result_path and result_max_inline is not None:
             command["result_path"] = result_path
             command["result_max_inline"] = int(result_max_inline)
@@ -548,15 +783,22 @@ class AgentClient:
         )
         submit_span.__enter__()
         try:
-            await self._send(command)
+            if framed and self.mode == "pool":
+                self._enqueue_invoke(digest, command, args_bytes or b"")
+            elif framed:
+                # Native runtime: frames yes, batching no (it forks one
+                # runner per invocation — there is nothing to fan back).
+                header = dict(command)
+                header["_body"] = "args_bytes"
+                await self._send_frame(
+                    frames.VERB_INVOKE, header, args_bytes or b""
+                )
+            else:
+                await self._send(command)
 
             def ready(c: "AgentClient"):
-                if task_id in c._errors:
-                    rejection = AgentError(
-                        f"agent@{c.address} rejected invoke {task_id}: "
-                        f"{c._errors.pop(task_id)}"
-                    )
-                    rejection.rejected = True  # type: ignore[attr-defined]
+                rejection = c._pop_rejection(task_id, "invoke")
+                if rejection is not None:
                     raise rejection
                 return c._started.get(task_id)
 
@@ -568,6 +810,83 @@ class AgentClient:
             raise
         finally:
             submit_span.end()
+
+    # -- invoke micro-batching -----------------------------------------------
+
+    def _enqueue_invoke(
+        self, digest: str, command: dict, body: bytes
+    ) -> None:
+        """Queue one framed invoke; the flusher coalesces per digest."""
+        self._pending_invokes.setdefault(digest, []).append((command, body))
+        total = sum(len(v) for v in self._pending_invokes.values())
+        if not self._flush_scheduled:
+            self._flush_scheduled = True
+            self._spawn_flush(immediate=False)
+        elif total >= _BATCH_MAX_OPS and not self._flush_now:
+            # A full batch flushes NOW — skipping any configured window —
+            # instead of waiting it out; the windowed flusher will find
+            # an empty queue.  One immediate flusher at a time: further
+            # over-max enqueues ride the one already scheduled.
+            self._flush_now = True
+            self._spawn_flush(immediate=True)
+
+    def _spawn_flush(self, immediate: bool) -> None:
+        task = asyncio.ensure_future(self._flush_invokes(immediate))
+        self._flush_tasks.add(task)
+        task.add_done_callback(self._flush_tasks.discard)
+
+    async def _flush_invokes(self, immediate: bool = False) -> None:
+        """Ship every queued invoke: one frame per digest group.
+
+        With the default zero window only invokes enqueued in the same
+        event-loop turn coalesce — a lone invoke pays no added latency.
+        A send failure files a rejection for every op in the group so the
+        waiters fail fast instead of sitting out their timeouts.
+        """
+        if not immediate and _BATCH_WINDOW_S > 0:
+            await asyncio.sleep(_BATCH_WINDOW_S)
+        else:
+            await asyncio.sleep(0)
+        pending, self._pending_invokes = self._pending_invokes, {}
+        self._flush_scheduled = False
+        self._flush_now = False
+        for digest, entries in pending.items():
+            try:
+                await self._send_invoke_group(digest, entries)
+            except (AgentError, TransportError, ValueError) as err:
+                async with self._cond:
+                    for command, _body in entries:
+                        tid = str(command.get("id") or "")
+                        self._errors[tid] = (
+                            f"batched invoke send failed: {err}"
+                        )
+                    self._cond.notify_all()
+
+    async def _send_invoke_group(self, digest: str, entries: list) -> None:
+        if len(entries) == 1:
+            command, body = entries[0]
+            header = dict(command)
+            header["_body"] = "args_bytes"
+            await self._send_frame(frames.VERB_INVOKE, header, body)
+            return
+        ops, bodies = [], []
+        fn_path = ""
+        for command, body in entries:
+            fn_path = fn_path or str(command.get("path") or "")
+            ops.append({
+                k: v for k, v in command.items()
+                if k not in ("cmd", "digest", "path")
+            })
+            bodies.append(body)
+        header: dict = {
+            "cmd": "multi_invoke", "digest": digest, "ops": ops,
+            "args_lens": [len(b) for b in bodies], "_body": "args_bytes",
+        }
+        if fn_path:
+            header["path"] = fn_path
+        await self._send_frame(
+            frames.VERB_MULTI_INVOKE, header, b"".join(bodies)
+        )
 
     async def wait_result(
         self, task_id: str, timeout: float | None = None
@@ -657,6 +976,11 @@ class AgentClient:
             command["deadline_s"] = float(deadline_s)
         if tenant:
             command["tenant"] = str(tenant)
+        if self.frames_active:
+            # Header-only frame: at serving request rates even the line
+            # framing + re-parse tax is worth skipping.
+            await self._send_frame(frames.VERB_SERVE, command)
+            return
         await self._send(command)
 
     async def serve_close(self, sid: str, timeout: float = 30.0) -> dict:
@@ -801,6 +1125,7 @@ class AgentClient:
         self._started.pop(task_id, None)
         self._exits.pop(task_id, None)
         self._errors.pop(task_id, None)
+        self._error_codes.pop(task_id, None)
         self._results.pop(task_id, None)
         if task_id not in self._serve_sinks:
             # Serving sessions outlive electron operations on the same
@@ -815,7 +1140,35 @@ class AgentClient:
         if self._dead is not None:
             raise AgentError(f"agent@{self.address} channel died: {self._dead}")
         _AGENT_RPCS.labels(cmd=str(command.get("cmd", "?"))).inc()
+        line = json.dumps(command)
+        AGENT_FRAMES_TOTAL.labels(
+            verb=str(command.get("cmd", "?")), encoding="jsonl"
+        ).inc()
+        AGENT_WIRE_BYTES_TOTAL.labels(
+            direction="up", encoding="jsonl"
+        ).inc(len(line) + 1)
         try:
-            await self._process.write_line(json.dumps(command))
+            await self._process.write_line(line)
+        except TransportError as err:
+            raise AgentError(f"agent@{self.address}: send failed: {err}") from err
+
+    async def _send_frame(
+        self, verb: int, header: dict, body: bytes = b""
+    ) -> None:
+        """One binary frame down the channel (negotiated path only)."""
+        if self._dead is not None:
+            raise AgentError(f"agent@{self.address} channel died: {self._dead}")
+        _AGENT_RPCS.labels(cmd=str(header.get("cmd", "?"))).inc()
+        payload = frames.encode_frame(
+            verb, header, body, codec=self._frame_codec
+        )
+        AGENT_FRAMES_TOTAL.labels(
+            verb=frames.VERB_NAMES.get(verb, str(verb)), encoding="binary"
+        ).inc()
+        AGENT_WIRE_BYTES_TOTAL.labels(
+            direction="up", encoding="binary"
+        ).inc(len(payload))
+        try:
+            await self._process.write_bytes(payload)
         except TransportError as err:
             raise AgentError(f"agent@{self.address}: send failed: {err}") from err

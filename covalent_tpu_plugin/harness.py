@@ -29,9 +29,11 @@ from __future__ import annotations
 
 import json
 import os
+import struct
 import sys
 import threading
 import time
+import zlib
 
 
 #: Per-process worker-event sequence + write-failure accounting.  The seq
@@ -561,6 +563,315 @@ def _emit(obj: dict) -> None:
         sys.stdout.flush()
 
 
+# --------------------------------------------------------------------------
+# Binary frame protocol (negotiated; JSONL stays the fallback).
+#
+# Hot-path payloads — RPC args/results, streamed serve tokens — used to pay
+# pickle -> base64 -> JSON-line on every message (~33% inflation plus a
+# JSON parse of the bulky string on both ends).  After negotiation the
+# channel interleaves length-prefixed binary frames with JSON lines:
+#
+#   magic(2)=C5 F7  version(1)  verb(1)  flags(1)  hlen(4 BE)  blen(4 BE)
+#   header: UTF-8 JSON object (the command/event, minus its bulky field)
+#   body:   raw bytes, re-attached under the field named by header["_body"]
+#
+# Negotiation rides the ready banner (same one-round-trip shape as the
+# COVALENT_TPU_CODECS= pre-flight probe): this server advertises
+# `"frames": 1` in `ready`, the client answers `{"cmd":"frames",...}`, the
+# ack flips both directions over.  No banner / no answer / the
+# COVALENT_TPU_AGENT_FRAMES=0 kill switch all leave the channel on JSONL
+# with byte-equal results.  This block mirrors transport/frames.py (and
+# native/agent.cc) — it must stay stdlib-only because this file runs
+# standalone on workers; the cross-implementation tests in
+# tests/test_frames.py keep the three byte-compatible.
+# --------------------------------------------------------------------------
+
+_FRAME_MAGIC = b"\xc5\xf7"
+_FRAME_VERSION = 1
+_FRAME_HEADER = struct.Struct(">2sBBBII")
+_FRAME_MAX_HEADER = 16 * 1024 * 1024
+_FRAME_MAX_BODY = 512 * 1024 * 1024
+_FRAME_MIN_COMPRESS = 512
+_FRAME_FLAG_ZLIB = 0x01
+
+_VERB_CMD = 0
+_VERB_INVOKE = 1
+_VERB_RESULT = 2
+_VERB_TELEMETRY = 3
+_VERB_MULTI_INVOKE = 4
+_VERB_SERVE = 5
+
+#: Outbound frame state, flipped by the negotiated `frames` command.
+_FRAMES = {"out": False, "codec": ""}
+
+
+def _frames_enabled() -> bool:
+    """Kill switch: COVALENT_TPU_AGENT_FRAMES=0/off forces JSONL-only."""
+    return os.environ.get(
+        "COVALENT_TPU_AGENT_FRAMES", ""
+    ).strip().lower() not in ("0", "off", "false", "no")
+
+
+def _emit_frame(verb: int, header: dict, body: bytes = b"") -> None:
+    """One binary frame on stdout (atomic under the emit lock).
+
+    The body is zlib-compressed when the negotiated codec allows and the
+    payload is big enough to win — same skip-if-incompressible heuristic
+    the file-staging codec applies.
+    """
+    flags = 0
+    if (
+        body
+        and _FRAMES["codec"] == "zlib"
+        and len(body) >= _FRAME_MIN_COMPRESS
+    ):
+        packed = zlib.compress(body, 6)
+        if len(packed) < len(body) * 0.9:
+            body, flags = packed, _FRAME_FLAG_ZLIB
+    head = json.dumps(header, separators=(",", ":")).encode()
+    with _EMIT_LOCK:
+        sys.stdout.flush()  # any pending text shares the one byte stream
+        out = sys.stdout.buffer
+        out.write(_FRAME_HEADER.pack(
+            _FRAME_MAGIC, _FRAME_VERSION, verb, flags, len(head), len(body)
+        ))
+        out.write(head)
+        if body:
+            out.write(body)
+        out.flush()
+
+
+def _handle_frames_cmd(command: dict) -> None:
+    """Negotiation verb: ack and flip the outbound side to frames.
+
+    A disabled runtime (kill switch) answers ``version: 0`` so a capable
+    client settles immediately on the JSONL fallback instead of waiting
+    out a timeout.
+    """
+    if not _frames_enabled():
+        _emit({"event": "frames", "version": 0})
+        return
+    codec = "zlib" if str(command.get("codec") or "") == "zlib" else ""
+    _emit({"event": "frames", "version": _FRAME_VERSION, "codec": codec})
+    _FRAMES["out"] = True
+    _FRAMES["codec"] = codec
+
+
+def _frame_resync(buffer: bytearray) -> None:
+    """Drop garbage through the next newline (or all of it).
+
+    After a bad magic/version/length the stream position is untrusted;
+    valid traffic is self-delimiting frames or newline-terminated JSON,
+    so the next newline is the only honest resync point.
+    """
+    nl = buffer.find(b"\n", 1)
+    if nl < 0:
+        buffer.clear()
+    else:
+        del buffer[:nl + 1]
+
+
+def _extract_commands(buffer: bytearray) -> list:
+    """Every complete inbound message in ``buffer`` (frames + JSON lines).
+
+    Mutates the buffer in place; incomplete trailing frames/lines stay
+    buffered for the next read.  Malformed input — bad magic or version,
+    oversized lengths, non-JSON frame headers, torn compressed bodies —
+    is answered with a clean ``error`` event and resynced past, NEVER
+    allowed to hang the loop or kill the resident runtime (a channel
+    death mid-frame simply leaves the partial frame buffered until the
+    reader sees EOF).  Torn bodies carry ``permanent: true``: re-sending
+    identical corrupt bytes can never succeed.
+    """
+    commands: list = []
+    while buffer:
+        if buffer[0] == _FRAME_MAGIC[0]:
+            if len(buffer) < _FRAME_HEADER.size:
+                break  # header still in flight
+            magic, version, _verb, flags, hlen, blen = _FRAME_HEADER.unpack(
+                bytes(buffer[:_FRAME_HEADER.size])
+            )
+            if magic != _FRAME_MAGIC or version != _FRAME_VERSION:
+                _emit({
+                    "event": "error", "code": "bad_frame",
+                    "message": (
+                        f"bad frame magic/version ({magic!r} v{version})"
+                    ),
+                })
+                _frame_resync(buffer)
+                continue
+            if hlen > _FRAME_MAX_HEADER or blen > _FRAME_MAX_BODY:
+                _emit({
+                    "event": "error", "code": "bad_frame",
+                    "message": (
+                        f"oversized frame (header {hlen}B, body {blen}B)"
+                    ),
+                })
+                _frame_resync(buffer)
+                continue
+            total = _FRAME_HEADER.size + hlen + blen
+            if len(buffer) < total:
+                break  # body still in flight
+            header = bytes(buffer[_FRAME_HEADER.size:_FRAME_HEADER.size + hlen])
+            body = bytes(buffer[_FRAME_HEADER.size + hlen:total])
+            del buffer[:total]
+            try:
+                command = json.loads(header.decode("utf-8"))
+                if not isinstance(command, dict):
+                    raise ValueError("frame header is not an object")
+            except (ValueError, UnicodeDecodeError) as err:
+                # Frame consumed whole (lengths were valid): the stream
+                # stays in sync, only this message is refused.
+                _emit({"event": "error", "code": "bad_frame",
+                       "message": f"frame header is not JSON: {err}"})
+                continue
+            if flags & _FRAME_FLAG_ZLIB:
+                try:
+                    body = zlib.decompress(body)
+                except zlib.error as err:
+                    ids = [str(command.get("id") or "")]
+                    if command.get("cmd") == "multi_invoke":
+                        # A batched frame's op ids live in ops: the
+                        # permanent refusal must reach EVERY waiting op,
+                        # not evaporate as one id-less log line.
+                        ids = [
+                            str(op.get("id") or "")
+                            for op in (command.get("ops") or [])
+                            if isinstance(op, dict)
+                        ] or ids
+                    for tid in ids:
+                        _emit({
+                            "event": "error", "id": tid,
+                            "code": "bad_frame", "permanent": True,
+                            "message": (
+                                "frame body failed decompression "
+                                f"(torn payload): {err}"
+                            ),
+                        })
+                    continue
+            key = command.pop("_body", None)
+            if key:
+                command[str(key)] = body
+            commands.append(command)
+        else:
+            nl = buffer.find(b"\n")
+            if nl < 0:
+                break  # line still in flight
+            raw = bytes(buffer[:nl])
+            del buffer[:nl + 1]
+            line = raw.decode(errors="replace").strip()
+            if not line:
+                continue
+            try:
+                command = json.loads(line)
+            except ValueError:
+                _emit({"event": "error", "message": "malformed command"})
+                continue
+            if isinstance(command, dict):
+                commands.append(command)
+            else:
+                _emit({"event": "error", "message": "malformed command"})
+    return commands
+
+
+class _TelemetryBatcher:
+    """Micro-batch coalescing for side-band telemetry frames.
+
+    At 1000+ tokens/s per session the per-record line write + flush + JSON
+    parse became its own hot path.  Intermediate ``serve.token`` chunks
+    buffer up to a few ms (COVALENT_TPU_SERVE_COALESCE_MS, default 2) or N
+    records (COVALENT_TPU_SERVE_COALESCE_MAX, default 32) and ship as ONE
+    ``telemetry_batch`` frame whose body is the JSON array of records.
+    Everything latency-sensitive — done markers, rejects, stats,
+    heartbeats, lifecycle events — flushes the pending buffer and itself
+    immediately, so per-id ordering is preserved and stream-final latency
+    is untouched.  Each record keeps its own envelope (seq, cumulative
+    ``idx``), so the dispatcher's dedup and the serving tier's
+    exactly-once replay splice see exactly the records they always did.
+    With frames off every record ships as its own JSON line — the
+    pre-frame protocol, byte for byte.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._pending: dict = {}  # id -> [records]
+        self._oldest: dict = {}   # id -> monotonic stamp of first record
+        try:
+            self.window_s = max(0.0, float(os.environ.get(
+                "COVALENT_TPU_SERVE_COALESCE_MS", "2"
+            )) / 1000.0)
+        except ValueError:
+            self.window_s = 0.002
+        try:
+            self.max_records = max(1, int(os.environ.get(
+                "COVALENT_TPU_SERVE_COALESCE_MAX", "32"
+            )))
+        except ValueError:
+            self.max_records = 32
+
+    def reset(self) -> None:
+        """Forked children must not inherit buffers or a held lock."""
+        self._lock = threading.Lock()
+        self._pending = {}
+        self._oldest = {}
+
+    def emit(self, task_id: str, data: dict) -> None:
+        if not _FRAMES["out"] or self.window_s <= 0:
+            _emit({"event": "telemetry", "id": task_id, "data": data})
+            return
+        urgent = data.get("type") != "serve.token" or data.get("done")
+        with self._lock:
+            self._pending.setdefault(task_id, []).append(data)
+            self._oldest.setdefault(task_id, time.monotonic())
+            full = len(self._pending[task_id]) >= self.max_records
+        if urgent or full:
+            self.flush()
+
+    def flush(self) -> None:
+        with self._lock:
+            pending, self._pending = self._pending, {}
+            self._oldest = {}
+        for task_id, records in pending.items():
+            emit_telemetry_batch(task_id, records)
+
+    def flush_aged(self) -> None:
+        """Ship buffers older than the window (called by the owning loops)."""
+        if not self._pending:
+            return
+        now = time.monotonic()
+        groups = []
+        with self._lock:
+            for task_id, t0 in list(self._oldest.items()):
+                if now - t0 >= self.window_s:
+                    records = self._pending.pop(task_id, None)
+                    self._oldest.pop(task_id, None)
+                    if records:
+                        groups.append((task_id, records))
+        for task_id, records in groups:
+            emit_telemetry_batch(task_id, records)
+
+
+def emit_telemetry_batch(task_id: str, records: list) -> None:
+    """One coalesced telemetry frame (or per-record lines when frames off)."""
+    if not _FRAMES["out"]:
+        for data in records:
+            _emit({"event": "telemetry", "id": task_id, "data": data})
+        return
+    try:
+        body = json.dumps(records, default=repr).encode()
+    except (TypeError, ValueError):
+        return
+    _emit_frame(
+        _VERB_TELEMETRY,
+        {"event": "telemetry_batch", "id": task_id,
+         "count": len(records), "_body": "records"},
+        body,
+    )
+
+
+_BATCHER = _TelemetryBatcher()
+
+
 def _spawn_task(command: dict, children: dict) -> None:
     task_id = command.get("id")
     spec_path = command.get("spec")
@@ -580,6 +891,12 @@ def _spawn_task(command: dict, children: dict) -> None:
             global _worker_event_lock, _EMIT_LOCK
             _worker_event_lock = threading.Lock()
             _EMIT_LOCK = threading.Lock()
+            # The child's stdout is about to become the task log, not the
+            # protocol channel: frame mode and any half-filled telemetry
+            # batch belong to the server process alone.
+            _FRAMES["out"] = False
+            _FRAMES["codec"] = ""
+            _BATCHER.reset()
             # The child is a task runner, not a session host: an inherited
             # copy of the server's live sessions would make its heartbeats
             # report a frozen fork-time serve occupancy forever.
@@ -704,8 +1021,13 @@ def _decode_rpc_args(command: dict) -> tuple:
 
     import cloudpickle
 
+    raw = command.get("args_bytes")
     b64 = command.get("args")
-    if b64 is not None:
+    if raw is not None:
+        # Binary-frame road: the channel delivered the exact pickle bytes,
+        # no base64 leg to pay or verify.
+        data = raw
+    elif b64 is not None:
         data = base64.b64decode(b64)
     else:
         path = command.get("args_path")
@@ -783,6 +1105,16 @@ def _emit_rpc_result(task_id: str, result, exception, command: dict) -> None:
                 "bytes": len(data),
             })
             return
+    if _FRAMES["out"]:
+        # Negotiated binary road: the raw pickle rides the frame body —
+        # no base64 inflation, no giant JSON string to escape and parse.
+        _emit_frame(
+            _VERB_RESULT,
+            {"event": "result", "id": task_id,
+             "ok": exception is None, "_body": "data_bytes"},
+            data,
+        )
+        return
     _emit({
         "event": "result", "id": task_id,
         "ok": exception is None,
@@ -799,10 +1131,7 @@ def _emit_rpc_event(spec: dict, task_id: str, type: str, **fields) -> None:
     ``rpc`` marker tells the dispatcher these events did NOT also land in
     a shared-filesystem sink, so they re-emit even on the local transport.
     """
-    _emit({
-        "event": "telemetry", "id": task_id,
-        "data": _build_worker_event(spec, type, rpc=True, **fields),
-    })
+    _BATCHER.emit(task_id, _build_worker_event(spec, type, rpc=True, **fields))
 
 
 def _start_rpc_heartbeat(spec: dict, task_id: str):
@@ -903,6 +1232,65 @@ def _rpc_invoke(command: dict, registry: dict, sync: bool = False) -> None:
     ).start()
 
 
+def _rpc_multi_invoke(command: dict, registry: dict) -> None:
+    """Batched invoke: N queued electrons for one digest in ONE frame.
+
+    The frame header carries the per-op command dicts (id, spec,
+    result_path, ...) plus ``args_lens``; the body is the concatenation of
+    each op's args pickle, split back out here by length.  One
+    ``multi_started`` acks every op at once; results fan back out by op id
+    through the exact same per-invocation path a lone ``invoke`` takes —
+    each op gets its own thread, heartbeats, and result event.  A body
+    whose lengths don't reconcile is torn content (``permanent``): the
+    dispatcher must not burn retries re-sending identical corrupt bytes.
+    """
+    digest = command.get("digest")
+    ops = [op for op in (command.get("ops") or []) if isinstance(op, dict)]
+    lens = command.get("args_lens") or []
+    body = command.get("args_bytes") or b""
+    ids = [str(op.get("id") or "") for op in ops]
+    if not digest or not ops or len(lens) != len(ops):
+        for tid in ids or [""]:
+            _emit({"event": "error", "id": tid, "code": "bad_request",
+                   "message": "multi_invoke requires digest, ops and "
+                              "args_lens"})
+        return
+    try:
+        lens = [int(n) for n in lens]
+        lens_ok = all(n >= 0 for n in lens) and sum(lens) == len(body)
+    except (TypeError, ValueError):
+        lens_ok = False
+    if not lens_ok:
+        for tid in ids:
+            _emit({"event": "error", "id": tid, "code": "bad_frame",
+                   "permanent": True,
+                   "message": "multi_invoke args_lens do not match the "
+                              "frame body (torn payload)"})
+        return
+    fn = registry.get(digest)
+    if fn is None and command.get("path"):
+        code, loaded = _load_fn_payload(command["path"], digest)
+        if not code:
+            registry[digest] = fn = loaded
+    if fn is None:
+        for tid in ids:
+            _emit({"event": "error", "id": tid, "code": "unregistered",
+                   "message": f"no registered function for digest "
+                              f"{str(digest)[:12]}"})
+        return
+    _emit({"event": "multi_started", "ids": ids, "pid": os.getpid(),
+           "rpc": True})
+    offset = 0
+    for op, n in zip(ops, lens):
+        op = dict(op)
+        op["args_bytes"] = body[offset:offset + n]
+        offset += n
+        threading.Thread(
+            target=_run_rpc_task, args=(op, fn),
+            name=f"covalent-tpu-rpc-{op.get('id')}", daemon=True,
+        ).start()
+
+
 def rpc_child() -> int:
     """``harness.py --rpc-child``: one invocation, command on stdin.
 
@@ -913,18 +1301,28 @@ def rpc_child() -> int:
     interpreter start per call) but keeps the protocol — and the
     no-disk-for-args/results property — uniform across both runtimes.
     """
-    line = sys.stdin.readline()
-    if not line.strip():
-        print("usage: harness.py --rpc-child  (invoke command on stdin)",
-              file=sys.stderr)
-        return 2
-    try:
-        command = json.loads(line)
-    except ValueError:
+    buffer = bytearray()
+    saw_bytes = False
+    while True:
+        for command in _extract_commands(buffer):
+            if command.get("cmd") == "frames":
+                # The native agent pre-announces the client's negotiated
+                # frame mode so this runner's result events ride frames.
+                _handle_frames_cmd(command)
+                continue
+            _rpc_invoke(command, {}, sync=True)
+            return 0
+        data = sys.stdin.buffer.read1(65536)
+        if not data:
+            break
+        saw_bytes = True
+        buffer.extend(data)
+    if saw_bytes:
         _emit({"event": "error", "message": "malformed invoke command"})
         return 1
-    _rpc_invoke(command, {}, sync=True)
-    return 0
+    print("usage: harness.py --rpc-child  (invoke command on stdin)",
+          file=sys.stderr)
+    return 2
 
 
 # --------------------------------------------------------------------------
@@ -1265,11 +1663,16 @@ class _ServeSession:
     # -- emission ----------------------------------------------------------
 
     def _emit_serve(self, type: str, **fields) -> None:
-        """One session record over the telemetry side-band (seq-stamped)."""
-        _emit({
-            "event": "telemetry", "id": self.sid,
-            "data": _build_worker_event(self.spec, type, rpc=True, **fields),
-        })
+        """One session record over the telemetry side-band (seq-stamped).
+
+        Routed through the coalescer: intermediate token chunks micro-
+        batch into one frame per window, everything else flushes through
+        immediately (and in order).
+        """
+        _BATCHER.emit(
+            self.sid,
+            _build_worker_event(self.spec, type, rpc=True, **fields),
+        )
 
     def _emit_reject(self, rid: str, code: str, message: str) -> None:
         self._emit_serve(
@@ -1455,6 +1858,9 @@ class _ServeSession:
                         command = None
                     if command is not None:
                         self.queue.put(command)
+                # Age-out the coalescing buffer: a token batch must never
+                # wait on MORE tokens to ship once its window expires.
+                _BATCHER.flush_aged()
                 if (
                     self.stats_interval_s > 0
                     and time.monotonic() - last_stats >= self.stats_interval_s
@@ -1469,6 +1875,10 @@ class _ServeSession:
                 except BaseException:  # noqa: BLE001 - teardown best-effort
                     pass
             self._emit_stats()
+            # The stats record is urgent (non-token) so the coalescer has
+            # flushed every buffered token ahead of it; serve_closed must
+            # still never overtake a straggler batch.
+            _BATCHER.flush()
             _SERVE_SESSIONS.pop(self.sid, None)
             _emit({
                 "event": "serve_closed", "id": self.sid,
@@ -1544,32 +1954,36 @@ def serve_child() -> int:
     """
     sessions: dict = {}
     opened: list = []  # every session ever opened, for the final drain
-    for line in sys.stdin:
-        line = line.strip()
-        if not line:
-            continue
-        try:
-            command = json.loads(line)
-        except ValueError:
-            _emit({"event": "error", "message": "malformed serve command"})
-            continue
-        name = command.get("cmd")
-        if name == "serve_open":
-            _serve_open(command, sessions)
-            session = sessions.get(str(command.get("id") or ""))
-            if session is not None and session not in opened:
-                opened.append(session)
-        elif name == "serve_request":
-            _serve_request(command, sessions)
-        elif name == "profile_start":
-            _profile_start(command)
-        elif name == "profile_stop":
-            _profile_stop(command)
-        elif name == "serve_close":
-            _serve_close(command, sessions)
+    buffer = bytearray()
+    closing = False
+    while not closing:
+        for command in _extract_commands(buffer):
+            name = command.get("cmd")
+            if name == "frames":
+                _handle_frames_cmd(command)
+            elif name == "serve_open":
+                _serve_open(command, sessions)
+                session = sessions.get(str(command.get("id") or ""))
+                if session is not None and session not in opened:
+                    opened.append(session)
+            elif name == "serve_request":
+                _serve_request(command, sessions)
+            elif name == "profile_start":
+                _profile_start(command)
+            elif name == "profile_stop":
+                _profile_stop(command)
+            elif name == "serve_close":
+                _serve_close(command, sessions)
+                closing = True
+                break
+            else:
+                _emit({"event": "error", "message": f"unknown cmd: {name}"})
+        if closing:
             break
-        else:
-            _emit({"event": "error", "message": f"unknown cmd: {name}"})
+        data = sys.stdin.buffer.read1(65536)
+        if not data:
+            break  # EOF closes the session, as before
+        buffer.extend(data)
     for session in sessions.values():
         session.close()
     for session in opened:
@@ -1607,6 +2021,7 @@ def _pump_watchers(watchers: dict) -> None:
         except OSError:
             continue
         w["buf"] += chunk
+        records = []
         while "\n" in w["buf"]:
             line, w["buf"] = w["buf"].split("\n", 1)
             line = line.strip()
@@ -1617,7 +2032,11 @@ def _pump_watchers(watchers: dict) -> None:
             except ValueError:
                 continue
             if isinstance(data, dict):
-                _emit({"event": "telemetry", "id": task_id, "data": data})
+                records.append(data)
+        if records:
+            # One frame per pump per task (or per-record lines when frames
+            # are off): a telemetry burst costs one write, not one per line.
+            emit_telemetry_batch(task_id, records)
 
 
 def _reap(children: dict, watchers: dict | None = None) -> None:
@@ -1680,10 +2099,16 @@ def serve() -> int:
     #: sid -> _ServeSession (serve_open cmd); sessions die with the
     #: channel — a reconnecting dispatcher re-opens on a fresh server.
     serve_sessions: dict = {}
-    buffer = ""
+    buffer = bytearray()
     running = True
     stdin_open = True
-    _emit({"event": "ready", "pid": os.getpid(), "mode": "pool"})
+    banner: dict = {"event": "ready", "pid": os.getpid(), "mode": "pool"}
+    if _frames_enabled():
+        # Capability advertisement: the client answers with a `frames`
+        # command (or stays silently on JSONL — old clients, kill switch).
+        banner["frames"] = _FRAME_VERSION
+        banner["codecs"] = ["zlib"]
+    _emit(banner)
 
     while running and (stdin_open or children):
         # With live watchers the select wakes on a short tick so telemetry
@@ -1711,25 +2136,21 @@ def serve() -> int:
                     session.close()
                 serve_sessions.clear()
                 continue
-            buffer += data.decode(errors="replace")
-            while "\n" in buffer:
-                line, buffer = buffer.split("\n", 1)
-                if not line.strip():
-                    continue
-                try:
-                    command = json.loads(line)
-                except ValueError:
-                    _emit({"event": "error", "message": "malformed command"})
-                    continue
+            buffer.extend(data)
+            for command in _extract_commands(buffer):
                 name = command.get("cmd")
                 if name == "ping":
                     _emit({"event": "pong"})
+                elif name == "frames":
+                    _handle_frames_cmd(command)
                 elif name == "run":
                     _spawn_task(command, children)
                 elif name == "register_fn":
                     _rpc_register(command, rpc_registry)
                 elif name == "invoke":
                     _rpc_invoke(command, rpc_registry)
+                elif name == "multi_invoke":
+                    _rpc_multi_invoke(command, rpc_registry)
                 elif name == "serve_open":
                     _serve_open(command, serve_sessions)
                 elif name == "serve_request":

@@ -330,6 +330,82 @@ static void emit_error(const std::string& message, const std::string& id = "") {
 }
 
 // ---------------------------------------------------------------------------
+// Binary frame protocol (negotiated; JSONL stays the fallback).
+//
+// Wire layout (mirrors harness.py and transport/frames.py — the three are
+// kept byte-compatible by tests/test_frames.py):
+//
+//   magic(2)=C5 F7  version(1)  verb(1)  flags(1)  hlen(4 BE)  blen(4 BE)
+//   header: UTF-8 JSON object   body: raw bytes
+//
+// This agent holds no Python runtime, so it never encodes or decodes frame
+// BODIES: inbound invoke/serve frames forward VERBATIM into the runner
+// children (which parse frames natively), and runner output frames —
+// binary results, coalesced token batches — pass through the stream pump
+// verbatim upstream.  The agent itself only reads frame HEADERS (plain
+// JSON) to route by session/registration, plus emits header-only frames
+// for its own watch side-band batches.  Negotiation rides the ready
+// banner: `"frames":1` advertised, client answers `{"cmd":"frames"}`, ack
+// flips the mode; the COVALENT_TPU_AGENT_FRAMES=0 env kill switch keeps
+// the agent JSONL-only.  No "codecs" are advertised, so clients never
+// compress bodies toward a native agent.
+// ---------------------------------------------------------------------------
+
+static const unsigned char kFrameMagic0 = 0xC5;
+static const unsigned char kFrameMagic1 = 0xF7;
+static const unsigned char kFrameVersion = 1;
+static const size_t kFrameHeaderLen = 13;
+static const uint64_t kFrameMaxHeader = 16ull * 1024 * 1024;
+static const uint64_t kFrameMaxBody = 512ull * 1024 * 1024;
+static const uint8_t kVerbTelemetry = 3;
+
+static bool g_frames = false;
+
+static bool frames_env_enabled() {
+  const char* env = getenv("COVALENT_TPU_AGENT_FRAMES");
+  if (!env) return true;
+  std::string v(env);
+  return !(v == "0" || v == "off" || v == "false" || v == "no");
+}
+
+static uint32_t read_be32(const char* p) {
+  return ((uint32_t)(unsigned char)p[0] << 24) |
+         ((uint32_t)(unsigned char)p[1] << 16) |
+         ((uint32_t)(unsigned char)p[2] << 8) |
+         (uint32_t)(unsigned char)p[3];
+}
+
+static void emit_raw(const std::string& bytes) {
+  fwrite(bytes.data(), 1, bytes.size(), stdout);
+  fflush(stdout);
+}
+
+static void emit_frame(uint8_t verb, const std::string& header,
+                       const std::string& body) {
+  unsigned char h[kFrameHeaderLen];
+  h[0] = kFrameMagic0; h[1] = kFrameMagic1;
+  h[2] = kFrameVersion; h[3] = verb; h[4] = 0;
+  uint32_t hl = (uint32_t)header.size(), bl = (uint32_t)body.size();
+  h[5] = (unsigned char)(hl >> 24); h[6] = (unsigned char)(hl >> 16);
+  h[7] = (unsigned char)(hl >> 8);  h[8] = (unsigned char)hl;
+  h[9] = (unsigned char)(bl >> 24); h[10] = (unsigned char)(bl >> 16);
+  h[11] = (unsigned char)(bl >> 8); h[12] = (unsigned char)bl;
+  fwrite(h, 1, sizeof h, stdout);
+  fwrite(header.data(), 1, header.size(), stdout);
+  if (!body.empty()) fwrite(body.data(), 1, body.size(), stdout);
+  fflush(stdout);
+}
+
+// After a bad magic/version/length the stream position is untrusted; the
+// next newline is the only honest resync point (valid traffic is
+// self-delimiting frames or newline-terminated JSON).
+static void frame_resync(std::string& buffer) {
+  size_t nl = buffer.find('\n', 1);
+  if (nl == std::string::npos) buffer.clear();
+  else buffer.erase(0, nl + 1);
+}
+
+// ---------------------------------------------------------------------------
 // SHA-256 (FIPS 180-4): register_fn digest verification, no dependencies.
 // ---------------------------------------------------------------------------
 
@@ -587,7 +663,12 @@ static void register_fn(const Json& cmd) {
        "\"}");
 }
 
-static void invoke_task(const Json& cmd, const std::string& raw_line) {
+// `payload` is the exact byte sequence piped to the runner child: the
+// invoke line + "\n" on the JSONL path, or the raw invoke FRAME verbatim
+// on the negotiated binary path (the Python runner parses both).  With
+// frames negotiated, a frames-enable line precedes it so the runner's own
+// result events come back framed and pass through the pump untouched.
+static void invoke_task(const Json& cmd, const std::string& payload) {
   const Json* id_field = cmd.get("id");
   const Json* digest = cmd.get("digest");
   if (!id_field || id_field->type != Json::Str || !digest ||
@@ -641,11 +722,13 @@ static void invoke_task(const Json& cmd, const std::string& raw_line) {
   close(in_pipe[0]);
   close(out_pipe[1]);
   // Feed the invoke command — it carries the CAS path and inline args, so
-  // the runner needs no disk staging — then close: exactly one line.
-  std::string payload = raw_line + "\n";
+  // the runner needs no disk staging — then close: exactly one command.
+  std::string full = g_frames
+      ? std::string("{\"cmd\":\"frames\",\"version\":1}\n") + payload
+      : payload;
   size_t off = 0;
-  while (off < payload.size()) {
-    ssize_t n = write(in_pipe[1], payload.data() + off, payload.size() - off);
+  while (off < full.size()) {
+    ssize_t n = write(in_pipe[1], full.data() + off, full.size() - off);
     if (n <= 0) break;
     off += (size_t)n;
   }
@@ -749,7 +832,12 @@ static void serve_open(const Json& cmd, const std::string& raw_line) {
   close(out_pipe[1]);
   // The serve_open line itself is the child's first command (it carries
   // the CAS path + options); the pipe stays open for the session's life.
-  if (!write_all(in_pipe[1], raw_line + "\n")) {
+  // With frames negotiated upstream, a frames-enable line goes first so
+  // the child's token stream comes back as coalesced binary frames.
+  std::string first = g_frames
+      ? std::string("{\"cmd\":\"frames\",\"version\":1}\n") + raw_line + "\n"
+      : raw_line + "\n";
+  if (!write_all(in_pipe[1], first)) {
     // Child unreachable at birth: fail the open (transient — a fresh
     // gang can retry), close both pipe ends so the child EOFs out, and
     // register ONLY the pid (the reaper needs it) — a session entry
@@ -769,7 +857,10 @@ static void serve_open(const Json& cmd, const std::string& raw_line) {
   // factory settles — nothing synthesized here.
 }
 
-static void serve_forward(const Json& cmd, const std::string& raw_line,
+// `payload` is the exact byte sequence forwarded to the session child —
+// a command line + "\n", or a raw binary frame verbatim (the --serve-child
+// loop parses both off one stream).
+static void serve_forward(const Json& cmd, const std::string& payload,
                           bool is_close) {
   const Json* id_field = cmd.get("id");
   const std::string sid =
@@ -792,7 +883,7 @@ static void serve_forward(const Json& cmd, const std::string& raw_line,
     }
     return;
   }
-  bool ok = write_all(it->second.stdin_fd, raw_line + "\n");
+  bool ok = write_all(it->second.stdin_fd, payload);
   if (is_close || !ok) {
     // Close (or a torn pipe): EOF the child's stdin; it drains admitted
     // lanes, emits serve_closed, and exits — the reaper cleans the maps.
@@ -891,8 +982,31 @@ static void pump_rpc_stream(int fd) {
   }
   RpcStream& s = it->second;
   s.buf.append(chunk, (size_t)n);
-  size_t nl;
-  while ((nl = s.buf.find('\n')) != std::string::npos) {
+  while (!s.buf.empty()) {
+    if ((unsigned char)s.buf[0] == kFrameMagic0) {
+      // Runner-emitted binary frame (framed result, coalesced token
+      // batch): forward VERBATIM — this agent never decodes bodies.
+      if (s.buf.size() < kFrameHeaderLen) break;
+      if ((unsigned char)s.buf[1] != kFrameMagic1 ||
+          (unsigned char)s.buf[2] != kFrameVersion) {
+        // Corrupt child output must never desync the upstream channel.
+        frame_resync(s.buf);
+        continue;
+      }
+      uint64_t hl = read_be32(s.buf.data() + 5);
+      uint64_t bl = read_be32(s.buf.data() + 9);
+      if (hl > kFrameMaxHeader || bl > kFrameMaxBody) {
+        frame_resync(s.buf);
+        continue;
+      }
+      uint64_t total = kFrameHeaderLen + hl + bl;
+      if (s.buf.size() < total) break;
+      emit_raw(s.buf.substr(0, (size_t)total));
+      s.buf.erase(0, (size_t)total);
+      continue;
+    }
+    size_t nl = s.buf.find('\n');
+    if (nl == std::string::npos) break;
     std::string line = s.buf.substr(0, nl);
     s.buf.erase(0, nl + 1);
     if (line.empty()) continue;
@@ -980,6 +1094,7 @@ static void pump_watchers() {
     }
     close(fd);
     size_t nl;
+    std::vector<std::string> records;
     while ((nl = w.buf.find('\n')) != std::string::npos) {
       std::string line = w.buf.substr(0, nl);
       w.buf.erase(0, nl + 1);
@@ -988,8 +1103,29 @@ static void pump_watchers() {
       // Validate before forwarding; a valid line embeds verbatim as the
       // data object (it is already JSON).
       if (!parse_json(line, parsed) || parsed.type != Json::Obj) continue;
-      emit("{\"event\":\"telemetry\",\"id\":\"" + json_escape(kv.first) +
-           "\",\"data\":" + line + "}");
+      records.push_back(line);
+    }
+    if (records.empty()) continue;
+    if (g_frames) {
+      // One telemetry_batch frame per pump per task: a heartbeat/event
+      // burst costs one write upstream, not one per line.  The body is
+      // the JSON array of the validated records.
+      std::string body = "[";
+      for (size_t r = 0; r < records.size(); r++) {
+        if (r) body += ",";
+        body += records[r];
+      }
+      body += "]";
+      emit_frame(kVerbTelemetry,
+                 "{\"event\":\"telemetry_batch\",\"id\":\"" +
+                     json_escape(kv.first) + "\",\"count\":" +
+                     std::to_string(records.size()) +
+                     ",\"_body\":\"records\"}",
+                 body);
+    } else {
+      for (const auto& line : records)
+        emit("{\"event\":\"telemetry\",\"id\":\"" + json_escape(kv.first) +
+             "\",\"data\":" + line + "}");
     }
   }
 }
@@ -1036,12 +1172,22 @@ static void handle_line(const std::string& line, bool& running) {
   }
   const std::string& name = cmd_field->s;
   if (name == "ping") emit("{\"event\":\"pong\"}");
+  else if (name == "frames") {
+    // Negotiation: ack then flip to frames.  The kill switch answers
+    // version 0 so a capable client settles on JSONL immediately.
+    if (frames_env_enabled()) {
+      emit("{\"event\":\"frames\",\"version\":1}");
+      g_frames = true;
+    } else {
+      emit("{\"event\":\"frames\",\"version\":0}");
+    }
+  }
   else if (name == "run") spawn(cmd);
   else if (name == "register_fn") register_fn(cmd);
-  else if (name == "invoke") invoke_task(cmd, line);
+  else if (name == "invoke") invoke_task(cmd, line + "\n");
   else if (name == "serve_open") serve_open(cmd, line);
-  else if (name == "serve_request") serve_forward(cmd, line, false);
-  else if (name == "serve_close") serve_forward(cmd, line, true);
+  else if (name == "serve_request") serve_forward(cmd, line + "\n", false);
+  else if (name == "serve_close") serve_forward(cmd, line + "\n", true);
   else if (name == "profile_start") profile_forward(cmd, line, false);
   else if (name == "profile_stop") profile_forward(cmd, line, true);
   else if (name == "kill") kill_task(cmd);
@@ -1049,6 +1195,88 @@ static void handle_line(const std::string& line, bool& running) {
   else if (name == "unwatch") unwatch_task(cmd);
   else if (name == "shutdown") { emit("{\"event\":\"bye\"}"); running = false; }
   else emit_error("unknown cmd: " + name);
+}
+
+// One complete inbound FRAME: route by the header's cmd.  Frames whose
+// body must reach a runner child (invoke, serve_request/close) forward
+// the raw frame bytes verbatim; header-only commands replay through
+// handle_line — the header IS the JSON command.  A non-JSON header is a
+// consumed, sync-preserving refusal (the lengths were valid).
+static void handle_frame(const std::string& header, const std::string& raw,
+                         bool& running) {
+  Json cmd;
+  if (!parse_json(header, cmd) || cmd.type != Json::Obj) {
+    emit_error("bad frame header");
+    return;
+  }
+  const Json* cmd_field = cmd.get("cmd");
+  const std::string name =
+      (cmd_field && cmd_field->type == Json::Str) ? cmd_field->s : "";
+  if (name == "invoke") {
+    invoke_task(cmd, raw);
+  } else if (name == "multi_invoke") {
+    // Batched invoke needs the resident pool interpreter; this agent
+    // forks one runner per invocation.  Clients only batch toward pool
+    // runtimes — refuse per op so no waiter sits out its timeout.
+    const Json* ops = cmd.get("ops");
+    if (ops && ops->type == Json::Arr) {
+      for (const auto& op : ops->arr) {
+        const Json* id = op.get("id");
+        emit("{\"event\":\"error\",\"id\":\"" +
+             json_escape(id && id->type == Json::Str ? id->s : "") +
+             "\",\"code\":\"unsupported\",\"message\":\"multi_invoke "
+             "requires the pool runtime\"}");
+      }
+    } else {
+      emit_error("multi_invoke requires ops");
+    }
+  } else if (name == "serve_request") {
+    serve_forward(cmd, raw, false);
+  } else if (name == "serve_close") {
+    serve_forward(cmd, raw, true);
+  } else {
+    handle_line(header, running);
+  }
+}
+
+// Extract every complete message (frame or line) from the stdin buffer.
+// Malformed frames answer a clean error and resync at the next newline —
+// the command loop must keep serving (fuzz contract: fail loud, never
+// hang); a frame truncated by channel death simply stays buffered until
+// the read loop sees EOF.
+static void process_buffer(std::string& buffer, bool& running) {
+  while (!buffer.empty()) {
+    if ((unsigned char)buffer[0] == kFrameMagic0) {
+      if (buffer.size() < kFrameHeaderLen) return;
+      if ((unsigned char)buffer[1] != kFrameMagic1 ||
+          (unsigned char)buffer[2] != kFrameVersion) {
+        emit("{\"event\":\"error\",\"code\":\"bad_frame\",\"message\":"
+             "\"bad frame magic/version\"}");
+        frame_resync(buffer);
+        continue;
+      }
+      uint64_t hl = read_be32(buffer.data() + 5);
+      uint64_t bl = read_be32(buffer.data() + 9);
+      if (hl > kFrameMaxHeader || bl > kFrameMaxBody) {
+        emit("{\"event\":\"error\",\"code\":\"bad_frame\",\"message\":"
+             "\"oversized frame\"}");
+        frame_resync(buffer);
+        continue;
+      }
+      uint64_t total = kFrameHeaderLen + hl + bl;
+      if (buffer.size() < total) return;
+      std::string header = buffer.substr(kFrameHeaderLen, (size_t)hl);
+      std::string raw = buffer.substr(0, (size_t)total);
+      buffer.erase(0, (size_t)total);
+      handle_frame(header, raw, running);
+    } else {
+      size_t pos = buffer.find('\n');
+      if (pos == std::string::npos) return;
+      std::string line = buffer.substr(0, pos);
+      buffer.erase(0, pos + 1);
+      handle_line(line, running);
+    }
+  }
 }
 
 int main() {
@@ -1063,7 +1291,10 @@ int main() {
   sigaction(SIGCHLD, &sa, nullptr);
   signal(SIGPIPE, SIG_IGN);
 
-  emit("{\"event\":\"ready\",\"pid\":" + std::to_string((long long)getpid()) + "}");
+  std::string banner =
+      "{\"event\":\"ready\",\"pid\":" + std::to_string((long long)getpid());
+  if (frames_env_enabled()) banner += ",\"frames\":1";
+  emit(banner + "}");
 
   std::string buffer;
   bool running = true;
@@ -1113,12 +1344,7 @@ int main() {
           continue;
         }
         buffer.append(chunk, (size_t)n);
-        size_t pos;
-        while ((pos = buffer.find('\n')) != std::string::npos) {
-          std::string line = buffer.substr(0, pos);
-          buffer.erase(0, pos + 1);
-          handle_line(line, running);
-        }
+        process_buffer(buffer, running);
       }
     }
   }

@@ -179,6 +179,14 @@ _EXECUTOR_PLUGIN_DEFAULTS = {
     # plugins register at import) measurably slows TPU backend init in the
     # children; interpreter+sitecustomize startup is the big win anyway.
     "pool_preload": "cloudpickle",
+    # Binary agent-channel frames (transport/frames.py): negotiated on the
+    # ready-banner handshake; RPC args/results and streamed serve tokens
+    # then ride length-prefixed raw-pickle frames (no base64, optional
+    # zlib body codec) with invoke micro-batching and token coalescing.
+    # Either side declining — COVALENT_TPU_AGENT_FRAMES=0 here, the same
+    # kill switch in the worker env, or an old runtime — degrades to the
+    # byte-equal JSONL fallback.
+    "agent_frames": True,
     # Wire codec (transport/codec.py): "auto" negotiates the best codec
     # both ends support (zstd > zlib > raw) during pre-flight and applies
     # it to staged uploads — same round-trip count, fewer bytes; "zlib"/
@@ -426,6 +434,7 @@ class TPUExecutor(RemoteExecutor):
         dispatch_mode: str | None = None,
         rpc_inline_args_max: int | None = None,
         pool_preload: str | None = None,
+        agent_frames: bool | None = None,
         compress: str | None = None,
         bundle: bool | None = None,
         prewarm: bool | None = None,
@@ -545,6 +554,16 @@ class TPUExecutor(RemoteExecutor):
         self.last_dispatch_mode = ""
         #: comma-separated modules the pool server imports once at start.
         self.pool_preload = str(resolve(pool_preload, "pool_preload"))
+        #: binary agent-channel frames: explicit arg >
+        #: COVALENT_TPU_AGENT_FRAMES > config.  The kill switch only stops
+        #: THIS side from negotiating — the runtime keeps advertising, and
+        #: either side declining leaves the channel on the JSONL fallback.
+        env_frames = os.environ.get("COVALENT_TPU_AGENT_FRAMES")
+        if agent_frames is None and env_frames is not None:
+            agent_frames = env_frames.strip().lower() not in (
+                "0", "off", "false", "no"
+            )
+        self.agent_frames = bool(resolve(agent_frames, "agent_frames"))
         #: wire codec policy: explicit arg > COVALENT_TPU_COMPRESS > config.
         env_compress = os.environ.get("COVALENT_TPU_COMPRESS")
         if compress is None and env_compress is not None:
@@ -1683,6 +1702,14 @@ class TPUExecutor(RemoteExecutor):
                 self._agents.pop(conn.address, None)
             for mode in modes:
                 try:
+                    # Frame-body compression mirrors the staging codec's
+                    # opt-in download leg: only a PINNED codec engages it
+                    # (deflate time beats the b64+JSON tax only when the
+                    # wire is the bottleneck); zlib is the one codec the
+                    # stdlib-only worker side always has.
+                    frames_codec = (
+                        "zlib" if self.compress in ("zlib", "zstd") else ""
+                    )
                     if mode == "pool":
                         client = await start_pool_server(
                             conn,
@@ -1690,10 +1717,16 @@ class TPUExecutor(RemoteExecutor):
                             self.python_path,
                             conda_env=self.conda_env,
                             preload=self.pool_preload,
+                            frames_enabled=self.agent_frames,
+                            frames_codec=frames_codec,
                         )
                     else:
                         binary = await ensure_agent_binary(conn, self.remote_cache)
-                        client = await AgentClient.start(conn, binary)
+                        client = await AgentClient.start(
+                            conn, binary,
+                            frames_enabled=self.agent_frames,
+                            frames_codec=frames_codec,
+                        )
                 except (AgentError, TransportError) as err:
                     app_log.info(
                         "worker %s: no %s runtime (%s)", conn.address, mode, err
@@ -3459,8 +3492,27 @@ class TPUExecutor(RemoteExecutor):
     @staticmethod
     def _decode_rpc_result(event: dict) -> tuple[Any, BaseException | None]:
         """``(result, exception)`` from a streamed result event — the same
-        pickle layout launch mode fetches from the result file."""
-        data = base64.b64decode(str(event.get("data") or ""))
+        pickle layout launch mode fetches from the result file.
+
+        A binary-frame result carries the raw pickle in ``data_bytes``;
+        the JSONL fallback base64-inlines it as ``data``.  A frame whose
+        body failed decompression arrives marked ``torn`` — content
+        corruption, raised as :class:`CodecIntegrityError` so the
+        resilience classifier makes it PERMANENT instead of burning gang
+        retries re-requesting the same torn bytes.
+        """
+        if event.get("torn"):
+            from .transport.codec import CodecIntegrityError
+
+            raise CodecIntegrityError(
+                f"streamed RPC result arrived torn: {event['torn']}"
+            )
+        raw = event.get("data_bytes")
+        data = (
+            bytes(raw)
+            if raw is not None
+            else base64.b64decode(str(event.get("data") or ""))
+        )
         return pickle.loads(data)
 
     async def _fetch_staged_rpc_result(
@@ -4170,9 +4222,10 @@ class TPUExecutor(RemoteExecutor):
                         key, client, fn_digest, remote_fn
                     )
                     if inline:
-                        invoke_kwargs["args_b64"] = base64.b64encode(
-                            args_payload
-                        ).decode("ascii")
+                        # Raw pickle bytes: the client ships them as a
+                        # binary frame body on a negotiated channel, or
+                        # base64-inlines them on the JSONL fallback.
+                        invoke_kwargs["args_bytes"] = args_payload
                     else:
                         # Oversized args take the CAS road (digest
                         # verified remotely), results still stream back.

@@ -13,11 +13,18 @@ from __future__ import annotations
 
 import asyncio
 
+from . import frames
 from .base import TransportError
 
 
 class TransportProcess:
-    """A running remote process with line-oriented stdin/stdout access."""
+    """A running remote process with line-oriented stdin/stdout access.
+
+    After the agent channel's frame negotiation the stream interleaves
+    JSON lines with length-prefixed binary frames; :meth:`read_event`
+    dispatches on the first byte (the frame magic can never begin a JSON
+    line) so one reader serves both encodings.
+    """
 
     def __init__(self, reader, writer, proc=None, describe: str = "process"):
         self._reader = reader
@@ -43,6 +50,16 @@ class TransportProcess:
         except (ConnectionError, BrokenPipeError, OSError) as err:
             raise TransportError(f"{self._describe}: write failed: {err}") from err
 
+    async def write_bytes(self, payload: bytes) -> None:
+        """Ship pre-encoded bytes (a binary frame) down the channel."""
+        if self._closed:
+            raise TransportError(f"{self._describe}: channel closed")
+        try:
+            self._writer.write(payload)
+            await self._writer.drain()
+        except (ConnectionError, BrokenPipeError, OSError) as err:
+            raise TransportError(f"{self._describe}: write failed: {err}") from err
+
     async def read_line(self, timeout: float | None = None) -> str:
         """Next stdout line (stripped). Raises on EOF — a dead channel must
         surface as an error, not an empty event."""
@@ -55,6 +72,70 @@ class TransportProcess:
         if not raw:
             raise TransportError(f"{self._describe}: channel EOF")
         return raw.decode(errors="replace").rstrip("\r\n")
+
+    async def _read_exactly(self, n: int, what: str) -> bytes:
+        """``readexactly`` with channel-death mapped to TransportError.
+
+        A channel that dies mid-frame leaves the stream unsynchronizable;
+        EOF here is a channel failure, never a clean close.
+        """
+        try:
+            return await self._reader.readexactly(n)
+        except asyncio.IncompleteReadError as err:
+            raise TransportError(
+                f"{self._describe}: channel EOF mid-{what} "
+                f"({len(err.partial)}/{n} bytes)"
+            ) from err
+
+    async def read_event(self, timeout: float | None = None):
+        """Next protocol message: ``("line", str)`` or
+        ``("frame", verb, flags, header_bytes, body_bytes)``.
+
+        The first byte disambiguates: the frame magic's lead byte is
+        non-ASCII and can never begin a JSON line.  A frame with bad
+        magic/version or an oversized length raises TransportError — once
+        the client's view of the stream desynchronizes nothing after the
+        bad header can be trusted, so the channel is torn down (the
+        resilience layer classifies that transient and retries on a fresh
+        one).
+        """
+
+        async def one_event():
+            first = await self._read_exactly(1, "message")
+            if first != frames.MAGIC[:1]:
+                rest = await self._reader.readline()
+                if not rest and not first.strip():
+                    raise TransportError(f"{self._describe}: channel EOF")
+                return (
+                    "line",
+                    (first + rest).decode(errors="replace").rstrip("\r\n"),
+                )
+            fixed = first + await self._read_exactly(
+                frames.HEADER_LEN - 1, "frame header"
+            )
+            magic, version, verb, flags, hlen, blen = frames.HEADER.unpack(
+                fixed
+            )
+            if magic != frames.MAGIC or version != frames.VERSION:
+                raise TransportError(
+                    f"{self._describe}: bad frame magic/version "
+                    f"({magic!r} v{version})"
+                )
+            if hlen > frames.MAX_HEADER_BYTES or blen > frames.MAX_BODY_BYTES:
+                raise TransportError(
+                    f"{self._describe}: oversized frame "
+                    f"(header {hlen}B, body {blen}B)"
+                )
+            header = await self._read_exactly(hlen, "frame")
+            body = await self._read_exactly(blen, "frame") if blen else b""
+            return ("frame", verb, flags, header, body)
+
+        try:
+            return await asyncio.wait_for(one_event(), timeout)
+        except asyncio.TimeoutError:
+            raise TransportError(
+                f"{self._describe}: no event within {timeout}s"
+            ) from None
 
     async def close(self, kill: bool = False) -> None:
         """Close stdin (letting the remote side drain) and reap."""

@@ -263,9 +263,13 @@ def test_agent_native_invoke_roundtrip_via_rpc_child(
     pid, event = run_async(flow())
     assert isinstance(pid, int) and pid > 0
     assert event.get("ok") is True
-    result, exception = pickle.loads(
-        base64.b64decode(str(event.get("data")))
-    )
+    # The channel negotiates binary frames by default, so the runner's
+    # result arrives as raw pickle bytes; a JSONL fallback would carry it
+    # base64-inline instead — both decode to the same pair.
+    raw = event.get("data_bytes")
+    if raw is None:
+        raw = base64.b64decode(str(event.get("data")))
+    result, exception = pickle.loads(raw)
     assert exception is None
     assert result == 42
 
@@ -447,3 +451,142 @@ def test_agent_native_serve_open_failure_fails_fast(
     error, elapsed = run_async(flow())
     assert "runner_exited" in str(error) or "spawn_failed" in str(error)
     assert elapsed < 10.0, f"open took {elapsed:.1f}s — waited out the timeout"
+
+
+# ---------------------------------------------------------------------------
+# Binary frame protocol on the native agent: negotiation, framed invoke
+# round-trip through the runner child, and parser fuzz — malformed frames
+# must fail loud as clean errors and never hang or kill the agent.
+# ---------------------------------------------------------------------------
+
+
+def test_native_agent_negotiates_frames(agent_binary, run_async):
+    async def flow():
+        conn = LocalTransport()
+        client = await AgentClient.start(conn, agent_binary)
+        try:
+            active = client.frames_active
+            banner = dict(client._banner)
+            await client.ping(timeout=10.0)
+        finally:
+            await client.close()
+        return active, banner
+
+    active, banner = run_async(flow())
+    assert active is True
+    assert banner.get("frames") == 1
+    # No codecs advertised: the native agent never inflates bodies itself.
+    assert not banner.get("codecs")
+
+
+def test_native_agent_framed_invoke_roundtrip(agent_binary, tmp_path, run_async):
+    """args as a raw frame body into the forked --rpc-child runner, the
+    framed result passed back verbatim through the stream pump."""
+    import hashlib
+    import pickle
+    import sys
+
+    import cloudpickle
+
+    from covalent_tpu_plugin import harness as harness_mod
+
+    def _make_add():
+        def add(a, b):
+            return a + b
+
+        return add
+
+    async def flow():
+        conn = LocalTransport()
+        client = await AgentClient.start(conn, agent_binary)
+        try:
+            assert client.frames_active
+            payload = cloudpickle.dumps(_make_add())
+            digest = hashlib.sha256(payload).hexdigest()
+            artifact = tmp_path / f"{digest}.pkl"
+            artifact.write_bytes(payload)
+            runner = [sys.executable, harness_mod.__file__, "--rpc-child"]
+            await client.register_fn(
+                digest, str(artifact), runner=runner, timeout=30.0
+            )
+            await client.invoke(
+                "natframe", digest, path=str(artifact),
+                args_bytes=cloudpickle.dumps(((19, 23), {})), timeout=30.0,
+            )
+            event = await client.wait_result("natframe", timeout=30.0)
+        finally:
+            await client.close()
+        return event
+
+    event = run_async(flow())
+    assert event.get("ok") is True
+    assert event.get("data_bytes") is not None, (
+        "runner result did not ride a binary frame"
+    )
+    import pickle
+
+    result, exception = pickle.loads(event["data_bytes"])
+    assert exception is None
+    assert result == 42
+
+
+def test_native_agent_survives_frame_garbage(agent_binary, run_async):
+    from covalent_tpu_plugin.transport import frames
+
+    async def flow():
+        conn = LocalTransport()
+        client = await AgentClient.start(conn, agent_binary)
+        try:
+            assert client.frames_active
+            garbage = [
+                bytes([frames.MAGIC[0], 0x13]) + b"not a frame\n",
+                frames.HEADER.pack(frames.MAGIC, 9, 0, 0, 1, 1) + b"\n",
+                frames.HEADER.pack(
+                    frames.MAGIC, frames.VERSION, 0, 0,
+                    frames.MAX_HEADER_BYTES + 7, 0,
+                ) + b"\n",
+                # well-framed but non-JSON header: consumed in sync
+                frames.HEADER.pack(
+                    frames.MAGIC, frames.VERSION, 0, 0, 4, 0
+                ) + b"{bad",
+                b"line noise without any structure\n",
+            ]
+            for chunk in garbage:
+                await client._process.write_bytes(chunk)
+                # The agent must keep answering after every injection.
+                await client.ping(timeout=10.0)
+            return True
+        finally:
+            await client.close()
+
+    assert run_async(flow()) is True
+
+
+def test_native_agent_multi_invoke_refused_per_op(agent_binary, run_async):
+    """The native agent cannot batch (one runner fork per invocation); a
+    multi_invoke frame is refused cleanly per op id, channel alive."""
+    from covalent_tpu_plugin.transport import frames
+
+    async def flow():
+        conn = LocalTransport()
+        client = await AgentClient.start(conn, agent_binary)
+        try:
+            await client._send_frame(
+                frames.VERB_MULTI_INVOKE,
+                {"cmd": "multi_invoke", "digest": "d" * 64,
+                 "ops": [{"id": "mop1"}, {"id": "mop2"}],
+                 "args_lens": [1, 1], "_body": "args_bytes"},
+                b"xy",
+            )
+            await client._wait(
+                lambda c: "mop1" in c._errors and "mop2" in c._errors, 15.0
+            )
+            errors = dict(client._errors)
+            await client.ping(timeout=10.0)
+        finally:
+            await client.close()
+        return errors
+
+    errors = run_async(flow())
+    assert "pool runtime" in errors["mop1"]
+    assert "pool runtime" in errors["mop2"]

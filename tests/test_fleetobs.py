@@ -528,17 +528,19 @@ def test_agent_stall_suspicion_confirmed_against_hb_file(
     # Tighten the never-beat launch slack so the suspicion actually fires
     # within the electron's runtime.
     monkeypatch.setattr(HeartbeatMonitor, "LAUNCH_SLACK_S", 1.0)
-    # 8 missed beats before suspicion: 0.4s flaked under full-suite load
-    # (a transiently starved beat thread read as a stall).
+    # 16 missed beats before suspicion: 0.4s flaked under full-suite load
+    # (a transiently starved beat thread read as a stall), and 0.8s still
+    # did on loaded machines — the .hb staleness tolerance must exceed the
+    # worst beat-thread starvation the suite inflicts, not the cadence.
     ex = make_local_executor(
         tmp_path, use_agent="pool", heartbeat_interval=0.1,
-        stall_threshold=0.8, max_task_retries=1, poll_freq=0.1,
+        stall_threshold=1.6, max_task_retries=1, poll_freq=0.1,
     )
 
     def slow(x):
         import time as _time
 
-        _time.sleep(1.5)
+        _time.sleep(3.0)
         return x * 3
 
     async def flow():
