@@ -240,6 +240,9 @@ class AgentClient:
         self._serve_errors: dict[str, dict] = {}
         self._serve_closed: dict[str, dict] = {}
         self._serve_sinks: dict[str, Any] = {}
+        #: "sid/rid" -> pushed ``serve_kv`` event (disaggregated prefill
+        #: answers: KV bundle bytes as a raw frame body, or an error).
+        self._serve_kv: dict[str, dict] = {}
         #: resident-mode profiling: profile id -> pushed profile_started /
         #: profile_stopped / profile_error events.
         self._profile_started: dict[str, dict] = {}
@@ -424,6 +427,18 @@ class AgentClient:
                         self._serve_errors[task_id] = event
                     elif kind == "serve_closed":
                         self._serve_closed[task_id] = event
+                    elif kind == "serve_kv":
+                        self._serve_kv[
+                            f"{task_id}/{event.get('rid') or ''}"
+                        ] = event
+                        # Bound abandoned answers: a prefill whose waiter
+                        # timed out leaves its (late) event unclaimed —
+                        # drop oldest so a pathological session cannot
+                        # grow this for the channel lifetime.
+                        while len(self._serve_kv) > 256:
+                            self._serve_kv.pop(
+                                next(iter(self._serve_kv))
+                            )
                     elif kind == "profile_started":
                         self._profile_started[task_id] = event
                     elif kind == "profile_stopped":
@@ -959,6 +974,9 @@ class AgentClient:
         params: dict | None = None,
         deadline_s: float = 0.0,
         tenant: str = "",
+        kv_bytes: bytes | None = None,
+        kv_digest: str = "",
+        kv_path: str = "",
     ) -> None:
         """Submit one request to an open session (fire-and-stream).
 
@@ -966,6 +984,13 @@ class AgentClient:
         ``serve.token`` records routed to the session's
         :meth:`watch_serve` sink; backpressure and unknown sessions
         arrive as ``serve.reject`` records the same way.
+
+        A disaggregated request attaches its prefilled KV bundle:
+        ``kv_bytes`` rides a raw binary frame body on a negotiated
+        channel (the gang-local fast path), ``kv_path`` references a
+        CAS-staged copy (the cross-pool road); either way ``kv_digest``
+        is verified worker-side before the engine unpickles anything,
+        and any mismatch silently degrades to a full prefill.
         """
         command: dict = {
             "cmd": "serve_request", "id": sid, "rid": rid, "prompt": prompt,
@@ -976,12 +1001,73 @@ class AgentClient:
             command["deadline_s"] = float(deadline_s)
         if tenant:
             command["tenant"] = str(tenant)
+        if kv_digest:
+            command["kv_digest"] = kv_digest
+        if kv_path:
+            command["kv_path"] = kv_path
         if self.frames_active:
-            # Header-only frame: at serving request rates even the line
-            # framing + re-parse tax is worth skipping.
+            # Header-only frame (or body-carrying for an inline KV
+            # bundle): at serving request rates even the line framing +
+            # re-parse tax is worth skipping.
+            if kv_bytes is not None and not kv_path:
+                command["_body"] = "kv_bytes"
+                await self._send_frame(
+                    frames.VERB_SERVE, command, kv_bytes
+                )
+                return
             await self._send_frame(frames.VERB_SERVE, command)
             return
+        if kv_bytes is not None and not kv_path:
+            command["kv"] = base64.b64encode(kv_bytes).decode("ascii")
         await self._send(command)
+
+    async def serve_prefill(
+        self,
+        sid: str,
+        rid: str,
+        prompt,
+        params: dict | None = None,
+        timeout: float = 60.0,
+    ) -> dict:
+        """Run a prefill-only pass on an open session; returns the
+        ``serve_kv`` event with the bundle under ``data_bytes``.
+
+        The worker's engine packages the prompt's prefilled cache lane
+        (plus cursor/rng/sampling state) as a serializable KV bundle and
+        streams it back as a raw frame body (base64 on a JSONL channel).
+        A worker-side refusal (unknown session, shed, an engine without
+        the surface) raises :class:`AgentError` — the disaggregated
+        front degrades to a full prefill on the decode replica.
+        """
+        command: dict = {
+            "cmd": "serve_prefill", "id": sid, "rid": rid, "prompt": prompt,
+        }
+        if params:
+            command["params"] = dict(params)
+        if self.frames_active:
+            await self._send_frame(frames.VERB_SERVE, command)
+        else:
+            await self._send(command)
+        key = f"{sid}/{rid}"
+
+        def settled(c: "AgentClient"):
+            return c._serve_kv.pop(key, None)
+
+        event = await self._wait(settled, timeout)
+        if event.get("code"):
+            raise AgentError(
+                f"agent@{self.address}: serve_prefill {rid} failed "
+                f"({event.get('code')}): {event.get('message')}"
+            )
+        if "data_bytes" not in event and event.get("data"):
+            try:
+                event["data_bytes"] = base64.b64decode(event["data"])
+            except (TypeError, ValueError) as err:
+                raise AgentError(
+                    f"agent@{self.address}: serve_prefill {rid} returned "
+                    f"an undecodable bundle: {err}"
+                ) from err
+        return event
 
     async def serve_close(self, sid: str, timeout: float = 30.0) -> dict:
         """Close a session; returns the ``serve_closed`` event (``served``
@@ -1103,6 +1189,10 @@ class AgentClient:
         self._serve_opened.pop(sid, None)
         self._serve_errors.pop(sid, None)
         self._serve_closed.pop(sid, None)
+        for key in [
+            k for k in self._serve_kv if k.startswith(f"{sid}/")
+        ]:
+            del self._serve_kv[key]
 
     async def wait_dead(self) -> None:
         """Block until this channel dies, then raise :class:`AgentError`.

@@ -54,9 +54,12 @@ __all__ = [
     "FnRegistry",
     "ResultCache",
     "bytes_digest",
+    "cas_bytes_prune_command",
     "cas_path",
     "file_digest",
     "harness_digest",
+    "prune_cas_dir",
+    "CAS_EVICTIONS_TOTAL",
     "CAS_UPLOADS_TOTAL",
     "RESULT_CACHE_TOTAL",
     "RPC_REGISTRATIONS_TOTAL",
@@ -91,6 +94,14 @@ RPC_REGISTRATIONS_TOTAL = REGISTRY.counter(
 )
 
 
+CAS_EVICTIONS_TOTAL = REGISTRY.counter(
+    "covalent_tpu_cas_evictions_total",
+    "CAS artifacts evicted by the byte-budget LRU prune "
+    "(site = the dispatcher's local mirror vs a worker's remote cache)",
+    ("site",),
+)
+
+
 def bytes_digest(data: bytes) -> str:
     return hashlib.sha256(data).hexdigest()
 
@@ -120,6 +131,102 @@ def harness_digest() -> str:
 def cas_path(remote_cache: str, digest: str, suffix: str = "") -> str:
     """Digest-addressed remote path under ``{remote_cache}/cas/``."""
     return f"{remote_cache}/{CAS_DIR}/{digest}{suffix}"
+
+
+def prune_cas_dir(root: str, max_bytes: int) -> int:
+    """Byte-budget LRU prune of one CAS directory; returns evictions.
+
+    The ``cas_ttl_hours`` age prune bounds *staleness* but not *size*:
+    KV bundles (disaggregated serving) are orders of magnitude larger
+    than function pickles and can fill a disk well inside the TTL.
+    Oldest-access-first (mtime — the maintenance pass ``touch``\\ es hot
+    artifacts, so recency IS the mtime) until the directory fits
+    ``max_bytes``; 0 disables.  Best-effort: a file vanishing mid-scan
+    (a concurrent prune, an in-flight publish) is skipped, never an
+    error.
+    """
+    if max_bytes <= 0:
+        return 0
+    entries: list[tuple[float, int, str]] = []
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return 0
+    for name in names:
+        path = os.path.join(root, name)
+        try:
+            stat = os.stat(path)
+        except OSError:
+            continue
+        if os.path.isfile(path):
+            entries.append((stat.st_mtime, stat.st_size, path))
+    entries.sort()
+    total = sum(size for _, size, _ in entries)
+    evicted = 0
+    for _, size, path in entries:
+        if total <= max_bytes:
+            break
+        try:
+            os.remove(path)
+        except OSError:
+            continue
+        total -= size
+        evicted += 1
+    if evicted:
+        CAS_EVICTIONS_TOTAL.labels(site="local").inc(evicted)
+        obs_events.emit(
+            "cas.bytes_pruned", root=root, evicted=evicted,
+            budget=max_bytes,
+        )
+    return evicted
+
+
+#: Remote mirror of :func:`prune_cas_dir` — runs under the worker's own
+#: interpreter inside the per-electron maintenance round trip, printing
+#: ``CAS_EVICTED=<n>`` for the dispatcher to account.  Kept tiny and
+#: stdlib-only (it executes via ``python -E -S -c``).
+_REMOTE_PRUNE_PROGRAM = """\
+import os, sys
+root, budget = sys.argv[1], int(sys.argv[2])
+entries = []
+try:
+    names = os.listdir(root)
+except OSError:
+    names = []
+for name in names:
+    path = os.path.join(root, name)
+    try:
+        stat = os.stat(path)
+    except OSError:
+        continue
+    if os.path.isfile(path):
+        entries.append((stat.st_mtime, stat.st_size, path))
+entries.sort()
+total = sum(size for _, size, _ in entries)
+evicted = 0
+for _, size, path in entries:
+    if total <= budget:
+        break
+    try:
+        os.remove(path)
+    except OSError:
+        continue
+    total -= size
+    evicted += 1
+print('CAS_EVICTED=%d' % evicted)
+"""
+
+
+def cas_bytes_prune_command(
+    python_path: str, cas_dir: str, max_bytes: int
+) -> str:
+    """Shell clause running the byte-budget LRU prune on a worker."""
+    import shlex
+
+    return (
+        f"{python_path} -E -S -c {shlex.quote(_REMOTE_PRUNE_PROGRAM)} "
+        f"{shlex.quote(cas_dir)} {int(max_bytes)}"
+    )
 
 
 class CASIndex:

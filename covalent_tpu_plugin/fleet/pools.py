@@ -67,6 +67,11 @@ class PoolSpec:
     transport: str = ""
     capacity: int = DEFAULT_CAPACITY
     fallback: bool = False
+    #: serving-tier placement hint for disaggregated sets: "prefill"
+    #: pools host prefill replicas (compute-heavy batched passes),
+    #: "decode" pools pin decode replicas (latency-critical token
+    #: loops); "" is role-neutral.  Electrons ignore it entirely.
+    role: str = ""
     executor: dict[str, Any] = field(default_factory=dict)
     #: (external_ip, internal_ip) pairs from registration-time discovery;
     #: seeds the executor's endpoint cache so a discovered pool's first
@@ -163,6 +168,10 @@ class Pool:
     @property
     def fallback(self) -> bool:
         return self.spec.fallback
+
+    @property
+    def role(self) -> str:
+        return self.spec.role
 
     def __repr__(self) -> str:  # pragma: no cover - debugging aid
         return (
@@ -299,6 +308,7 @@ class Pool:
             "free": self.free_slots,
             "warm": self.warm,
             "fallback": self.fallback,
+            **({"role": self.role} if self.role else {}),
             "placed_total": self.placed_total,
             "workers": list(self.spec.workers)
             or ([self.spec.tpu_name] if self.spec.tpu_name else ["local"]),
@@ -368,7 +378,9 @@ def parse_pool_specs(text: str) -> list[PoolSpec]:
       ``v5e=10.0.0.1+10.0.0.2@4;spare=tpu:my-v5e-8@2;cpu=local@2``.
       Addresses may carry a login (``edge=ubuntu@10.0.0.9``): a trailing
       ``@suffix`` is only read as capacity when it is numeric (or
-      ``cap``-prefixed, which always claims to be one).
+      ``cap``-prefixed, which always claims to be one).  A trailing
+      ``!role`` marks the pool's serving role for disaggregated
+      placement (``pre=10.0.0.1@2!prefill;dec=10.0.0.2@4!decode``).
     """
     text = (text or "").strip()
     if not text:
@@ -389,6 +401,10 @@ def parse_pool_specs(text: str) -> list[PoolSpec]:
                 f"bad pool entry {entry!r} (want name=target[@capN])"
             )
         target = target.strip()
+        role = ""
+        head_role, sep_role, role_text = target.rpartition("!")
+        if sep_role and role_text.strip().isalpha() and head_role.strip():
+            target, role = head_role.strip(), role_text.strip()
         capacity = DEFAULT_CAPACITY
         head, sep, cap_text = target.rpartition("@")
         if sep:
@@ -409,6 +425,8 @@ def parse_pool_specs(text: str) -> list[PoolSpec]:
         spec_kwargs: dict[str, Any] = {
             "name": name.strip(), "capacity": capacity,
         }
+        if role:
+            spec_kwargs["role"] = role
         if target == "local":
             spec_kwargs.update(transport="local", fallback=True)
         elif target.startswith("tpu:"):

@@ -1617,11 +1617,18 @@ class _ServeSession:
         self.digest = str(command.get("digest") or "")
         self.path = str(command.get("path") or "")
         self.queue: "queue_mod.Queue" = queue_mod.Queue()
+        #: serve_prefill commands awaiting the session thread (the
+        #: disaggregated tier's prefill-only work: no decode lane taken).
+        self.prefill_queue: "queue_mod.Queue" = queue_mod.Queue()
         #: rid -> {"deadline": abs_ts|None, "emitted": n, "t_admit": ts}
         self.running: dict = {}
         self.slots = 1
         self.served = 0
         self.tokens_total = 0
+        #: KV data plane accounting (disaggregated prefill/decode).
+        self.kv_admits = 0
+        self.kv_fallbacks = 0
+        self.prefills = 0
         self._t_open = time.time()
         self._closed = threading.Event()
         self._engine = None
@@ -1653,6 +1660,35 @@ class _ServeSession:
         command["_enqueued"] = time.monotonic()
         self.queue.put(command)
 
+    def submit_prefill(self, command: dict) -> None:
+        """Queue one prefill-only command (disaggregated tier).
+
+        Same bounded-admission verdict as :meth:`submit`; refusals
+        answer with a ``serve_kv`` error event so the dispatcher's
+        prefill waiter fails fast (and degrades to a full prefill on the
+        decode replica) instead of sitting out its timeout.
+        """
+        rid = str(command.get("rid") or "")
+        if not rid:
+            self._emit_kv("", code="bad_request",
+                          message="serve_prefill requires rid")
+            return
+        if self._closed.is_set():
+            self._emit_kv(rid, code="unknown_session",
+                          message="session closed")
+            return
+        if self.prefill_queue.qsize() >= self.queue_max:
+            self._emit_kv(
+                rid, code="serve_admission_shed",
+                message=f"prefill queue full ({self.queue_max})",
+            )
+            return
+        self.prefill_queue.put(dict(command))
+        # Wake an idle session loop NOW instead of on its 100ms tick: a
+        # prefill replica is usually idle exactly when a prefill lands,
+        # and the tick would tax every disaggregated request's TTFT.
+        self.queue.put(None)
+
     def close(self) -> None:
         self._closed.set()
         self.queue.put(None)  # wake the loop
@@ -1679,8 +1715,125 @@ class _ServeSession:
             "serve.reject", rid=rid, code=code, message=message
         )
 
+    def _emit_kv(
+        self, rid: str, data: bytes | None = None,
+        code: str = "", message: str = "",
+    ) -> None:
+        """One ``serve_kv`` answer to a prefill command: the bundle bytes
+        ride a raw binary frame body on a negotiated channel (the same
+        road RPC result pickles take), base64-in-JSON otherwise; a
+        failure ships the ``code``/``message`` pair with no body."""
+        event = {"event": "serve_kv", "id": self.sid, "rid": rid}
+        if code:
+            event["code"] = code
+            event["message"] = message
+            _emit(event)
+            return
+        data = data or b""
+        import hashlib as hashlib_mod
+
+        event["digest"] = hashlib_mod.sha256(data).hexdigest()
+        event["bytes"] = len(data)
+        if _FRAMES["out"]:
+            event["_body"] = "data_bytes"
+            _emit_frame(_VERB_SERVE, event, data)
+        else:
+            import base64
+
+            event["data"] = base64.b64encode(data).decode("ascii")
+            _emit(event)
+
+    def _pump_prefill(self) -> None:
+        """Run queued prefill-only commands on the session thread (the
+        engine is single-threaded state) and stream each KV bundle back."""
+        import queue as queue_mod
+
+        while True:
+            try:
+                command = self.prefill_queue.get_nowait()
+            except queue_mod.Empty:
+                return
+            rid = str(command.get("rid") or "")
+            prefill = getattr(self._engine, "prefill_only", None)
+            if prefill is None:
+                self._emit_kv(
+                    rid, code="unsupported",
+                    message="engine has no prefill_only surface",
+                )
+                continue
+            try:
+                data = prefill(
+                    command.get("prompt"),
+                    dict(command.get("params") or {}),
+                )
+                if not isinstance(data, (bytes, bytearray)):
+                    raise TypeError(
+                        f"prefill_only returned {type(data).__name__}, "
+                        "want bytes"
+                    )
+            except BaseException as err:  # noqa: BLE001 - engine refusals
+                self._emit_kv(rid, code="prefill_failed", message=repr(err))
+                continue
+            self.prefills += 1
+            self._emit_kv(rid, bytes(data))
+
+    def _resolve_kv(self, command: dict):
+        """``(kv_bytes | None, verified)`` for a KV-attached request.
+
+        The bundle arrives as a raw frame body (``kv_bytes``), base64
+        JSON (``kv``), or a CAS path staged by the dispatcher
+        (``kv_path``); whichever road, its sha256 must match the
+        announced ``kv_digest`` BEFORE the engine may unpickle it —
+        exactly the register_fn contract.  Any resolution or digest
+        failure returns ``(None, False)``: the caller degrades to a full
+        prefill, never a user-visible error.
+        """
+        data = command.get("kv_bytes")
+        if data is None and command.get("kv"):
+            import base64
+
+            try:
+                data = base64.b64decode(command["kv"])
+            except (TypeError, ValueError):
+                return None, False
+        if data is None and command.get("kv_path"):
+            try:
+                with open(command["kv_path"], "rb") as f:
+                    data = f.read()
+            except OSError:
+                return None, False
+        if data is None:
+            return None, False
+        import hashlib as hashlib_mod
+
+        digest = str(command.get("kv_digest") or "")
+        if not digest or hashlib_mod.sha256(
+            data
+        ).hexdigest() != digest:
+            return None, False
+        return bytes(data), True
+
     def _emit_stats(self) -> None:
         age = max(time.time() - self._t_open, 1e-9)
+        extra: dict = {}
+        # Engine-local counters (ContinuousEngine.stats: prefix-tree
+        # hits/misses, prefill positions, KV traffic) become serving
+        # metrics — without this they are invisible to /metrics,
+        # /history, and the SLO plane.
+        engine_stats = getattr(self._engine, "stats", None)
+        if isinstance(engine_stats, dict):
+            for key in (
+                "prefix_hits", "prefix_misses", "prefill_positions",
+                "prefix_evictions", "kv_exports",
+            ):
+                value = engine_stats.get(key)
+                if isinstance(value, (int, float)):
+                    extra[key] = value
+        if self.kv_admits or self.kv_fallbacks:
+            extra["kv_admits"] = self.kv_admits
+            extra["kv_fallbacks"] = self.kv_fallbacks
+        if self.prefills:
+            extra["prefills"] = self.prefills
         self._emit_serve(
             "serve.stats",
             slots=self.slots,
@@ -1689,6 +1842,7 @@ class _ServeSession:
             served=self.served,
             tokens_total=self.tokens_total,
             tokens_per_s=round(self.tokens_total / age, 3),
+            **extra,
         )
 
     # -- session thread ----------------------------------------------------
@@ -1761,11 +1915,35 @@ class _ServeSession:
                 continue
             prompt = command.get("prompt")
             params = dict(command.get("params") or {})
-            try:
-                self._engine.admit(rid, prompt, params)
-            except BaseException as err:  # noqa: BLE001 - engine rejections
-                self._emit_reject(rid, "engine_error", repr(err))
-                continue
+            admitted = False
+            if (
+                command.get("kv_bytes") is not None
+                or command.get("kv")
+                or command.get("kv_path")
+            ):
+                # Disaggregated fast path: scatter the shipped KV bundle
+                # straight into a lane (digest-verified first).  ANY
+                # failure — torn transfer, mismatched digest, a bundle
+                # from a different engine shape, an engine without the
+                # surface — degrades to the full prefill below; the
+                # caller's stream must never see the difference.
+                kv_data, verified = self._resolve_kv(command)
+                admit_kv = getattr(self._engine, "admit_from_kv", None)
+                if verified and admit_kv is not None:
+                    try:
+                        admit_kv(rid, kv_data, params)
+                        admitted = True
+                        self.kv_admits += 1
+                    except BaseException:  # noqa: BLE001 - fall back
+                        admitted = False
+                if not admitted:
+                    self.kv_fallbacks += 1
+            if not admitted:
+                try:
+                    self._engine.admit(rid, prompt, params)
+                except BaseException as err:  # noqa: BLE001 - rejections
+                    self._emit_reject(rid, "engine_error", repr(err))
+                    continue
             self.running[rid] = {
                 "deadline": (
                     command["_enqueued"] + deadline_s
@@ -1844,6 +2022,7 @@ class _ServeSession:
             while not (self._closed.is_set()
                        and not self.running
                        and self.queue.empty()):
+                self._pump_prefill()
                 self._admit_waiting()
                 if self.running:
                     self._pump_engine()
@@ -1931,6 +2110,22 @@ def _serve_request(command: dict, sessions: dict) -> None:
     session.submit(command)
 
 
+def _serve_prefill(command: dict, sessions: dict) -> None:
+    sid = str(command.get("id") or "")
+    session = sessions.get(sid)
+    if session is None:
+        # A direct serve_kv error (not a streamed reject): the prefill
+        # waiter settles on serve_kv events only.
+        _emit({
+            "event": "serve_kv", "id": sid,
+            "rid": str(command.get("rid") or ""),
+            "code": "unknown_session",
+            "message": f"no open session {sid!r}",
+        })
+        return
+    session.submit_prefill(command)
+
+
 def _serve_close(command: dict, sessions: dict) -> None:
     sid = str(command.get("id") or "")
     session = sessions.pop(sid, None)
@@ -1968,6 +2163,8 @@ def serve_child() -> int:
                     opened.append(session)
             elif name == "serve_request":
                 _serve_request(command, sessions)
+            elif name == "serve_prefill":
+                _serve_prefill(command, sessions)
             elif name == "profile_start":
                 _profile_start(command)
             elif name == "profile_stop":
@@ -2155,6 +2352,8 @@ def serve() -> int:
                     _serve_open(command, serve_sessions)
                 elif name == "serve_request":
                     _serve_request(command, serve_sessions)
+                elif name == "serve_prefill":
+                    _serve_prefill(command, serve_sessions)
                 elif name == "serve_close":
                     _serve_close(command, serve_sessions)
                 elif name == "profile_start":

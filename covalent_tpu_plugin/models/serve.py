@@ -57,8 +57,11 @@ cache layout).
 
 from __future__ import annotations
 
+import collections
 import functools
-from typing import Any, Sequence
+import hashlib
+import pickle
+from typing import Any, Callable, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -67,6 +70,9 @@ import numpy as np
 from .decode import _decode_model, _filter_top_k, init_cache
 from .speculative import _set_cursor
 from .transformer import TransformerLM
+
+#: Wire format version of a serialized KV bundle (prefill_only's output).
+KV_BUNDLE_VERSION = 1
 
 
 class RollingCacheUnsupported(ValueError):
@@ -237,6 +243,127 @@ def _make_prefix_admit(decoder, temperature, top_k, eos_token_id, batch,
         return caches, buffer, pos, plen, row_cap, n_gen, done, rng
 
     return admit_wave
+
+
+@functools.lru_cache(maxsize=32)
+def _make_kv_admit(eos_token_id, batch, g):
+    """Fused scatter for admissions whose prefill already happened
+    elsewhere (an imported KV bundle): no decoder pass at all — the wave
+    only scatters the imported cache lanes, buffer rows (first generated
+    token included, computed by the *prefill* tier), cursors, and budgets
+    into the donated serving state.  ``mode="drop"`` pads exactly like
+    the prefill waves."""
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def admit_wave(state, new_lanes, rows, plens, firsts, slots, caps_in):
+        caches, buffer, pos, plen, row_cap, n_gen, done, rng = state
+        caches = jax.tree_util.tree_map(
+            lambda c, nl: c.at[slots].set(nl, mode="drop"),
+            caches, new_lanes,
+        )
+        buffer = buffer.at[slots].set(rows, mode="drop")
+        pos = pos.at[slots].set(plens, mode="drop")
+        plen = plen.at[slots].set(plens, mode="drop")
+        row_cap = row_cap.at[slots].set(caps_in, mode="drop")
+        n_gen = n_gen.at[slots].set(
+            jnp.ones((g,), jnp.int32), mode="drop"
+        )
+        fin = caps_in <= 1
+        if eos_token_id is not None:
+            fin = fin | (firsts == eos_token_id)
+        done = done.at[slots].set(fin, mode="drop")
+        return caches, buffer, pos, plen, row_cap, n_gen, done, rng
+
+    return admit_wave
+
+
+@functools.lru_cache(maxsize=64)
+def _make_lane_prefill(decoder, temperature, top_k, bucket):
+    """Standalone single-lane full prefill (``prefill_only``'s slow path).
+
+    Structurally the SAME computation as ``_make_admit``'s inner
+    ``lane_prefill`` — bucketed pass on a zero lane, cursor rewind, first
+    token from the last real position — vmapped over a leading dim of 1
+    so the compiled program matches the admission wave's lane exactly
+    (the bit-equality contract between a disaggregated prefill and the
+    in-place admission path rests on it)."""
+
+    @jax.jit
+    def prefill(params, lane_zero, padded, plens, keys):
+        # padded (1, bucket); plens (1,); keys (1, 2).
+        def lane_prefill(tokens, pl, key):
+            logits, mutated = decoder.apply(
+                {"params": params, "cache": lane_zero}, tokens[None],
+                mutable=["cache"],
+            )
+            cache = _set_cursor(mutated["cache"], pl)
+            last = jnp.take_along_axis(
+                logits, (pl - 1)[None, None, None], axis=1
+            )[0, 0]
+            first = _choose_tokens(
+                last[None, :], key, temperature, top_k
+            )[0]
+            return cache, first
+
+        lanes, firsts = jax.vmap(lane_prefill)(padded, plens, keys)
+        return (
+            jax.tree_util.tree_map(lambda c: c[0], lanes), firsts[0]
+        )
+
+    return prefill
+
+
+@functools.lru_cache(maxsize=64)
+def _make_lane_prefix_prefill(decoder, temperature, top_k, bucket,
+                              prefix_len):
+    """Standalone single-lane suffix prefill on a cached prefix lane
+    (``prefill_only``'s fast path), mirroring ``_make_prefix_admit``'s
+    inner lane the same way :func:`_make_lane_prefill` mirrors the full
+    wave."""
+
+    @jax.jit
+    def prefill(params, prefix_lane, padded, slens, keys):
+        # padded (1, bucket) SUFFIX tokens; slens (1,); keys (1, 2).
+        def lane_prefill(tokens, sl, key):
+            logits, mutated = decoder.apply(
+                {"params": params, "cache": prefix_lane}, tokens[None],
+                mutable=["cache"],
+            )
+            cache = _set_cursor(mutated["cache"], prefix_len + sl)
+            last = jnp.take_along_axis(
+                logits, (sl - 1)[None, None, None], axis=1
+            )[0, 0]
+            first = _choose_tokens(
+                last[None, :], key, temperature, top_k
+            )[0]
+            return cache, first
+
+        lanes, firsts = jax.vmap(lane_prefill)(padded, slens, keys)
+        return (
+            jax.tree_util.tree_map(lambda c: c[0], lanes), firsts[0]
+        )
+
+    return prefill
+
+
+def _tokens_digest(tokens: np.ndarray) -> str:
+    """Content key of a token prefix (the prefix tree's index)."""
+    return hashlib.sha256(
+        np.ascontiguousarray(tokens, np.int32).tobytes()
+    ).hexdigest()
+
+
+class _PrefixEntry:
+    """One cached KV lane: the exact tokens it prefilled, cursor parked
+    at ``tokens.size``.  ``pinned`` marks the constructor-supplied
+    ``shared_prefix`` template, exempt from LRU eviction."""
+
+    __slots__ = ("tokens", "lane", "pinned")
+
+    def __init__(self, tokens: np.ndarray, lane: Any, pinned: bool) -> None:
+        self.tokens = tokens
+        self.lane = lane
+        self.pinned = pinned
 
 
 @functools.lru_cache(maxsize=32)
@@ -639,15 +766,34 @@ class ContinuousEngine:
     (``length``, default ``config.max_seq``) — the price of compiling
     once for a session's whole lifetime.
 
-    ``shared_prefix`` turns on shared-prefix prefill reuse for the
-    dominant serving shape (a common system prompt ahead of every user
-    turn): the prefix is prefilled ONCE at construction into a template
-    cache lane, and an admitted prompt that starts with it prefills only
-    its suffix on top of that lane — same numerics (greedy outputs stay
-    bit-identical to the full-prefill road, asserted against the oracle
-    in ``tests/test_continuous.py``), strictly less prefill work
-    (``stats["prefill_positions"]``).  A prompt NOT extending the prefix
-    silently takes the full-prefill path (``stats["prefix_misses"]``).
+    **Prefix tree.**  Prefill reuse is generalized beyond one static
+    ``shared_prefix``: the engine keeps a small LRU *prefix tree* of
+    reusable KV lanes keyed by token-prefix digest.  Every admission's
+    post-prefill lane is inserted (cursor parked at the prompt length),
+    and a later prompt reuses the DEEPEST cached lane sharing a common
+    prefix with it — including a *partial* reuse, where a lane prefilled
+    for ``[a b c d]`` serves a prompt ``[a b x ...]`` rewound to the
+    2-token common prefix (positions past the rewound cursor are dead
+    until overwritten, the same exactness argument as pad positions).
+    Repeated prompts therefore hit warm KV (the previous admission's
+    lane rewound one position) without any configuration; a
+    ``shared_prefix`` still seeds a pinned, never-evicted entry.
+    Numerics are unchanged: greedy outputs stay bit-identical to the
+    full-prefill road (asserted against the oracle in
+    ``tests/test_continuous.py``) and hits strictly shrink
+    ``stats["prefill_positions"]``.  ``prefix_cache_size`` bounds the
+    unpinned entries (0 disables reuse caching); ``prefix_min_tokens``
+    is the shortest reusable prefix worth a dedicated compiled wave.
+
+    **KV export/import (disaggregated prefill/decode).**
+    :meth:`prefill_only` runs the admission prefill for one prompt and
+    returns a serializable KV *bundle* — cache lane, cursor, first
+    generated token, rng/sampling fingerprint — without occupying a
+    decode slot; :meth:`admit_from_kv` scatters an imported bundle into
+    a free slot and goes straight to decode.  A prefill-tier engine and
+    a decode-tier engine composed this way stream greedy tokens
+    bit-identical to one engine doing both (the serving tier's
+    ``DisaggregatedSet`` rides exactly this pair through the CAS).
     """
 
     def __init__(
@@ -665,6 +811,8 @@ class ContinuousEngine:
         max_new_tokens: int = 16,
         length: int | None = None,
         shared_prefix: Sequence[int] | None = None,
+        prefix_cache_size: int = 8,
+        prefix_min_tokens: int = 4,
     ) -> None:
         decoder = _decode_model(model)
         config = decoder.config
@@ -733,15 +881,32 @@ class ContinuousEngine:
         self._rid_slot: dict[str, int] = {}
         #: admissions awaiting a flush: (rid, tokens, cap).
         self._pending: list[tuple[str, np.ndarray, int]] = []
-        #: host-loop counters: shared-prefix hit/miss accounting plus the
+        #: KV-bundle admissions awaiting a flush:
+        #: (rid, tokens, cap, first token, imported lane).
+        self._pending_kv: list[tuple[str, np.ndarray, int, int, Any]] = []
+        #: host-loop counters: prefix-tree hit/miss accounting, the
         #: prefill positions each admission paid (full-prompt bucket on
         #: the slow path, suffix bucket on a prefix hit) — the measurable
-        #: "prefill work" the serve_scale bench arm asserts shrinks.
+        #: "prefill work" the serve bench arms assert shrinks — plus the
+        #: KV plane's export/import/eviction traffic.
         self.stats: dict[str, int] = {
             "prefix_hits": 0, "prefix_misses": 0, "prefill_positions": 0,
+            "prefix_evictions": 0, "kv_admits": 0, "kv_exports": 0,
         }
-        self._prefix_tokens: np.ndarray | None = None
-        self._prefix_lane = None
+        #: prefix digest -> _PrefixEntry, oldest-insert first (LRU order
+        #: maintained by move_to_end on every hit).
+        self._prefix_tree: "collections.OrderedDict[str, _PrefixEntry]" = (
+            collections.OrderedDict()
+        )
+        self._prefix_cache_size = max(0, int(prefix_cache_size))
+        self._prefix_min = max(1, int(prefix_min_tokens))
+        #: canonical lane layout: the treedef every imported KV bundle is
+        #: rebuilt against and the shape/dtype table it is validated by.
+        lane_leaves, self._lane_treedef = jax.tree_util.tree_flatten(lane)
+        self._lane_shapes = [
+            (tuple(leaf.shape), jnp.dtype(leaf.dtype))
+            for leaf in lane_leaves
+        ]
         if shared_prefix is not None:
             ptoks = np.asarray(shared_prefix, np.int32).reshape(-1)
             if ptoks.size < 1:
@@ -752,20 +917,20 @@ class ContinuousEngine:
                     f"for a suffix + generation inside the session's "
                     f"static length ({self._length})"
                 )
-            self._prefix_tokens = ptoks
             # Prefill the shared prefix ONCE per engine (per replica):
             # one exact-length pass on a zero lane, cursor parked at the
-            # prefix boundary.  Every prefix-matching admission copies
-            # this lane instead of re-running the prefix positions.
+            # prefix boundary.  It seeds the prefix tree as a PINNED
+            # entry — every prefix-matching admission copies this lane
+            # instead of re-running the prefix positions, and LRU churn
+            # can never evict it.
             zero = jax.tree_util.tree_map(jnp.zeros_like, lane)
             _logits, mutated = decoder.apply(
                 {"params": params, "cache": zero},
                 jnp.asarray(ptoks)[None],
                 mutable=["cache"],
             )
-            self._prefix_lane = _set_cursor(
-                mutated["cache"], int(ptoks.size)
-            )
+            prefix_lane = _set_cursor(mutated["cache"], int(ptoks.size))
+            self._insert_prefix(ptoks, lambda: prefix_lane, pinned=True)
 
     # -- serving-engine surface -------------------------------------------
 
@@ -778,7 +943,9 @@ class ContinuousEngine:
         session rejects the request instead of wedging a lane.
         """
         params = params or {}
-        if rid in self._rid_slot or any(p[0] == rid for p in self._pending):
+        if rid in self._rid_slot or any(
+            p[0] == rid for p in self._pending
+        ) or any(p[0] == rid for p in self._pending_kv):
             raise ValueError(f"request id {rid!r} already admitted")
         tokens = np.asarray(prompt, np.int32).reshape(-1)
         if tokens.size < 1:
@@ -791,9 +958,170 @@ class ContinuousEngine:
                 f"prompt + budget ({tokens.size + cap}) exceeds the "
                 f"session's static length ({self._length})"
             )
-        if len(self._rid_slot) + len(self._pending) >= self.slots:
+        if self.busy >= self.slots:
             raise RuntimeError("no free lane (all slots busy)")
         self._pending.append((rid, tokens, cap))
+
+    # -- disaggregated prefill/decode surface ------------------------------
+
+    def prefill_only(self, prompt, params: dict | None = None) -> bytes:
+        """Run the admission prefill for one prompt WITHOUT taking a
+        decode slot; returns a serialized KV bundle.
+
+        The bundle carries everything :meth:`admit_from_kv` needs to
+        skip prefill entirely on another engine of the same model: the
+        prompt, the prefilled cache lane (cursor parked at the prompt
+        length), the first generated token, and the admission rng /
+        sampling fingerprint.  The prefill itself is the admission
+        wave's exact computation (prefix-tree hits included — a prefill
+        tier warms its own tree), so a decode engine admitting the
+        bundle streams greedy tokens bit-identical to one engine doing
+        both phases.  Consumes one key from this engine's admission
+        chain, like a normal admission.
+        """
+        params = params or {}
+        tokens = np.asarray(prompt, np.int32).reshape(-1)
+        if tokens.size < 1:
+            raise ValueError("prompt needs at least one token")
+        if tokens.size + 1 > self._length:
+            raise ValueError(
+                f"prompt ({tokens.size} tokens) leaves no room for "
+                f"generation inside the session's static length "
+                f"({self._length})"
+            )
+        self._adm_key, key = jax.random.split(self._adm_key)
+        m, lane_m, _entry_digest = self._lookup_prefix(tokens)
+        if m:
+            bucket = min(
+                1 << (int(tokens.size) - m - 1).bit_length(),
+                self._config.max_seq - m,
+            )
+            suffix = tokens[m:]
+            padded = np.full((1, bucket), self._pad, np.int32)
+            padded[0, : suffix.size] = suffix
+            fn = _make_lane_prefix_prefill(
+                self._decoder, self._temperature, self._top_k,
+                int(bucket), int(m),
+            )
+            lane, first = fn(
+                self._params, lane_m, jnp.asarray(padded),
+                jnp.asarray([suffix.size], jnp.int32), key[None],
+            )
+            self.stats["prefix_hits"] += 1
+        else:
+            bucket = min(
+                1 << (int(tokens.size) - 1).bit_length(),
+                self._config.max_seq,
+            )
+            padded = np.full((1, bucket), self._pad, np.int32)
+            padded[0, : tokens.size] = tokens
+            lane_zero = jax.tree_util.tree_unflatten(
+                self._lane_treedef,
+                [
+                    jnp.zeros(shape, dtype)
+                    for shape, dtype in self._lane_shapes
+                ],
+            )
+            fn = _make_lane_prefill(
+                self._decoder, self._temperature, self._top_k, int(bucket),
+            )
+            lane, first = fn(
+                self._params, lane_zero, jnp.asarray(padded),
+                jnp.asarray([tokens.size], jnp.int32), key[None],
+            )
+            if self._prefix_tree:
+                self.stats["prefix_misses"] += 1
+        self.stats["prefill_positions"] += bucket
+        self.stats["kv_exports"] += 1
+        self._insert_prefix(tokens, lambda: lane)
+        leaves = jax.tree_util.tree_leaves(lane)
+        bundle = {
+            "v": KV_BUNDLE_VERSION,
+            "prompt": [int(t) for t in tokens],
+            "first": int(first),
+            "plen": int(tokens.size),
+            "rng": np.asarray(key),
+            "temperature": self._temperature,
+            "top_k": self._top_k,
+            "eos": self._eos,
+            "leaves": [np.asarray(leaf) for leaf in leaves],
+        }
+        return pickle.dumps(bundle, protocol=4)
+
+    def admit_from_kv(
+        self, rid: str, bundle, params: dict | None = None
+    ) -> None:
+        """Reserve a lane for a request whose prefill already ran
+        elsewhere (flushed at the next step, like :meth:`admit`).
+
+        ``bundle`` is :meth:`prefill_only`'s bytes (or the already
+        unpickled dict).  The lane is validated leaf-by-leaf against
+        this engine's cache layout, and the bundle's sampling
+        fingerprint (temperature / top_k / eos) against this engine's
+        statics — a bundle from a different model shape OR a
+        differently-configured engine raises :class:`ValueError` so the
+        session falls back to a full prefill instead of decoding a
+        stream whose first token was drawn under different rules.  No
+        admission key is consumed (the first token was drawn by the
+        prefill tier).
+        """
+        params = params or {}
+        if isinstance(bundle, (bytes, bytearray)):
+            bundle = pickle.loads(bytes(bundle))
+        if not isinstance(bundle, dict) or int(
+            bundle.get("v") or 0
+        ) != KV_BUNDLE_VERSION:
+            raise ValueError("unrecognized KV bundle")
+        fingerprint = (
+            float(bundle.get("temperature", 0.0) or 0.0),
+            bundle.get("top_k"),
+            bundle.get("eos"),
+        )
+        ours = (self._temperature, self._top_k, self._eos)
+        if fingerprint != ours:
+            raise ValueError(
+                f"KV bundle sampling fingerprint {fingerprint} does not "
+                f"match this engine's {ours}"
+            )
+        if rid in self._rid_slot or any(
+            p[0] == rid for p in self._pending
+        ) or any(p[0] == rid for p in self._pending_kv):
+            raise ValueError(f"request id {rid!r} already admitted")
+        tokens = np.asarray(bundle.get("prompt") or (), np.int32).reshape(-1)
+        if tokens.size < 1:
+            raise ValueError("KV bundle has an empty prompt")
+        cap = int(params.get("max_new_tokens", self._default_cap))
+        if cap < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {cap}")
+        if tokens.size + cap > self._length:
+            raise ValueError(
+                f"prompt + budget ({tokens.size + cap}) exceeds the "
+                f"session's static length ({self._length})"
+            )
+        if self.busy >= self.slots:
+            raise RuntimeError("no free lane (all slots busy)")
+        leaves = bundle.get("leaves")
+        if not isinstance(leaves, (list, tuple)) or len(leaves) != len(
+            self._lane_shapes
+        ):
+            raise ValueError(
+                "KV bundle does not match this engine's cache layout "
+                f"({len(leaves) if isinstance(leaves, (list, tuple)) else 0}"
+                f" leaves, want {len(self._lane_shapes)})"
+            )
+        imported = []
+        for leaf, (shape, dtype) in zip(leaves, self._lane_shapes):
+            arr = np.asarray(leaf)
+            if tuple(arr.shape) != shape or jnp.dtype(arr.dtype) != dtype:
+                raise ValueError(
+                    f"KV bundle lane leaf {arr.shape}/{arr.dtype} does "
+                    f"not match this engine's {shape}/{dtype}"
+                )
+            imported.append(jnp.asarray(arr))
+        lane = jax.tree_util.tree_unflatten(self._lane_treedef, imported)
+        first = int(bundle.get("first") or 0)
+        self._pending_kv.append((rid, tokens, cap, first, lane))
+        self.stats["kv_admits"] += 1
 
     def step(self) -> list[dict]:
         """Flush admissions, run one sync chunk, return fresh tokens.
@@ -841,6 +1169,7 @@ class ContinuousEngine:
         cache and buffer anyway).
         """
         self._pending = [p for p in self._pending if p[0] != rid]
+        self._pending_kv = [p for p in self._pending_kv if p[0] != rid]
         slot = self._rid_slot.pop(rid, None)
         if slot is None:
             return
@@ -855,26 +1184,82 @@ class ContinuousEngine:
         """Drop device state so the backend can reclaim the cache lanes."""
         self._state = None
         self._pending.clear()
+        self._pending_kv.clear()
+        self._prefix_tree.clear()
         self._rid_slot.clear()
         self._slot_rid = [None] * self.slots
 
     @property
     def busy(self) -> int:
-        return len(self._rid_slot) + len(self._pending)
+        return (
+            len(self._rid_slot) + len(self._pending) + len(self._pending_kv)
+        )
 
     # -- internals ---------------------------------------------------------
 
-    def _shares_prefix(self, tokens: np.ndarray) -> bool:
-        """Whether this prompt rides the shared-prefix fast path: it must
-        extend the session prefix by at least one token (the suffix pass
-        needs a position to read first-token logits from); an equal or
-        mismatched prompt falls back to the full-prefill road."""
-        prefix = self._prefix_tokens
-        return (
-            prefix is not None
-            and tokens.size > prefix.size
-            and bool(np.array_equal(tokens[: prefix.size], prefix))
+    def _lookup_prefix(
+        self, tokens: np.ndarray
+    ) -> tuple[int, Any, str]:
+        """``(m, lane, entry_digest)`` of the deepest cached prefix
+        usable for ``tokens`` — ``(0, None, "")`` when none qualifies.
+
+        An entry is usable at depth ``m`` when its first ``m`` tokens
+        equal the prompt's (m capped at ``len(prompt) - 1``: the suffix
+        pass needs at least one position to read first-token logits
+        from) and ``m >= prefix_min_tokens``.  A partial match rewinds
+        the entry's lane cursor to ``m`` — positions past the rewound
+        cursor hold stale K/V that stays dead until the suffix pass
+        overwrites it, the same exactness argument the pad positions
+        ride.  Touches the winning entry's LRU slot; counts nothing
+        (callers own the hit/miss stats).
+        """
+        best_m, best_digest, best_entry = 0, "", None
+        limit_all = int(tokens.size) - 1
+        for digest, entry in self._prefix_tree.items():
+            limit = min(int(entry.tokens.size), limit_all)
+            if limit <= best_m or limit < self._prefix_min:
+                continue
+            eq = entry.tokens[:limit] == tokens[:limit]
+            m = limit if bool(eq.all()) else int(np.argmin(eq))
+            if m >= self._prefix_min and m > best_m:
+                best_m, best_digest, best_entry = m, digest, entry
+        if best_entry is None:
+            return 0, None, ""
+        self._prefix_tree.move_to_end(best_digest)
+        lane = best_entry.lane
+        if best_m != int(best_entry.tokens.size):
+            lane = _set_cursor(lane, best_m)
+        return best_m, lane, best_digest
+
+    def _insert_prefix(
+        self, tokens: np.ndarray, lane_fn: Callable[[], Any],
+        pinned: bool = False,
+    ) -> None:
+        """Cache one prefilled lane under its token digest (LRU-bounded).
+
+        ``lane_fn`` defers the (device-gather) lane materialization until
+        the entry is known to be fresh and cacheable; pinned entries
+        (the constructor's ``shared_prefix``) never count against the
+        bound and never evict.
+        """
+        if not pinned and (
+            self._prefix_cache_size <= 0
+            or int(tokens.size) < self._prefix_min + 1
+        ):
+            return
+        digest = _tokens_digest(tokens)
+        if digest in self._prefix_tree:
+            self._prefix_tree.move_to_end(digest)
+            return
+        self._prefix_tree[digest] = _PrefixEntry(
+            np.array(tokens, np.int32, copy=True), lane_fn(), pinned
         )
+        unpinned = [
+            d for d, e in self._prefix_tree.items() if not e.pinned
+        ]
+        while len(unpinned) > self._prefix_cache_size:
+            del self._prefix_tree[unpinned.pop(0)]
+            self.stats["prefix_evictions"] += 1
 
     def _flush_admissions(self) -> None:
         """Admit pending requests in fused bucketed waves (one compiled
@@ -883,19 +1268,21 @@ class ContinuousEngine:
         split in admission order BEFORE the prefix partition so sampled
         streams draw identically whichever prefill road they take.
 
-        Prompts sharing the session's ``shared_prefix`` prefill only
-        their suffix on top of the once-computed prefix lane
-        (``_make_prefix_admit``); everything else — including a
-        mismatched prefix — takes the full-prompt wave unchanged.
+        A prompt with a usable prefix-tree lane prefills only its suffix
+        on top of it (``_make_prefix_admit``, grouped by entry + depth +
+        bucket); everything else takes the full-prompt wave; KV-bundle
+        admissions skip prefill entirely (``_make_kv_admit``).  After
+        the waves run, each freshly prefilled lane is inserted back into
+        the prefix tree, so repeated prompts and shared prefixes across
+        later requests hit warm KV.
         """
-        if not self._pending:
+        if not (self._pending or self._pending_kv):
             return
         free = [s for s in range(self.slots) if self._slot_rid[s] is None]
         picked: list[tuple[int, np.ndarray, int, Any, int]] = []
-        picked_prefix: list[tuple[int, np.ndarray, int, Any, int]] = []
-        prefix_len = (
-            0 if self._prefix_tokens is None else self._prefix_tokens.size
-        )
+        #: (entry digest, m, bucket) -> (lane, [(slot, tokens, cap, key)])
+        picked_prefix: dict[tuple[str, int, int], tuple[Any, list]] = {}
+        picked_kv: list[tuple[int, np.ndarray, int, int, Any]] = []
         while self._pending and free:
             rid, tokens, cap = self._pending.pop(0)
             slot = free.pop(0)
@@ -903,26 +1290,37 @@ class ContinuousEngine:
             self._rid_slot[rid] = slot
             self._reported[slot] = 0
             self._adm_key, key = jax.random.split(self._adm_key)
-            if self._shares_prefix(tokens):
-                # Pad K/V land at cache slots >= prefix_len + suffix
-                # length, so the bucket is capped to what fits BEYOND the
+            m, lane_m, entry_digest = self._lookup_prefix(tokens)
+            if m:
+                # Pad K/V land at cache slots >= m + suffix length, so
+                # the bucket is capped to what fits BEYOND the reused
                 # prefix (admit() already bounded prompt + budget).
                 bucket = min(
-                    1 << (int(tokens.size) - prefix_len - 1).bit_length(),
-                    self._config.max_seq - prefix_len,
+                    1 << (int(tokens.size) - m - 1).bit_length(),
+                    self._config.max_seq - m,
                 )
                 self.stats["prefix_hits"] += 1
                 self.stats["prefill_positions"] += bucket
-                picked_prefix.append((slot, tokens, cap, key, bucket))
+                lane_g, group = picked_prefix.setdefault(
+                    (entry_digest, m, bucket), (lane_m, [])
+                )
+                group.append((slot, tokens, cap, key))
             else:
                 bucket = min(
                     1 << (int(tokens.size) - 1).bit_length(),
                     self._config.max_seq,
                 )
-                if self._prefix_tokens is not None:
+                if self._prefix_tree:
                     self.stats["prefix_misses"] += 1
                 self.stats["prefill_positions"] += bucket
                 picked.append((slot, tokens, cap, key, bucket))
+        while self._pending_kv and free:
+            rid, tokens, cap, first, lane = self._pending_kv.pop(0)
+            slot = free.pop(0)
+            self._slot_rid[slot] = rid
+            self._rid_slot[rid] = slot
+            self._reported[slot] = 0
+            picked_kv.append((slot, tokens, cap, first, lane))
         for bucket in sorted({p[4] for p in picked}):
             group = [p for p in picked if p[4] == bucket]
             g = 1 << (len(group) - 1).bit_length()
@@ -948,8 +1346,7 @@ class ContinuousEngine:
                 jnp.asarray(padded), jnp.asarray(plens),
                 jnp.asarray(slots), jnp.asarray(caps_in), jnp.stack(keys),
             )
-        for bucket in sorted({p[4] for p in picked_prefix}):
-            group = [p for p in picked_prefix if p[4] == bucket]
+        for (_entry, m, bucket), (lane_m, group) in picked_prefix.items():
             g = 1 << (len(group) - 1).bit_length()
             rows = np.full((g, self._length), self._pad, np.int32)
             padded = np.full((g, bucket), self._pad, np.int32)
@@ -957,8 +1354,8 @@ class ContinuousEngine:
             slots = np.full(g, self.slots, np.int32)  # OOB rows dropped
             caps_in = np.ones(g, np.int32)
             keys = [jax.random.PRNGKey(0)] * g
-            for r, (slot, tokens, cap, key, _) in enumerate(group):
-                suffix = tokens[prefix_len:]
+            for r, (slot, tokens, cap, key) in enumerate(group):
+                suffix = tokens[m:]
                 rows[r, : tokens.size] = tokens
                 padded[r, : suffix.size] = suffix
                 slens[r] = suffix.size
@@ -967,14 +1364,62 @@ class ContinuousEngine:
                 keys[r] = key
             wave = _make_prefix_admit(
                 self._decoder, self._temperature, self._top_k, self._eos,
-                int(self.slots), int(bucket), int(g), int(prefix_len),
+                int(self.slots), int(bucket), int(g), int(m),
             )
             self._state = wave(
-                self._params, self._state, self._prefix_lane,
+                self._params, self._state, lane_m,
                 jnp.asarray(rows), jnp.asarray(padded),
                 jnp.asarray(slens), jnp.asarray(slots),
                 jnp.asarray(caps_in), jnp.stack(keys),
             )
+        if picked_kv:
+            g = 1 << (len(picked_kv) - 1).bit_length()
+            rows = np.full((g, self._length), self._pad, np.int32)
+            plens = np.ones(g, np.int32)
+            firsts = np.zeros(g, np.int32)
+            slots = np.full(g, self.slots, np.int32)  # OOB rows dropped
+            caps_in = np.ones(g, np.int32)
+            lanes = [p[4] for p in picked_kv]
+            lanes += [lanes[0]] * (g - len(lanes))  # padded rows drop
+            for r, (slot, tokens, cap, first, _lane) in enumerate(
+                picked_kv
+            ):
+                rows[r, : tokens.size] = tokens
+                rows[r, tokens.size] = first
+                plens[r] = tokens.size
+                firsts[r] = first
+                slots[r] = slot
+                caps_in[r] = cap
+            stacked = jax.tree_util.tree_map(
+                lambda *leaves: jnp.stack(leaves), *lanes
+            )
+            wave = _make_kv_admit(self._eos, int(self.slots), int(g))
+            self._state = wave(
+                self._state, stacked, jnp.asarray(rows),
+                jnp.asarray(plens), jnp.asarray(firsts),
+                jnp.asarray(slots), jnp.asarray(caps_in),
+            )
+        # Feed the tree: every admission's post-wave lane (cursor already
+        # parked at the prompt length by its wave — or carried by the
+        # imported bundle) becomes a reusable prefix for later prompts.
+        if self._prefix_cache_size > 0:
+            state = self._state
+            candidates = [
+                (slot, tokens) for slot, tokens, *_ in picked
+            ] + [
+                (slot, tokens)
+                for _, (_lane, group) in picked_prefix.items()
+                for slot, tokens, _cap, _key in group
+            ] + [
+                (slot, tokens) for slot, tokens, *_ in picked_kv
+            ]
+            for slot, tokens in candidates:
+                self._insert_prefix(
+                    tokens,
+                    lambda slot=slot: jax.tree_util.tree_map(
+                        lambda c: c[slot], state[0]
+                    ),
+                )
 
 
 def lm_engine_factory(model: TransformerLM, params: Any, **engine_kwargs):

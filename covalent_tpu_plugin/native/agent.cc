@@ -892,6 +892,40 @@ static void serve_forward(const Json& cmd, const std::string& payload,
   }
 }
 
+// serve_prefill forwards like serve_request, but its waiter settles on
+// serve_kv events only — an unknown session must answer with a serve_kv
+// error (not a streamed serve.reject) or the dispatcher's prefill call
+// sits out its whole timeout before degrading to a full prefill.
+static void serve_prefill_forward(const Json& cmd,
+                                  const std::string& payload) {
+  const Json* id_field = cmd.get("id");
+  const std::string sid =
+      (id_field && id_field->type == Json::Str) ? id_field->s : "";
+  auto it = g_serve_children.find(sid);
+  if (it == g_serve_children.end()) {
+    const Json* rid = cmd.get("rid");
+    emit("{\"event\":\"serve_kv\",\"id\":\"" + json_escape(sid) +
+         "\",\"rid\":\"" +
+         json_escape(rid && rid->type == Json::Str ? rid->s : "") +
+         "\",\"code\":\"unknown_session\",\"message\":\"no open "
+         "session\"}");
+    return;
+  }
+  if (!write_all(it->second.stdin_fd, payload)) {
+    close(it->second.stdin_fd);
+    g_serve_children.erase(it);
+    // A torn pipe means no serve_kv will ever come from the child: the
+    // waiter must fail NOW (and degrade to full prefill), not sit out
+    // its whole timeout — same rationale as the unknown-session branch.
+    const Json* rid = cmd.get("rid");
+    emit("{\"event\":\"serve_kv\",\"id\":\"" + json_escape(sid) +
+         "\",\"rid\":\"" +
+         json_escape(rid && rid->type == Json::Str ? rid->s : "") +
+         "\",\"code\":\"runner_exited\",\"message\":\"serve runner pipe "
+         "broken\"}");
+  }
+}
+
 // Resident-mode profiling: the native agent holds no Python/jax runtime of
 // its own — the resident state worth profiling lives in its serve-child
 // session runners.  profile_start/profile_stop forward verbatim into a live
@@ -1187,6 +1221,7 @@ static void handle_line(const std::string& line, bool& running) {
   else if (name == "invoke") invoke_task(cmd, line + "\n");
   else if (name == "serve_open") serve_open(cmd, line);
   else if (name == "serve_request") serve_forward(cmd, line + "\n", false);
+  else if (name == "serve_prefill") serve_prefill_forward(cmd, line + "\n");
   else if (name == "serve_close") serve_forward(cmd, line + "\n", true);
   else if (name == "profile_start") profile_forward(cmd, line, false);
   else if (name == "profile_stop") profile_forward(cmd, line, true);
@@ -1232,6 +1267,8 @@ static void handle_frame(const std::string& header, const std::string& raw,
     }
   } else if (name == "serve_request") {
     serve_forward(cmd, raw, false);
+  } else if (name == "serve_prefill") {
+    serve_prefill_forward(cmd, raw);
   } else if (name == "serve_close") {
     serve_forward(cmd, raw, true);
   } else {

@@ -10,6 +10,12 @@ dispatch plane's answer to interactive traffic (ROADMAP item 2).
   fleet pools behind a session-aware router (:class:`ReplicaSet`):
   least-loaded placement with per-tenant DRR fairness, sticky session
   ids, per-replica health with drain-on-death onto survivors.
+* :func:`open_disaggregated_set` — split the set into a prefill tier
+  and a decode tier connected by CAS-addressed KV bundles
+  (:class:`DisaggregatedSet`): long prompts prefill on dedicated
+  replicas, ship their KV through the CAS with digest verification,
+  and decode replicas admit straight from KV — degrading to a full
+  prefill on any failure, never a user-visible error.
 * :class:`~.supervisor.SessionSupervisor` — one supervised session:
   reconnect after channel death, exactly-once ``idx``-spliced stream
   replay; both fronts share it, so neither duplicates replay machinery.
@@ -18,6 +24,7 @@ dispatch plane's answer to interactive traffic (ROADMAP item 2).
   shared-prefix prefill reuse for common system prompts.
 """
 
+from .disagg import DisaggregatedSet, open_disaggregated_set
 from .handle import (
     ServeError,
     ServeHandle,
@@ -26,6 +33,13 @@ from .handle import (
     open_session,
 )
 from .metrics import (
+    SERVE_DISAGG_REQUESTS_TOTAL,
+    SERVE_KV_TRANSFER_BYTES_TOTAL,
+    SERVE_KV_TRANSFER_SECONDS,
+    SERVE_KV_TRANSFERS_TOTAL,
+    SERVE_PREFILL_POSITIONS,
+    SERVE_PREFIX_HITS,
+    SERVE_PREFIX_MISSES,
     SERVE_QUEUE_DEPTH,
     SERVE_RECONNECTS_TOTAL,
     SERVE_REPLICA_IN_FLIGHT,
@@ -50,6 +64,7 @@ from .replicas import (
 from .supervisor import SessionSupervisor
 
 __all__ = [
+    "DisaggregatedSet",
     "ServeError",
     "ServeHandle",
     "ServeRequest",
@@ -60,6 +75,14 @@ __all__ = [
     "ReplicaView",
     "open_session",
     "open_replica_set",
+    "open_disaggregated_set",
+    "SERVE_DISAGG_REQUESTS_TOTAL",
+    "SERVE_KV_TRANSFER_BYTES_TOTAL",
+    "SERVE_KV_TRANSFER_SECONDS",
+    "SERVE_KV_TRANSFERS_TOTAL",
+    "SERVE_PREFILL_POSITIONS",
+    "SERVE_PREFIX_HITS",
+    "SERVE_PREFIX_MISSES",
     "SERVE_QUEUE_DEPTH",
     "SERVE_RECONNECTS_TOTAL",
     "SERVE_REPLICA_IN_FLIGHT",

@@ -77,8 +77,10 @@ SERVE_WORKER_SLOTS = REGISTRY.gauge(
 # the supervisor's ``_drop_live`` when the replica retires, so a scaled-
 # down set leaves no stale series behind (the same reap contract the
 # per-session gauges follow).  ``outcome`` on the router counter is a
-# closed set: ``sticky`` (pinned sid honored), ``least_loaded`` (fresh
-# placement), ``queued`` (no open replica had headroom — DRR queue),
+# closed set: ``sticky`` (pinned sid honored), ``prefix_affinity``
+# (steered to the replica whose engine prefix tree is warm for the
+# prompt), ``least_loaded`` (fresh placement),
+# ``queued`` (no open replica had headroom — DRR queue),
 # ``shed`` (router admission bound hit), ``failover`` (re-routed off a
 # dead replica).
 
@@ -125,4 +127,65 @@ SERVE_ROUTER_DECISION_SECONDS = REGISTRY.histogram(
     buckets=(
         0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.05, 0.25,
     ),
+)
+
+# -- engine prefix counters (per-session, fed by serve.stats) ---------------
+# ContinuousEngine.stats counters surfaced as serving metrics: set from
+# every worker stats record and reaped by the supervisor's ``_drop_live``
+# like serve_tokens_per_s — without these the prefix tree and the prefill
+# accounting are engine-local and invisible to /metrics, /history, SLOs.
+
+SERVE_PREFIX_HITS = REGISTRY.gauge(
+    "covalent_tpu_serve_prefix_hits",
+    "Engine prefix-tree admission hits per serving session",
+    ("session",),
+)
+
+SERVE_PREFIX_MISSES = REGISTRY.gauge(
+    "covalent_tpu_serve_prefix_misses",
+    "Engine prefix-tree admission misses per serving session",
+    ("session",),
+)
+
+SERVE_PREFILL_POSITIONS = REGISTRY.gauge(
+    "covalent_tpu_serve_prefill_positions",
+    "Prefill positions paid by a serving session's engine "
+    "(suffix buckets on prefix hits, full-prompt buckets on misses)",
+    ("session",),
+)
+
+# -- disaggregated prefill/decode -------------------------------------------
+# The KV transfer plane: prefill replicas package admission prefill as
+# content-addressed KV bundles; decode replicas import them and go
+# straight to decode.  ``outcome`` is a closed set: ``ok`` (bundle
+# fetched, digest-verified), ``digest_mismatch`` (torn/stale transfer —
+# degraded to full prefill), ``error`` (prefill tier unreachable or
+# refused — degraded), ``fallback`` (no prefill tier routable).  ``path``
+# on the request counter: ``disagg`` (KV road taken), ``direct`` (short
+# prompt, classic road), ``fallback`` (eligible but degraded).
+
+SERVE_KV_TRANSFERS_TOTAL = REGISTRY.counter(
+    "covalent_tpu_serve_kv_transfers_total",
+    "KV bundle transfers between the prefill and decode tiers by outcome",
+    ("outcome",),
+)
+
+SERVE_KV_TRANSFER_BYTES_TOTAL = REGISTRY.counter(
+    "covalent_tpu_serve_kv_transfer_bytes_total",
+    "Serialized KV bundle bytes shipped from the prefill tier",
+)
+
+SERVE_KV_TRANSFER_SECONDS = REGISTRY.histogram(
+    "covalent_tpu_serve_kv_transfer_seconds",
+    "Prefill-tier round trip: serve_prefill submit -> verified bundle",
+    buckets=(
+        0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+        2.5, 5.0,
+    ),
+)
+
+SERVE_DISAGG_REQUESTS_TOTAL = REGISTRY.counter(
+    "covalent_tpu_serve_disagg_requests_total",
+    "Requests through a disaggregated set by road taken",
+    ("path",),
 )

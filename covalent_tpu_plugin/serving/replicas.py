@@ -137,6 +137,17 @@ class ReplicaRouter:
         )
         #: sticky key -> [replica_id, last_used] (TTL-expired lazily).
         self._sticky: dict[str, list] = {}
+        #: prefix key -> replica id that last served a request sharing
+        #: that prompt prefix (bounded FIFO): requests carrying the same
+        #: key steer to the replica whose engine-side prefix tree is
+        #: already warm for it.  A *preference*, never a pin — sticky
+        #: sids rank above it, and it only engages when the remembered
+        #: replica is open with headroom, so DRR fairness (which decides
+        #: WHOSE request pops) is untouched.
+        self._prefix_sites: "collections.OrderedDict[str, str]" = (
+            collections.OrderedDict()
+        )
+        self._prefix_sites_max = 1024
         #: rotation cursor for exact load ties, so equal replicas share.
         self._rr = 0
 
@@ -185,6 +196,23 @@ class ReplicaRouter:
             k for k, (rid, _) in self._sticky.items() if rid == replica_id
         ]:
             del self._sticky[key]
+        for key in [
+            k for k, rid in self._prefix_sites.items()
+            if rid == replica_id
+        ]:
+            del self._prefix_sites[key]
+
+    def record_prefix_site(self, prefix_key: str, replica_id: str) -> None:
+        """Remember which replica last warmed ``prefix_key`` (bounded)."""
+        if not prefix_key:
+            return
+        self._prefix_sites[prefix_key] = replica_id
+        self._prefix_sites.move_to_end(prefix_key)
+        while len(self._prefix_sites) > self._prefix_sites_max:
+            self._prefix_sites.popitem(last=False)
+
+    def prefix_site(self, prefix_key: str) -> str | None:
+        return self._prefix_sites.get(prefix_key)
 
     # -- admission + placement ----------------------------------------------
 
@@ -209,7 +237,7 @@ class ReplicaRouter:
         replica is mid-reconnect — requeues with its original enqueue
         stamp, so fairness age and ``queued`` accounting survive the
         deferral.  Returns ``(item, replica_id, outcome)`` per placement,
-        ``outcome`` in ``{"sticky", "least_loaded"}``.
+        ``outcome`` in ``{"sticky", "prefix_affinity", "least_loaded"}``.
         """
         headroom = {
             rid: view.capacity - view.load
@@ -230,6 +258,7 @@ class ReplicaRouter:
             if item is None:
                 break
             sticky = str(item.task_metadata.get("sticky") or "")
+            prefix_key = str(item.task_metadata.get("prefix_key") or "")
             target = None
             outcome = "least_loaded"
             if sticky:
@@ -247,6 +276,15 @@ class ReplicaRouter:
                             continue
                     # else: the pin points at a dead replica — fall
                     # through to a fresh placement and re-pin below.
+            if target is None and prefix_key:
+                # Prefix affinity ranks BELOW sticky and above
+                # least-loaded, and unlike a pin it never defers: a warm
+                # prefix tree is worth steering toward, not waiting on.
+                site = self.prefix_site(prefix_key)
+                if site is not None and headroom.get(site, 0) > 0:
+                    view = views.get(site)
+                    if view is not None and view.open:
+                        target, outcome = site, "prefix_affinity"
             if target is None:
                 target = self._least_loaded(views, headroom)
                 if target is None:
@@ -258,6 +296,8 @@ class ReplicaRouter:
                 # Refresh the pin's TTL on use: a multi-turn caller stays
                 # put as long as its turns keep landing.
                 self.pin(sticky, target)
+            if prefix_key:
+                self.record_prefix_site(prefix_key, target)
             headroom[target] -= 1
             assigned.append((item, target, outcome))
         for item in deferred:
@@ -570,9 +610,13 @@ class ReplicaSet:
             tenant,
         )
         request.sticky = sticky
+        await self._prepare_request(request)
         item = WorkItem(
             fn=None, args=(), kwargs={},
-            task_metadata={"request": request, "sticky": sticky},
+            task_metadata={
+                "request": request, "sticky": sticky,
+                "prefix_key": request.prefix_key,
+            },
             tenant=tenant or DEFAULT_TENANT,
         )
         t0 = time.perf_counter()
@@ -594,6 +638,11 @@ class ReplicaSet:
             SERVE_ROUTER_DECISIONS_TOTAL.labels(outcome="queued").inc()
         await self._dispatch_assignments(assignments)
         return request
+
+    async def _prepare_request(self, request: ServeRequest) -> None:
+        """Pre-dispatch hook: a disaggregated set runs the prefill tier
+        here (attaching the KV bundle and prefix key) before the router
+        ever sees the request.  The base set does nothing."""
 
     def _default_deadline_s(self) -> float:
         for sup in self._replicas.values():
@@ -645,7 +694,10 @@ class ReplicaSet:
         SERVE_ROUTER_DECISIONS_TOTAL.labels(outcome="failover").inc()
         item = WorkItem(
             fn=None, args=(), kwargs={},
-            task_metadata={"request": request, "sticky": sticky},
+            task_metadata={
+                "request": request, "sticky": sticky,
+                "prefix_key": request.prefix_key,
+            },
             tenant=request.tenant or DEFAULT_TENANT,
         )
         try:

@@ -41,6 +41,9 @@ from ..resilience import FaultClass, RetryPolicy, classify_error
 from ..transport.base import TransportError
 from ..utils.log import app_log
 from .metrics import (
+    SERVE_PREFILL_POSITIONS,
+    SERVE_PREFIX_HITS,
+    SERVE_PREFIX_MISSES,
     SERVE_QUEUE_DEPTH,
     SERVE_RECONNECTS_TOTAL,
     SERVE_REPLICA_IN_FLIGHT,
@@ -133,6 +136,15 @@ class ServeRequest:
         #: the caller's multi-turn session key (set by a replica set);
         #: rides the request so a drain-on-death re-route keeps the pin.
         self.sticky = ""
+        #: (bundle bytes, sha256) attached by a disaggregated front: the
+        #: decode replica admits from this KV instead of prefilling.  It
+        #: rides the request so a replay — or a re-route onto another
+        #: replica — keeps the prefill-tier work.
+        self.kv: tuple[bytes, str] | None = None
+        #: prefix-affinity routing key (digest of the prompt's reusable
+        #: prefix): the router steers requests sharing it to the replica
+        #: whose engine-side prefix tree is already warm for it.
+        self.prefix_key = ""
         self.tokens: list[int] = []
         self.error: str = ""
         self.t_submit = time.monotonic()
@@ -546,6 +558,28 @@ class SessionSupervisor:
 
     async def _send_request(self, request: ServeRequest) -> None:
         assert self._client is not None
+        kv_bytes: bytes | None = None
+        kv_digest = ""
+        kv_path = ""
+        if request.kv is not None:
+            kv_bytes, kv_digest = request.kv
+            if not self._client.frames_active:
+                # Cross-pool road: a JSONL channel would pay ~33% base64
+                # inflation per send (and per replay), so the bundle
+                # ships ONCE into the worker's remote CAS — digest-named,
+                # single-flighted, deduped across identical prompts —
+                # and the request references it by path.  Any staging
+                # failure just drops the KV: the worker's full-prefill
+                # fallback owns correctness.
+                try:
+                    kv_path = await self._stage_kv(kv_bytes, kv_digest)
+                    kv_bytes = None
+                except Exception as err:  # noqa: BLE001 - degrade
+                    app_log.debug(
+                        "KV staging for %s failed (%s); degrading to "
+                        "full prefill", request.rid, err,
+                    )
+                    kv_bytes, kv_digest = None, ""
         await self._client.serve_request(
             self._sid_g,
             request.rid,
@@ -553,6 +587,55 @@ class SessionSupervisor:
             params=request.params,
             deadline_s=request.deadline_s,
             tenant=request.tenant,
+            kv_bytes=kv_bytes,
+            kv_digest=kv_digest,
+            kv_path=kv_path,
+        )
+
+    async def _stage_kv(self, data: bytes, digest: str) -> str:
+        """Ship one KV bundle into this session's worker CAS; returns the
+        remote path.  Content-addressed: a repeated prompt's identical
+        bundle is a present-set hit, zero wire bytes."""
+        executor = self.executor
+        local = os.path.join(
+            executor.cache_dir, "cas", f"{digest}.kv"
+        )
+        if not os.path.exists(local):
+            os.makedirs(os.path.dirname(local), exist_ok=True)
+            await asyncio.to_thread(self._write_payload, local, data)
+        conn = self._conns[0]
+        key = executor._pool_key(self.address)
+        remote = cas_path(executor.remote_cache, digest, ".kv")
+        await executor._cas.ensure(
+            key, conn, digest, local, remote,
+            codec=executor._codec_for(key, conn),
+            python_path=executor.python_path,
+        )
+        return remote
+
+    async def prefill_kv(
+        self,
+        prompt,
+        params: dict | None = None,
+        rid: str = "",
+        timeout_s: float = 60.0,
+    ) -> dict:
+        """Run a prefill-only pass on this session's resident engine and
+        return the ``serve_kv`` event (bundle under ``data_bytes``,
+        worker-announced sha256 under ``digest``).
+
+        The disaggregated front calls this on a prefill-tier replica;
+        the caller owns digest verification of the received bytes and
+        the degrade-to-full-prefill decision on any failure.
+        """
+        await self._await_ready()
+        client = self._client
+        if client is None:
+            raise ServeError(f"session {self.sid} has no live runtime")
+        rid = rid or f"kv-{uuid.uuid4().hex[:8]}"
+        return await client.serve_prefill(
+            self._sid_g, rid, [int(t) for t in prompt],
+            params=params, timeout=timeout_s,
         )
 
     async def _await_ready(self) -> None:
@@ -649,6 +732,9 @@ class SessionSupervisor:
             if k in (
                 "slots", "busy", "queued", "served",
                 "tokens_total", "tokens_per_s",
+                "prefix_hits", "prefix_misses", "prefill_positions",
+                "prefix_evictions", "kv_admits", "kv_fallbacks",
+                "kv_exports", "prefills",
             )
         }
         SERVE_QUEUE_DEPTH.labels(session=self.sid).set(
@@ -657,6 +743,18 @@ class SessionSupervisor:
         SERVE_TOKENS_PER_S.labels(session=self.sid).set(
             float(self.stats.get("tokens_per_s") or 0.0)
         )
+        # Engine prefix counters ride the same stats record; only engines
+        # that report them (ContinuousEngine) create the series, so stub
+        # engines leave no dead zero gauges behind.
+        for key, gauge in (
+            ("prefix_hits", SERVE_PREFIX_HITS),
+            ("prefix_misses", SERVE_PREFIX_MISSES),
+            ("prefill_positions", SERVE_PREFILL_POSITIONS),
+        ):
+            if key in self.stats:
+                gauge.labels(session=self.sid).set(
+                    float(self.stats[key] or 0)
+                )
 
     def _finish(self, rid: str, outcome: str) -> None:
         if self._requests.pop(rid, None) is not None:
@@ -842,6 +940,9 @@ class SessionSupervisor:
             pass
         SERVE_QUEUE_DEPTH.remove(session=self.sid)
         SERVE_TOKENS_PER_S.remove(session=self.sid)
+        SERVE_PREFIX_HITS.remove(session=self.sid)
+        SERVE_PREFIX_MISSES.remove(session=self.sid)
+        SERVE_PREFILL_POSITIONS.remove(session=self.sid)
         if self.replica_of is not None:
             SERVE_REPLICA_IN_FLIGHT.remove(
                 set=self.replica_of[0], replica=self.replica_of[1]

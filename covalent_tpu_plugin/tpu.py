@@ -54,11 +54,13 @@ from .agent import (
     start_pool_server,
 )
 from .cache import (
+    CAS_EVICTIONS_TOTAL,
     RESULT_CACHE_TOTAL,
     CASIndex,
     FnRegistry,
     ResultCache,
     bytes_digest,
+    cas_bytes_prune_command,
     cas_path,
     file_digest,
     harness_digest,
@@ -175,6 +177,13 @@ _EXECUTOR_PLUGIN_DEFAULTS = {
     # stay hot, while one-off payloads from long-gone electrons cannot fill
     # the worker disk.  0 disables pruning.
     "cas_ttl_hours": 168.0,
+    # Byte budget for the same CAS dir, enforced oldest-access-first during
+    # the per-electron maintenance round trip (the touch keeps hot
+    # artifacts at the LRU tail).  The TTL bounds staleness; this bounds
+    # SIZE — KV bundles (disaggregated serving) are orders of magnitude
+    # larger than fn pickles and can fill a disk well inside the TTL.
+    # 0 disables; COVALENT_TPU_CAS_MAX_BYTES overrides per process.
+    "cas_max_bytes": 0,
     # NOT jax by default: forking a parent that already imported jax (PJRT
     # plugins register at import) measurably slows TPU backend init in the
     # children; interpreter+sitecustomize startup is the big win anyway.
@@ -443,6 +452,7 @@ class TPUExecutor(RemoteExecutor):
         result_cache_max_entries: int | None = None,
         result_cache_max_bytes: int | None = None,
         cas_ttl_hours: float | None = None,
+        cas_max_bytes: int | None = None,
         max_task_retries: int | None = None,
         retry_base_delay: float | None = None,
         retry_max_delay: float | None = None,
@@ -597,6 +607,22 @@ class TPUExecutor(RemoteExecutor):
             )
         self.cache_results = bool(resolve(cache_results, "cache_results"))
         self.cas_ttl_hours = float(resolve(cas_ttl_hours, "cas_ttl_hours"))
+        #: byte budget for remote_cache/cas/ (and the local KV mirror):
+        #: oldest-access-first LRU eviction once the dir outgrows it.
+        #: The TTL prune bounds staleness; this bounds SIZE — KV bundles
+        #: are orders of magnitude larger than fn pickles.  0 = off.
+        env_cas_bytes = os.environ.get("COVALENT_TPU_CAS_MAX_BYTES")
+        if cas_max_bytes is None and env_cas_bytes is not None:
+            try:
+                cas_max_bytes = int(env_cas_bytes)
+            except ValueError:
+                app_log.warning(
+                    "ignoring non-integer COVALENT_TPU_CAS_MAX_BYTES=%r",
+                    env_cas_bytes,
+                )
+        self.cas_max_bytes = max(
+            0, int(resolve(cas_max_bytes, "cas_max_bytes"))
+        )
 
         #: gang-level retry budget (resilience.py): explicit arg > env >
         #: config > default-off, the same chain as cache_results — the env
@@ -1526,6 +1552,16 @@ class TPUExecutor(RemoteExecutor):
         prune = self._cas_prune_clause()
         if prune:
             parts.append(prune)
+        if self.cas_max_bytes > 0:
+            # Byte-budget LRU AFTER the touch, so this electron's hot
+            # artifacts sit at the LRU tail and one-off payloads (unique
+            # args pickles, KV bundles) evict first.  The worker prints
+            # CAS_EVICTED=<n> for the dispatcher's eviction counter.
+            parts.append(cas_bytes_prune_command(
+                self.python_path,
+                cas_path(self.remote_cache, "").rstrip("/"),
+                self.cas_max_bytes,
+            ))
         return "; ".join(parts) + "; true"
 
     async def _preflight(self, conn: Transport, key: str | None = None) -> None:
@@ -2570,11 +2606,26 @@ class TPUExecutor(RemoteExecutor):
             # entries (best-effort: the clause ends in `true`, and a failed
             # round-trip must not fail a cleanup that already succeeded).
             try:
-                await conn.run(self._cas_maintenance_command(staged))
+                maintained = await conn.run(
+                    self._cas_maintenance_command(staged)
+                )
             except (TransportError, OSError) as err:
                 app_log.debug(
                     "CAS maintenance on %s skipped: %s", conn.address, err
                 )
+            else:
+                if self.cas_max_bytes > 0:
+                    for token in (maintained.stdout or "").split():
+                        if token.startswith("CAS_EVICTED="):
+                            try:
+                                evicted = int(token.split("=", 1)[1])
+                            except ValueError:
+                                continue
+                            if evicted > 0:
+                                CAS_EVICTIONS_TOTAL.labels(
+                                    site="remote"
+                                ).inc(evicted)
+                            break
 
         await asyncio.gather(
             *(clean_worker(i, c) for i, c in enumerate(conns)),

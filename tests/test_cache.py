@@ -11,6 +11,9 @@ switches, and a full run() short-circuit that never touches the transport.
 
 import asyncio
 import json
+import os
+import sys
+import time
 
 import pytest
 
@@ -669,3 +672,57 @@ def test_cleanup_maintenance_skips_prune_when_disabled(tmp_path):
     staged = ex._write_function_files("op", lambda: 1, (), {}, "/wd")
     cmd = ex._cas_maintenance_command(staged)
     assert "touch -c" in cmd and "find" not in cmd
+
+
+def test_prune_cas_dir_byte_budget_lru(tmp_path):
+    """Oldest-mtime-first eviction until the dir fits the budget; newer
+    (touched-hot) artifacts survive; 0 disables."""
+    from covalent_tpu_plugin.cache import prune_cas_dir
+
+    root = tmp_path / "cas"
+    root.mkdir()
+    now = time.time()
+    for i in range(5):
+        path = root / f"a{i}.pkl"
+        path.write_bytes(b"x" * 100)
+        os.utime(path, (now - 500 + i * 100, now - 500 + i * 100))
+    assert prune_cas_dir(str(root), 0) == 0
+    assert prune_cas_dir(str(root), 250) == 3  # two newest fit (200B)
+    left = sorted(p.name for p in root.iterdir())
+    assert left == ["a3.pkl", "a4.pkl"]
+    assert prune_cas_dir(str(root), 250) == 0  # already under budget
+
+
+def test_remote_cas_bytes_prune_command(tmp_path):
+    """The worker-side mirror evicts the same way and announces the
+    count the dispatcher's counter consumes."""
+    import subprocess
+
+    from covalent_tpu_plugin.cache import cas_bytes_prune_command
+
+    root = tmp_path / "cas"
+    root.mkdir()
+    now = time.time()
+    for i in range(4):
+        path = root / f"b{i}.kv"
+        path.write_bytes(b"y" * 1000)
+        os.utime(path, (now - 400 + i * 100, now - 400 + i * 100))
+    command = cas_bytes_prune_command(sys.executable, str(root), 2500)
+    out = subprocess.run(
+        ["sh", "-c", command], capture_output=True, text=True, check=True
+    )
+    assert "CAS_EVICTED=2" in out.stdout
+    assert sorted(p.name for p in root.iterdir()) == ["b2.kv", "b3.kv"]
+
+
+def test_cleanup_maintenance_includes_byte_prune(tmp_path):
+    """cas_max_bytes wires the LRU clause into the maintenance round
+    trip (after the touch, so hot artifacts sit at the LRU tail) and
+    off by default."""
+    ex = make_executor(tmp_path, cas_max_bytes=12345)
+    staged = ex._write_function_files("op", lambda: 1, (), {}, "/wd")
+    cmd = ex._cas_maintenance_command(staged)
+    assert "CAS_EVICTED" in cmd and "12345" in cmd
+    assert cmd.index("touch -c") < cmd.index("CAS_EVICTED")
+    off = make_executor(tmp_path, cache_dir=str(tmp_path / "c2"))
+    assert "CAS_EVICTED" not in off._cas_maintenance_command(staged)

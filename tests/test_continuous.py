@@ -389,10 +389,13 @@ def test_engine_shared_prefix_streams_bit_equal():
 
 
 def test_engine_shared_prefix_mismatch_falls_back():
-    """A prompt NOT extending the prefix — diverging content, equal to
-    the prefix, or shorter — takes the full-prefill road (miss counted)
-    and still matches the oracle bit-for-bit; hits and misses mix freely
-    in one admission flush."""
+    """Prefix-tree semantics (ISSUE 13 generalization): a diverging
+    prompt reuses the COMMON part of a cached prefix (the lane rewinds
+    to the divergence point), an equal prompt reuses all but its last
+    token, and only a prompt whose usable common prefix is shorter than
+    ``prefix_min_tokens`` takes the full-prefill road — all of them
+    matching the oracle bit-for-bit, hits and misses mixing freely in
+    one admission flush."""
     from covalent_tpu_plugin.models.serve import ContinuousEngine
 
     model, params = shared()
@@ -400,9 +403,9 @@ def test_engine_shared_prefix_mismatch_falls_back():
     hit = np.concatenate([prefix, np.asarray([21, 22], np.int32)])
     diverged = np.concatenate(
         [prefix[:-1], np.asarray([60, 21, 22], np.int32)]
-    )
-    exact = prefix.copy()          # equal prompt: no suffix to prefill
-    short = prefix[:3].copy()      # shorter than the prefix
+    )  # rewound hit at the 5-token common prefix
+    exact = prefix.copy()          # rewound hit at prefix[:-1]
+    short = prefix[:3].copy()      # usable prefix < prefix_min_tokens
     requests = {
         "hit": (hit, 6), "div": (diverged, 6),
         "exact": (exact, 6), "short": (short, 6),
@@ -416,8 +419,8 @@ def test_engine_shared_prefix_mismatch_falls_back():
     for rid, (prompt, cap) in requests.items():
         want = oracle(model, params, prompt, cap)[prompt.size:]
         np.testing.assert_array_equal(streams[rid], want)
-    assert engine.stats["prefix_hits"] == 1
-    assert engine.stats["prefix_misses"] == 3
+    assert engine.stats["prefix_hits"] == 3
+    assert engine.stats["prefix_misses"] == 1
 
 
 def test_engine_shared_prefix_sampling_deterministic():
@@ -467,3 +470,180 @@ def test_engine_shared_prefix_validation():
             model, params, max_batch=1, length=8,
             shared_prefix=np.arange(1, 8, dtype=np.int32),
         )
+
+
+# ---------------------------------------------------------------------------
+# Disaggregated prefill/decode (ISSUE 13): prefill_only on one engine,
+# admit_from_kv on another — greedy streams must stay BIT-equal to one
+# engine doing both phases (and to the batch-1 oracle), the decode
+# engine must pay ZERO prefill positions, and the prefix tree must turn
+# repeated prompts and shared prefixes into warm-KV hits.
+# ---------------------------------------------------------------------------
+
+
+def test_engine_kv_disaggregated_streams_bit_equal():
+    """prefill_only -> serialized bundle -> admit_from_kv on a separate
+    decode engine: streams bit-equal to the oracle AND to a single
+    non-disaggregated engine, with no prefill work on the decode side."""
+    from covalent_tpu_plugin.models.serve import ContinuousEngine
+
+    model, params = shared()
+    prompts = ragged_prompts(5, base_seed=77)
+    requests = {f"r{i}": (p, 6) for i, p in enumerate(prompts)}
+
+    joint = ContinuousEngine(
+        model, params, max_batch=2, sync_steps=3, max_new_tokens=6,
+    )
+    joint_streams, _ = drive_engine(joint, dict(requests))
+    joint.close()
+
+    prefill_engine = ContinuousEngine(
+        model, params, max_batch=2, sync_steps=3, max_new_tokens=6,
+    )
+    decode_engine = ContinuousEngine(
+        model, params, max_batch=2, sync_steps=3, max_new_tokens=6,
+    )
+    bundles = {
+        rid: prefill_engine.prefill_only(p, {"max_new_tokens": cap})
+        for rid, (p, cap) in requests.items()
+    }
+    assert all(isinstance(b, bytes) for b in bundles.values())
+
+    queue = list(requests.items())
+    streams = {rid: [] for rid in requests}
+    done = set()
+    for _ in range(400):
+        while queue and decode_engine.busy < decode_engine.slots:
+            rid, (_p, cap) = queue.pop(0)
+            decode_engine.admit_from_kv(
+                rid, bundles[rid], {"max_new_tokens": cap}
+            )
+        for event in decode_engine.step():
+            streams[event["rid"]].extend(event["tokens"])
+            if event["done"]:
+                done.add(event["rid"])
+        if len(done) == len(requests) and not queue:
+            break
+    else:
+        raise AssertionError("decode engine never drained")
+
+    for rid, (p, cap) in requests.items():
+        want = oracle(model, params, p, cap)[p.size:]
+        np.testing.assert_array_equal(joint_streams[rid], want)
+        np.testing.assert_array_equal(streams[rid], want)
+    assert decode_engine.stats["kv_admits"] == len(requests)
+    # The disaggregation contract: ALL prefill positions were paid on
+    # the prefill tier, none on the decode tier.
+    assert decode_engine.stats["prefill_positions"] == 0
+    assert prefill_engine.stats["prefill_positions"] > 0
+    assert prefill_engine.stats["kv_exports"] == len(requests)
+    prefill_engine.close()
+    decode_engine.close()
+
+
+def test_engine_prefix_tree_repeated_and_shared_prompts():
+    """The LRU prefix tree without ANY shared_prefix configuration: a
+    repeated prompt hits (the previous admission's lane rewound one
+    position), a prompt sharing a long prefix hits, and streams stay
+    oracle-exact; the bound evicts oldest-first with the counter moving."""
+    from covalent_tpu_plugin.models.serve import ContinuousEngine
+
+    model, params = shared()
+    base = np.asarray([7, 3, 9, 1, 12, 5, 8, 2], np.int32)
+    repeat = base.copy()
+    shared_tail = np.concatenate([base[:6], np.asarray([40, 41], np.int32)])
+    engine = ContinuousEngine(
+        model, params, max_batch=1, sync_steps=2, max_new_tokens=5,
+    )
+    streams = {}
+    for rid, prompt in (
+        ("a", base), ("b", repeat), ("c", shared_tail)
+    ):
+        engine.admit(rid, prompt, {"max_new_tokens": 5})
+        got = []
+        for _ in range(100):
+            events = engine.step()
+            for event in events:
+                got.extend(event["tokens"])
+                if event["done"]:
+                    break
+            else:
+                continue
+            break
+        streams[rid] = got
+    for rid, prompt in (("a", base), ("b", repeat), ("c", shared_tail)):
+        want = oracle(model, params, prompt, 5)[prompt.size:]
+        np.testing.assert_array_equal(streams[rid], want)
+    # a: cold miss (tree empty — not even counted as a miss);
+    # b: repeated prompt -> rewound hit; c: shared 6-token prefix -> hit.
+    assert engine.stats["prefix_hits"] == 2
+    assert engine.stats["prefix_misses"] == 0
+
+    # LRU bound: a cache of 1 entry evicts oldest-first as fresh
+    # admissions insert their lanes.
+    small = ContinuousEngine(
+        model, params, max_batch=1, sync_steps=2, max_new_tokens=3,
+        prefix_cache_size=1,
+    )
+    for i, seed in enumerate((50, 51, 52)):
+        prompt = np.asarray(
+            jax.random.randint(
+                jax.random.PRNGKey(seed), (6,), 0, CFG.vocab_size
+            ),
+            np.int32,
+        )
+        small.admit(f"e{i}", prompt, {"max_new_tokens": 3})
+        for _ in range(50):
+            if any(ev["done"] for ev in small.step()):
+                break
+    assert small.stats["prefix_evictions"] >= 1
+    small.close()
+    engine.close()
+
+
+def test_engine_admit_from_kv_validation():
+    """Garbage bytes, a bundle from a different model shape, duplicate
+    rids, and over-budget admissions are refused with ValueError —
+    never scattered into live lanes."""
+    from covalent_tpu_plugin.models.serve import ContinuousEngine
+
+    model, params = shared()
+    engine = ContinuousEngine(
+        model, params, max_batch=2, sync_steps=2, max_new_tokens=4,
+    )
+    prompt = np.asarray([3, 1, 4, 1, 5], np.int32)
+    bundle = engine.prefill_only(prompt)
+    with pytest.raises(Exception):
+        engine.admit_from_kv("bad", b"not a pickle")
+    other_cfg = dataclasses.replace(CFG, d_model=16, n_heads=2)
+    other = TransformerLM(other_cfg)
+    other_params = other.init(
+        jax.random.PRNGKey(3), jnp.zeros((1, 4), jnp.int32)
+    )["params"]
+    other_engine = ContinuousEngine(
+        other, other_params, max_batch=1, sync_steps=2, max_new_tokens=4,
+    )
+    with pytest.raises(ValueError, match="cache layout|lane leaf"):
+        other_engine.admit_from_kv("r1", bundle)
+    other_engine.close()
+    engine.admit_from_kv("r1", bundle)
+    with pytest.raises(ValueError, match="already admitted"):
+        engine.admit_from_kv("r1", bundle)
+    with pytest.raises(ValueError, match="exceeds"):
+        engine.admit_from_kv(
+            "r2", bundle, {"max_new_tokens": 1000}
+        )
+    # The valid admission still decodes oracle-exact after the refusals.
+    got = []
+    for _ in range(100):
+        events = engine.step()
+        for event in events:
+            got.extend(event["tokens"])
+            if event["done"]:
+                break
+        else:
+            continue
+        break
+    want = oracle(model, params, prompt, 4)[prompt.size:]
+    np.testing.assert_array_equal(got, want)
+    engine.close()

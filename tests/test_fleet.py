@@ -590,6 +590,24 @@ def test_parse_compact_pool_specs():
     assert by_name["cpu"].fallback and by_name["cpu"].capacity == 3
 
 
+def test_parse_pool_spec_roles():
+    """A trailing '!role' marks the serving role (disaggregated
+    placement); it composes with capacity and user@host addresses and
+    rides the JSON form as a first-class field."""
+    specs = parse_pool_specs(
+        "pre=10.0.0.1@2!prefill; dec=ubuntu@10.0.0.2@4!decode; n=10.0.0.3"
+    )
+    by_name = {s.name: s for s in specs}
+    assert by_name["pre"].role == "prefill" and by_name["pre"].capacity == 2
+    assert by_name["dec"].role == "decode"
+    assert by_name["dec"].workers == ("ubuntu@10.0.0.2",)
+    assert by_name["n"].role == ""
+    [json_spec] = parse_pool_specs(
+        json.dumps({"name": "p", "workers": ["w"], "role": "prefill"})
+    )
+    assert json_spec.role == "prefill"
+
+
 def test_parse_json_pool_specs():
     specs = parse_pool_specs(json.dumps([
         {"name": "a", "workers": ["w1"], "capacity": 2},
