@@ -46,7 +46,7 @@ BENCH_PHASES = {
         "BENCH_PHASES",
         "overhead,obs_tax,fanout,cached_fanout,bundled_fanout,"
         "rpc_overhead,serve_traffic,serve_scale,serve_disagg,"
-        "chaos_fanout,sched_fanout,tpu",
+        "chaos_fanout,preemption_chaos,sched_fanout,tpu",
     ).split(",")
     if phase.strip()
 }
@@ -340,6 +340,46 @@ def tpu_preflight(timeout_s: float) -> tuple[bool, float, str]:
 
 def trivial_electron(i: int) -> int:
     return i * i
+
+
+def preemptible_train(steps: int, step_s: float, progress_path: str):
+    """Checkpoint-cooperative training electron (preemption_chaos phase).
+
+    Appends every executed step to ``progress_path`` so the phase can
+    count recomputation across gang attempts; registers a snapshot hook
+    for the harness's interval/SIGTERM checkpointer and resumes from the
+    dispatcher-shipped bundle when one exists.
+    """
+    import time as time_mod
+
+    from covalent_tpu_plugin.utils import checkpoint as ckpt
+
+    state = {"acc": 0.0, "step": -1}
+    start = 0
+    resumed = ckpt.resume_state()
+    if resumed is not None:
+        step0, tree = resumed
+        state.update(tree)
+        start = int(step0) + 1
+
+    def snap():
+        # One read of the rebinding variable: the hook runs from the
+        # checkpointer thread AND the SIGTERM handler, and each step
+        # publishes a fresh dict instead of mutating in place, so a
+        # snapshot is always internally consistent.
+        current = state
+        return dict(current), current["step"]
+
+    ckpt.register_snapshot(snap)
+    try:
+        for step in range(start, steps):
+            with open(progress_path, "a") as f:
+                f.write(f"{step}\n")
+            time_mod.sleep(step_s)
+            state = {"acc": state["acc"] + step, "step": step}
+    finally:
+        ckpt.unregister_snapshot()
+    return state["acc"], start
 
 
 #: ~36 KiB of structured, compressible text per electron — the realistic
@@ -3330,6 +3370,136 @@ async def main() -> None:
         emit({"phase": "chaos_fanout", "skipped": "BENCH_PHASES"})
     except Exception as error:  # noqa: BLE001
         emit({"phase": "chaos_fanout", "error": repr(error)})
+
+    # ---- phase 2c': elastic gangs under spot preemption ------------------
+    # The same checkpoint-cooperative training electron through three arms:
+    # clean (no faults), full-retry (preempted, checkpointing OFF — the
+    # pre-elastic behavior: the retry recomputes from step 0), and resume
+    # (preempted, interval checkpointing ON — the retry restores the
+    # newest complete checkpoint).  The artifact records recomputed steps
+    # and recovered wall per arm; CI asserts the resume arm recomputes at
+    # most HALF the full-retry arm's steps and recovers strictly faster.
+    try:
+        if "preemption_chaos" not in BENCH_PHASES:
+            raise _PhaseSkipped
+        from covalent_tpu_plugin.transport import ChaosPlan
+
+        PREEMPT_STEPS = int(os.environ.get("BENCH_PREEMPT_STEPS", "80"))
+        PREEMPT_STEP_S = float(os.environ.get("BENCH_PREEMPT_STEP_S", "0.05"))
+
+        def preempt_executor(arm: str, plan, checkpoint_s: float):
+            return TPUExecutor(
+                transport="local",
+                cache_dir=f"{workdir}/cache_preempt_{arm}",
+                remote_cache=f"{workdir}/remote_preempt_{arm}",
+                python_path=sys.executable,
+                poll_freq=0.1,
+                pool_preload="cloudpickle",
+                use_agent=False,       # poll path: ops drive the preempt op count
+                heartbeat_interval=0.5,  # telemetry carries the preempt notice
+                max_task_retries=2,
+                retry_base_delay=0.05,
+                retry_max_delay=0.2,
+                checkpoint_interval_s=checkpoint_s,
+                chaos=plan,
+                task_env={
+                    "PYTHONPATH": repo_root + os.pathsep
+                    + os.environ.get("PYTHONPATH", ""),
+                },
+            )
+
+        async def preempt_arm(arm: str, chaos: bool, checkpoint_s: float):
+            plan = (
+                ChaosPlan(preempt_after=20, preempt_grace=1.0, max_faults=1)
+                if chaos
+                else None
+            )
+            ex = preempt_executor(arm, plan, checkpoint_s)
+            progress = f"{workdir}/preempt_progress_{arm}.txt"
+            t0 = time.perf_counter()
+            try:
+                result = await ex.run(
+                    preemptible_train,
+                    [PREEMPT_STEPS, PREEMPT_STEP_S, progress],
+                    {},
+                    {"dispatch_id": f"preempt-{arm}", "node_id": 0},
+                )
+            finally:
+                await ex.close()
+            wall = time.perf_counter() - t0
+            with open(progress) as f:
+                executed = [int(x) for x in f.read().split()]
+            return {
+                "arm": arm,
+                "wall_s": round(wall, 3),
+                "result_ok": result[0] == sum(range(PREEMPT_STEPS)),
+                "resumed_start": int(result[1]),
+                "steps_executed": len(executed),
+                "steps_recomputed": len(executed) - len(set(executed)),
+                "faults_injected": plan.faults_injected if plan else 0,
+            }
+
+        async def preemption_phase():
+            clean = await preempt_arm("clean", chaos=False, checkpoint_s=0.0)
+            retry = await preempt_arm("retry", chaos=True, checkpoint_s=0.0)
+            resume = await preempt_arm(
+                "resume", chaos=True, checkpoint_s=0.1
+            )
+            return clean, retry, resume
+
+        clean, retry, resume = await asyncio.wait_for(
+            preemption_phase(), FANOUT_BUDGET_S * 2
+        )
+        assert clean["result_ok"] and retry["result_ok"], (clean, retry)
+        assert resume["result_ok"], resume
+        retry_recovered = max(0.0, retry["wall_s"] - clean["wall_s"])
+        resume_recovered = max(0.0, resume["wall_s"] - clean["wall_s"])
+        summary["preemption_clean_wall_s"] = clean["wall_s"]
+        summary["preemption_retry_wall_s"] = retry["wall_s"]
+        summary["preemption_resume_wall_s"] = resume["wall_s"]
+        summary["preemption_retry_recomputed_steps"] = (
+            retry["steps_recomputed"]
+        )
+        summary["preemption_resume_recomputed_steps"] = (
+            resume["steps_recomputed"]
+        )
+        summary["preemption_retry_recovered_wall_s"] = round(
+            retry_recovered, 3
+        )
+        summary["preemption_resume_recovered_wall_s"] = round(
+            resume_recovered, 3
+        )
+        # Both faulted arms must actually have been preempted for the
+        # comparison to mean anything; the resume arm must have resumed.
+        faulted = (
+            retry["faults_injected"] == 1
+            and resume["faults_injected"] == 1
+            and retry["resumed_start"] == 0
+            and resume["resumed_start"] > 0
+        )
+        summary["preemption_resume_recomputed_ok"] = bool(
+            faulted
+            and resume["steps_recomputed"]
+            <= retry["steps_recomputed"] / 2
+        )
+        summary["preemption_resume_recovered_ok"] = bool(
+            faulted and resume_recovered < retry_recovered
+        )
+        emit({
+            "phase": "preemption_chaos",
+            "steps": PREEMPT_STEPS,
+            "arms": [clean, retry, resume],
+            "retry_recovered_wall_s": round(retry_recovered, 3),
+            "resume_recovered_wall_s": round(resume_recovered, 3),
+            "resume_recomputed_ok":
+                summary["preemption_resume_recomputed_ok"],
+            "resume_recovered_ok":
+                summary["preemption_resume_recovered_ok"],
+        })
+    except _PhaseSkipped:
+        emit({"phase": "preemption_chaos", "skipped": "BENCH_PHASES"})
+    except Exception as error:  # noqa: BLE001
+        emit({"phase": "preemption_chaos", "error": repr(error)})
 
     # ---- phase 2d: fleet scheduler fan-out vs naive 1:1 dispatch ---------
     # 16 electrons, 2 tenants, through the fleet work queue onto 2 warm

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
@@ -72,6 +73,12 @@ class PoolSpec:
     #: "decode" pools pin decode replicas (latency-critical token
     #: loops); "" is role-neutral.  Electrons ignore it entirely.
     role: str = ""
+    #: spot/preemptible capacity (compact form: ``!spot``): the scheduler
+    #: prefers stable pools for ordinary electrons (an electron opts in
+    #: with ``spot_ok`` metadata), and the pool's executor defaults to
+    #: checkpoint-heavy dispatch (``checkpoint_interval_s``) so work
+    #: placed here survives reclaims by resuming, not recomputing.
+    preemptible: bool = False
     executor: dict[str, Any] = field(default_factory=dict)
     #: (external_ip, internal_ip) pairs from registration-time discovery;
     #: seeds the executor's endpoint cache so a discovered pool's first
@@ -119,6 +126,15 @@ def _default_executor_factory(spec: PoolSpec) -> Any:
     elif not (spec.workers or spec.tpu_name or kwargs.get("hostname")):
         # No topology at all: a local pool (the fallback shape).
         kwargs.setdefault("transport", "local")
+    if (
+        spec.preemptible
+        and "checkpoint_interval_s" not in kwargs
+        and not os.environ.get("COVALENT_TPU_CHECKPOINT_INTERVAL_S")
+    ):
+        # Checkpoint-heavy placement: spot capacity WILL be reclaimed, so
+        # a preemptible pool's electrons snapshot by default and a reclaim
+        # costs one interval of recompute, not the whole run.
+        kwargs["checkpoint_interval_s"] = 60.0
     executor = TPUExecutor(**kwargs)
     if spec.endpoints and executor.tpu_name:
         executor.seed_endpoints(spec.endpoints)
@@ -172,6 +188,10 @@ class Pool:
     @property
     def role(self) -> str:
         return self.spec.role
+
+    @property
+    def preemptible(self) -> bool:
+        return self.spec.preemptible
 
     def __repr__(self) -> str:  # pragma: no cover - debugging aid
         return (
@@ -309,6 +329,7 @@ class Pool:
             "warm": self.warm,
             "fallback": self.fallback,
             **({"role": self.role} if self.role else {}),
+            **({"preemptible": True} if self.preemptible else {}),
             "placed_total": self.placed_total,
             "workers": list(self.spec.workers)
             or ([self.spec.tpu_name] if self.spec.tpu_name else ["local"]),
@@ -380,7 +401,12 @@ def parse_pool_specs(text: str) -> list[PoolSpec]:
       ``@suffix`` is only read as capacity when it is numeric (or
       ``cap``-prefixed, which always claims to be one).  A trailing
       ``!role`` marks the pool's serving role for disaggregated
-      placement (``pre=10.0.0.1@2!prefill;dec=10.0.0.2@4!decode``).
+      placement (``pre=10.0.0.1@2!prefill;dec=10.0.0.2@4!decode``), and
+      ``!spot`` (or ``!preemptible``) marks spot capacity — the scheduler
+      prefers stable pools unless an electron opts in (``spot_ok``
+      metadata), and the pool's executor defaults to checkpoint-heavy
+      dispatch so reclaims resume instead of recomputing.  Tags stack:
+      ``cheap=10.0.0.3@4!decode!spot``.
     """
     text = (text or "").strip()
     if not text:
@@ -402,9 +428,22 @@ def parse_pool_specs(text: str) -> list[PoolSpec]:
             )
         target = target.strip()
         role = ""
-        head_role, sep_role, role_text = target.rpartition("!")
-        if sep_role and role_text.strip().isalpha() and head_role.strip():
-            target, role = head_role.strip(), role_text.strip()
+        preemptible = False
+        # A target may carry several ``!tag`` suffixes (e.g.
+        # ``@2!prefill!spot``): "spot"/"preemptible" flag the pool's
+        # capacity class, anything else is the serving role.
+        while True:
+            head_tag, sep_tag, tag_text = target.rpartition("!")
+            tag = tag_text.strip()
+            if not (sep_tag and tag.isalpha() and head_tag.strip()):
+                break
+            if tag.lower() in ("spot", "preemptible"):
+                preemptible = True
+            elif role:
+                break  # one serving role only; stop consuming
+            else:
+                role = tag
+            target = head_tag.strip()
         capacity = DEFAULT_CAPACITY
         head, sep, cap_text = target.rpartition("@")
         if sep:
@@ -427,6 +466,8 @@ def parse_pool_specs(text: str) -> list[PoolSpec]:
         }
         if role:
             spec_kwargs["role"] = role
+        if preemptible:
+            spec_kwargs["preemptible"] = True
         if target == "local":
             spec_kwargs.update(transport="local", fallback=True)
         elif target.startswith("tpu:"):

@@ -391,11 +391,20 @@ class FleetScheduler:
             pool.rpc_digest_count() for pool in available
         ):
             digest = self._fn_digest_of(item)
+        # Spot-capacity hint: stable pools win for electrons that did not
+        # opt into preemptible placement (``spot_ok`` metadata) — spot
+        # pools carry checkpoint-tolerant work, SLO-critical work pins to
+        # stable capacity.  Subordinate to the accelerator-over-fallback
+        # preference: a spot TPU still beats the local CPU fallback.
+        spot_ok = bool(
+            item is not None and item.task_metadata.get("spot_ok")
+        )
 
         def rank(pool: Pool):
             return (
                 0 if pool.name == preferred else 1,
                 1 if pool.fallback else 0,
+                0 if (spot_ok or not pool.preemptible) else 1,
                 0 if pool.warm else 1,
                 0 if pool.holds_fn_digest(digest) else 1,
                 -pool.free_slots,

@@ -273,6 +273,253 @@ def _start_heartbeat(spec: dict):
     return stop
 
 
+# --------------------------------------------------------------------------
+# Cooperative checkpointing (elastic gangs).
+#
+# A training electron registers a snapshot hook via
+# ``covalent_tpu_plugin.utils.checkpoint.register_snapshot``; this harness
+# (stdlib-only — the package is looked up through sys.modules, never
+# imported) calls it on the configured interval and on SIGTERM (the spot
+# preemption notice), publishing each snapshot as a sha256-named bundle in
+# the worker's remote CAS plus an atomically-replaced per-lineage manifest.
+# A kill mid-save can never tear the "latest": bundles publish tmp+replace
+# and the manifest only ever references fully-written files, so the
+# dispatcher's resume discovery (which digest-verifies every candidate)
+# either finds a complete checkpoint or falls back to the previous one.
+# --------------------------------------------------------------------------
+
+def _sanitize_lineage(lineage: str) -> str:
+    import re
+
+    return re.sub(r"[^A-Za-z0-9._-]", "_", str(lineage))
+
+
+def _ckpt_manifest_path(directory: str, lineage: str) -> str:
+    return os.path.join(directory, f"ckpt_{_sanitize_lineage(lineage)}.json")
+
+
+def _write_checkpoint_bundle(
+    directory: str, lineage: str, step: int, tree, keep_n: int
+) -> tuple:
+    """Publish one checkpoint bundle atomically; returns (path, digest, n).
+
+    Bundle = pickled ``{"v", "lineage", "step", "tree", "meta"}`` named by
+    the sha256 of its bytes (a CAS artifact: the dispatcher re-stages it to
+    replacement workers through the ordinary content-addressed upload
+    path).  The manifest keeps a newest-first ``history`` of the last
+    ``keep_n`` complete steps; bundles that fall off it are unlinked, so
+    checkpoint output is bounded however long the task runs.
+    """
+    import hashlib
+
+    try:
+        import cloudpickle as pickler
+    except ImportError:
+        import pickle as pickler
+    payload = pickler.dumps({
+        "v": 1,
+        "lineage": lineage,
+        "step": int(step),
+        "tree": _to_host(tree),
+        "meta": {"saved_at": time.time(), "pid": os.getpid()},
+    })
+    digest = hashlib.sha256(payload).hexdigest()
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, f"{digest}.ckpt")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+    os.replace(tmp, path)
+
+    manifest_path = _ckpt_manifest_path(directory, lineage)
+    # The manifest update is a read-modify-write: two process-0 writers
+    # CAN coexist on a shared filesystem (a straggling old gang inside
+    # its preemption grace window and the resumed replacement), and the
+    # loser of an unlocked race would silently drop the other's newest
+    # entry — both costing recompute on the next resume and leaking its
+    # bundle past the keep_n GC forever.  flock serializes them; hosts
+    # without fcntl (or filesystems without lock support) degrade to the
+    # unlocked behavior.
+    lock_fd = None
+    try:
+        import fcntl
+
+        lock_fd = os.open(
+            f"{manifest_path}.lock", os.O_CREAT | os.O_RDWR, 0o644
+        )
+        fcntl.flock(lock_fd, fcntl.LOCK_EX)
+    except (ImportError, OSError):
+        if lock_fd is not None:
+            os.close(lock_fd)
+        lock_fd = None
+    try:
+        return _publish_manifest(
+            manifest_path, lineage, path, digest, payload, step, keep_n
+        )
+    finally:
+        if lock_fd is not None:
+            os.close(lock_fd)  # closing releases the flock
+
+
+def _publish_manifest(
+    manifest_path: str, lineage: str, path: str, digest: str,
+    payload: bytes, step: int, keep_n: int,
+) -> tuple:
+    """Manifest read-modify-write + GC (under the caller's flock)."""
+    history = []
+    try:
+        with open(manifest_path, encoding="utf-8") as f:
+            manifest = json.load(f)
+        if isinstance(manifest, dict) and isinstance(
+            manifest.get("history"), list
+        ):
+            history = [
+                h for h in manifest["history"]
+                if isinstance(h, dict) and h.get("step") != int(step)
+            ]
+    except (OSError, ValueError):
+        pass  # missing or torn manifest: rebuild from this save
+    history.insert(
+        0, {"step": int(step), "digest": digest, "file": path,
+            "bytes": len(payload)},
+    )
+    # Highest step first, not insertion order: a straggling old gang and
+    # a resumed replacement can interleave saves on a shared filesystem,
+    # and resume discovery must always see the furthest-trained state at
+    # the head.
+    history.sort(
+        key=lambda h: h.get("step", -1)
+        if isinstance(h.get("step"), int) else -1,
+        reverse=True,
+    )
+    keep_n = max(1, int(keep_n or 1))
+    dropped, history = history[keep_n:], history[:keep_n]
+    tmp_manifest = f"{manifest_path}.tmp.{os.getpid()}"
+    with open(tmp_manifest, "w", encoding="utf-8") as f:
+        json.dump(
+            {"lineage": lineage, "updated": time.time(),
+             "history": history},
+            f,
+        )
+    os.replace(tmp_manifest, manifest_path)
+    live = {h["digest"] for h in history}
+    for old in dropped:
+        if old.get("digest") in live:
+            continue
+        try:
+            os.unlink(old.get("file") or "")
+        except OSError:
+            pass
+    return path, digest, len(payload)
+
+
+def _start_checkpointer(spec: dict):
+    """Interval checkpointer for one task; returns ``(stop, save_now)``.
+
+    ``save_now(trigger)`` takes one snapshot synchronously (used by both
+    the interval thread and the SIGTERM handler; a shared lock + step
+    high-water mark make concurrent calls safe and idempotent).  Only
+    process 0 checkpoints — the snapshot hook's train state is replicated
+    across the gang (the same single-writer contract as the result file).
+    """
+    cfg = spec.get("checkpoint") or {}
+    try:
+        interval = float(cfg.get("interval_s") or 0)
+    except (TypeError, ValueError):
+        interval = 0.0
+    distributed = spec.get("distributed") or {}
+    process_id = int(distributed.get("process_id") or 0)
+    if interval <= 0 or not cfg.get("dir") or process_id != 0:
+        return None, None
+    directory = os.path.abspath(str(cfg["dir"]))
+    lineage = str(cfg.get("lineage") or spec.get("operation_id") or "task")
+    keep_n = int(cfg.get("keep_n") or 3)
+    state = {"last_step": None, "failures": 0}
+    lock = threading.Lock()
+
+    def save_now(trigger: str):
+        module = sys.modules.get("covalent_tpu_plugin.utils.checkpoint")
+        take = getattr(module, "take_snapshot", None)
+        if take is None:
+            return None  # electron never registered a hook
+        try:
+            snap = take()
+        except Exception as err:  # noqa: BLE001 - user hook
+            state["failures"] += 1
+            if state["failures"] == 1:
+                print(f"snapshot hook failed: {err!r}", file=sys.stderr)
+            _emit_worker_event(
+                spec, "worker.checkpoint_error", lineage=lineage,
+                trigger=trigger, error=repr(err),
+            )
+            return None
+        if snap is None:
+            return None
+        tree, step = snap
+        step = int(step)
+        if step < 0:
+            return None
+        with lock:
+            last = state["last_step"]
+            if last is not None and step <= last:
+                return None  # nothing new since the previous save
+            path, digest, nbytes = _write_checkpoint_bundle(
+                directory, lineage, step, tree, keep_n
+            )
+            state["last_step"] = step
+        _emit_worker_event(
+            spec, "worker.checkpoint_saved", lineage=lineage, step=step,
+            digest=digest, path=path, bytes=nbytes, trigger=trigger,
+        )
+        return step
+
+    stop = threading.Event()
+
+    def loop() -> None:
+        while not stop.wait(interval):
+            try:
+                save_now("interval")
+            except Exception as err:  # noqa: BLE001 - never kill the task
+                state["failures"] += 1
+                if state["failures"] == 1:
+                    print(f"checkpoint save failed: {err!r}", file=sys.stderr)
+
+    threading.Thread(
+        target=loop, name="covalent-tpu-checkpointer", daemon=True
+    ).start()
+    return stop, save_now
+
+
+def _install_preempt_handler(spec: dict, save_now) -> None:
+    """SIGTERM = the spot preemption notice: final snapshot, then die.
+
+    The handler emits ``worker.preempt_notice`` (streamed up the telemetry
+    side-band so the dispatcher can label the coming death), takes one
+    last cooperative checkpoint inside the grace window, restores the
+    default disposition and re-raises SIGTERM so the process exits with
+    the true signal status the dispatcher's pollers expect.
+    """
+    import signal
+
+    if threading.current_thread() is not threading.main_thread():
+        return  # signal API is main-thread-only (RPC invocations skip)
+
+    def _on_term(signum, frame):
+        _emit_worker_event(spec, "worker.preempt_notice", signal="SIGTERM")
+        try:
+            if save_now is not None:
+                save_now("preempt")
+        except Exception:  # noqa: BLE001 - dying anyway; save is best-effort
+            pass
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+    except (ValueError, OSError):  # pragma: no cover - exotic platforms
+        pass
+
+
 def install_pip_deps(pip_deps: list) -> None:
     """Install an electron's pip dependencies; raise RuntimeError on failure.
 
@@ -383,6 +630,14 @@ def run_task(spec: dict) -> int:
 
     _apply_spec_env(spec)
 
+    # Event sinks resolve to absolute BEFORE the task chdirs into its
+    # workdir: mid-task emissions (checkpoint saves, the SIGTERM
+    # preemption notice) race the chdir'd function, and a relative
+    # events path would scatter them across working directories.
+    for sink_key in ("events_file", "telemetry_file"):
+        if spec.get(sink_key):
+            spec[sink_key] = os.path.abspath(spec[sink_key])
+
     distributed = spec.get("distributed")
     process_id = int(distributed["process_id"]) if distributed else 0
     _emit_worker_event(spec, "worker.task_started", process_id=process_id)
@@ -390,6 +645,36 @@ def run_task(spec: dict) -> int:
     # barrier, the task itself): a worker hung anywhere keeps beating —
     # and one that goes silent is genuinely wedged.
     heartbeat_stop = _start_heartbeat(spec)
+
+    # Elastic gangs: interval checkpointer (the SIGTERM preemption handler
+    # is installed LATER, after the distributed bootstrap — jax's
+    # distributed runtime registers its own signal handlers during
+    # initialize and would silently replace ours), and the resume
+    # contract — a retry attempt shipping a verified checkpoint exposes
+    # it to the electron via the COVALENT_TPU_RESUME_* env trio.
+    checkpoint_stop, checkpoint_now = _start_checkpointer(spec)
+    resume = spec.get("resume") or {}
+    if resume.get("file"):
+        # Absolute before the task chdirs into its workdir: the electron
+        # reads this env var *after* the chdir.
+        os.environ["COVALENT_TPU_RESUME_CHECKPOINT"] = os.path.abspath(
+            str(resume["file"])
+        )
+        os.environ["COVALENT_TPU_RESUME_STEP"] = str(resume.get("step", ""))
+        os.environ["COVALENT_TPU_RESUME_DIGEST"] = str(
+            resume.get("digest", "")
+        )
+        _emit_worker_event(
+            spec, "worker.resume_available", process_id=process_id,
+            step=resume.get("step"), digest=resume.get("digest"),
+        )
+    else:
+        for stale in (
+            "COVALENT_TPU_RESUME_CHECKPOINT",
+            "COVALENT_TPU_RESUME_STEP",
+            "COVALENT_TPU_RESUME_DIGEST",
+        ):
+            os.environ.pop(stale, None)
 
     pip_deps = spec.get("pip_deps") or []
     if pip_deps:
@@ -458,6 +743,13 @@ def run_task(spec: dict) -> int:
     with open(spec["function_file"], "rb") as f:
         fn, args, kwargs = pickle.load(f)
 
+    # The SIGTERM preemption contract (notice event + final cooperative
+    # snapshot + die with the signal) — installed after EVERY import that
+    # can register its own signal handling (jax.distributed.initialize
+    # above does), so the spot notice always reaches this handler.
+    if spec.get("checkpoint"):
+        _install_preempt_handler(spec, checkpoint_now)
+
     # Optional device-level tracing (SURVEY §5: the reference captures no
     # timings at all; this surfaces the XLA/TPU view of the electron).  The
     # trace lands in the task workdir/cache so the dispatcher can scp it.
@@ -507,6 +799,8 @@ def run_task(spec: dict) -> int:
 
     if heartbeat_stop is not None:
         heartbeat_stop.set()
+    if checkpoint_stop is not None:
+        checkpoint_stop.set()
     _emit_worker_event(
         spec, "worker.task_finished", process_id=process_id,
         ok=exception is None,
@@ -911,6 +1205,10 @@ def _spawn_task(command: dict, children: dict) -> None:
 
             _signal.set_wakeup_fd(-1)
             _signal.signal(_signal.SIGCHLD, _signal.SIG_DFL)
+            # The serve-preempt notice handler belongs to the server; a
+            # task child's own preemption contract (checkpoint + die) is
+            # installed by run_task when the spec configures it.
+            _signal.signal(_signal.SIGTERM, _signal.SIG_DFL)
             os.setsid()
             log_fd = os.open(
                 command.get("log") or os.devnull,
@@ -2138,6 +2436,56 @@ def _serve_close(command: dict, sessions: dict) -> None:
     # block on here — the command loop must stay live.
 
 
+def _announce_preemption(reason: str = "sigterm") -> None:
+    """Emit ``serve.preempt`` on every live session's side-band."""
+    for session in list(_SERVE_SESSIONS.values()):
+        try:
+            session._emit_serve("serve.preempt", reason=reason)
+        except Exception:  # noqa: BLE001 - notice is best-effort
+            pass
+    try:
+        _BATCHER.flush()
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def _install_serve_preempt_notice() -> None:
+    """SIGTERM on a serving runtime = the spot preemption notice.
+
+    Announce ``serve.preempt`` on every live session's side-band and KEEP
+    SERVING: the dispatcher-side supervisor warm-hands the sessions off to
+    a fresh gang during the grace window (draining in-flight streams via
+    the exactly-once idx splice), and the preempter's hard kill — or the
+    channel death — is what actually ends this process, not the notice.
+    """
+    import signal
+
+    if threading.current_thread() is not threading.main_thread():
+        return  # pragma: no cover - signal API is main-thread-only
+
+    def _on_term(signum, frame):
+        if not _SERVE_SESSIONS:
+            # Nothing to hand off: keep the pre-notice contract and die
+            # with the signal, so plain TERM-driven teardown of idle
+            # runtimes is unchanged.
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            os.kill(os.getpid(), signal.SIGTERM)
+            return
+        # Never write the channel from the handler itself: the main thread
+        # may hold _EMIT_LOCK at delivery time and the handler runs ON the
+        # main thread (same-thread deadlock).  A helper thread serializes
+        # through the lock normally.
+        threading.Thread(
+            target=_announce_preemption,
+            name="covalent-tpu-preempt-notice", daemon=True,
+        ).start()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+    except (ValueError, OSError):  # pragma: no cover - exotic platforms
+        pass
+
+
 def serve_child() -> int:
     """``harness.py --serve-child``: one serving session over stdin.
 
@@ -2147,6 +2495,7 @@ def serve_child() -> int:
     over its channel verbatim — the protocol (and the engine contract)
     stays uniform across both runtimes.  EOF closes the session.
     """
+    _install_serve_preempt_notice()
     sessions: dict = {}
     opened: list = []  # every session ever opened, for the final drain
     buffer = bytearray()
@@ -2281,6 +2630,9 @@ def serve() -> int:
     signal.set_wakeup_fd(wpipe)
     signal.signal(signal.SIGCHLD, lambda *_: None)
     signal.signal(signal.SIGPIPE, signal.SIG_IGN)
+    # Preemption notice for resident serving sessions hosted in THIS
+    # process (pool mode): announce, keep serving through the grace window.
+    _install_serve_preempt_notice()
 
     sel = selectors.DefaultSelector()
     sel.register(0, selectors.EVENT_READ, "stdin")

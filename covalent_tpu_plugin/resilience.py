@@ -49,6 +49,7 @@ __all__ = [
     "Deadline",
     "FaultClass",
     "RetryPolicy",
+    "WorkerPreemptedError",
     "WorkerStalledError",
     "classify_error",
     "TASK_RETRIES_TOTAL",
@@ -85,6 +86,17 @@ class WorkerStalledError(TransportError):
     worker is classified and retried *before* the hard ``task_timeout``
     fires.  Transient by construction — a gang restart on fresh state is
     exactly the remedy for a hang."""
+
+
+class WorkerPreemptedError(TransportError):
+    """A worker died *after announcing a preemption notice*
+    (``worker.preempt_notice``, the SIGTERM the cloud delivers before
+    reclaiming a spot TPU VM).  Transient — and kept distinguishable from
+    an ordinary channel death or worker crash because the remedy differs:
+    the retry should resume from the cooperative checkpoint the notice
+    handler just published, and an operator watching
+    ``covalent_tpu_task_retries_total{reason="worker_preempted"}`` is
+    watching their spot-reclaim rate, not a bug."""
 
 
 class FaultClass(str, Enum):
@@ -134,6 +146,10 @@ def classify_error(error: BaseException) -> tuple[FaultClass, str]:
         # Missed-heartbeat liveness failures keep their own label so an
         # operator can tell a wedged worker from a dropped channel.
         return FaultClass.TRANSIENT, "worker_stalled"
+    if isinstance(error, WorkerPreemptedError):
+        # Spot reclaim: transient, resumable from the notice-triggered
+        # checkpoint, and its own label (capacity churn is not a bug).
+        return FaultClass.TRANSIENT, "worker_preempted"
     if isinstance(error, TransportError):
         # Covers AgentError (agent RPC loss) and chaos-injected faults too.
         return FaultClass.TRANSIENT, "transport"

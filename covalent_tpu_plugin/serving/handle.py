@@ -116,6 +116,16 @@ class ServeHandle:
         return self._sup.reconnects
 
     @property
+    def handoffs(self) -> int:
+        return self._sup.handoffs
+
+    async def handoff(self, reason: str = "planned") -> bool:
+        """Warm drain-and-reopen onto a fresh gang (planned churn): the
+        replacement session opens BEFORE the old one is retired and every
+        in-flight stream is spliced exactly-once across the move."""
+        return await self._sup.handoff(reason=reason)
+
+    @property
     def opened_at(self) -> float:
         return self._sup.opened_at
 

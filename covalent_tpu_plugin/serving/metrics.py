@@ -50,6 +50,12 @@ SERVE_RECONNECTS_TOTAL = REGISTRY.counter(
     "Serving sessions re-opened after a channel/worker death",
 )
 
+SERVE_HANDOFFS_TOTAL = REGISTRY.counter(
+    "covalent_tpu_serve_handoffs_total",
+    "Warm session handoffs (replacement opened BEFORE the old gang died)",
+    ("outcome",),
+)
+
 #: Time-to-first-token, submit -> first streamed chunk.  The streaming
 #: side-band's whole point: TTFT must sit near one decode chunk, not at
 #: end-of-response - the bench phase asserts exactly that.

@@ -41,6 +41,7 @@ from ..resilience import FaultClass, RetryPolicy, classify_error
 from ..transport.base import TransportError
 from ..utils.log import app_log
 from .metrics import (
+    SERVE_HANDOFFS_TOTAL,
     SERVE_PREFILL_POSITIONS,
     SERVE_PREFIX_HITS,
     SERVE_PREFIX_MISSES,
@@ -287,6 +288,17 @@ class SessionSupervisor:
         self.generation = 0
         self.served = 0
         self.reconnects = 0
+        #: warm handoffs completed (drain-and-reopen before gang death).
+        self.handoffs = 0
+        self._gen_counter = 0
+        self._in_handoff = False
+        self._handoff_task: asyncio.Task | None = None
+        #: a worker preemption notice (serve.preempt on the side-band)
+        #: auto-triggers a warm handoff; COVALENT_TPU_SERVE_HANDOFF=0
+        #: disables and leaves recovery to the reconnect path.
+        self._auto_handoff = str(
+            os.environ.get("COVALENT_TPU_SERVE_HANDOFF", "1")
+        ).strip().lower() not in ("0", "off", "false", "no")
         self.opened_at = 0.0
         self.stats: dict[str, Any] = {}
         self.address = ""
@@ -339,6 +351,7 @@ class SessionSupervisor:
             "served": self.served,
             "in_flight": self.in_flight,
             "reconnects": self.reconnects,
+            "handoffs": self.handoffs,
             "age_s": (
                 round(time.time() - self.opened_at, 3) if self.opened_at else 0
             ),
@@ -419,9 +432,19 @@ class SessionSupervisor:
         refusal would otherwise leave the just-proved-broken transports
         pooled, and every reconnect retry would silently reuse them.
         """
+        self._adopt(await self._dial_generation())
+
+    async def _dial_generation(self) -> dict:
+        """Dial + open one fresh session generation WITHOUT touching the
+        current binding; returns it for :meth:`_adopt`.
+
+        The split is what makes the warm handoff possible: the old
+        generation keeps streaming while the replacement leases, stages,
+        and opens — the swap at adopt time is a few field writes.
+        """
         dialed: list = []
         try:
-            await self._open_generation_on(dialed)
+            return await self._dial_generation_on(dialed)
         except BaseException:
             if dialed:
                 try:
@@ -430,7 +453,15 @@ class SessionSupervisor:
                     pass
             raise
 
-    async def _open_generation_on(self, dialed: list) -> None:
+    def _adopt(self, binding: dict) -> None:
+        self._client = binding["client"]
+        self._conns = binding["conns"]
+        self._sid_g = binding["sid_g"]
+        self.address = binding["address"]
+        self.slots = binding["slots"]
+        self.generation += 1
+
+    async def _dial_generation_on(self, dialed: list) -> dict:
         executor = self.executor
         lease = await executor.lease_gang(dialed=dialed)
         conns, addresses = lease.conns, lease.addresses
@@ -467,7 +498,8 @@ class SessionSupervisor:
             runner = [
                 executor.python_path, remote_harness, "--serve-child",
             ]
-        sid_g = f"{self.sid}.g{self.generation}"
+        sid_g = f"{self.sid}.g{self._gen_counter}"
+        self._gen_counter += 1
         spec: dict[str, Any] = {"operation_id": sid_g}
         if executor.task_env:
             spec["env"] = dict(executor.task_env)
@@ -489,12 +521,13 @@ class SessionSupervisor:
         except BaseException:
             client.unwatch_serve(sid_g)
             raise
-        self._client = client
-        self._conns = list(conns)
-        self._sid_g = sid_g
-        self.address = address
-        self.slots = int(opened.get("slots") or 1)
-        self.generation += 1
+        return {
+            "client": client,
+            "conns": list(conns),
+            "sid_g": sid_g,
+            "address": address,
+            "slots": int(opened.get("slots") or 1),
+        }
 
     # -- requests -----------------------------------------------------------
 
@@ -667,6 +700,36 @@ class SessionSupervisor:
             self._on_reject(data)
         elif kind == "serve.stats":
             self._on_stats(data)
+        elif kind == "serve.preempt":
+            self._on_preempt(data)
+
+    def _on_preempt(self, data: dict) -> None:
+        """The worker hosting this session announced a preemption notice
+        (SIGTERM): start the warm handoff NOW, while the old runtime is
+        still serving inside its grace window."""
+        obs_events.emit(
+            "serve.preempt_notice",
+            sid=self.sid,
+            address=self.address,
+            reason=str(data.get("reason") or ""),
+        )
+        if not self._auto_handoff or self._closed or self._in_handoff:
+            return
+
+        async def _run() -> None:
+            try:
+                await self.handoff(reason="preempt_notice")
+            except Exception:  # noqa: BLE001 - reconnect path still guards
+                app_log.exception(
+                    "preemption-notice handoff for %s failed", self.sid
+                )
+
+        # Hold the reference: an unreferenced task can be collected
+        # mid-await, silently dropping the handoff.
+        self._handoff_task = asyncio.ensure_future(_run())
+        self._handoff_task.add_done_callback(
+            lambda _t: setattr(self, "_handoff_task", None)
+        )
 
     def _on_token(self, data: dict) -> None:
         rid = str(data.get("rid") or "")
@@ -763,6 +826,105 @@ class SessionSupervisor:
             self._publish_in_flight()
             self._changed()
 
+    # -- warm handoff ---------------------------------------------------------
+
+    async def handoff(self, reason: str = "planned") -> bool:
+        """Drain-and-reopen: move this session to a FRESH gang with zero
+        dropped tokens.
+
+        The replacement generation is leased, staged, and opened while the
+        old one is still serving (planned churn — a preemption notice, a
+        rebalance — gives us that window); the swap then re-sends every
+        in-flight request on the new session, whose restart-from-0 streams
+        are spliced on each request's token high-water mark, so callers
+        observe exactly-once delivery across the move.  The old session is
+        closed best-effort afterwards — it is about to die anyway.
+
+        Returns True when the session now runs on the new generation;
+        False when no handoff was possible (closed/failed/already moving,
+        or the replacement open failed — the reconnect path still guards
+        the latter when the old gang eventually dies).
+        """
+        if (
+            self._closed
+            or self._failed is not None
+            or self._in_handoff
+            or not self._ready.is_set()
+        ):
+            return False
+        self._in_handoff = True
+        try:
+            old_client, old_sid = self._client, self._sid_g
+            old_conns, old_address = list(self._conns), self.address
+            obs_events.emit(
+                "serve.handoff_started",
+                sid=self.sid,
+                address=old_address,
+                reason=reason,
+                in_flight=self.in_flight,
+            )
+            try:
+                binding = await self._dial_generation()
+            except asyncio.CancelledError:
+                raise
+            except BaseException as err:  # noqa: BLE001 - degrade, not fail
+                SERVE_HANDOFFS_TOTAL.labels(outcome="failed").inc()
+                obs_events.emit(
+                    "serve.handoff_failed",
+                    sid=self.sid,
+                    address=old_address,
+                    reason=reason,
+                    error=repr(err),
+                )
+                app_log.warning(
+                    "warm handoff of %s failed (%s); the reconnect path "
+                    "recovers when the old gang dies", self.sid, err,
+                )
+                return False
+            # Swap: stop the old generation's feed BEFORE replaying so the
+            # splice sees one stream at a time, then re-send everything
+            # in flight on the fresh session.
+            self._adopt(binding)
+            if old_client is not None:
+                old_client.unwatch_serve(old_sid)
+            await self._replay_in_flight()
+            self.handoffs += 1
+            SERVE_HANDOFFS_TOTAL.labels(outcome="ok").inc()
+            obs_events.emit(
+                "serve.handoff_complete",
+                sid=self.sid,
+                from_address=old_address,
+                to_address=self.address,
+                generation=self.generation,
+                replayed=len(self._requests),
+                reason=reason,
+            )
+            # Retire the old generation: a short drain-free close (its
+            # requests were replayed; duplicates are spliced away), and
+            # its channels leave the pool unless the replacement landed on
+            # the very same gang (single-address executors re-lease the
+            # pooled transport).
+            if old_client is not None:
+                try:
+                    await old_client.serve_close(old_sid, timeout=5.0)
+                except (
+                    AgentError, TransportError, asyncio.TimeoutError,
+                ) as err:
+                    app_log.debug(
+                        "post-handoff close of %s failed: %s", old_sid, err
+                    )
+            shared = {id(c) for c in self._conns}
+            leftovers = [c for c in old_conns if id(c) not in shared]
+            if leftovers:
+                try:
+                    await self.executor._discard_workers(leftovers)
+                except Exception:  # noqa: BLE001 - teardown is best-effort
+                    pass
+            self._changed()
+            return True
+        finally:
+            self._in_handoff = False
+
     # -- supervision / reconnect --------------------------------------------
 
     async def _supervise(self) -> None:
@@ -781,6 +943,18 @@ class SessionSupervisor:
                 death = AgentError("agent channel closed")
             if self._closed:
                 return
+            if self._client is not client:
+                # A warm handoff moved the session while we waited: the
+                # death belongs to the RETIRED generation (the preempted
+                # gang finally going away), not the live one.
+                continue
+            if self._in_handoff:
+                # The old gang died mid-handoff; let the handoff finish —
+                # its replay owns the streams — then watch the new client.
+                while self._in_handoff and not self._closed:
+                    await asyncio.sleep(0.05)
+                if self._client is not client:
+                    continue
             obs_events.emit(
                 "serve.session_lost",
                 sid=self.sid,

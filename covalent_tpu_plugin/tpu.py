@@ -36,6 +36,7 @@ import hashlib
 import json
 import os
 import pickle
+import re
 import shlex
 import time
 import weakref
@@ -87,6 +88,7 @@ from .resilience import (
     Deadline,
     FaultClass,
     RetryPolicy,
+    WorkerPreemptedError,
     WorkerStalledError,
     classify_error,
 )
@@ -232,6 +234,19 @@ _EXECUTOR_PLUGIN_DEFAULTS = {
     # Fault-injection spec (transport/chaos.py); also COVALENT_TPU_CHAOS.
     # Empty = no chaos wrapper (the production default).
     "chaos": "",
+    # Cooperative checkpointing (elastic gangs, ROADMAP item 1): when > 0,
+    # training electrons that registered a snapshot hook
+    # (utils.checkpoint.register_snapshot) have their train state published
+    # every N seconds — and on the SIGTERM spot-preemption notice — as
+    # sha256-named bundles in the worker's remote CAS; the retry driver
+    # then resumes the replacement gang from the newest complete
+    # checkpoint instead of recomputing from step 0.  0 disables;
+    # COVALENT_TPU_CHECKPOINT_INTERVAL_S overrides per process.
+    "checkpoint_interval_s": 0.0,
+    # Complete checkpoint steps retained per lineage (older bundles are
+    # garbage-collected by the worker); COVALENT_TPU_CHECKPOINT_KEEP
+    # overrides per process.
+    "checkpoint_keep_n": 3,
     # Worker heartbeat cadence (obs/heartbeat.py): each harness process
     # beats every N seconds — step counter, RSS, device-memory stats —
     # into the telemetry side-band the dispatcher streams back (agent
@@ -272,6 +287,30 @@ _WALL_OVERHEAD_HIST = REGISTRY.histogram(
     "covalent_tpu_wall_overhead_seconds",
     "Per-electron wall-clock dispatch overhead (elapsed minus execute)",
 )
+CHECKPOINT_SAVES_TOTAL = REGISTRY.counter(
+    "covalent_tpu_checkpoint_saves_total",
+    "Cooperative train-state checkpoint bundles published by workers",
+    ("trigger",),
+)
+CHECKPOINT_RESTORES_TOTAL = REGISTRY.counter(
+    "covalent_tpu_checkpoint_restores_total",
+    "Retry attempts dispatched with a verified resume checkpoint reference",
+)
+_CHECKPOINT_RESUMED_STEP = REGISTRY.gauge(
+    "covalent_tpu_checkpoint_resumed_step",
+    "Step of the most recent checkpoint shipped as a resume reference",
+)
+
+
+def _sanitize_lineage(lineage: str) -> str:
+    """Filesystem-safe lineage token (must match harness._sanitize_lineage:
+    the worker writes the manifest this name resolves)."""
+    return re.sub(r"[^A-Za-z0-9._-]", "_", str(lineage))
+
+
+def _ckpt_manifest_remote(remote_cache: str, lineage: str) -> str:
+    """Remote path of one lineage's checkpoint manifest (CAS dir)."""
+    return cas_path(remote_cache, f"ckpt_{_sanitize_lineage(lineage)}", ".json")
 
 
 def _split_host_port(hostport: str) -> tuple[str, int | None]:
@@ -323,6 +362,14 @@ class StagedTask:
         self.remote_result_file = f"{remote_cache}/result_{operation_id}.pkl"
         self.remote_log_file = f"{remote_cache}/log_{operation_id}.txt"
         self.remote_pid_file = f"{remote_cache}/pid_{operation_id}"
+        #: resume checkpoint shipped to every worker under an OP-SCOPED
+        #: remote name, outside the content-addressed staging road:
+        #: (local, remote, digest).  Deliberately not a cas/ artifact —
+        #: the worker-side checkpointer's keep_n GC owns digest-named
+        #: ``.ckpt`` files there, and a not-yet-dead old gang's racing
+        #: save must never unlink the bundle the replacement attempt is
+        #: about to restore from.
+        self.resume_artifact: tuple[str, str, str] | None = None
 
     def remote_telemetry_file(self, process_id: int) -> str:
         """Worker-local JSONL side-band (heartbeats + worker events) the
@@ -462,6 +509,8 @@ class TPUExecutor(RemoteExecutor):
         chaos: "str | ChaosPlan | None" = None,
         heartbeat_interval: float | None = None,
         stall_threshold: float | None = None,
+        checkpoint_interval_s: float | None = None,
+        checkpoint_keep_n: int | None = None,
         pool: TransportPool | None = None,
     ) -> None:
         def resolve(value, key):
@@ -684,6 +733,40 @@ class TPUExecutor(RemoteExecutor):
         self.stall_threshold = resolve_float_env(
             stall_threshold, "COVALENT_TPU_STALL_S", "stall_threshold"
         )
+        #: cooperative checkpointing cadence (elastic gangs): shipped in
+        #: the task spec; the harness snapshots the electron's registered
+        #: train state on this interval and on SIGTERM.
+        self.checkpoint_interval_s = resolve_float_env(
+            checkpoint_interval_s, "COVALENT_TPU_CHECKPOINT_INTERVAL_S",
+            "checkpoint_interval_s",
+        )
+        env_keep = os.environ.get("COVALENT_TPU_CHECKPOINT_KEEP")
+        if checkpoint_keep_n is None and env_keep is not None:
+            try:
+                checkpoint_keep_n = int(env_keep)
+            except ValueError:
+                app_log.warning(
+                    "ignoring non-integer COVALENT_TPU_CHECKPOINT_KEEP=%r",
+                    env_keep,
+                )
+        self.checkpoint_keep_n = max(
+            1, int(resolve(checkpoint_keep_n, "checkpoint_keep_n"))
+        )
+        #: lineage (base operation id) -> newest-first checkpoint records
+        #: {"step","digest","file","local"?} learned from worker
+        #: checkpoint_saved events and resume discovery.
+        self._ckpt_records: dict[str, list[dict[str, Any]]] = {}
+        #: lineage -> resume reference the next retry attempt ships
+        #: ({"step","digest","local"}), produced by _discover_resume.
+        self._resume_plans: dict[str, dict[str, Any]] = {}
+        #: attempt operation ids whose worker announced a preemption
+        #: notice (worker.preempt_notice): relabels the coming death.
+        self._preempt_notices: set[str] = set()
+        #: (lineage, step, digest) triples already counted/mirrored — the
+        #: telemetry side-band re-tails from offset 0 after reconnects.
+        self._ckpt_seen: set[tuple[str, int, str]] = set()
+        #: operation id -> this attempt's gang transports (mirror fetches).
+        self._op_conns: dict[str, list[Transport]] = {}
         #: live per-operation view served by the ops /status endpoint:
         #: operation_id -> {"stage", "attempt", "trace_id", "since"}.
         self._op_status: dict[str, dict[str, Any]] = {}
@@ -1293,6 +1376,8 @@ class TPUExecutor(RemoteExecutor):
         pip_deps: Sequence[str] = (),
         payload: bytes | None = None,
         trace: dict | None = None,
+        lineage: str | None = None,
+        resume: dict | None = None,
     ) -> StagedTask:
         """Stage the function pickle + per-worker task specs locally.
 
@@ -1337,6 +1422,25 @@ class TPUExecutor(RemoteExecutor):
             if self.transport_kind == "local" and obs_events.get_sink().enabled
             else None
         )
+        checkpoint_block: dict[str, Any] | None = None
+        if self.checkpoint_interval_s > 0:
+            checkpoint_block = {
+                "dir": f"{self.remote_cache}/cas",
+                "lineage": lineage or operation_id,
+                "interval_s": self.checkpoint_interval_s,
+                "keep_n": self.checkpoint_keep_n,
+            }
+        resume_block: dict[str, Any] | None = None
+        if resume and resume.get("local") and resume.get("digest"):
+            remote_bundle = f"{self.remote_cache}/resume_{operation_id}.ckpt"
+            staged.resume_artifact = (
+                resume["local"], remote_bundle, resume["digest"]
+            )
+            resume_block = {
+                "file": remote_bundle,
+                "step": int(resume.get("step", 0)),
+                "digest": resume["digest"],
+            }
         for process_id in range(num_processes):
             spec: dict[str, Any] = {
                 "operation_id": operation_id,
@@ -1367,6 +1471,10 @@ class TPUExecutor(RemoteExecutor):
                 spec["profile_dir"] = f"{self.profile_dir}/{operation_id}"
             if pip_deps:
                 spec["pip_deps"] = list(pip_deps)
+            if checkpoint_block is not None:
+                spec["checkpoint"] = checkpoint_block
+            if resume_block is not None:
+                spec["resume"] = resume_block
             if dist_blocks is not None:
                 spec["distributed"] = dist_blocks[process_id]
             local_spec = str(
@@ -1636,12 +1744,24 @@ class TPUExecutor(RemoteExecutor):
                 [(local, remote, digest) for local, remote, digest in artifacts],
                 codec=codec, python_path=self.python_path,
             )
-            return
-        for local, remote, digest in artifacts:
-            await self._cas.ensure(
-                key, conn, digest, local, remote,
-                codec=codec, python_path=self.python_path,
-            )
+        else:
+            for local, remote, digest in artifacts:
+                await self._cas.ensure(
+                    key, conn, digest, local, remote,
+                    codec=codec, python_path=self.python_path,
+                )
+        if staged.resume_artifact is not None:
+            # The resume bundle ships OUTSIDE the CAS road, under an
+            # op-scoped name: the present-set/skip-if-held optimizations
+            # are digest-keyed, and a digest-named copy in cas/ belongs
+            # to the worker checkpointer's keep_n GC — a straggling old
+            # gang's save could unlink it between this upload and the
+            # harness reading it.  tmp + rename keeps the publish atomic;
+            # the harness digest-verifies before restoring either way.
+            local, remote, digest = staged.resume_artifact
+            tmp = f"{remote}.tmp.{os.getpid()}.{process_id}"
+            await conn.put(local, tmp)
+            await conn.rename(tmp, remote)
 
     # ------------------------------------------------------------------ #
     # Submit / status / poll / fetch / cancel / cleanup                  #
@@ -1857,6 +1977,16 @@ class TPUExecutor(RemoteExecutor):
         if data.get("type") == "worker.heartbeat":
             self._record_heartbeat(operation_id, worker, data)
             return
+        if data.get("type") == "worker.checkpoint_saved":
+            # Elastic gangs: learn the lineage's newest checkpoint and
+            # mirror the bundle locally while the worker is still alive —
+            # the mirror survives a full-gang loss (the preempted VM's
+            # disk does not).
+            self._record_checkpoint(operation_id, worker, data)
+        elif data.get("type") == "worker.preempt_notice":
+            # SIGTERM reached this attempt's worker: the coming death is
+            # a spot reclaim, not a crash.
+            self._preempt_notices.add(operation_id)
         if self.transport_kind == "local" and not data.get("rpc"):
             return
         body = {k: v for k, v in data.items() if k not in ("type", "ts")}
@@ -1868,6 +1998,236 @@ class TPUExecutor(RemoteExecutor):
             **({"worker_ts": worker_ts} if worker_ts else {}),
             **body,
         )
+
+    # ------------------------------------------------------------------ #
+    # Elastic gangs: checkpoint records, mirroring, resume discovery      #
+    # ------------------------------------------------------------------ #
+
+    @staticmethod
+    def _verify_file(path: str, digest: str) -> bool:
+        from .utils.checkpoint import verify_bundle_file
+
+        return verify_bundle_file(path, digest)
+
+    def _local_bundle_path(self, digest: str) -> str:
+        return os.path.join(self.cache_dir, "cas", f"{digest}.ckpt")
+
+    def _record_checkpoint(
+        self, operation_id: str, worker: str, data: dict
+    ) -> None:
+        """File one worker.checkpoint_saved record (agent backhaul).
+
+        Dedups on (lineage, step, digest) — the side-band re-tails from
+        offset 0 after reconnects — counts the save, and schedules an
+        off-critical-path mirror fetch of the bundle into the local CAS so
+        resume survives the loss of the worker that wrote it.
+        """
+        lineage = str(data.get("lineage") or "")
+        digest = str(data.get("digest") or "")
+        try:
+            step = int(data.get("step"))
+        except (TypeError, ValueError):
+            return
+        if not lineage or not digest:
+            return
+        key = (lineage, step, digest)
+        if key in self._ckpt_seen:
+            return
+        if len(self._ckpt_seen) > 8192:
+            self._ckpt_seen.clear()
+        self._ckpt_seen.add(key)
+        CHECKPOINT_SAVES_TOTAL.labels(
+            trigger=str(data.get("trigger") or "interval")
+        ).inc()
+        entry = {
+            "step": step, "digest": digest,
+            "file": str(data.get("path") or ""), "worker": worker,
+        }
+        records = self._ckpt_records.setdefault(lineage, [])
+        records[:] = [r for r in records if r["step"] != step]
+        records.append(entry)
+        records.sort(key=lambda r: r["step"], reverse=True)
+        del records[max(8, self.checkpoint_keep_n * 2):]
+        if len(self._ckpt_records) > 256:  # unread lineages (direct API)
+            self._ckpt_records.pop(next(iter(self._ckpt_records)))
+        conns = self._op_conns.get(operation_id) or []
+        addresses = self._worker_addresses()
+        conn = next(
+            (
+                c for c, a in zip(conns, addresses)
+                if a == worker and c is not None
+            ),
+            conns[0] if conns else None,
+        )
+        if conn is not None:
+            task = asyncio.ensure_future(
+                self._mirror_checkpoint(conn, entry)
+            )
+            self._cleanup_tasks.add(task)
+            task.add_done_callback(self._cleanup_tasks.discard)
+
+    async def _mirror_checkpoint(self, conn: Transport, entry: dict) -> None:
+        """Best-effort digest-verified copy of one bundle into the local
+        CAS (the durable side of the cooperative-checkpoint contract)."""
+        digest = entry["digest"]
+        local = self._local_bundle_path(digest)
+        if os.path.exists(local):
+            entry["local"] = local
+            return
+        remote = entry.get("file") or cas_path(
+            self.remote_cache, digest, ".ckpt"
+        )
+        tmp = f"{local}.tmp.{os.getpid()}.{os.urandom(4).hex()}"
+        try:
+            os.makedirs(os.path.dirname(local), exist_ok=True)
+            await conn.get(remote, tmp)
+            if await asyncio.to_thread(self._verify_file, tmp, digest):
+                os.replace(tmp, local)
+                entry["local"] = local
+            else:
+                os.unlink(tmp)
+        except (TransportError, OSError, asyncio.CancelledError) as err:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            if isinstance(err, asyncio.CancelledError):
+                raise
+            app_log.debug(
+                "checkpoint mirror of %s failed: %s", digest[:12], err
+            )
+
+    async def _discover_resume(
+        self, lineage: str, conns: list[Transport] | None
+    ) -> dict[str, Any] | None:
+        """The lineage's newest COMPLETE checkpoint, verified and mirrored
+        locally — the resume reference the next retry attempt ships.
+
+        Sources, newest step first: records learned from the telemetry
+        backhaul (already mirrored when the fetch won the race with the
+        preemption) merged with the worker-side manifest, probed over the
+        failed attempt's still-alive channels or — when the whole gang is
+        gone — one fresh pooled dial per address.  Every candidate's bytes
+        are sha256-verified; a torn bundle (killed mid-save, truncated
+        disk) is skipped with a ``task.resume_skipped_torn`` event and the
+        previous complete step wins.
+        """
+        if self.checkpoint_interval_s <= 0:
+            return self._resume_plans.get(lineage)
+        usable = [c for c in (conns or []) if c is not None]
+        manifest_path = _ckpt_manifest_remote(self.remote_cache, lineage)
+        probe_cmd = f"cat {shlex.quote(manifest_path)} 2>/dev/null"
+
+        async def probe(conn: Transport) -> list | None:
+            result = await asyncio.wait_for(conn.run(probe_cmd), timeout=10.0)
+            if result.exit_status != 0 or not result.stdout.strip():
+                return None
+            manifest = json.loads(result.stdout)
+            history = manifest.get("history")
+            return history if isinstance(history, list) else None
+
+        history: list = []
+        reader: Transport | None = None
+        for conn in list(usable):
+            try:
+                found = await probe(conn)
+            except (Exception, asyncio.TimeoutError):  # noqa: BLE001
+                continue
+            if found:
+                history, reader = found, conn
+                break
+        have_verified_record = False
+        for record in self._ckpt_records.get(lineage, ()):
+            if record.get("local") and await asyncio.to_thread(
+                self._verify_file, record["local"], record["digest"]
+            ):
+                have_verified_record = True
+                break
+        if reader is None and not have_verified_record:
+            # The attempt's channels are all dead (full-gang loss) AND
+            # nothing usable was mirrored over the backhaul: one fresh
+            # pooled dial per address, until the first answer — against a
+            # fully reclaimed gang every dial times out, so this road is
+            # taken only when it is the ONLY road to a resume.  The pool
+            # keeps whatever dials succeed for the next attempt to reuse.
+            for address in self._worker_addresses():
+                try:
+                    conn = await asyncio.wait_for(
+                        self._client_connect(address), timeout=15.0
+                    )
+                    found = await probe(conn)
+                except (Exception, asyncio.TimeoutError):  # noqa: BLE001
+                    continue
+                usable.append(conn)
+                if found:
+                    history, reader = found, conn
+                break
+        merged: dict[tuple[int, str], dict] = {}
+        for entry in list(self._ckpt_records.get(lineage, ())) + [
+            h for h in history if isinstance(h, dict)
+        ]:
+            try:
+                step = int(entry.get("step"))
+            except (TypeError, ValueError):
+                continue
+            digest = str(entry.get("digest") or "")
+            if digest:
+                merged.setdefault((step, digest), dict(entry))
+        best = self._resume_plans.get(lineage)
+        fetch_order = (
+            [reader] if reader is not None else []
+        ) + [c for c in usable if c is not reader]
+        for (step, digest), entry in sorted(merged.items(), reverse=True):
+            if best is not None and step <= int(best.get("step", -1)):
+                break  # nothing newer than the already-verified plan
+            local = entry.get("local") or self._local_bundle_path(digest)
+            verified = os.path.exists(local) and await asyncio.to_thread(
+                self._verify_file, local, digest
+            )
+            if not verified:
+                remote = entry.get("file") or cas_path(
+                    self.remote_cache, digest, ".ckpt"
+                )
+                for conn in fetch_order:
+                    tmp = f"{local}.tmp.{os.getpid()}.{os.urandom(4).hex()}"
+                    try:
+                        os.makedirs(os.path.dirname(local), exist_ok=True)
+                        await asyncio.wait_for(
+                            conn.get(remote, tmp), timeout=60.0
+                        )
+                    except (Exception, asyncio.TimeoutError):  # noqa: BLE001
+                        try:
+                            os.unlink(tmp)
+                        except OSError:
+                            pass
+                        continue  # channel problem: try another worker
+                    if await asyncio.to_thread(
+                        self._verify_file, tmp, digest
+                    ):
+                        os.replace(tmp, local)
+                        verified = True
+                    else:
+                        # The bundle ITSELF is torn (killed mid-save or a
+                        # truncated disk): no channel will fetch it whole.
+                        # Fall back to the previous complete step.
+                        try:
+                            os.unlink(tmp)
+                        except OSError:
+                            pass
+                        obs_events.emit(
+                            "task.resume_skipped_torn",
+                            lineage=lineage, step=step, digest=digest,
+                        )
+                    break
+            if verified:
+                plan = {"step": step, "digest": digest, "local": local}
+                self._resume_plans[lineage] = plan
+                obs_events.emit(
+                    "task.resume_planned",
+                    lineage=lineage, step=step, digest=digest,
+                )
+                return plan
+        return best
 
     async def _start_backhaul(
         self, operation_id: str, staged: StagedTask
@@ -2090,13 +2450,25 @@ class TPUExecutor(RemoteExecutor):
         a parsed beat is handed to ``on_heartbeat`` — this is how the
         polling path gets worker liveness for free.
         """
+        # Zombie-aware liveness: `kill -0` answers true for a zombie, and a
+        # nohup-launched harness whose spawning shell already exited can
+        # stay a zombie indefinitely on hosts without a reaping init
+        # (containers).  A TERM-killed (e.g. preempted) worker must read
+        # DEAD, not RUNNING-forever, so the probe checks the process STATE
+        # first; hosts without `ps` fall through to the kill -0 answer.
         if pid is not None:
-            liveness = f"elif kill -0 {pid} 2>/dev/null; then echo RUNNING; "
+            liveness = (
+                f"elif ps -o state= -p {pid} 2>/dev/null | grep -q Z; "
+                "then echo DEAD; "
+                f"elif kill -0 {pid} 2>/dev/null; then echo RUNNING; "
+            )
         elif pid_file is not None:
             quoted = shlex.quote(pid_file)
             liveness = (
                 f"elif test -s {quoted}; then "
-                f"if kill -0 \"$(cat {quoted})\" 2>/dev/null; "
+                f"if ps -o state= -p \"$(cat {quoted})\" 2>/dev/null "
+                "| grep -q Z; then echo DEAD; "
+                f"elif kill -0 \"$(cat {quoted})\" 2>/dev/null; "
                 "then echo RUNNING; else echo DEAD; fi; "
                 "elif true; then echo STARTING; "
             )
@@ -2590,6 +2962,10 @@ class TPUExecutor(RemoteExecutor):
                 staged.remote_hb_file(process_id),
                 f"{staged.remote_pid_file}.{process_id}.metrics",
             ]
+            if staged.resume_artifact is not None:
+                # Op-scoped resume bundle (shipped outside the CAS): the
+                # harness read it at startup; nothing dedupes against it.
+                files.append(staged.resume_artifact[1])
             if process_id == 0:
                 files.append(staged.remote_result_file)
                 # Pinned-codec downloads stage a packed copy next to the
@@ -2790,6 +3166,14 @@ class TPUExecutor(RemoteExecutor):
             return False
         if self._chaos is not None and mode != "rpc":
             return False
+        if self.checkpoint_interval_s > 0 and mode != "rpc":
+            # Cooperative checkpointing needs the launch harness: the
+            # interval thread and the SIGTERM handler (main-thread signal
+            # API) belong to a per-task process, not a shared resident
+            # runtime hosting concurrent invocations.  An explicit "rpc"
+            # pin wins (same contract as the chaos gate above) — the
+            # electron keeps the fast path and simply isn't checkpointed.
+            return False
         # Worker-count check without triggering discovery: pod slices
         # (explicit multi-worker lists or tpu_name topologies) launch.
         if self.tpu_name or len(self.workers) > 1:
@@ -2829,8 +3213,10 @@ class TPUExecutor(RemoteExecutor):
         label = label or "transient"
         # First retry reuses pooled channels (cheap, covers one-off blips);
         # later retries — and channel-shaped failures — redial from scratch
-        # in case the worker was recreated behind the same address.
-        redial = attempt >= 1 or label == "channel"
+        # in case the worker was recreated behind the same address.  A
+        # preempted worker's channel is gone by definition (the VM is being
+        # reclaimed), so preemption always redials.
+        redial = attempt >= 1 or label in ("channel", "worker_preempted")
         return _RetryDispatch(
             label, message or str(error or "transient failure"), redial,
             conns=conns,
@@ -2874,6 +3260,14 @@ class TPUExecutor(RemoteExecutor):
             # ids, so the base mark must die with the run (else a later
             # dispatch reusing the id would read as pre-cancelled).
             self._cancelled_ops.discard(base_operation_id)
+            # Checkpoint lineage state dies with the run: a later dispatch
+            # reusing the operation id is NEW work and must never resume
+            # from (or dedup against) this run's checkpoints.
+            self._resume_plans.pop(base_operation_id, None)
+            self._ckpt_records.pop(base_operation_id, None)
+            self._ckpt_seen = {
+                k for k in self._ckpt_seen if k[0] != base_operation_id
+            }
 
     async def _run_with_retries(
         self,
@@ -2963,6 +3357,24 @@ class TPUExecutor(RemoteExecutor):
                         redial=retry.redial,
                         error=retry.message,
                     )
+                    if self.checkpoint_interval_s > 0:
+                        # Elastic resume: find (and digest-verify) the
+                        # lineage's newest complete checkpoint so the next
+                        # attempt restores instead of recomputing.  Runs
+                        # BEFORE the discard: a preempted gang's surviving
+                        # channels are still open inside the grace window
+                        # and answer the manifest probe in one round trip.
+                        # Never fatal — a failed discovery just means a
+                        # cold restart, which is what retries always did.
+                        try:
+                            await self._discover_resume(
+                                base_operation_id, retry.conns
+                            )
+                        except Exception as err:  # noqa: BLE001
+                            app_log.debug(
+                                "resume discovery for %s failed: %s",
+                                base_operation_id, err,
+                            )
                     if retry.redial and retry.conns:
                         await self._discard_workers(retry.conns)
                     if delay:
@@ -3001,6 +3413,15 @@ class TPUExecutor(RemoteExecutor):
         """
         dispatch_id = task_metadata.get("dispatch_id", "dispatch")
         node_id = task_metadata.get("node_id", 0)
+        # The lineage (base operation id) is constant across gang retries:
+        # it keys the worker-side checkpoint manifest and the resume plan
+        # a retry attempt ships.
+        lineage = (
+            operation_id
+            if attempt == 0
+            else operation_id[: -len(f".r{attempt}")]
+        )
+        resume_plan = self._resume_plans.get(lineage)
 
         current_remote_workdir = self.remote_workdir
         if self.create_unique_workdir:  # ssh.py:486-491
@@ -3103,6 +3524,8 @@ class TPUExecutor(RemoteExecutor):
                         pip_deps=task_metadata.get("pip_deps", ()),
                         payload=staged_payload,
                         trace=trace_context,
+                        lineage=lineage,
+                        resume=resume_plan,
                     )
 
             stage_task = asyncio.create_task(asyncio.to_thread(_stage))
@@ -3165,6 +3588,28 @@ class TPUExecutor(RemoteExecutor):
             # Staging errors (e.g. an unpicklable electron) surface here,
             # after a successful connect — same precedence as before.
             staged = await stage_task
+            #: mirror fetches (checkpoint_saved backhaul) resolve this
+            #: attempt's transports by operation id.
+            self._op_conns[operation_id] = conns
+
+            if resume_plan is not None and staged.resume_artifact:
+                # This attempt restores instead of recomputing: the bundle
+                # rides the CAS staging road to every worker and the spec
+                # points the harness (and the electron's resume_state())
+                # at it.
+                CHECKPOINT_RESTORES_TOTAL.inc()
+                _CHECKPOINT_RESUMED_STEP.set(
+                    float(resume_plan.get("step", 0))
+                )
+                obs_events.emit(
+                    "task.resumed",
+                    operation_id=operation_id,
+                    lineage=lineage,
+                    attempt=attempt,
+                    step=resume_plan.get("step"),
+                    digest=resume_plan.get("digest"),
+                    trace_id=root.trace_id,
+                )
 
             self._set_stage(operation_id, "launching")
             try:
@@ -3224,6 +3669,13 @@ class TPUExecutor(RemoteExecutor):
                 pids=pids,
             )
             addresses = self._worker_addresses()
+            for conn, address in zip(conns, addresses):
+                # Chaos preemption targeting: a wrapped transport records
+                # its worker's process-group leader so a preempt fault can
+                # deliver the SIGTERM notice to the right processes.
+                notify = getattr(conn, "chaos_notify_pid", None)
+                if notify is not None and address in pids:
+                    notify(pids[address])
             self._set_stage(operation_id, "executing")
             if self.heartbeat_interval > 0:
                 # Liveness bookkeeping for this attempt, then the telemetry
@@ -3321,6 +3773,10 @@ class TPUExecutor(RemoteExecutor):
                             f"{addresses[blamed]} ({status.value}); "
                             f"log tail:\n{log_tail}"
                         )
+                    preempted = (
+                        operation_id in self._preempt_notices
+                        or "worker.preempt_notice" in (telemetry_tail or "")
+                    )
                     if status is TaskStatus.STALLED:
                         # Route through the classifier: WorkerStalledError
                         # is the liveness layer's fault type, keeping its
@@ -3328,6 +3784,16 @@ class TPUExecutor(RemoteExecutor):
                         retry = self._plan_retry(
                             attempt, deadline,
                             error=WorkerStalledError(failure_msg),
+                            message=failure_msg, conns=conns,
+                        )
+                    elif status is not TaskStatus.TIMEOUT and preempted:
+                        # The worker announced the SIGTERM preemption
+                        # notice before dying: spot reclaim, not a crash —
+                        # its own label, and the retry that follows will
+                        # resume from the notice-triggered checkpoint.
+                        retry = self._plan_retry(
+                            attempt, deadline,
+                            error=WorkerPreemptedError(failure_msg),
                             message=failure_msg, conns=conns,
                         )
                     else:
@@ -3389,7 +3855,16 @@ class TPUExecutor(RemoteExecutor):
                 await self.cancel(operation_id, mark=False)
                 await self._discard_workers(conns)
                 retry = self._plan_retry(
-                    attempt, deadline, reason="channel", error=err,
+                    attempt, deadline,
+                    reason=(
+                        # A channel dying after its worker announced the
+                        # preemption notice IS the preemption (the grace
+                        # window elapsed): keep the spot-reclaim label.
+                        "worker_preempted"
+                        if operation_id in self._preempt_notices
+                        else "channel"
+                    ),
+                    error=err,
                     message=f"control-plane channel died mid-task: {err}",
                     conns=conns,
                 )
@@ -3479,6 +3954,8 @@ class TPUExecutor(RemoteExecutor):
         if artifact:
             self.last_timings["profile_trace"] = artifact
         self._op_status.pop(operation_id, None)
+        self._op_conns.pop(operation_id, None)
+        self._preempt_notices.discard(operation_id)
         MONITOR.forget(operation_id)
         obs_events.emit(
             "task.state",

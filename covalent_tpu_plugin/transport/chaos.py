@@ -28,6 +28,13 @@ silently injecting nothing would fake a green resilience test):
 * ``drop_match``      — channel dies on the next command containing this
   substring (pair with ``drop_match_skip=N`` to let N matches through).
 * ``truncate_uploads``— corrupt the next N uploads (half the payload).
+* ``preempt_after``   — models a TPU spot preemption: after N ops on one
+  transport, SIGTERM is delivered to the worker processes the executor
+  registered on it (``chaos_notify_pid``), then the channel drops after
+  a ``preempt_grace``-second grace window — notice first, loss second,
+  exactly the Cloud TPU preemption sequence.
+* ``preempt_grace``   — seconds between the SIGTERM notice and channel
+  death (default 1.0).
 * ``max_faults``      — process-wide budget across ALL injected faults.
 
 Every injected fault emits a ``chaos.fault`` event and increments
@@ -40,6 +47,7 @@ from __future__ import annotations
 import asyncio
 import os
 import random
+import time
 from typing import Any
 
 from ..obs import events as obs_events
@@ -59,9 +67,9 @@ CHAOS_FAULTS_TOTAL = REGISTRY.counter(
 
 _INT_KEYS = (
     "seed", "connect_errors", "run_errors", "drop_after",
-    "drop_match_skip", "truncate_uploads", "max_faults",
+    "drop_match_skip", "truncate_uploads", "max_faults", "preempt_after",
 )
-_FLOAT_KEYS = ("delay", "p_connect_error", "p_run_error")
+_FLOAT_KEYS = ("delay", "p_connect_error", "p_run_error", "preempt_grace")
 _STR_KEYS = ("drop_match",)
 
 
@@ -86,6 +94,8 @@ class ChaosPlan:
         drop_match_skip: int = 0,
         truncate_uploads: int = 0,
         max_faults: int = 0,
+        preempt_after: int = 0,
+        preempt_grace: float = 1.0,
     ) -> None:
         self.seed = int(seed)
         self.delay = float(delay)
@@ -98,6 +108,8 @@ class ChaosPlan:
         self.drop_match_skip = int(drop_match_skip)
         self.truncate_uploads = int(truncate_uploads)
         self.max_faults = int(max_faults)  # 0 = unbounded
+        self.preempt_after = int(preempt_after)
+        self.preempt_grace = float(preempt_grace)
         self.rng = random.Random(self.seed)
         self.faults_injected = 0
         self._match_seen = 0
@@ -109,6 +121,7 @@ class ChaosPlan:
             self.delay > 0, self.connect_errors > 0, self.p_connect_error > 0,
             self.run_errors > 0, self.p_run_error > 0, self.drop_after > 0,
             self.drop_match, self.truncate_uploads > 0,
+            self.preempt_after > 0,
         ))
 
     def take_fault(self, kind: str, **detail: Any) -> bool:
@@ -177,13 +190,43 @@ class ChaosTransport(Transport):
         self.plan = plan
         self.ops = 0
         self.dead = False
+        #: worker process-group leaders the executor registered on this
+        #: channel (chaos_notify_pid) — the preempt fault's SIGTERM targets.
+        self.worker_pids: list[int] = []
+        self._preempted = False
+        self._dead_at: float | None = None
 
     @property
     def address(self) -> str:  # type: ignore[override]
         return self.inner.address
 
+    def chaos_notify_pid(self, pid: int) -> None:
+        """Register one worker pid launched over this channel (the
+        executor calls this after dispatch) so a ``preempt_after`` fault
+        can deliver its SIGTERM notice to the right process group."""
+        if pid and pid not in self.worker_pids:
+            self.worker_pids.append(int(pid))
+
+    async def _deliver_preempt_notice(self) -> None:
+        """SIGTERM every registered worker's process group via the INNER
+        channel (the notice arrives even though this wrapper is about to
+        drop): group first so the harness's own children get it, direct
+        pid as the fallback for the pre-setsid race."""
+        for pid in list(self.worker_pids):
+            try:
+                await self.inner.run(
+                    f"kill -s TERM -- -{pid} 2>/dev/null || "
+                    f"kill -s TERM {pid} 2>/dev/null || true"
+                )
+            except Exception as err:  # noqa: BLE001 - notice is best-effort
+                app_log.debug("chaos: preempt notice to %s failed: %s",
+                              pid, err)
+
     async def _gate(self, op: str, command: str = "") -> None:
         """Count one op; raise if the channel is (or now becomes) dead."""
+        if self._dead_at is not None and time.monotonic() >= self._dead_at:
+            # The preemption grace window elapsed: the VM is gone.
+            self.dead = True
         if self.dead:
             raise TransportError(
                 f"chaos: channel to {self.address} is dead"
@@ -192,6 +235,24 @@ class ChaosTransport(Transport):
             await asyncio.sleep(self.plan.delay)
         self.ops += 1
         plan = self.plan
+        if (
+            plan.preempt_after
+            and not self._preempted
+            and self.ops > plan.preempt_after
+            and plan.take_fault(
+                "preempt", address=self.address, op=op, ops=self.ops,
+                pids=list(self.worker_pids), grace_s=plan.preempt_grace,
+            )
+        ):
+            # Spot preemption sequence: TERM notice now, channel loss
+            # after the grace window.  Ops inside the window still work —
+            # that is what lets a cooperative final checkpoint (and a
+            # serving warm handoff) land before the loss.
+            self._preempted = True
+            self._dead_at = time.monotonic() + max(
+                0.0, plan.preempt_grace
+            )
+            await self._deliver_preempt_notice()
         if plan.drop_after and self.ops > plan.drop_after:
             if plan.take_fault("drop", address=self.address, op=op, ops=self.ops):
                 self.dead = True

@@ -9,8 +9,13 @@ transport, executor, and harness layers share one implementation.
 from .checkpoint import (
     checkpoint_dir,
     latest_step,
+    prune_checkpoints,
+    register_snapshot,
+    reshard_tree,
     restore_checkpoint,
+    resume_state,
     save_checkpoint,
+    unregister_snapshot,
 )
 from .config import get_config, set_config, update_config
 from .log import app_log
@@ -20,8 +25,13 @@ from .timing import StageTimer
 __all__ = [
     "checkpoint_dir",
     "latest_step",
+    "prune_checkpoints",
+    "register_snapshot",
+    "reshard_tree",
     "restore_checkpoint",
+    "resume_state",
     "save_checkpoint",
+    "unregister_snapshot",
     "get_config",
     "set_config",
     "update_config",

@@ -14,20 +14,43 @@ gives electron bodies a first-class, TPU-correct implementation to call:
   a pickle fallback so the API works on any worker.  Device arrays are
   materialised to host before the fallback writes, and writes are atomic
   (temp + rename) so a killed task never leaves a torn checkpoint.
+
+Elastic-gang additions (ROADMAP item 1):
+
+* ``register_snapshot`` — a training electron registers a zero-arg hook
+  returning ``(train_state_tree, step)``; the *harness* (which never
+  imports this package — it finds the module through ``sys.modules``)
+  calls :func:`take_snapshot` on its checkpoint interval and on the
+  SIGTERM preemption notice, publishing sha256-named bundles into the
+  worker's remote CAS.
+* ``resume_state`` — the replacement gang's side of the contract: when
+  the dispatcher shipped a resume bundle with the retry attempt
+  (``COVALENT_TPU_RESUME_CHECKPOINT``), returns ``(step, tree)`` after
+  digest verification, optionally resharded onto a new mesh.
+* ``reshard_tree`` — maps host arrays saved under an N-worker mesh onto
+  an M-worker replacement mesh (elastic re-meshing): ``jax.device_put``
+  against the new mesh's shardings, replicated by default.
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
 import pickle
 import re
 import sys
 import uuid
 from pathlib import Path
-from typing import Any
+from typing import Any, Callable
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
 _ORBAX: Any = None  # resolved on first use; see _orbax()
+
+#: Environment contract for dispatcher-shipped resume bundles (set by the
+#: harness from the retry attempt's task spec).
+RESUME_PATH_ENV = "COVALENT_TPU_RESUME_CHECKPOINT"
+RESUME_STEP_ENV = "COVALENT_TPU_RESUME_STEP"
+RESUME_DIGEST_ENV = "COVALENT_TPU_RESUME_DIGEST"
 
 
 def _orbax():
@@ -88,6 +111,7 @@ def save_checkpoint(
     base: str | os.PathLike | None = None,
     *,
     per_process: bool = False,
+    keep_n: int | None = None,
 ) -> Path:
     """Persist ``tree`` for ``step``; returns the checkpoint path.
 
@@ -118,6 +142,8 @@ def save_checkpoint(
     if ocp is not None:
         checkpointer = ocp.PyTreeCheckpointer()
         checkpointer.save(target.resolve(), _to_host(tree), force=True)
+        if keep_n:
+            prune_checkpoints(base, keep_n)
         return target
     # Unique temp per writer: concurrent savers of the same step (replicated
     # multi-process electrons on a shared filesystem) must never interleave
@@ -126,7 +152,42 @@ def save_checkpoint(
     with open(tmp, "wb") as f:
         pickle.dump(_to_host(tree), f)
     os.replace(tmp, target)
+    if keep_n:
+        prune_checkpoints(base, keep_n)
     return target
+
+
+def prune_checkpoints(
+    base: str | os.PathLike | None = None, keep_n: int = 1
+) -> list[int]:
+    """Drop all but the newest ``keep_n`` saved steps; returns the steps
+    removed.  Interrupted saves (``.tmp_*`` files) never match the step
+    pattern, so they are invisible to :func:`latest_step` by construction —
+    this bounds the *completed* history so checkpoint dirs stop growing
+    unbounded under interval checkpointing."""
+    root = checkpoint_dir(base)
+    keep_n = max(1, int(keep_n))
+    steps = sorted(
+        (
+            (int(m.group(1)), p)
+            for p in root.iterdir()
+            if (m := _STEP_RE.match(p.name))
+        ),
+        reverse=True,
+    )
+    removed: list[int] = []
+    for step, path in steps[keep_n:]:
+        try:
+            if path.is_dir():
+                import shutil
+
+                shutil.rmtree(path)
+            else:
+                path.unlink()
+            removed.append(step)
+        except OSError:  # pragma: no cover - concurrent pruner/reader race
+            continue
+    return removed
 
 
 def latest_step(base: str | os.PathLike | None = None) -> int | None:
@@ -171,3 +232,153 @@ def restore_checkpoint(
         )
     with open(target, "rb") as f:
         return pickle.load(f)
+
+
+# --------------------------------------------------------------------------
+# Cooperative checkpointing (elastic gangs): the electron side.
+#
+# The harness process (stdlib-only, never imports this package) reaches the
+# registered hook through ``sys.modules["covalent_tpu_plugin.utils.
+# checkpoint"]`` — the same rendezvous trick ``_process_index`` uses for
+# jax.  An electron that never imports this module simply has no hook, and
+# the harness's checkpointer thread idles.
+# --------------------------------------------------------------------------
+
+_SNAPSHOT: dict[str, Any] = {"hook": None}
+
+
+def register_snapshot(hook: Callable[[], tuple[Any, int] | None]) -> None:
+    """Register the training electron's train-state snapshot hook.
+
+    ``hook()`` must return ``(tree, step)`` — the current train state (host
+    or device arrays; the harness materialises to host) and the step it
+    corresponds to — or ``None`` when there is nothing to save yet.  It is
+    called from the harness's checkpointer thread on the configured
+    interval AND from the SIGTERM preemption handler, concurrently with
+    the training loop: return a consistent reference (e.g. the state
+    object swapped in whole at each step), not a structure mutated in
+    place mid-step.
+    """
+    if not callable(hook):
+        raise TypeError(f"snapshot hook must be callable, got {hook!r}")
+    _SNAPSHOT["hook"] = hook
+
+
+def unregister_snapshot() -> None:
+    _SNAPSHOT["hook"] = None
+
+
+def take_snapshot() -> tuple[Any, int] | None:
+    """``(tree, step)`` from the registered hook, or None.  Called by the
+    harness checkpointer (via sys.modules); exceptions propagate so the
+    harness can count them without this module importing its event sink."""
+    hook = _SNAPSHOT["hook"]
+    if hook is None:
+        return None
+    snap = hook()
+    if snap is None:
+        return None
+    tree, step = snap
+    return tree, int(step)
+
+
+def verify_bundle_file(path: str | os.PathLike, digest: str) -> bool:
+    """Whether ``path``'s bytes match the sha256 ``digest`` (torn-bundle
+    guard shared by the dispatcher's resume discovery and tests)."""
+    sha = hashlib.sha256()
+    try:
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                sha.update(chunk)
+    except OSError:
+        return False
+    return sha.hexdigest() == digest
+
+
+def resume_state(
+    mesh: Any = None, shardings: Any = None
+) -> tuple[int, Any] | None:
+    """The dispatcher-shipped resume checkpoint, or None (cold start).
+
+    When the retry driver found a complete checkpoint for this electron's
+    lineage, the harness exposes it via ``COVALENT_TPU_RESUME_CHECKPOINT``
+    (+ step/digest).  Returns ``(step, tree)`` after verifying the bundle
+    bytes against the shipped digest — a torn artifact returns None so the
+    electron recomputes instead of restoring garbage.  ``mesh`` (with
+    optional ``shardings``) reshards the host tree onto the *current* gang
+    via :func:`reshard_tree`, so a checkpoint saved at N workers restores
+    on an M-worker replacement.
+    """
+    path = os.environ.get(RESUME_PATH_ENV, "")
+    if not path or not os.path.exists(path):
+        return None
+    expected = os.environ.get(RESUME_DIGEST_ENV, "")
+    if expected and not verify_bundle_file(path, expected):
+        print(
+            f"resume checkpoint {path} failed digest verification; "
+            "recomputing from scratch",
+            file=sys.stderr,
+        )
+        return None
+    try:
+        import cloudpickle as pickler
+    except ImportError:  # pragma: no cover - cloudpickle ships with workers
+        pickler = pickle
+    with open(path, "rb") as f:
+        bundle = pickler.load(f)
+    tree = bundle["tree"]
+    step = int(bundle["step"])
+    if mesh is not None:
+        tree = reshard_tree(tree, mesh, shardings=shardings)
+    return step, tree
+
+
+def host_tree(tree: Any) -> Any:
+    """Every leaf gathered to host memory (full arrays, any mesh size)."""
+    return _to_host(tree)
+
+
+def reshard_tree(tree: Any, mesh: Any, shardings: Any = None) -> Any:
+    """Place a host-array tree onto ``mesh`` (elastic re-meshing).
+
+    A checkpoint bundle holds *full host arrays* (the snapshot path
+    gathers before pickling), so restoring onto a replacement gang with a
+    different worker/device count is one ``jax.device_put`` per leaf:
+
+    * ``shardings=None`` — replicate every leaf (the train-state default:
+      data-parallel replicas all hold full params/opt state).
+    * ``shardings`` — a matching pytree of ``PartitionSpec`` (placed on
+      ``mesh``) or concrete ``Sharding`` objects per leaf, for sharded
+      state; XLA scatters each full host array onto the new mesh.
+
+    Non-array leaves (ints, strings, None) pass through untouched, so a
+    mixed train-state dict reshards without ceremony.
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    host = _to_host(tree)
+
+    def place(leaf: Any, sharding: Any) -> Any:
+        if not isinstance(leaf, (np.ndarray, np.generic)) and not hasattr(
+            leaf, "shape"
+        ):
+            return leaf
+        if sharding is None:
+            sharding = PartitionSpec()
+        if not isinstance(sharding, jax.sharding.Sharding):
+            sharding = NamedSharding(mesh, sharding)
+        return jax.device_put(leaf, sharding)
+
+    # flatten_up_to (the pjit in_shardings pattern), not tree_map over
+    # both trees: PartitionSpec is a tuple subclass, so a naive two-tree
+    # map would flatten INTO the spec instead of treating it as a leaf.
+    leaves, treedef = jax.tree_util.tree_flatten(host)
+    if shardings is None:
+        shard_leaves: list[Any] = [None] * len(leaves)
+    else:
+        shard_leaves = treedef.flatten_up_to(shardings)
+    return jax.tree_util.tree_unflatten(
+        treedef, [place(l, s) for l, s in zip(leaves, shard_leaves)]
+    )

@@ -334,3 +334,54 @@ def test_cached_agent_failed_ping_restarts_agent(tmp_path, run_async):
     assert (first, second) == (1, 2)
     assert fresh is not None and fresh is not stale  # genuinely restarted
     assert AGENT_RESTARTS_TOTAL.value == restarts_before + 1
+
+
+def test_preempt_after_sigterm_then_grace_then_drop(tmp_path, run_async):
+    """The ``preempt_after`` primitive models a TPU spot reclaim: SIGTERM
+    reaches the registered worker's process group on the Nth op, ops keep
+    working inside the grace window (the cooperative-checkpoint window),
+    then the channel drops — counted under ``chaos_faults_total``."""
+    import signal
+    import subprocess
+
+    from covalent_tpu_plugin.transport import ChaosTransport, LocalTransport
+    from covalent_tpu_plugin.transport.base import TransportError
+    from covalent_tpu_plugin.transport.chaos import plan_from_spec
+
+    plan = plan_from_spec(
+        "preempt_after=2,preempt_grace=0.4,max_faults=1"
+    )
+    assert plan is not None and plan.active
+    faults_before = counter_value(
+        "covalent_tpu_chaos_faults_total", kind="preempt"
+    )
+    worker = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(60)"],
+        start_new_session=True,
+    )
+    conn = ChaosTransport(LocalTransport(), plan)
+    conn.chaos_notify_pid(worker.pid)
+
+    async def flow():
+        await conn.run("echo one")  # op 1
+        await conn.run("echo two")  # op 2
+        await conn.run("echo notice")  # op 3: fault fires, channel alive
+        inside_grace = await conn.run("echo still-here")  # grace window
+        await asyncio.sleep(0.5)  # grace elapses
+        with pytest.raises(TransportError):
+            await conn.run("echo gone")
+        return inside_grace
+
+    try:
+        inside_grace = run_async(flow())
+        assert inside_grace.exit_status == 0
+        worker.wait(timeout=10)
+        # SIGTERM (not KILL): the notice the harness's handler can act on.
+        assert worker.returncode == -signal.SIGTERM
+        assert counter_value(
+            "covalent_tpu_chaos_faults_total", kind="preempt"
+        ) == faults_before + 1
+    finally:
+        if worker.poll() is None:
+            worker.kill()
+            worker.wait()

@@ -12,8 +12,13 @@ import pytest
 from covalent_tpu_plugin.utils import (
     checkpoint_dir,
     latest_step,
+    prune_checkpoints,
+    register_snapshot,
+    reshard_tree,
     restore_checkpoint,
+    resume_state,
     save_checkpoint,
+    unregister_snapshot,
 )
 
 
@@ -86,6 +91,107 @@ def test_nonzero_process_skips_write(tmp_path, monkeypatch):
     target = save_checkpoint({"x": 1}, step=3, base=tmp_path / "proc1",
                              per_process=True)
     assert target.exists()
+
+
+def test_keep_n_prunes_old_steps(tmp_path):
+    """keep_n garbage collection: only the newest N complete steps
+    survive, and interrupted saves (tmp files) are invisible to
+    latest_step by construction."""
+    for step in range(6):
+        save_checkpoint({"s": step}, step=step, base=tmp_path, keep_n=3)
+    steps = sorted(
+        int(p.name.split("_")[1])
+        for p in tmp_path.iterdir()
+        if p.name.startswith("step_")
+    )
+    assert steps == [3, 4, 5]
+    # A torn tmp file (killed mid-save) is never selected nor counted.
+    (tmp_path / ".tmp_step_9.123.deadbeef").write_bytes(b"torn")
+    assert latest_step(tmp_path) == 5
+    assert prune_checkpoints(tmp_path, keep_n=1) == [4, 3]
+    assert latest_step(tmp_path) == 5
+
+
+def test_snapshot_registry_roundtrip():
+    from covalent_tpu_plugin.utils import checkpoint as ckpt_mod
+
+    assert ckpt_mod.take_snapshot() is None  # no hook registered
+    state = {"acc": 1.5}
+    register_snapshot(lambda: (dict(state), 4))
+    try:
+        tree, step = ckpt_mod.take_snapshot()
+        assert tree == {"acc": 1.5} and step == 4
+        with pytest.raises(TypeError):
+            register_snapshot("not-callable")
+    finally:
+        unregister_snapshot()
+    assert ckpt_mod.take_snapshot() is None
+
+
+def test_resume_state_env_contract(tmp_path, monkeypatch):
+    """resume_state: digest-verified bundle -> (step, tree); a torn
+    artifact (wrong digest) returns None so the electron recomputes."""
+    import hashlib
+
+    import cloudpickle
+
+    from covalent_tpu_plugin.utils import checkpoint as ckpt_mod
+
+    payload = cloudpickle.dumps(
+        {"v": 1, "step": 11, "tree": {"w": np.ones(3)}, "meta": {}}
+    )
+    bundle = tmp_path / "bundle.ckpt"
+    bundle.write_bytes(payload)
+    monkeypatch.delenv(ckpt_mod.RESUME_PATH_ENV, raising=False)
+    assert resume_state() is None  # cold start: nothing shipped
+    monkeypatch.setenv(ckpt_mod.RESUME_PATH_ENV, str(bundle))
+    monkeypatch.setenv(
+        ckpt_mod.RESUME_DIGEST_ENV, hashlib.sha256(payload).hexdigest()
+    )
+    step, tree = resume_state()
+    assert step == 11
+    np.testing.assert_array_equal(tree["w"], np.ones(3))
+    # Torn bundle: digest mismatch -> None, never garbage state.
+    bundle.write_bytes(payload[: len(payload) // 2])
+    assert resume_state() is None
+
+
+def test_reshard_tree_across_mesh_sizes():
+    """Elastic re-meshing: state saved under a 2-device mesh restores
+    bit-equal onto 1- and 4-device replacement meshes (CPU virtual mesh),
+    sharded leaves included."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    devices = jax.devices()
+    assert len(devices) >= 4  # conftest forces an 8-device CPU mesh
+    w = np.arange(32.0).reshape(8, 4)
+    mesh2 = Mesh(np.array(devices[:2]), ("data",))
+    saved = {
+        "w": jax.device_put(w, NamedSharding(mesh2, PartitionSpec("data"))),
+        "step": 7,
+    }
+    from covalent_tpu_plugin.utils.checkpoint import host_tree
+
+    host = host_tree(saved)  # what a checkpoint bundle holds
+    np.testing.assert_array_equal(np.asarray(host["w"]), w)
+    for n in (1, 4):
+        mesh_n = Mesh(np.array(devices[:n]), ("data",))
+        restored = reshard_tree(
+            host, mesh_n,
+            shardings={"w": PartitionSpec("data"), "step": PartitionSpec()},
+        )
+        assert restored["step"] == 7
+        assert len(restored["w"].sharding.mesh.devices) == n
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(restored["w"])), w
+        )
+        # Replicated default (no shardings): same bytes, full copy per
+        # device — the train-state restore path.
+        replicated = reshard_tree(host, mesh_n)
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(replicated["w"])), w
+        )
 
 
 def test_resume_across_electron_dispatches(tmp_path, run_async):

@@ -992,3 +992,77 @@ def test_ops_status_carries_fleet_section(run_async):
         await scheduler.close()
 
     run_async(go())
+
+
+def test_parse_pool_spec_spot_tag():
+    """'!spot' (or '!preemptible') marks spot capacity; it stacks with a
+    serving role and rides the JSON form as a first-class field."""
+    specs = parse_pool_specs(
+        "cheap=10.0.0.1@4!spot; mixed=10.0.0.2@2!decode!spot; s=10.0.0.3"
+    )
+    by_name = {s.name: s for s in specs}
+    assert by_name["cheap"].preemptible and by_name["cheap"].capacity == 4
+    assert by_name["mixed"].preemptible and by_name["mixed"].role == "decode"
+    assert not by_name["s"].preemptible
+    [json_spec] = parse_pool_specs(
+        json.dumps({"name": "p", "workers": ["w"], "preemptible": True})
+    )
+    assert json_spec.preemptible
+
+
+def test_placement_prefers_stable_over_spot_unless_opted_in(run_async):
+    """Spot pools rank after stable ones for ordinary electrons; a
+    'spot_ok' electron takes the (warm) spot pool — checkpoint-tolerant
+    work rides cheap capacity, everything else pins to stable."""
+    spot = StubExecutor(warm=True)  # warm spot must STILL lose...
+    stable = StubExecutor(warm=False)
+    registry = PoolRegistry()
+    registry.register(
+        PoolSpec(name="spot", capacity=2, transport="local",
+                 preemptible=True),
+        executor=spot,
+    )
+    registry.register(
+        PoolSpec(name="stable", capacity=2, transport="local"),
+        executor=stable,
+    )
+    scheduler = FleetScheduler(registry)
+
+    async def go():
+        await scheduler.run(lambda: 1, (), {}, {"node_id": 1})
+        await scheduler.run(
+            lambda: 2, (), {}, {"node_id": 2, "spot_ok": True}
+        )
+        await scheduler.close()
+
+    run_async(go())
+    assert len(stable.ran) == 1  # ordinary electron avoided spot
+    assert len(spot.ran) == 1    # opted-in electron took the warm spot pool
+
+
+def test_preemptible_pool_defaults_to_checkpoint_heavy_dispatch(tmp_path):
+    """A spot pool's real executor gets checkpoint-heavy dispatch by
+    default (reclaims resume, not recompute); explicit kwargs win."""
+    from covalent_tpu_plugin.fleet.pools import _default_executor_factory
+
+    spec = PoolSpec(
+        name="spot", transport="local", preemptible=True,
+        executor={"cache_dir": str(tmp_path / "c")},
+    )
+    ex = _default_executor_factory(spec)
+    assert ex.checkpoint_interval_s == 60.0
+    spec_explicit = PoolSpec(
+        name="spot2", transport="local", preemptible=True,
+        executor={
+            "cache_dir": str(tmp_path / "c2"),
+            "checkpoint_interval_s": 5.0,
+        },
+    )
+    assert _default_executor_factory(
+        spec_explicit
+    ).checkpoint_interval_s == 5.0
+    spec_stable = PoolSpec(
+        name="stable", transport="local",
+        executor={"cache_dir": str(tmp_path / "c3")},
+    )
+    assert _default_executor_factory(spec_stable).checkpoint_interval_s == 0.0

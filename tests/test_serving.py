@@ -751,3 +751,85 @@ def test_pool_serve_failed_open_sid_is_reopenable(tmp_path, run_async):
     opened, closed = run_async(flow())
     assert opened["slots"] == 2
     assert closed["served"] == 1
+
+
+def test_serve_warm_handoff_zero_dropped_tokens(tmp_path, run_async):
+    """Planned churn: handoff() opens the replacement session BEFORE
+    retiring the old one and splices in-flight streams on the idx replay —
+    byte-equal results, exactly-once, no reconnect event, handle usable
+    on the new generation."""
+
+    async def flow():
+        ex = make_serve_executor(tmp_path)
+        try:
+            handle = await open_session(
+                ex, make_factory(step_delay=0.1, default_cap=12)
+            )
+            requests = [await handle.request([100 * i]) for i in range(3)]
+            for _ in range(200):
+                if all(len(r.tokens) >= 4 for r in requests):
+                    break
+                await asyncio.sleep(0.05)
+            assert all(len(r.tokens) >= 4 for r in requests)
+            moved = await handle.handoff(reason="test")
+            results = [await r.result(timeout=60) for r in requests]
+            stats = (
+                moved, handle.handoffs, handle.generation,
+                handle.reconnects, handle.state,
+            )
+            late = await handle.request([7], params={"max_new_tokens": 3})
+            late_result = await late.result(timeout=30)
+            await handle.close()
+        finally:
+            await ex.close()
+        return results, stats, late_result
+
+    results, stats, late_result = run_async(flow())
+    moved, handoffs, generation, reconnects, state = stats
+    assert moved is True
+    for i, tokens in enumerate(results):
+        assert tokens == [100 * i + j + 1 for j in range(12)], tokens
+    assert handoffs == 1
+    assert generation == 2  # the replacement generation took over
+    assert reconnects == 0  # warm path, not the death path
+    assert state == "open"
+    assert late_result == [8, 9, 10]
+
+
+def test_serve_preempt_notice_triggers_auto_handoff(tmp_path, run_async):
+    """SIGTERM on the serving runtime (the spot preemption notice): the
+    worker announces ``serve.preempt`` on the side-band and KEEPS serving;
+    the supervisor warm-hands the session off inside the grace window —
+    streams stay byte-equal and exactly-once."""
+    import os as os_mod
+    import signal
+
+    async def flow():
+        ex = make_serve_executor(tmp_path)
+        try:
+            handle = await open_session(
+                ex, make_factory(step_delay=0.1, default_cap=12)
+            )
+            requests = [await handle.request([100 * i]) for i in range(3)]
+            for _ in range(200):
+                if all(len(r.tokens) >= 4 for r in requests):
+                    break
+                await asyncio.sleep(0.05)
+            server_pid = ex._agents["localhost"]._process._proc.pid
+            os_mod.kill(server_pid, signal.SIGTERM)  # the preemption notice
+            for _ in range(200):
+                if handle.handoffs:
+                    break
+                await asyncio.sleep(0.05)
+            results = [await r.result(timeout=60) for r in requests]
+            stats = (handle.handoffs, handle.state)
+            await handle.close()
+        finally:
+            await ex.close()
+        return results, stats
+
+    results, (handoffs, state) = run_async(flow())
+    for i, tokens in enumerate(results):
+        assert tokens == [100 * i + j + 1 for j in range(12)], tokens
+    assert handoffs == 1
+    assert state == "open"
