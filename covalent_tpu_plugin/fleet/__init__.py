@@ -21,8 +21,13 @@ decoupled from execution):
 * :mod:`fleet.executor` — the :class:`FleetExecutor` facade keeping the
   ``@ct.electron(executor=...)`` surface: electrons submitted through it
   ride the queue instead of mapping 1:1 onto gangs.
+* :mod:`fleet.autoscale` — the closed sensor→actuator loop: the
+  :class:`AutoscaleController` turns history-ring trends and SLO burn
+  alerts into predictive pool-capacity and replica-count targets, with
+  hysteresis, cooldowns, scale-to-zero, and stable-pool pinning.
 """
 
+from .autoscale import AutoscaleController, PoolPolicy, ReplicaSetPolicy
 from .executor import FleetExecutor, default_scheduler, reset_default_scheduler
 from .lease import GangLease
 from .pools import Pool, PoolRegistry, PoolSpec, parse_pool_specs
@@ -30,6 +35,7 @@ from .queue import FairWorkQueue, QueueFullError, WorkItem
 from .scheduler import AutoscaleHook, FleetScheduler, LocalPoolAutoscaler
 
 __all__ = [
+    "AutoscaleController",
     "AutoscaleHook",
     "FairWorkQueue",
     "FleetExecutor",
@@ -37,9 +43,11 @@ __all__ = [
     "GangLease",
     "LocalPoolAutoscaler",
     "Pool",
+    "PoolPolicy",
     "PoolRegistry",
     "PoolSpec",
     "QueueFullError",
+    "ReplicaSetPolicy",
     "WorkItem",
     "default_scheduler",
     "parse_pool_specs",

@@ -1,0 +1,948 @@
+"""Closed-loop predictive autoscaling: the SLO plane drives the fleet.
+
+Every sensor already exists — the history ring's windowed queries and
+trend slopes (:mod:`..obs.history`), the SLO engine's multi-window burn
+alerts (:mod:`..obs.slo`), the scheduler's live queue depth — and every
+actuator exists too: :class:`~.pools.Pool` capacity, ``ReplicaSet.
+scale_to`` (including scale-to-zero), gang teardown and ``prewarm()``.
+This module is the loop that connects them: one
+:class:`AutoscaleController` periodically turns *trends* into per-pool
+capacity targets and per-replica-set replica counts, then actuates.
+
+Three properties make the loop production-shaped rather than a
+thermostat:
+
+* **Predictive, not edge-triggered.**  Demand is projected a *lead
+  time* ahead — ``predicted = now + max(0, slope) × lead`` — where the
+  slope comes from ``HISTORY.query(..., agg="trend")`` (queue depth for
+  pools, per-replica in-flight for serving sets) and the lead is the
+  **measured** cold start: the ``covalent_tpu_prewarm_seconds``
+  histogram's per-pool mean, recorded by every real ``prewarm()``.
+  Capacity that takes 8 s to warm starts warming when the trend says
+  demand is 8 s away, not when the latency SLO is already burning.
+* **Flap-free.**  Scale-ups take a short cooldown; scale-downs require
+  utilization *sustained* below the release threshold for the full
+  down-cooldown AND no relevant SLO burning — a queue oscillating
+  around a watermark moves capacity at most once per dwell, asserted
+  under a fake clock in the test tier.
+* **SLO-driven.**  The controller subscribes to the SLO engine's alert
+  hooks: a burning serving SLO forces a replica scale-up on its managed
+  SLO-critical sets immediately (and pins their placement to stable,
+  non-spot pools via ``prefer_stable``); a burning dispatch/queue SLO
+  forces pool capacity up.  Burn state also vetoes every scale-down —
+  shedding capacity during an incident is how incidents get worse.
+
+Scale-to-zero rides the same loop: a pool whose gang sits warm with
+nothing placed and no serving sessions past ``idle_ttl_s`` is torn down
+(``Pool.teardown()``); an idle managed set whose policy allows
+``min_replicas=0`` suspends via ``scale_to(0)``.  Both re-warm on
+demand — the set transparently on its next request, the pool on its
+next placement or the controller's own predictive ``prewarm()`` when
+the trend turns positive again.
+
+Environment knobs (all overridable per-controller):
+
+========================================  ====================================
+``COVALENT_TPU_AUTOSCALE_INTERVAL_S``     evaluation tick (default 1.0)
+``COVALENT_TPU_AUTOSCALE_UP_COOLDOWN_S``  min dwell between scale-ups (3.0)
+``COVALENT_TPU_AUTOSCALE_COOLDOWN_S``     sustained-below dwell before any
+                                          scale-down (30.0)
+``COVALENT_TPU_AUTOSCALE_IDLE_TTL_S``     idle seconds before scale-to-zero
+                                          (300.0; 0 disables)
+``COVALENT_TPU_AUTOSCALE_LEAD_S``         predictive lead override (0 =
+                                          measured from prewarm durations)
+``COVALENT_TPU_AUTOSCALE_TREND_WINDOW_S`` trend-fit window (30.0)
+========================================  ====================================
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import itertools
+import json
+import math
+import os
+import time
+import weakref
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from ..obs import events as obs_events
+from ..obs.history import HISTORY, MetricsHistory
+from ..obs.metrics import REGISTRY
+from ..obs.opsserver import (
+    ensure_ops_server,
+    register_status_provider,
+    unregister_status_provider,
+)
+from ..utils.log import app_log
+from .pools import Pool, PoolRegistry
+
+__all__ = [
+    "AutoscaleController",
+    "PoolPolicy",
+    "ReplicaSetPolicy",
+    "AUTOSCALE_DECISIONS_TOTAL",
+]
+
+AUTOSCALE_DECISIONS_TOTAL = REGISTRY.counter(
+    "covalent_tpu_autoscale_decisions_total",
+    "Autoscale controller actuations by action",
+    ("action",),
+)
+
+#: Gauge of the controller's most recent capacity target per resource —
+#: the dashboard view of "what the loop is steering toward".
+AUTOSCALE_TARGET = REGISTRY.gauge(
+    "covalent_tpu_autoscale_target",
+    "Autoscale controller capacity target per managed resource",
+    ("resource",),
+)
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "").strip().lower()
+    if not raw:
+        return default
+    # Same off-words the sibling knobs accept (obs.history._env_float):
+    # COVALENT_TPU_AUTOSCALE_IDLE_TTL_S=off must DISABLE scale-to-zero,
+    # not silently fall back to the enabled default.
+    if raw in ("0", "off", "false", "no", "none"):
+        return 0.0
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+@dataclass
+class PoolPolicy:
+    """Scaling bounds for one managed pool."""
+
+    min_capacity: int = 1
+    max_capacity: int = 8
+    step: int = 1
+    #: None rides the controller default; 0 disables scale-to-zero.
+    idle_ttl_s: float | None = None
+
+    def __post_init__(self) -> None:
+        self.min_capacity = max(1, int(self.min_capacity))
+        self.max_capacity = max(self.min_capacity, int(self.max_capacity))
+        self.step = max(1, int(self.step))
+
+
+@dataclass
+class ReplicaSetPolicy:
+    """Scaling bounds + utilization targets for one managed replica set."""
+
+    min_replicas: int = 1  # 0 allows scale-to-zero suspension
+    max_replicas: int = 4
+    #: scale up when predicted load exceeds this fraction of the live
+    #: decode-slot capacity (the hysteresis high band).
+    target_utilization: float = 0.75
+    #: scale down only when utilization sits below this fraction for the
+    #: whole down-cooldown (the hysteresis low band).
+    scale_down_utilization: float = 0.3
+    #: SLO-critical: serving burn alerts force scale-ups here and the
+    #: set's placement pins to stable (non-spot) pools.
+    slo_critical: bool = True
+    #: trend/load scale-ups require the desired count to exceed the live
+    #: count for this many CONSECUTIVE ticks (1 = act immediately) — a
+    #: one-tick in-flight spike is not demand.  Burn-driven scale-ups
+    #: bypass the stabilization entirely: an incident does not wait.
+    up_stabilization_ticks: int = 1
+    idle_ttl_s: float | None = None
+
+    def __post_init__(self) -> None:
+        self.min_replicas = max(0, int(self.min_replicas))
+        self.max_replicas = max(
+            max(1, self.min_replicas), int(self.max_replicas)
+        )
+        self.up_stabilization_ticks = max(1, int(self.up_stabilization_ticks))
+        if not 0.0 < self.target_utilization <= 1.0:
+            raise ValueError(
+                f"target_utilization must be in (0, 1], got "
+                f"{self.target_utilization}"
+            )
+        if not 0.0 <= self.scale_down_utilization < self.target_utilization:
+            raise ValueError(
+                "scale_down_utilization must be below target_utilization "
+                f"(got {self.scale_down_utilization} vs "
+                f"{self.target_utilization})"
+            )
+
+
+class _ResourceState:
+    """Per-resource actuation memory: cooldowns, dwell, idle tracking."""
+
+    __slots__ = (
+        "last_up", "last_down", "below_since", "idle_since",
+        "last_prewarm", "up_pending",
+    )
+
+    def __init__(self) -> None:
+        self.last_up: float | None = None
+        self.last_down: float | None = None
+        self.below_since: float | None = None
+        self.idle_since: float | None = None
+        self.last_prewarm: float | None = None
+        #: consecutive ticks the desired count exceeded the live count.
+        self.up_pending = 0
+
+
+class AutoscaleController:
+    """The sensor→actuator loop over one fleet's pools and replica sets.
+
+    Construct with the scheduler whose fleet it drives (or a bare
+    registry), then :meth:`manage_pool` / :meth:`manage_replica_set` the
+    resources it owns and :meth:`start` the tick task.  Tests drive
+    :meth:`tick` directly under an injected clock — every decision the
+    loop can make is reachable without sleeping.
+    """
+
+    _ids = itertools.count()
+
+    def __init__(
+        self,
+        scheduler: Any = None,
+        registry: PoolRegistry | None = None,
+        *,
+        history: MetricsHistory | None = None,
+        slo_engine: Any = None,
+        interval_s: float | None = None,
+        up_cooldown_s: float | None = None,
+        down_cooldown_s: float | None = None,
+        idle_ttl_s: float | None = None,
+        lead_s: float | None = None,
+        trend_window_s: float | None = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.scheduler = scheduler
+        self.registry = registry or (
+            scheduler.registry if scheduler is not None else None
+        )
+        self.history = history if history is not None else HISTORY
+        self._engine = slo_engine
+        self._clock = clock
+        self.interval_s = (
+            _env_float("COVALENT_TPU_AUTOSCALE_INTERVAL_S", 1.0)
+            if interval_s is None else float(interval_s)
+        )
+        self.up_cooldown_s = (
+            _env_float("COVALENT_TPU_AUTOSCALE_UP_COOLDOWN_S", 3.0)
+            if up_cooldown_s is None else float(up_cooldown_s)
+        )
+        self.down_cooldown_s = (
+            _env_float("COVALENT_TPU_AUTOSCALE_COOLDOWN_S", 30.0)
+            if down_cooldown_s is None else float(down_cooldown_s)
+        )
+        self.idle_ttl_s = (
+            _env_float("COVALENT_TPU_AUTOSCALE_IDLE_TTL_S", 300.0)
+            if idle_ttl_s is None else float(idle_ttl_s)
+        )
+        self.lead_override_s = (
+            _env_float("COVALENT_TPU_AUTOSCALE_LEAD_S", 0.0)
+            if lead_s is None else float(lead_s)
+        )
+        self.trend_window_s = (
+            _env_float("COVALENT_TPU_AUTOSCALE_TREND_WINDOW_S", 30.0)
+            if trend_window_s is None else float(trend_window_s)
+        )
+        #: lead-time fallback before any prewarm has been measured, and
+        #: the bounds the measurement is clamped into.
+        self.default_lead_s = 2.0
+        self.max_lead_s = 30.0
+
+        self._pools: dict[str, PoolPolicy] = {}
+        self._sets: list[tuple[Any, ReplicaSetPolicy]] = []
+        self._state: dict[str, _ResourceState] = {}
+        #: SLO name -> (state, metric) updated by the alert hook (the
+        #: engine evaluates on the history sampler thread) and refreshed
+        #: from the engine's last evaluation each tick.
+        self._burning: dict[str, str] = {}
+        self._decisions: collections.deque = collections.deque(maxlen=64)
+        self.decision_counts: dict[str, int] = {}
+        self._prewarm_tasks: dict[str, asyncio.Task] = {}
+        self._suspended_seen: set[str] = set()
+        self._closing = False
+        self._task: asyncio.Task | None = None
+        self._wake: asyncio.Event | None = None
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._hooked_engine: Any = None
+
+        ensure_ops_server()
+        self._ops_name = f"autoscale:{next(self._ids)}"
+        ops_name = self._ops_name
+        self_ref = weakref.ref(
+            self, lambda _ref: unregister_status_provider(ops_name)
+        )
+
+        def _ops_provider():
+            controller = self_ref()
+            return controller.status() if controller is not None else None
+
+        register_status_provider(ops_name, _ops_provider)
+        self._attach_engine(slo_engine)
+
+    # -- wiring -------------------------------------------------------------
+
+    def _attach_engine(self, engine: Any) -> None:
+        """Subscribe the burn hook once an engine exists (lazy: the
+        process-wide engine may start after the controller)."""
+        if engine is None or engine is self._hooked_engine:
+            return
+        self._engine = engine
+        self._hooked_engine = engine
+        engine.add_alert_hook(self._on_slo_alert)
+
+    def _on_slo_alert(self, name: str, state: str, info: dict) -> None:
+        """SLO engine alert hook (called from the history sampler
+        thread): record the burn and wake the tick loop immediately —
+        an incident should not wait out the remainder of an interval."""
+        if self._closing:
+            return
+        if state == "burning":
+            self._burning[name] = str(info.get("metric") or "")
+        else:
+            self._burning.pop(name, None)
+        loop, wake = self._loop, self._wake
+        if loop is not None and wake is not None and not loop.is_closed():
+            try:
+                loop.call_soon_threadsafe(wake.set)
+            except RuntimeError:
+                pass
+
+    def manage_pool(
+        self, pool: "Pool | str", **policy: Any
+    ) -> PoolPolicy:
+        """Put one pool under closed-loop capacity control."""
+        name = pool if isinstance(pool, str) else pool.name
+        if self.registry is None or self.registry.get(name) is None:
+            raise ValueError(f"unknown pool {name!r}")
+        pol = PoolPolicy(**policy)
+        self._pools[name] = pol
+        return pol
+
+    def manage_replica_set(
+        self, replica_set: Any, **policy: Any
+    ) -> ReplicaSetPolicy:
+        """Put one serving replica set under closed-loop replica control.
+
+        ``slo_critical=True`` (the default) additionally pins the set's
+        future replica placement to stable pools (``prefer_stable``) —
+        SLO-critical serving must not sit on capacity that spot reclaims
+        can yank mid-burn.
+        """
+        pol = ReplicaSetPolicy(**policy)
+        self._sets = [
+            (rset, p) for rset, p in self._sets if rset is not replica_set
+        ] + [(replica_set, pol)]
+        if pol.slo_critical:
+            try:
+                replica_set.prefer_stable = True
+            except Exception:  # noqa: BLE001 - duck-typed stubs
+                pass
+        return pol
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        """Start the tick loop on the running event loop (idempotent)."""
+        loop = asyncio.get_running_loop()
+        if self._task is not None and not self._task.done():
+            return
+        self._loop = loop
+        self._wake = asyncio.Event()
+        self._task = loop.create_task(self._run())
+
+    async def _run(self) -> None:
+        while not self._closing:
+            try:
+                await self.tick()
+            except asyncio.CancelledError:
+                raise
+            except Exception as err:  # noqa: BLE001 - loop must survive
+                app_log.warning("autoscale tick failed: %s", err)
+            try:
+                await asyncio.wait_for(
+                    self._wake.wait(), self.interval_s
+                )
+            except asyncio.TimeoutError:
+                pass
+            else:
+                self._wake.clear()
+
+    async def close(self) -> None:
+        self._closing = True
+        unregister_status_provider(self._ops_name)
+        if self._hooked_engine is not None:
+            # Detach the alert hook: the bound method strongly
+            # references this controller, so a process-wide engine would
+            # otherwise keep every closed controller (and its fleet)
+            # alive and keep feeding it burn transitions forever.
+            remover = getattr(
+                self._hooked_engine, "remove_alert_hook", None
+            )
+            if remover is not None:
+                remover(self._on_slo_alert)
+            self._hooked_engine = None
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._task = None
+        for task in list(self._prewarm_tasks.values()):
+            task.cancel()
+        self._prewarm_tasks.clear()
+
+    # -- signals ------------------------------------------------------------
+
+    def _refresh_burning(self) -> None:
+        """Fold the engine's last evaluation into the hook-fed state (the
+        hook only sees *transitions*; a controller attached mid-burn
+        must still see it)."""
+        if self._engine is None:
+            from ..obs import slo as _slo
+
+            self._attach_engine(_slo.get_engine())
+        engine = self._engine
+        if engine is None:
+            return
+        try:
+            view = engine.status()
+        except Exception:  # noqa: BLE001 - observability never fatal
+            return
+        for name, info in (view.get("slos") or {}).items():
+            if info.get("state") == "burning":
+                self._burning[name] = str(info.get("metric") or "")
+            else:
+                self._burning.pop(name, None)
+
+    def _burning_kinds(self) -> tuple[bool, bool]:
+        """(serving SLO burning, dispatch/queue SLO burning).
+
+        ``dict()`` snapshots in one C-level (GIL-atomic) step: the SLO
+        alert hook mutates ``_burning`` from the history sampler thread,
+        and iterating the live dict here would raise "changed size
+        during iteration" exactly when a burn transition fires — losing
+        the one tick that was supposed to react to it.
+        """
+        metrics = list(dict(self._burning).values())
+        serving = any(
+            metric.startswith("covalent_tpu_serve") for metric in metrics
+        )
+        dispatch = any(
+            not metric.startswith("covalent_tpu_serve")
+            for metric in metrics
+        )
+        return serving, dispatch
+
+    def _slope(
+        self, metric: str, label_filter: dict[str, str] | None = None
+    ) -> float:
+        """Summed per-second trend slope across a metric's series."""
+        try:
+            view = self.history.query(
+                metric, window_s=self.trend_window_s, agg="trend"
+            )
+        except Exception:  # noqa: BLE001 - sensors must not crash the loop
+            return 0.0
+        total = 0.0
+        for key, stats in (view.get("series") or {}).items():
+            if label_filter:
+                try:
+                    labels = json.loads(key) if key else {}
+                except ValueError:
+                    labels = {}
+                if any(
+                    str(labels.get(k)) != str(v)
+                    for k, v in label_filter.items()
+                ):
+                    continue
+            total += float(stats.get("slope_per_s") or 0.0)
+        return total
+
+    def _lead_for(self, pool_name: str = "") -> float:
+        """Predictive lead time: the measured cold start for this pool.
+
+        Reads the ``covalent_tpu_prewarm_seconds`` histogram — per-pool
+        mean when that pool has measurements, the all-pools mean
+        otherwise, the shipped default when nothing was ever measured.
+        An explicit override (``COVALENT_TPU_AUTOSCALE_LEAD_S`` /
+        ``lead_s=``) wins unconditionally.
+        """
+        if self.lead_override_s > 0:
+            return self.lead_override_s
+        hist = REGISTRY.get("covalent_tpu_prewarm_seconds")
+        if hist is None:
+            return self.default_lead_s
+        pool_mean = total_sum = 0.0
+        pool_count = total_count = 0
+        try:
+            for labels, child in hist._series():
+                total_sum += child.sum
+                total_count += child.count
+                if labels.get("pool") == pool_name and child.count:
+                    pool_mean = child.sum / child.count
+                    pool_count = child.count
+        except Exception:  # noqa: BLE001 - metrics views are best-effort
+            return self.default_lead_s
+        if pool_count:
+            measured = pool_mean
+        elif total_count:
+            measured = total_sum / total_count
+        else:
+            return self.default_lead_s
+        return min(self.max_lead_s, max(self.interval_s, measured))
+
+    def _queue_signals(self) -> tuple[int, float]:
+        """(current fleet queue depth, trend slope in items/s)."""
+        depth = 0
+        if self.scheduler is not None:
+            try:
+                depth = int(self.scheduler.queue.depth)
+            except Exception:  # noqa: BLE001 - duck-typed schedulers
+                depth = 0
+        return depth, self._slope("covalent_tpu_queue_depth")
+
+    # -- the loop body -------------------------------------------------------
+
+    async def tick(self) -> list[dict[str, Any]]:
+        """One sensor→decision→actuation round; returns the decisions."""
+        now = self._clock()
+        self._refresh_burning()
+        serving_burn, dispatch_burn = self._burning_kinds()
+        decisions: list[dict[str, Any]] = []
+        decisions += await self._tick_pools(now, dispatch_burn)
+        decisions += await self._tick_sets(now, serving_burn)
+        return decisions
+
+    def _record(
+        self, action: str, resource: str, target: int | None,
+        reason: str, now: float,
+    ) -> dict[str, Any]:
+        decision = {
+            "action": action,
+            "resource": resource,
+            **({"target": target} if target is not None else {}),
+            "reason": reason,
+            "ts": round(now, 3),
+        }
+        AUTOSCALE_DECISIONS_TOTAL.labels(action=action).inc()
+        self.decision_counts[action] = (
+            self.decision_counts.get(action, 0) + 1
+        )
+        self._decisions.append(decision)
+        obs_events.emit("autoscale.decision", **decision)
+        return decision
+
+    def _state_of(self, resource: str) -> _ResourceState:
+        state = self._state.get(resource)
+        if state is None:
+            state = self._state[resource] = _ResourceState()
+        return state
+
+    def _up_ready(self, state: _ResourceState, now: float) -> bool:
+        return (
+            state.last_up is None
+            or now - state.last_up >= self.up_cooldown_s
+        )
+
+    def _down_ready(
+        self, state: _ResourceState, now: float, below: bool
+    ) -> bool:
+        """Scale-down gate: utilization must sit below the release band
+        for the whole down-cooldown (sustained, not instantaneous), and
+        the down itself re-arms the dwell."""
+        if not below:
+            state.below_since = None
+            return False
+        if state.below_since is None:
+            state.below_since = now
+        if now - state.below_since < self.down_cooldown_s:
+            return False
+        return (
+            state.last_down is None
+            or now - state.last_down >= self.down_cooldown_s
+        ) and (
+            state.last_up is None
+            or now - state.last_up >= self.down_cooldown_s
+        )
+
+    # -- pools ---------------------------------------------------------------
+
+    async def _tick_pools(
+        self, now: float, dispatch_burn: bool
+    ) -> list[dict[str, Any]]:
+        decisions: list[dict[str, Any]] = []
+        if not self._pools or self.registry is None:
+            return decisions
+        depth, slope = self._queue_signals()
+        managed = [
+            (name, self.registry.get(name), pol)
+            for name, pol in self._pools.items()
+        ]
+        managed = [(n, p, pol) for n, p, pol in managed if p is not None]
+        if not managed:
+            return decisions
+        in_use = sum(p.in_use for _n, p, _pol in managed)
+        capacity = sum(p.capacity for _n, p, _pol in managed)
+        # The predictive demand: everything running plus the backlog the
+        # trend says will exist once fresh capacity could be warm.
+        lead = max(self._lead_for(n) for n, _p, _pol in managed)
+        predicted_backlog = max(0.0, depth + max(0.0, slope) * lead)
+        demand = in_use + math.ceil(predicted_backlog)
+        if dispatch_burn:
+            # A burning dispatch/queue SLO is a demand signal in itself:
+            # force at least one step of growth past current capacity.
+            demand = max(demand, capacity + 1)
+        AUTOSCALE_TARGET.labels(resource="pools").set(demand)
+
+        if demand > capacity:
+            # Demand is high: every pool's sustained-below dwell re-arms,
+            # even for pools whose up-cooldown blocks action this tick —
+            # otherwise an oscillating queue could bank "below" time
+            # across spikes and flap a scale-down in between.
+            for name, _p, _pol in managed:
+                self._state_of(f"pool:{name}").below_since = None
+            # Scale-up order: spot pools first — batch/electron overflow
+            # belongs on cheap capacity, keeping stable slots free for
+            # the serving tier pinned there.
+            deficit = demand - capacity
+            for name, pool, pol in sorted(
+                managed, key=lambda entry: (not entry[1].preemptible,
+                                            entry[0]),
+            ):
+                if deficit <= 0:
+                    break
+                state = self._state_of(f"pool:{name}")
+                if pool.capacity >= pol.max_capacity:
+                    continue
+                if not self._up_ready(state, now):
+                    continue
+                # One full step per pool per tick (never a partial step
+                # even when the deficit is smaller: capacity is cheap to
+                # shed later, a second reaction round trip is not).
+                target = min(pol.max_capacity, pool.capacity + pol.step)
+                grown = target - pool.capacity
+                if grown <= 0:
+                    continue
+                pool.capacity = target
+                state.last_up = now
+                state.below_since = None
+                deficit -= grown
+                decisions.append(self._record(
+                    "pool_up", name, target,
+                    "slo_burn" if dispatch_burn else "queue_trend", now,
+                ))
+        elif demand < capacity and not dispatch_burn:
+            # Hysteresis: released capacity only after the demand sat a
+            # full dwell below (capacity - step) — never mid-burn.
+            for name, pool, pol in sorted(
+                managed, key=lambda entry: -entry[1].free_slots,
+            ):
+                state = self._state_of(f"pool:{name}")
+                below = demand <= capacity - pol.step
+                if pool.capacity <= pol.min_capacity:
+                    state.below_since = None
+                    continue
+                if not self._down_ready(state, now, below):
+                    continue
+                target = max(pol.min_capacity, pool.capacity - pol.step)
+                shrunk = pool.capacity - target  # may be < step (clamped)
+                pool.capacity = target
+                state.last_down = now
+                state.below_since = None
+                capacity -= shrunk
+                decisions.append(self._record(
+                    "pool_down", name, target, "idle_capacity", now,
+                ))
+        else:
+            # demand == capacity (or a burn): not "below" — every pool's
+            # sustained-below dwell re-arms.  Without this, a fleet
+            # pinned at max capacity under oscillating demand would bank
+            # quiet ticks across spikes and flap a scale-down.
+            for name, _p, _pol in managed:
+                self._state_of(f"pool:{name}").below_since = None
+        decisions += await self._scale_pools_to_zero(
+            now, depth, slope, dispatch_burn, managed
+        )
+        return decisions
+
+    async def _scale_pools_to_zero(
+        self,
+        now: float,
+        depth: int,
+        slope: float,
+        dispatch_burn: bool,
+        managed: list,
+    ) -> list[dict[str, Any]]:
+        """Idle-TTL gang teardown + predictive re-warm per pool."""
+        decisions: list[dict[str, Any]] = []
+        demand_coming = (
+            depth > 0 or slope > 0 or dispatch_burn
+        )
+        for name, pool, pol in managed:
+            state = self._state_of(f"pool:{name}")
+            ttl = self.idle_ttl_s if pol.idle_ttl_s is None else pol.idle_ttl_s
+            idle = (
+                pool.in_use == 0
+                and pool.warm
+                and pool.serve_session_count() == 0
+                and not demand_coming
+            )
+            if not idle:
+                state.idle_since = None
+            elif ttl > 0:
+                if state.idle_since is None:
+                    state.idle_since = now
+                elif now - state.idle_since >= ttl:
+                    if await pool.teardown():
+                        decisions.append(self._record(
+                            "gang_teardown", name, None,
+                            f"idle>{ttl:g}s", now,
+                        ))
+                    state.idle_since = None
+            # Predictive re-warm: demand is trending in and this pool's
+            # gang is cold — start the dial/pre-flight/agent warm-up now
+            # so the lead time is already paid when placement needs it.
+            # The up-cooldown paces retries when the dial keeps failing.
+            if (
+                demand_coming
+                and not pool.warm
+                and not pool.fallback
+                and name not in self._prewarm_tasks
+                and (
+                    state.last_prewarm is None
+                    or now - state.last_prewarm >= self.up_cooldown_s
+                )
+            ):
+                state.last_prewarm = now
+                task = asyncio.ensure_future(pool.prewarm())
+                self._prewarm_tasks[name] = task
+                task.add_done_callback(
+                    lambda t, n=name: (
+                        self._prewarm_tasks.pop(n, None),
+                        None if t.cancelled() else t.exception(),
+                    )
+                )
+                decisions.append(self._record(
+                    "prewarm", name, None,
+                    "slo_burn" if dispatch_burn else "queue_trend", now,
+                ))
+        return decisions
+
+    # -- replica sets --------------------------------------------------------
+
+    async def _tick_sets(
+        self, now: float, serving_burn: bool
+    ) -> list[dict[str, Any]]:
+        decisions: list[dict[str, Any]] = []
+        for rset, pol in list(self._sets):
+            try:
+                decisions += await self._tick_one_set(
+                    rset, pol, now, serving_burn
+                )
+            except Exception as err:  # noqa: BLE001 - one bad set
+                app_log.warning(
+                    "autoscale: replica set %s tick failed: %s",
+                    getattr(rset, "name", "?"), err,
+                )
+        return decisions
+
+    async def _tick_one_set(
+        self, rset: Any, pol: ReplicaSetPolicy, now: float,
+        serving_burn: bool,
+    ) -> list[dict[str, Any]]:
+        decisions: list[dict[str, Any]] = []
+        name = getattr(rset, "name", "set")
+        resource = f"set:{name}"
+        state = self._state_of(resource)
+        if getattr(rset, "state", "") == "closed":
+            self._sets = [
+                (r, p) for r, p in self._sets if r is not rset
+            ]
+            return decisions
+        live = int(getattr(rset, "live_replicas", 0))
+        suspended = bool(getattr(rset, "suspended", False))
+        if resource in self._suspended_seen and live > 0:
+            # The set re-warmed itself on demand (scale-to-zero exit
+            # happens in the request path, not here): account for it so
+            # operators see the resume in the same decision stream.
+            self._suspended_seen.discard(resource)
+            decisions.append(self._record(
+                "set_resume", name, live, "demand_rewarm", now,
+            ))
+        load = int(getattr(rset, "in_flight", 0)) + int(
+            getattr(rset, "queued", 0)
+        )
+        slots = int(getattr(rset, "decode_slots", 0))
+        per_replica = (slots / live) if live and slots else 0.0
+        slope = self._slope(
+            "covalent_tpu_serve_replica_in_flight", {"set": name}
+        )
+        lead = self._lead_for("")
+        predicted = load + max(0.0, slope) * lead
+        desired = (
+            math.ceil(predicted / (per_replica * pol.target_utilization))
+            if per_replica else live
+        )
+        desired = min(pol.max_replicas, max(pol.min_replicas, desired))
+        if serving_burn and pol.slo_critical:
+            # The burn path: a burning serving SLO forces one step of
+            # growth regardless of what the trend predicts — clearing
+            # the burn is the point of having warm headroom.
+            desired = max(desired, min(pol.max_replicas, live + 1))
+        AUTOSCALE_TARGET.labels(resource=resource).set(desired)
+
+        if live == 0:
+            if suspended:
+                # Suspended set: demand re-warms it through its own
+                # request path; the controller only tracks it.
+                self._suspended_seen.add(resource)
+                return decisions
+            # Every replica died WITHOUT a suspension (all past their
+            # retry budgets): the request path raises for such a set, so
+            # the controller is the only thing that can honor the
+            # policy's replica floor — re-open to it, paced by the
+            # up-cooldown so a dead fleet is retried, not hammered.
+            if self._up_ready(state, now):
+                target = max(1, pol.min_replicas)
+                try:
+                    revived = int(await rset.scale_to(target))
+                except Exception as err:  # noqa: BLE001 - retried next tick
+                    app_log.warning(
+                        "autoscale: reviving dead set %s failed: %s",
+                        name, err,
+                    )
+                    revived = 0
+                state.last_up = now
+                if revived:
+                    decisions.append(self._record(
+                        "set_up", name, target, "revive_dead", now,
+                    ))
+            return decisions
+        if desired > live:
+            # High demand re-arms the sustained-below dwell regardless of
+            # whether the up-cooldown lets this tick act (no flapping on
+            # oscillating load).
+            state.below_since = None
+            state.idle_since = None
+            state.up_pending += 1
+            burn_driven = serving_burn and pol.slo_critical
+            # Trend/load scale-ups wait out the stabilization window (a
+            # one-tick in-flight spike is not demand); a burning SLO
+            # acts immediately — that is what the headroom is FOR.
+            if (
+                not burn_driven
+                and state.up_pending < pol.up_stabilization_ticks
+            ):
+                return decisions
+            if self._up_ready(state, now):
+                await rset.scale_to(desired)
+                state.last_up = now
+                state.up_pending = 0
+                decisions.append(self._record(
+                    "set_up", name, desired,
+                    "slo_burn" if serving_burn else "load_trend", now,
+                ))
+            return decisions
+        state.up_pending = 0
+        # Scale-down / scale-to-zero side: vetoed outright mid-burn.
+        if serving_burn and pol.slo_critical:
+            state.below_since = None
+            state.idle_since = None
+            return decisions
+        utilization = (load / slots) if slots else 0.0
+        ttl = self.idle_ttl_s if pol.idle_ttl_s is None else pol.idle_ttl_s
+        if pol.min_replicas == 0 and ttl > 0 and load == 0 and slope <= 0:
+            if state.idle_since is None:
+                state.idle_since = now
+            elif now - state.idle_since >= ttl:
+                await rset.scale_to(0)
+                self._suspended_seen.add(resource)
+                state.idle_since = None
+                state.below_since = None
+                state.last_down = now
+                decisions.append(self._record(
+                    "set_suspend", name, 0, f"idle>{ttl:g}s", now,
+                ))
+                return decisions
+        else:
+            state.idle_since = None
+        if desired < live:
+            below = utilization < pol.scale_down_utilization
+            if self._down_ready(state, now, below):
+                target = max(desired, max(1, pol.min_replicas), live - 1)
+                if target < live:
+                    await rset.scale_to(target)
+                    state.last_down = now
+                    state.below_since = None
+                    decisions.append(self._record(
+                        "set_down", name, target, "low_utilization", now,
+                    ))
+        else:
+            state.below_since = None
+        return decisions
+
+    # -- observability -------------------------------------------------------
+
+    def status(self) -> dict[str, Any]:
+        """The ``autoscaler`` section of the ops ``/status`` payload."""
+        now = self._clock()
+
+        def cooldown_view(resource: str) -> dict[str, Any]:
+            state = self._state.get(resource)
+            if state is None:
+                return {}
+            view: dict[str, Any] = {}
+            if state.last_up is not None:
+                view["since_up_s"] = round(now - state.last_up, 3)
+            if state.last_down is not None:
+                view["since_down_s"] = round(now - state.last_down, 3)
+            if state.below_since is not None:
+                view["below_for_s"] = round(now - state.below_since, 3)
+            if state.idle_since is not None:
+                view["idle_for_s"] = round(now - state.idle_since, 3)
+            return view
+
+        pools: dict[str, Any] = {}
+        for name, pol in self._pools.items():
+            pool = self.registry.get(name) if self.registry else None
+            pools[name] = {
+                "capacity": pool.capacity if pool else None,
+                "in_use": pool.in_use if pool else None,
+                "warm": pool.warm if pool else None,
+                "min": pol.min_capacity,
+                "max": pol.max_capacity,
+                "lead_s": round(self._lead_for(name), 3),
+                "cooldown": cooldown_view(f"pool:{name}"),
+            }
+        sets: dict[str, Any] = {}
+        for rset, pol in self._sets:
+            name = getattr(rset, "name", "set")
+            sets[name] = {
+                "replicas": int(getattr(rset, "live_replicas", 0)),
+                "suspended": bool(getattr(rset, "suspended", False)),
+                "in_flight": int(getattr(rset, "in_flight", 0)),
+                "queued": int(getattr(rset, "queued", 0)),
+                "min": pol.min_replicas,
+                "max": pol.max_replicas,
+                "slo_critical": pol.slo_critical,
+                "cooldown": cooldown_view(f"set:{name}"),
+            }
+        return {
+            "interval_s": self.interval_s,
+            "up_cooldown_s": self.up_cooldown_s,
+            "down_cooldown_s": self.down_cooldown_s,
+            "idle_ttl_s": self.idle_ttl_s,
+            # dict() snapshot: the alert hook writes from another thread.
+            "burning": sorted(dict(self._burning)),
+            "pools": pools,
+            "sets": sets,
+            "decisions": list(self._decisions)[-16:],
+            "decision_counts": dict(self.decision_counts),
+        }

@@ -163,7 +163,17 @@ class Pool:
         self.in_use = 0
         #: electrons ever placed here (per-pool placement breakdown).
         self.placed_total = 0
+        if executor is not None:
+            self._label_executor(executor)
         self._publish_slots()
+
+    def _label_executor(self, executor: Any) -> None:
+        """Stamp the pool name onto the executor so per-pool metrics
+        (prewarm cold-start durations) key on this pool."""
+        try:
+            executor.pool_label = self.name
+        except Exception:  # noqa: BLE001 - stub executors may refuse
+            pass
 
     # -- identity -----------------------------------------------------------
 
@@ -209,6 +219,7 @@ class Pool:
     def executor(self) -> Any:
         if self._executor is None:
             self._executor = self._factory(self.spec)
+            self._label_executor(self._executor)
         return self._executor
 
     @property
@@ -304,6 +315,20 @@ class Pool:
         POOL_SLOTS.labels(pool=self.name, state="in_use").set(self.in_use)
         POOL_SLOTS.labels(pool=self.name, state="free").set(self.free_slots)
 
+    def serve_session_count(self) -> int:
+        """Live serving sessions pinned to this pool's gang (0 on cold
+        or stub executors) — the autoscale controller's idle probe: a
+        pool with sessions is never scale-to-zero eligible."""
+        if self._executor is None:
+            return 0
+        probe = getattr(self._executor, "serve_sessions", None)
+        if probe is None:
+            return 0
+        try:
+            return len(probe())
+        except Exception:  # noqa: BLE001 - idle probes must not crash
+            return 0
+
     # -- lifecycle ----------------------------------------------------------
 
     async def prewarm(self) -> bool:
@@ -312,6 +337,26 @@ class Pool:
         if warmer is None:
             return False
         return bool(await warmer())
+
+    async def teardown(self) -> bool:
+        """Scale-to-zero actuator: drop this pool's warm gang.
+
+        Refuses while any capacity slot is in use (the executor
+        additionally refuses while electrons or serving sessions are
+        live); a cold or stub executor has nothing to tear down.  The
+        next placement — or a controller-driven :meth:`prewarm` ahead of
+        predicted demand — re-dials the gang from cold.
+        """
+        if self._executor is None or self.in_use > 0:
+            return False
+        down = getattr(self._executor, "teardown_gang", None)
+        if down is None:
+            return False
+        try:
+            return bool(await down())
+        except Exception as err:  # noqa: BLE001 - teardown is best-effort
+            app_log.warning("pool %s gang teardown failed: %s", self.name, err)
+            return False
 
     async def close(self) -> None:
         if self._executor is None:

@@ -79,6 +79,13 @@ class LocalPoolAutoscaler(AutoscaleHook):
     adds ``step`` slots (bounded by ``max_capacity``), scale-down removes
     them (never below ``min_capacity``).  In-flight electrons are never
     interrupted — capacity only bounds NEW placements.
+
+    ``cooldown_s`` is the anti-thrash dwell: after any resize, further
+    resizes are suppressed (counted in ``suppressed``) until the dwell
+    elapses.  Without it, a queue oscillating around the watermarks can
+    resize capacity back and forth on consecutive pump ticks — each
+    flap re-publishing slot gauges and (for a cloud implementation)
+    churning real capacity.  ``clock`` is injectable for tests.
     """
 
     def __init__(
@@ -87,20 +94,38 @@ class LocalPoolAutoscaler(AutoscaleHook):
         step: int = 1,
         max_capacity: int = 8,
         min_capacity: int = 1,
+        cooldown_s: float = 5.0,
+        clock: Callable[[], float] = time.monotonic,
     ) -> None:
         self.pool_name = pool_name
         self.step = max(1, int(step))
         self.max_capacity = int(max_capacity)
         self.min_capacity = max(1, int(min_capacity))
+        self.cooldown_s = max(0.0, float(cooldown_s))
+        self._clock = clock
+        self._last_resize: float | None = None
         self.scale_ups = 0
         self.scale_downs = 0
+        #: watermark firings ignored because the dwell had not elapsed.
+        self.suppressed = 0
+
+    def _in_cooldown(self) -> bool:
+        if self._last_resize is None or self.cooldown_s <= 0:
+            return False
+        if self._clock() - self._last_resize < self.cooldown_s:
+            self.suppressed += 1
+            return True
+        return False
 
     def on_high(self, depth: int, registry: PoolRegistry) -> None:
         pool = registry.get(self.pool_name)
         if pool is None or pool.capacity >= self.max_capacity:
             return
+        if self._in_cooldown():
+            return
         pool.capacity = min(self.max_capacity, pool.capacity + self.step)
         self.scale_ups += 1
+        self._last_resize = self._clock()
         obs_events.emit(
             "fleet.scale_up",
             pool=self.pool_name,
@@ -112,8 +137,11 @@ class LocalPoolAutoscaler(AutoscaleHook):
         pool = registry.get(self.pool_name)
         if pool is None or pool.capacity <= self.min_capacity:
             return
+        if self._in_cooldown():
+            return
         pool.capacity = max(self.min_capacity, pool.capacity - self.step)
         self.scale_downs += 1
+        self._last_resize = self._clock()
         obs_events.emit(
             "fleet.scale_down",
             pool=self.pool_name,
@@ -394,8 +422,12 @@ class FleetScheduler:
         # Spot-capacity hint: stable pools win for electrons that did not
         # opt into preemptible placement (``spot_ok`` metadata) — spot
         # pools carry checkpoint-tolerant work, SLO-critical work pins to
-        # stable capacity.  Subordinate to the accelerator-over-fallback
-        # preference: a spot TPU still beats the local CPU fallback.
+        # stable capacity.  The preference is SYMMETRIC: a ``spot_ok``
+        # electron is actively PUSHED onto spot pools (batch traffic
+        # belongs on cheap capacity, keeping stable slots free for the
+        # SLO-critical serving the autoscale controller pins there), not
+        # merely allowed on them.  Subordinate to the accelerator-over-
+        # fallback preference: a spot TPU still beats the CPU fallback.
         spot_ok = bool(
             item is not None and item.task_metadata.get("spot_ok")
         )
@@ -404,7 +436,7 @@ class FleetScheduler:
             return (
                 0 if pool.name == preferred else 1,
                 1 if pool.fallback else 0,
-                0 if (spot_ok or not pool.preemptible) else 1,
+                0 if pool.preemptible == spot_ok else 1,
                 0 if pool.warm else 1,
                 0 if pool.holds_fn_digest(digest) else 1,
                 -pool.free_slots,

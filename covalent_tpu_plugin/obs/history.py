@@ -202,6 +202,7 @@ class MetricsHistory:
         metric: str,
         window_s: float = 60.0,
         labels: dict[str, str] | None = None,
+        agg: str = "",
     ) -> dict[str, Any]:
         """Windowed, kind-aware view of one metric.
 
@@ -210,6 +211,13 @@ class MetricsHistory:
         stats + timeline.  ``labels`` (exact match) restricts to one
         series.  An unknown metric or empty window answers with zero
         samples rather than raising — dashboards poll speculatively.
+
+        ``agg="trend"`` swaps the per-series stats for a least-squares
+        **slope** over the window — the predictive-autoscaling primitive
+        ("is the queue depth growing, and how fast"): gauges report
+        ``slope_per_s`` of the raw value, counters and histograms report
+        the slope of their per-second *rate* (a positive value means
+        traffic is accelerating, not merely flowing).
         """
         window = self._window(window_s)
         out: dict[str, Any] = {
@@ -219,6 +227,8 @@ class MetricsHistory:
             "kind": None,
             "series": {},
         }
+        if agg:
+            out["agg"] = agg
         if not window:
             return out
         wanted = _series_key(labels) if labels else None
@@ -249,13 +259,79 @@ class MetricsHistory:
                 points = [(window_start, zeros)] + points
             elif key not in first_series and kind == "counter":
                 points = [(window_start, 0.0)] + points
-            if kind == "histogram":
+            if agg == "trend":
+                out["series"][key] = self._trend_stats(kind, points)
+            elif kind == "histogram":
                 out["series"][key] = self._histogram_stats(metric, points)
             elif kind == "counter":
                 out["series"][key] = self._counter_stats(points)
             else:
                 out["series"][key] = self._gauge_stats(points)
         return out
+
+    # -- trend (agg="trend") -------------------------------------------------
+
+    @staticmethod
+    def _slope_of(points: list[tuple[float, float]]) -> float:
+        """Least-squares slope (units per second) over ``(ts, value)``.
+
+        Fewer than two points — or a degenerate time axis — has no
+        trend; the answer is 0.0, never an exception (the controller
+        polls this every tick, including on freshly started rings).
+        """
+        n = len(points)
+        if n < 2:
+            return 0.0
+        t0 = points[0][0]
+        ts = [t - t0 for t, _ in points]
+        vs = [float(v) for _, v in points]
+        mean_t = sum(ts) / n
+        mean_v = sum(vs) / n
+        var_t = sum((t - mean_t) ** 2 for t in ts)
+        if var_t <= 0:
+            return 0.0
+        cov = sum(
+            (t - mean_t) * (v - mean_v) for t, v in zip(ts, vs)
+        )
+        return cov / var_t
+
+    @classmethod
+    def _trend_stats(
+        cls, kind: str | None, points: list[tuple[float, Any]]
+    ) -> dict[str, Any]:
+        """Per-series trend: value slope for gauges, rate slope for
+        cumulative kinds (counters by value delta, histograms by
+        observation-count delta).  Counter resets (value decreasing)
+        drop the torn interval instead of reporting a negative burst."""
+        if kind == "gauge" or kind is None:
+            values = [(ts, float(v)) for ts, v in points]
+            return {
+                "last": values[-1][1] if values else 0.0,
+                "slope_per_s": cls._slope_of(values),
+            }
+        # Cumulative kinds: build the per-interval rate series at
+        # interval midpoints, then fit the slope of THAT — "is the rate
+        # itself rising" is the question predictive scaling asks.
+        rates: list[tuple[float, float]] = []
+        increase = 0.0
+        for (t_a, p_a), (t_b, p_b) in zip(points, points[1:]):
+            dt = t_b - t_a
+            if dt <= 0:
+                continue
+            v_a = p_a[0] if kind == "histogram" else float(p_a)
+            v_b = p_b[0] if kind == "histogram" else float(p_b)
+            if v_b < v_a:  # reset between samples: skip the torn interval
+                continue
+            increase += v_b - v_a
+            rates.append(((t_a + t_b) / 2.0, (v_b - v_a) / dt))
+        span = max(points[-1][0] - points[0][0], 1e-9)
+        return {
+            "increase": increase,
+            "rate_per_s": (
+                increase / span if len(points) > 1 else 0.0
+            ),
+            "slope_per_s": cls._slope_of(rates),
+        }
 
     @staticmethod
     def _gauge_stats(points: list[tuple[float, float]]) -> dict[str, Any]:

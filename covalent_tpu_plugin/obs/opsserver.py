@@ -252,6 +252,7 @@ class OpsServer:
         with _providers_lock:
             providers = dict(_providers)
         fleet_views: dict[str, Any] = {}
+        autoscale_views: dict[str, Any] = {}
         for name, provider in providers.items():
             try:
                 view = provider()
@@ -280,12 +281,21 @@ class OpsServer:
                 # live ones nest by provider name instead of silently
                 # overwriting each other.
                 fleet_views[name] = view
+            elif name.partition(":")[0] == "autoscale" and view:
+                # The autoscale controller's live view (targets, last
+                # decisions, cooldown state) gets the same first-class
+                # treatment as the fleet section.
+                autoscale_views[name] = view
             elif view:
                 out.setdefault("providers", {})[name] = view
         if len(fleet_views) == 1:
             out["fleet"] = next(iter(fleet_views.values()))
         elif fleet_views:
             out["fleet"] = fleet_views
+        if len(autoscale_views) == 1:
+            out["autoscaler"] = next(iter(autoscale_views.values()))
+        elif autoscale_views:
+            out["autoscaler"] = autoscale_views
         return out
 
     def history(self, params: dict) -> dict[str, Any]:
@@ -293,8 +303,10 @@ class OpsServer:
 
         ``?metric=<name>&window=<seconds>`` answers the kind-aware query
         (rates for counters, percentiles for histograms, timelines for
-        gauges); without ``metric`` the ring describes itself so
-        dashboards can discover what is queryable.
+        gauges); ``&agg=trend`` swaps the stats for per-window
+        least-squares slopes (the autoscale controller's question);
+        without ``metric`` the ring describes itself so dashboards can
+        discover what is queryable.
         """
         metric = (params.get("metric") or [""])[0]
         if not metric:
@@ -303,7 +315,8 @@ class OpsServer:
             window_s = float((params.get("window") or ["60"])[0])
         except ValueError:
             window_s = 60.0
-        return _history.HISTORY.query(metric, window_s=window_s)
+        agg = (params.get("agg") or [""])[0]
+        return _history.HISTORY.query(metric, window_s=window_s, agg=agg)
 
     def slo(self) -> dict[str, Any]:
         """The /slo payload: a fresh evaluation of every configured SLO."""

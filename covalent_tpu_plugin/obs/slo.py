@@ -252,6 +252,17 @@ class SLOEngine:
         if hook not in self._alert_hooks:
             self._alert_hooks.append(hook)
 
+    def remove_alert_hook(
+        self, hook: Callable[[str, str, dict], None]
+    ) -> None:
+        """Detach a hook (no-op when absent) — a closing subscriber
+        (e.g. an autoscale controller) must not be kept alive, or kept
+        firing, by a process-wide engine."""
+        try:
+            self._alert_hooks.remove(hook)
+        except ValueError:
+            pass
+
     # -- evaluation --------------------------------------------------------
 
     def _window_burn(

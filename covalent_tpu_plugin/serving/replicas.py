@@ -349,6 +349,7 @@ class ReplicaSet:
         sticky_ttl_s: float | None = None,
         router_queue_max: int | None = None,
         tenant_weights: dict[str, float] | None = None,
+        prefer_stable: bool = False,
         **session_options: Any,
     ) -> None:
         if not targets:
@@ -363,6 +364,11 @@ class ReplicaSet:
             raise ValueError(
                 f"replicas must be >= 1, got {self.replicas_wanted}"
             )
+        #: SLO-critical placement: rank non-preemptible (stable) pool
+        #: targets ahead of spot ones, so serving replicas pin to
+        #: capacity that will not be reclaimed under them.  The autoscale
+        #: controller sets this on the sets it manages as SLO-critical.
+        self.prefer_stable = bool(prefer_stable)
         self._session_options = dict(session_options)
         self._router_queue_max = router_queue_max
         self.router = ReplicaRouter(
@@ -381,6 +387,16 @@ class ReplicaSet:
         self._next_rid = 0
         self._next_replica = 0
         self._closed = False
+        #: scale-to-zero: True between a drain-to-zero (scale_to(0)) and
+        #: the re-warm the next request (or explicit scale-up) triggers.
+        self._suspended = False
+        #: replica count a demand-triggered resume re-opens (the
+        #: controller grows it further from trends once traffic flows).
+        self._resume_to = 1
+        #: serializes scale transitions against each other AND against a
+        #: request arriving mid-teardown — such a request waits for the
+        #: drain to finish, then re-warms; it is never dropped.
+        self._scale_lock = asyncio.Lock()
         self._pump_tasks: set[asyncio.Task] = set()
         #: recent router decision walls (the <1ms bench assertion reads
         #: the same numbers the histogram observes).
@@ -404,7 +420,37 @@ class ReplicaSet:
             return "open"
         if "reconnecting" in states:
             return "reconnecting"
+        if self._suspended:
+            return "suspended"
         return "failed"
+
+    @property
+    def suspended(self) -> bool:
+        """Scaled to zero: no live replicas, re-warms on first demand."""
+        return self._suspended and not any(
+            s.alive for s in self._replicas.values()
+        )
+
+    @property
+    def live_replicas(self) -> int:
+        """Replicas that are open or recovering (the autoscale view)."""
+        return len([s for s in self._replicas.values() if s.alive])
+
+    @property
+    def decode_slots(self) -> int:
+        """Aggregate engine slots across live replicas — the honest
+        concurrency capacity (the router's per-replica view adds the
+        admission queue on top; a utilization target must not)."""
+        return sum(
+            max(1, sup.slots)
+            for sup in self._replicas.values()
+            if sup.alive
+        )
+
+    @property
+    def queued(self) -> int:
+        """Requests waiting in the router's DRR queue."""
+        return self.router.queued
 
     @property
     def supervisors(self) -> dict[str, SessionSupervisor]:
@@ -446,6 +492,7 @@ class ReplicaSet:
         return {
             "name": self.name,
             "state": self.state,
+            **({"suspended": True} if self.suspended else {}),
             "replicas": {
                 rid: sup.status() for rid, sup in self._replicas.items()
             },
@@ -511,9 +558,13 @@ class ReplicaSet:
         """Placement order for the next replica.
 
         Spread first (fewest replicas of THIS set already on the
-        target), then the serving analog of fn-digest affinity: a target
-        whose gang already holds the factory's CAS digest re-opens with
-        zero staging, then warm gangs over cold, then free pool slots.
+        target); under ``prefer_stable`` non-preemptible pools beat spot
+        ones next (SLO-critical serving pins to capacity that will not
+        be reclaimed — ahead even of staging affinity: re-staging a
+        factory is cheap, losing a replica mid-burn is not); then the
+        serving analog of fn-digest affinity: a target whose gang
+        already holds the factory's CAS digest re-opens with zero
+        staging, then warm gangs over cold, then free pool slots.
         """
         assigned: dict[int, int] = {}
         for executor, _pool in self._placements.values():
@@ -533,10 +584,17 @@ class ReplicaSet:
                     affinity = bool(holds(self._digest))
                 except Exception:  # noqa: BLE001 - ranking is best-effort
                     affinity = False
+            # getattr: unit tests build bare sets via __new__.
+            spot = bool(
+                getattr(self, "prefer_stable", False)
+                and pool is not None
+                and getattr(pool, "preemptible", False)
+            )
             warm = bool(getattr(executor, "is_warm", False))
             free = pool.free_slots if pool is not None else 0
             return (
                 assigned.get(id(executor), 0),
+                spot,
                 not affinity,
                 not warm,
                 -free,
@@ -588,14 +646,22 @@ class ReplicaSet:
         waits in the per-tenant DRR queue and dispatches as lanes free —
         its stream just starts later.  A full router queue sheds with
         :class:`ServeRequestRejected` (``serve_admission_shed``).
+
+        A set scaled to zero (``scale_to(0)``) re-warms here: the first
+        request after the idle teardown waits out any still-draining
+        suspension (mid-teardown requests are never dropped), opens a
+        fresh replica, and streams normally — cold-start latency, no
+        error.
         """
         if self._closed:
             raise ServeError(f"replica set {self.name} is closed")
-        live = [s for s in self._replicas.values() if s.alive]
-        if not live:
-            raise ServeError(
-                f"replica set {self.name} has no live replicas"
-            )
+        if not any(s.alive for s in self._replicas.values()):
+            if self._suspended:
+                await self._ensure_live()
+            else:
+                raise ServeError(
+                    f"replica set {self.name} has no live replicas"
+                )
         self._next_rid += 1
         rid = f"{self.name}-r{self._next_rid}"
         request = ServeRequest(
@@ -629,6 +695,25 @@ class ReplicaSet:
             )
             request._fail(rejection)
             raise rejection from None
+        if self.suspended:
+            # A scale_to(0) drained the set between the alive-check at
+            # the top and this submit — the ``_prepare_request`` hook is
+            # a real suspension point (a disaggregated prefill round
+            # trip) — and the drain's own queued-demand check ran before
+            # this item existed.  Re-warm NOW rather than leaving the
+            # item in a queue nothing pumps; a failed re-warm unqueues
+            # and fails it loudly.
+            try:
+                await self._ensure_live()
+            except BaseException:
+                self.router.remove(
+                    lambda it: it.task_metadata.get("request") is request
+                )
+                if not request.done:
+                    request._fail(ServeError(
+                        f"replica set {self.name}: re-warm failed"
+                    ))
+                raise
         assignments = self.router.pump(self._views())
         elapsed = time.perf_counter() - t0
         self.decision_s.append(elapsed)
@@ -788,15 +873,64 @@ class ReplicaSet:
         every admitted and queued request first), releases its fleet
         capacity pin, and reaps its per-session AND per-replica metric
         series through the supervisor's ``_drop_live``.
+
+        ``scale_to(0)`` is **scale-to-zero**: every replica drain-closes
+        and the set suspends — the next :meth:`request` (or a later
+        scale-up) re-warms it from the staged factory payload.  A request
+        racing the teardown waits for the drain and re-warms; it is
+        never dropped, and its stream is exactly-once like any other.
         """
         if self._closed:
             raise ServeError(f"replica set {self.name} is closed")
         replicas = int(replicas)
-        if replicas < 1:
-            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        if replicas < 0:
+            raise ValueError(f"replicas must be >= 0, got {replicas}")
+        async with self._scale_lock:
+            return await self._scale_locked(replicas)
+
+    async def _scale_locked(self, replicas: int) -> int:
         live = {
             rid: sup for rid, sup in self._replicas.items() if sup.alive
         }
+        if replicas == 0:
+            # Remember the width a demand-triggered resume restores; the
+            # flag is up BEFORE the drain so a request arriving
+            # mid-teardown queues behind the lock and re-warms after.
+            self._resume_to = max(1, min(self.replicas_wanted, len(live)))
+            self._suspended = True
+            for rid in list(live):
+                await self._retire_replica(rid)
+            self.replicas_wanted = 0
+            if self.router.queued:
+                # Demand slipped in while the drain held the lock (a
+                # request that still saw a live replica queued into the
+                # router, whose items only worker-ADMITTED drains
+                # finish): a suspended set never pumps, so those waiters
+                # would hang until unrelated new traffic re-warmed it.
+                # Queued requests ARE demand — re-warm immediately
+                # instead of suspending over them.  A re-warm that opens
+                # NOTHING fails the stranded waiters loudly (the set
+                # stays suspended and resumable).
+                revived = await self._scale_locked(max(1, self._resume_to))
+                if revived == 0:
+                    self._suspended = True
+                    for item in self.router.drain():
+                        request = item.task_metadata.get("request")
+                        if request is not None and not request.done:
+                            request._fail(ServeError(
+                                f"replica set {self.name}: re-warm "
+                                f"failed with queued requests"
+                            ))
+                return revived
+            self._publish_replica_states()
+            obs_events.emit(
+                "serve.replica_set_suspended",
+                set=self.name,
+                resume_to=self._resume_to,
+            )
+            return 0
+        resumed = self._suspended
+        self._suspended = False
         if replicas > len(live):
             grow = replicas - len(live)
             results = await asyncio.gather(
@@ -818,14 +952,52 @@ class ReplicaSet:
                 await self._retire_replica(rid)
         self.replicas_wanted = replicas
         self._publish_replica_states()
+        now_live = len([
+            s for s in self._replicas.values() if s.alive
+        ])
+        if resumed and now_live == 0:
+            # Every resume open failed: stay suspended so the NEXT
+            # demand retries the re-warm instead of hitting a dead,
+            # unresumable set.
+            self._suspended = True
+        elif resumed:
+            obs_events.emit(
+                "serve.replica_set_resumed",
+                set=self.name,
+                replicas=now_live,
+            )
         obs_events.emit(
             "serve.replica_set_scaled",
             set=self.name,
-            replicas=len([
-                s for s in self._replicas.values() if s.alive
-            ]),
+            replicas=now_live,
         )
-        return len([s for s in self._replicas.values() if s.alive])
+        return now_live
+
+    async def _ensure_live(self) -> None:
+        """Re-warm a suspended set on first demand (scale-to-zero exit).
+
+        Serialized behind the scale lock: a request that raced a
+        still-draining ``scale_to(0)`` waits here for the drain, then
+        re-opens ``_resume_to`` replicas and proceeds.  A re-warm that
+        opens nothing raises (the caller's request fails loudly instead
+        of queueing into a set nothing will ever pump); the set stays
+        suspended so the next demand retries.
+        """
+        async with self._scale_lock:
+            if self._closed:
+                raise ServeError(f"replica set {self.name} is closed")
+            if any(s.alive for s in self._replicas.values()):
+                return
+            if not self._suspended:
+                raise ServeError(
+                    f"replica set {self.name} has no live replicas"
+                )
+            revived = await self._scale_locked(max(1, self._resume_to))
+            if revived == 0:
+                raise ServeError(
+                    f"replica set {self.name}: scale-to-zero re-warm "
+                    f"failed to open a replica"
+                )
 
     async def _retire_replica(self, replica_id: str) -> None:
         supervisor = self._replicas.pop(replica_id, None)
@@ -884,6 +1056,7 @@ async def open_replica_set(
     sticky_ttl_s: float | None = None,
     router_queue_max: int | None = None,
     tenant_weights: dict[str, float] | None = None,
+    prefer_stable: bool = False,
     **session_options: Any,
 ) -> ReplicaSet:
     """Open ``replicas`` sessions of one factory behind a routing front.
@@ -907,6 +1080,7 @@ async def open_replica_set(
         sticky_ttl_s=sticky_ttl_s,
         router_queue_max=router_queue_max,
         tenant_weights=tenant_weights,
+        prefer_stable=prefer_stable,
         **session_options,
     )
     return await replica_set._open()

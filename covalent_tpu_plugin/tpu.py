@@ -283,6 +283,16 @@ _PREWARM_TOTAL = REGISTRY.counter(
     "DAG-driven connection prewarm attempts by result",
     ("result",),
 )
+#: Measured cold-start: how long a full gang prewarm (connect +
+#: pre-flight + agent warm-up) takes, labelled by fleet pool ("" for
+#: pool-less executors).  The autoscale controller sizes its predictive
+#: lead time from this — capacity must start warming this many seconds
+#: before the trend says demand arrives, measured, not guessed.
+_PREWARM_SECONDS = REGISTRY.histogram(
+    "covalent_tpu_prewarm_seconds",
+    "Gang prewarm (cold-start) duration per fleet pool",
+    ("pool",),
+)
 _WALL_OVERHEAD_HIST = REGISTRY.histogram(
     "covalent_tpu_wall_overhead_seconds",
     "Per-electron wall-clock dispatch overhead (elapsed minus execute)",
@@ -831,6 +841,10 @@ class TPUExecutor(RemoteExecutor):
         #: (serving.open_session registers/deregisters; /status and the
         #: fleet pool view read it).
         self._serve_handles: dict[str, Any] = {}
+        #: fleet pool name this executor backs ("" standalone) — set by
+        #: fleet.pools.Pool so per-pool metrics (prewarm cold-start
+        #: durations) key on the pool operators actually scale.
+        self.pool_label = ""
         self.last_timings: dict[str, Any] = {}
         #: operation id -> fetched, digest-verified local profile artifact
         #: (merged into ``last_timings["profile_trace"]`` by the epilogue).
@@ -1293,6 +1307,7 @@ class TPUExecutor(RemoteExecutor):
             return False
         self._guard_event_loop()
         self._prewarmed = True  # optimistic: concurrent callers skip
+        started = time.monotonic()
         try:
             with Span("executor.prewarm", {"transport": self.transport_kind}):
                 lease = await self.lease_gang()
@@ -1310,10 +1325,41 @@ class TPUExecutor(RemoteExecutor):
             app_log.debug("prewarm failed (dispatch will retry): %s", err)
             return False
         _PREWARM_TOTAL.labels(result="warmed").inc()
+        # The measured cold-start: the autoscale controller reads this
+        # histogram (per pool) to size its predictive lead time.
+        _PREWARM_SECONDS.labels(pool=self.pool_label).observe(
+            time.monotonic() - started
+        )
         obs_events.emit(
             "executor.prewarm",
             transport=self.transport_kind,
             workers=len(lease),
+        )
+        return True
+
+    async def teardown_gang(self) -> bool:
+        """Scale-to-zero actuator: tear down this executor's warm gang.
+
+        Closes the pooled transports, resident agents, and per-key
+        pre-flight/CAS/registry state — the idle-capacity release the
+        autoscale controller performs after a pool sits unused past its
+        TTL.  Refuses (returns False) while electrons are in flight or
+        serving sessions are live, and when there is nothing warm to
+        drop.  The next dispatch — or :meth:`prewarm`, which the
+        controller fires ahead of predicted demand — re-dials from cold
+        through the ordinary path; nothing about the executor's
+        configuration or retry envelope changes.
+        """
+        self._guard_event_loop()
+        if self._op_status or self._serve_handles:
+            return False
+        if not self.is_warm:
+            return False
+        await self._discard_workers()
+        obs_events.emit(
+            "executor.gang_teardown",
+            transport=self.transport_kind,
+            **({"pool": self.pool_label} if self.pool_label else {}),
         )
         return True
 

@@ -533,7 +533,12 @@ def test_register_replace_closes_displaced_executor(run_async):
 def test_autoscale_watermarks_fire_edge_triggered(run_async):
     blocker = StubExecutor()
     registry, _ = stub_registry(only=(blocker, 1, False))
-    autoscaler = LocalPoolAutoscaler("only", step=2, max_capacity=4)
+    # cooldown_s=0: this test exercises the edge-triggered watermark
+    # wiring on a real clock; the anti-thrash dwell has its own
+    # fake-clock regression test in test_autoscale.py.
+    autoscaler = LocalPoolAutoscaler(
+        "only", step=2, max_capacity=4, cooldown_s=0.0
+    )
     scheduler = FleetScheduler(
         registry, autoscale=autoscaler, high_watermark=2, low_watermark=0
     )
