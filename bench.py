@@ -273,6 +273,77 @@ def percentile(values, q: float) -> float:
     return ordered[lo] + (ordered[hi] - ordered[lo]) * (rank - lo)
 
 
+#: The tiling TTFT segments every traced serving request records (the
+#: decode/flush tail is excluded — attribution answers "where did my
+#: TTFT go", and TTFT ends at the first streamed token).
+TTFT_SEGMENTS = ("prefill", "route", "dispatch", "ttft_wait")
+
+
+def latency_attribution(trace_ids) -> dict:
+    """Per-segment share-of-TTFT percentiles for one arm's requests.
+
+    Pulls each request's waterfall from the in-process trace store and
+    aggregates the tiling TTFT segments into p50/p95 shares — the
+    artifact-level answer to "where did my TTFT go" across the arm, and
+    the completeness evidence the CI smoke asserts on: every found
+    trace must carry waterfall segments, contain no orphan spans, and
+    its segment sum must cover the root's end-to-end duration.
+    """
+    out: dict = {
+        "requests": len(trace_ids),
+        "traces_found": 0,
+        "traces_complete": 0,
+        "traces_full_waterfall": 0,
+        "orphan_spans": 0,
+        "ttft_segments": {},
+    }
+    try:
+        from covalent_tpu_plugin.obs.tracestore import TRACE_STORE
+
+        shares: dict = {}
+        coverages = []
+        for trace_id in trace_ids:
+            view = TRACE_STORE.waterfall(str(trace_id))
+            if view is None:
+                continue
+            out["traces_found"] += 1
+            out["orphan_spans"] += sum(
+                1 for s in view.get("spans", ()) if s.get("orphan")
+            )
+            segments = view.get("segments") or {}
+            ttft = sum(
+                segments[name]["duration_s"]
+                for name in TTFT_SEGMENTS
+                if name in segments
+            )
+            if view.get("coverage") is not None:
+                coverages.append(view["coverage"])
+            if ttft <= 0:
+                continue
+            out["traces_complete"] += 1
+            if all(name in segments for name in TTFT_SEGMENTS):
+                # A short prompt legitimately skips the prefill tile;
+                # the full four-segment waterfall only appears on the
+                # KV-road (long-prompt) requests.
+                out["traces_full_waterfall"] += 1
+            for name in TTFT_SEGMENTS:
+                if name in segments:
+                    shares.setdefault(name, []).append(
+                        segments[name]["duration_s"] / ttft
+                    )
+        for name, values in shares.items():
+            out["ttft_segments"][name] = {
+                "p50_share": round(percentile(values, 0.50), 4),
+                "p95_share": round(percentile(values, 0.95), 4),
+            }
+        if coverages:
+            out["coverage_p50"] = round(percentile(coverages, 0.50), 4)
+            out["coverage_min"] = round(min(coverages), 4)
+    except Exception as error:  # noqa: BLE001 - observability never fatal
+        out["error"] = repr(error)
+    return out
+
+
 def load_last_known_good() -> dict | None:
     """Newest committed self-run combined line, stamped with provenance.
 
@@ -1748,6 +1819,14 @@ async def main() -> None:
         else:
             ensure_history(interval_s=0.25)
         ensure_slo_engine()
+        from covalent_tpu_plugin.obs.tracestore import ensure_trace_store
+
+        # Keep EVERY trace for the bench run (env still wins): the serve
+        # phases' latency_attribution blocks and the CI completeness
+        # assertions need each request's waterfall, not a 10% sample.
+        ensure_trace_store().sample = float(
+            os.environ.get("COVALENT_TPU_TRACE_SAMPLE", "") or 1.0
+        )
     except Exception as error:  # noqa: BLE001 - observability never fatal
         emit({"phase": "introspection", "error": repr(error)})
 
@@ -2762,6 +2841,7 @@ async def main() -> None:
                 )
                 wall = time.perf_counter() - t0
                 latencies = [r.latency_s for r in requests]
+                trace_ids = [r.span.trace_id for r in requests]
                 decisions = sorted(rset.decision_s)
                 status = rset.status()
                 await rset.close()
@@ -2771,6 +2851,7 @@ async def main() -> None:
             return {
                 "wall_s": wall,
                 "latencies": latencies,
+                "trace_ids": trace_ids,
                 "results": list(results),
                 "decisions": decisions,
                 "per_replica_served": {
@@ -2936,6 +3017,9 @@ async def main() -> None:
                 round(ROUTER_DECISION_BUDGET_S * 1e3, 3),
             "router_ok": summary["serve_scale_router_ok"],
             "per_replica_served": many_arm["per_replica_served"],
+            "latency_attribution": latency_attribution(
+                many_arm["trace_ids"]
+            ),
             "prefix_reuse": prefix_info,
             "prefix_reuse_ok": prefix_reuse_ok,
             "introspection": introspection_view([
@@ -3104,6 +3188,7 @@ async def main() -> None:
                 )
                 wall = time.perf_counter() - t0
                 latencies = [r.latency_s for r in requests]
+                trace_ids = [r.span.trace_id for r in requests]
                 status = sset.status()
                 await sset.close()
             finally:
@@ -3115,6 +3200,7 @@ async def main() -> None:
                 "wall_s": wall,
                 "results": list(results),
                 "latencies": latencies,
+                "trace_ids": trace_ids,
                 "status": status,
             }
 
@@ -3279,6 +3365,25 @@ async def main() -> None:
         )
         summary["serve_disagg_prefix_hits"] = probe_info["prefix_hits"]
         summary["serve_disagg_prefix_hit_ok"] = prefix_hit_ok
+        # Trace completeness verdicts ride the final combined line: the
+        # disagg arm is the acceptance target (dispatcher -> prefill
+        # worker -> decode worker under ONE trace), so its long-prompt
+        # requests must yield at least one full four-segment waterfall
+        # with zero orphan spans.
+        attribution = latency_attribution(split_arm["trace_ids"])
+        summary["trace_traces_found"] = attribution["traces_found"]
+        summary["trace_traces_complete"] = attribution["traces_complete"]
+        summary["trace_full_waterfalls"] = attribution[
+            "traces_full_waterfall"
+        ]
+        summary["trace_orphan_spans"] = attribution["orphan_spans"]
+        summary["trace_coverage_min"] = attribution.get("coverage_min")
+        summary["trace_completeness_ok"] = bool(
+            attribution["traces_complete"] >= 1
+            and attribution["traces_full_waterfall"] >= 1
+            and attribution["orphan_spans"] == 0
+            and "error" not in attribution
+        )
         emit({
             "phase": "serve_disagg",
             "requests": SERVE_DISAGG_REQUESTS,
@@ -3297,6 +3402,7 @@ async def main() -> None:
             "kv_transfer_p50_ms": split_status["kv_transfer_p50_ms"],
             "kv_transfer_accounted": kv_accounted,
             "kv_probe": probe_info,
+            "latency_attribution": attribution,
             "p95_fused_s": round(
                 percentile(fused_arm["latencies"], 0.95), 4
             ),
@@ -3879,6 +3985,7 @@ async def main() -> None:
                 )
                 wall = time.perf_counter() - t0
                 latencies = [r.latency_s for r in requests]
+                trace_ids = [r.span.trace_id for r in requests]
                 scale_decisions = (
                     dict(controller.decision_counts)
                     if controller is not None else {}
@@ -3918,6 +4025,7 @@ async def main() -> None:
                 "wall_s": wall,
                 "results": list(results),
                 "latencies": latencies,
+                "trace_ids": trace_ids,
                 "gang_seconds": gang_seconds,
                 "max_live": max(
                     (live for _t, live in gang_samples), default=0
@@ -4007,6 +4115,9 @@ async def main() -> None:
             "burn_cleared": burn_cleared,
             "scaled_up": scaled_up,
             "autoscale_decisions": auto_arm["decisions"],
+            "latency_attribution": latency_attribution(
+                auto_arm["trace_ids"]
+            ),
             "introspection": introspection_view([
                 "covalent_tpu_serve_request_seconds",
                 "covalent_tpu_serve_replicas",
@@ -4115,6 +4226,19 @@ async def main() -> None:
         await asyncio.wait_for(executor.close(), 15)
     except Exception:  # noqa: BLE001
         pass
+
+    # Archive the whole trace store when asked (CI sets
+    # COVALENT_TPU_TRACE_DUMP so the sampled waterfalls ride the build
+    # artifact next to the metrics snapshots).
+    dump_path = os.environ.get("COVALENT_TPU_TRACE_DUMP")
+    if dump_path:
+        try:
+            from covalent_tpu_plugin.obs.tracestore import ensure_trace_store
+
+            with open(dump_path, "w") as f:
+                json.dump(ensure_trace_store().dump(), f, sort_keys=True)
+        except Exception as error:  # noqa: BLE001 - artifact, not a gate
+            emit({"phase": "trace_dump", "error": repr(error)})
 
     # ---- final combined line (must be LAST) ------------------------------
     def sub(phase, key):

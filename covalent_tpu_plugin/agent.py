@@ -977,6 +977,7 @@ class AgentClient:
         kv_bytes: bytes | None = None,
         kv_digest: str = "",
         kv_path: str = "",
+        trace: dict | None = None,
     ) -> None:
         """Submit one request to an open session (fire-and-stream).
 
@@ -991,6 +992,11 @@ class AgentClient:
         CAS-staged copy (the cross-pool road); either way ``kv_digest``
         is verified worker-side before the engine unpickles anything,
         and any mismatch silently degrades to a full prefill.
+
+        ``trace`` (a :func:`~.obs.trace.context_of` carrier) rides the
+        command header so the worker's per-request spans — queue wait,
+        admission, decode — join the dispatcher's trace instead of
+        starting orphan ones.
         """
         command: dict = {
             "cmd": "serve_request", "id": sid, "rid": rid, "prompt": prompt,
@@ -1001,6 +1007,8 @@ class AgentClient:
             command["deadline_s"] = float(deadline_s)
         if tenant:
             command["tenant"] = str(tenant)
+        if trace:
+            command["trace"] = dict(trace)
         if kv_digest:
             command["kv_digest"] = kv_digest
         if kv_path:
@@ -1028,6 +1036,7 @@ class AgentClient:
         prompt,
         params: dict | None = None,
         timeout: float = 60.0,
+        trace: dict | None = None,
     ) -> dict:
         """Run a prefill-only pass on an open session; returns the
         ``serve_kv`` event with the bundle under ``data_bytes``.
@@ -1038,12 +1047,18 @@ class AgentClient:
         A worker-side refusal (unknown session, shed, an engine without
         the surface) raises :class:`AgentError` — the disaggregated
         front degrades to a full prefill on the decode replica.
+
+        ``trace`` propagates the requesting stream's trace context so
+        the prefill tier's worker span lands in the SAME trace as the
+        decode tier's — the cross-tier handoff is one waterfall.
         """
         command: dict = {
             "cmd": "serve_prefill", "id": sid, "rid": rid, "prompt": prompt,
         }
         if params:
             command["params"] = dict(params)
+        if trace:
+            command["trace"] = dict(trace)
         if self.frames_active:
             await self._send_frame(frames.VERB_SERVE, command)
         else:

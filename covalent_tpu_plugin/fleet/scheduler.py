@@ -34,6 +34,7 @@ from typing import Any, Callable
 
 from ..obs import events as obs_events
 from ..obs.metrics import REGISTRY
+from ..obs.trace import context_of, current_span, record_span
 from ..obs.opsserver import (
     ensure_ops_server,
     register_status_provider,
@@ -239,6 +240,13 @@ class FleetScheduler:
             ),
             future=loop.create_future(),
         )
+        # Capture the submitter's trace context at enqueue: placement
+        # happens later on the pump task, where the ambient contextvar
+        # is the pump's, not the caller's — without the carrier the
+        # queue-wait span would land in the wrong trace.
+        ambient = current_span()
+        if ambient is not None:
+            item.task_metadata.setdefault("trace", context_of(ambient))
         try:
             shed = self.queue.put(item)
         except QueueFullError:
@@ -371,17 +379,33 @@ class FleetScheduler:
             return False
         outcome = "rerouted" if rerouted else "placed"
         self._count(outcome)
+        queue_wait_s = max(0.0, self._clock() - item.enqueued_at)
         obs_events.emit(
             "fleet.placed",
             operation_id=item.operation_id,
             tenant=item.tenant,
             pool=pool.name,
             rerouted=rerouted,
-            queue_wait_s=round(
-                max(0.0, self._clock() - item.enqueued_at), 4
-            ),
+            queue_wait_s=round(queue_wait_s, 4),
             depth=self.queue.depth,
         )
+        carrier = item.task_metadata.get("trace")
+        if isinstance(carrier, dict) and carrier.get("trace_id"):
+            record_span(
+                "fleet.queue_wait",
+                trace_id=str(carrier["trace_id"]),
+                parent_id=(
+                    str(carrier["span_id"])
+                    if carrier.get("span_id") else None
+                ),
+                start_ts=time.time() - queue_wait_s,
+                duration_s=queue_wait_s,
+                attributes={
+                    "operation_id": item.operation_id,
+                    "pool": pool.name,
+                    "segment": "queue_wait",
+                },
+            )
         pool.place()
         task = self._loop.create_task(self._run_item(pool, item))
         self._running[item.operation_id] = (pool, item, task)

@@ -2013,6 +2013,40 @@ class _ServeSession:
             "serve.reject", rid=rid, code=code, message=message
         )
 
+    def _emit_span(
+        self, name: str, trace, t0: float, t1: float | None = None,
+        **attrs,
+    ) -> None:
+        """One worker-side span record over the telemetry side-band.
+
+        ``trace`` is the per-request ``context_of`` carrier off the
+        ``serve_request``/``serve_prefill`` command header; without one
+        (an old dispatcher, a malformed carrier) the span is dropped —
+        a worker must never mint orphan traces the store can't finalize.
+        The dispatcher re-emits the record with these ids preserved
+        (``SessionSupervisor._on_remote_span``), which is what puts the
+        worker's queue/admission/decode time inside the request's own
+        waterfall.  ``t0``/``t1`` are monotonic stamps; the wall-clock
+        ``start_ts`` is reconstructed here so the two clock domains
+        never mix on the wire.
+        """
+        if not isinstance(trace, dict) or not trace.get("trace_id"):
+            return
+        t1 = time.monotonic() if t1 is None else t1
+        parent = trace.get("span_id")
+        fields = {
+            "name": name,
+            "trace_id": str(trace["trace_id"]),
+            "parent_id": str(parent) if parent else None,
+            "span_id": os.urandom(8).hex(),
+            "start_ts": round(time.time() - (time.monotonic() - t0), 6),
+            "duration_s": round(max(0.0, t1 - t0), 6),
+            "status": "OK",
+        }
+        if attrs:
+            fields["attributes"] = attrs
+        self._emit_serve("span", **fields)
+
     def _emit_kv(
         self, rid: str, data: bytes | None = None,
         code: str = "", message: str = "",
@@ -2059,6 +2093,8 @@ class _ServeSession:
                     message="engine has no prefill_only surface",
                 )
                 continue
+            trace = command.get("trace")
+            t_prefill = time.monotonic()
             try:
                 data = prefill(
                     command.get("prompt"),
@@ -2073,6 +2109,10 @@ class _ServeSession:
                 self._emit_kv(rid, code="prefill_failed", message=repr(err))
                 continue
             self.prefills += 1
+            self._emit_span(
+                "serve.worker.prefill", trace, t_prefill,
+                rid=rid, kv_bytes=len(data),
+            )
             self._emit_kv(rid, bytes(data))
 
     def _resolve_kv(self, command: dict):
@@ -2180,6 +2220,11 @@ class _ServeSession:
     def _emit_open_error(
         self, code: str, err, permanent: bool = False, label: str = ""
     ) -> None:
+        # Mark terminal BEFORE the error leaves the process: the client
+        # reopens the sid the moment this event lands, and _serve_open
+        # must find a closed session it can wait out — not a live-looking
+        # one it refuses as a duplicate.
+        self._closed.set()
         _emit({
             "event": "serve_error", "id": self.sid, "code": code,
             "message": repr(err), "permanent": bool(permanent),
@@ -2213,6 +2258,12 @@ class _ServeSession:
                 continue
             prompt = command.get("prompt")
             params = dict(command.get("params") or {})
+            trace = command.get("trace")
+            t_admit_start = time.monotonic()
+            self._emit_span(
+                "serve.worker.queue_wait", trace,
+                command["_enqueued"], t_admit_start, rid=rid,
+            )
             admitted = False
             if (
                 command.get("kv_bytes") is not None
@@ -2242,13 +2293,19 @@ class _ServeSession:
                 except BaseException as err:  # noqa: BLE001 - rejections
                     self._emit_reject(rid, "engine_error", repr(err))
                     continue
+            t_admitted = time.monotonic()
+            self._emit_span(
+                "serve.worker.admission", trace, t_admit_start, t_admitted,
+                rid=rid, kv=admitted,
+            )
             self.running[rid] = {
                 "deadline": (
                     command["_enqueued"] + deadline_s
                     if deadline_s > 0 else None
                 ),
                 "emitted": 0,
-                "t_admit": time.monotonic(),
+                "t_admit": t_admitted,
+                "trace": trace,
             }
 
     def _cancel_lane(self, rid: str) -> None:
@@ -2287,6 +2344,15 @@ class _ServeSession:
                 extra.setdefault(
                     "gen_s", round(time.monotonic() - state["t_admit"], 6)
                 )
+                # Span BEFORE the final token record: the dispatcher
+                # finalizes the trace on ``done``, and the side-band is
+                # ordered — emitting after would strand the decode span
+                # as a straggler.
+                self._emit_span(
+                    "serve.worker.decode", state.get("trace"),
+                    state["t_admit"], rid=rid,
+                    tokens=state["emitted"],
+                )
             self._emit_serve(
                 "serve.token", rid=rid, idx=idx, tokens=tokens, done=done,
                 **extra,
@@ -2300,6 +2366,11 @@ class _ServeSession:
         for rid, state in list(self.running.items()):
             if state["deadline"] is not None and now >= state["deadline"]:
                 self._cancel_lane(rid)
+                self._emit_span(
+                    "serve.worker.decode", state.get("trace"),
+                    state["t_admit"], rid=rid,
+                    tokens=state["emitted"], error="deadline_exceeded",
+                )
                 self._emit_serve(
                     "serve.token", rid=rid, idx=state["emitted"],
                     tokens=[], done=True, error="deadline_exceeded",
@@ -2372,6 +2443,13 @@ def _serve_open(command: dict, sessions: dict) -> None:
         return
     existing = sessions.get(sid)
     if existing is not None:
+        if existing._closed.is_set() and existing._thread.is_alive():
+            # Terminating but not yet dead: a failed factory open (or a
+            # drained close) emits its error BEFORE the thread's last
+            # instructions run, and the client legitimately reopens the
+            # moment that event lands — wait out the teardown rather
+            # than racing it into a spurious permanent "duplicate".
+            existing._thread.join(timeout=2.0)
         if existing._closed.is_set() and not existing._thread.is_alive():
             # A dead entry (failed factory open, or a drained close whose
             # serve_close never arrived): evict so the sid is re-openable

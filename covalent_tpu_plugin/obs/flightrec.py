@@ -93,6 +93,9 @@ class FlightRecorder:
         self._rings: "collections.OrderedDict[str, collections.deque]" = (
             collections.OrderedDict()
         )
+        #: base operation id -> most recent trace id seen on its records,
+        #: so ``GET /tasks/<op>`` can cross-link ``GET /traces/<id>``.
+        self._trace_ids: dict[str, str] = {}
 
     # -- feeding -----------------------------------------------------------
 
@@ -111,7 +114,8 @@ class FlightRecorder:
             ring = collections.deque(maxlen=self.per_task)
             self._rings[base] = ring
             while len(self._rings) > self.max_tasks:
-                self._rings.popitem(last=False)
+                evicted, _ = self._rings.popitem(last=False)
+                self._trace_ids.pop(evicted, None)
         else:
             self._rings.move_to_end(base)
         return ring
@@ -132,10 +136,15 @@ class FlightRecorder:
             compact = self._compact(event)
             with self._lock:
                 self._ring_for(base).append(compact)
+                trace_id = event.get("trace_id")
+                if trace_id:
+                    self._trace_ids[base] = str(trace_id)
         except Exception:  # noqa: BLE001 - observers must not break flow
             pass
 
-    def record_stage(self, operation_id: str, stage: str) -> None:
+    def record_stage(
+        self, operation_id: str, stage: str, trace_id: str | None = None
+    ) -> None:
         """Dispatcher stage transition (these are /status state, not
         events — the recorder is where they become history)."""
         if _disabled():
@@ -146,16 +155,24 @@ class FlightRecorder:
             "operation_id": operation_id,
             "stage": stage,
         }
+        if trace_id:
+            record["trace_id"] = str(trace_id)
+        base = base_operation_id(operation_id)
         with self._lock:
-            self._ring_for(base_operation_id(operation_id)).append(record)
+            self._ring_for(base).append(record)
+            if trace_id:
+                self._trace_ids[base] = str(trace_id)
 
     def forget(self, operation_id: str) -> None:
         with self._lock:
-            self._rings.pop(base_operation_id(operation_id), None)
+            base = base_operation_id(operation_id)
+            self._rings.pop(base, None)
+            self._trace_ids.pop(base, None)
 
     def clear(self) -> None:
         with self._lock:
             self._rings.clear()
+            self._trace_ids.clear()
 
     # -- views / dumps -----------------------------------------------------
 
@@ -172,11 +189,16 @@ class FlightRecorder:
             if ring is None:
                 return None
             records = list(ring)
-        return {
+            trace_id = self._trace_ids.get(base)
+        view: dict[str, Any] = {
             "operation_id": base,
             "records": records,
             "count": len(records),
         }
+        if trace_id:
+            view["trace_id"] = trace_id
+            view["trace_url"] = f"/traces/{trace_id}"
+        return view
 
     def dump(self, operation_id: str, reason: str) -> dict[str, Any]:
         """Black-box payload for one task (empty ring still dumps)."""

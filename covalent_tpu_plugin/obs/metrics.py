@@ -214,16 +214,20 @@ class Gauge(_Metric):
 
 
 class _HistogramChild:
-    __slots__ = ("buckets", "counts", "sum", "count", "_lock")
+    __slots__ = ("buckets", "counts", "sum", "count", "exemplars", "_lock")
 
     def __init__(self, buckets: tuple[float, ...]) -> None:
         self.buckets = buckets
         self.counts = [0] * (len(buckets) + 1)  # +1 for the +Inf bucket
         self.sum = 0.0
         self.count = 0
+        #: bucket index -> (value, trace_id, unix ts) of the most recent
+        #: exemplar-carrying observation that landed in that bucket.  One
+        #: slot per bucket keeps the memory bound independent of traffic.
+        self.exemplars: dict[int, tuple[float, str, float]] = {}
         self._lock = threading.Lock()
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, trace_id: str | None = None) -> None:
         value = float(value)
         with self._lock:
             self.sum += value
@@ -231,8 +235,16 @@ class _HistogramChild:
             for i, bound in enumerate(self.buckets):
                 if value <= bound:
                     self.counts[i] += 1
-                    return
-            self.counts[-1] += 1
+                    break
+            else:
+                i = len(self.buckets)
+                self.counts[-1] += 1
+            if trace_id:
+                self.exemplars[i] = (value, str(trace_id), time.time())
+
+    def exemplar_snapshot(self) -> dict[int, tuple[float, str, float]]:
+        with self._lock:
+            return dict(self.exemplars)
 
     def cumulative(self) -> list[int]:
         """Per-bucket cumulative counts, Prometheus ``le`` semantics."""
@@ -281,8 +293,8 @@ class Histogram(_Metric):
     def _new_child(self) -> _HistogramChild:
         return _HistogramChild(self.buckets)
 
-    def observe(self, value: float) -> None:
-        self._default_child().observe(value)
+    def observe(self, value: float, trace_id: str | None = None) -> None:
+        self._default_child().observe(value, trace_id=trace_id)
 
     def quantile(self, q: float) -> float | None:
         return self._default_child().quantile(q)
@@ -384,20 +396,29 @@ class Registry:
             for labels, child in metric._series():
                 entry: dict[str, Any] = {"labels": labels}
                 if metric.kind == "histogram":
+                    bounds = (*metric.buckets, float("inf"))
                     entry.update(
                         count=child.count,
                         sum=round(child.sum, 9),
                         buckets={
                             _fmt_float(b): c
-                            for b, c in zip(
-                                (*metric.buckets, float("inf")),
-                                child.cumulative(),
-                            )
+                            for b, c in zip(bounds, child.cumulative())
                         },
                         p50=child.quantile(0.5),
                         p95=child.quantile(0.95),
                         p99=child.quantile(0.99),
                     )
+                    exemplars = child.exemplar_snapshot()
+                    if exemplars:
+                        entry["exemplars"] = {
+                            _fmt_float(bounds[i]): {
+                                "value": round(value, 9),
+                                "trace_id": trace_id,
+                                "ts": round(ts, 6),
+                            }
+                            for i, (value, trace_id, ts)
+                            in sorted(exemplars.items())
+                        }
                 else:
                     entry["value"] = child.value
                 series.append(entry)
@@ -411,8 +432,17 @@ class Registry:
     def snapshot_json(self, indent: int | None = None) -> str:
         return json.dumps(self.snapshot(), indent=indent, sort_keys=True)
 
-    def prometheus_text(self) -> str:
-        """Prometheus text exposition format (version 0.0.4)."""
+    def prometheus_text(self, openmetrics: bool = False) -> str:
+        """Prometheus text exposition format (version 0.0.4).
+
+        With ``openmetrics=True`` the output follows the OpenMetrics text
+        format instead: bucket lines carry ``# {trace_id="..."} value ts``
+        exemplar suffixes (when an observation recorded one) and the body
+        ends with the mandatory ``# EOF`` terminator, so a p99 bucket
+        links straight to a reconstructable ``/traces/<id>`` waterfall.
+        Exemplars are invalid in the classic 0.0.4 format, hence the
+        explicit opt-in.
+        """
         lines: list[str] = []
         with self._lock:
             metrics = list(self._metrics.values())
@@ -424,12 +454,23 @@ class Registry:
                 base = ",".join(f'{k}="{v}"' for k, v in labels.items())
                 if metric.kind == "histogram":
                     bounds = (*metric.buckets, float("inf"))
-                    for bound, cum in zip(bounds, child.cumulative()):
+                    exemplars = (
+                        child.exemplar_snapshot() if openmetrics else {}
+                    )
+                    for i, (bound, cum) in enumerate(
+                        zip(bounds, child.cumulative())
+                    ):
                         le = f'le="{_fmt_float(bound)}"'
                         labelset = f"{base},{le}" if base else le
-                        lines.append(
-                            f"{metric.name}_bucket{{{labelset}}} {cum}"
-                        )
+                        line = f"{metric.name}_bucket{{{labelset}}} {cum}"
+                        ex = exemplars.get(i)
+                        if ex is not None:
+                            value, trace_id, ts = ex
+                            line += (
+                                f' # {{trace_id="{_fmt_label_value(trace_id)}"}}'
+                                f" {_fmt_float(value)} {round(ts, 3)}"
+                            )
+                        lines.append(line)
                     suffix = f"{{{base}}}" if base else ""
                     lines.append(
                         f"{metric.name}_sum{suffix} {_fmt_float(child.sum)}"
@@ -440,6 +481,8 @@ class Registry:
                     lines.append(
                         f"{metric.name}{suffix} {_fmt_float(child.value)}"
                     )
+        if openmetrics:
+            lines.append("# EOF")
         return "\n".join(lines) + ("\n" if lines else "")
 
 

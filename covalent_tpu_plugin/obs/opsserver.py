@@ -42,6 +42,7 @@ from . import events as _events
 from . import flightrec as _flightrec
 from . import history as _history
 from . import slo as _slo
+from . import tracestore as _tracestore
 from .heartbeat import MONITOR
 from .metrics import REGISTRY
 
@@ -137,10 +138,30 @@ class OpsServer:
                     route = url.path.rstrip("/") or "/"
                     params = parse_qs(url.query)
                     if route == "/metrics":
-                        self._send(
-                            200, REGISTRY.prometheus_text().encode(),
-                            "text/plain; version=0.0.4",
+                        # OpenMetrics (exemplar-carrying) exposition on
+                        # content negotiation or ?format=openmetrics; the
+                        # classic 0.0.4 format cannot legally carry
+                        # exemplars, so it stays the default.
+                        accept = self.headers.get("Accept") or ""
+                        fmt = (params.get("format") or [""])[0]
+                        openmetrics = (
+                            fmt == "openmetrics"
+                            or "application/openmetrics-text" in accept
                         )
+                        if openmetrics:
+                            self._send(
+                                200,
+                                REGISTRY.prometheus_text(
+                                    openmetrics=True
+                                ).encode(),
+                                "application/openmetrics-text; "
+                                "version=1.0.0; charset=utf-8",
+                            )
+                        else:
+                            self._send(
+                                200, REGISTRY.prometheus_text().encode(),
+                                "text/plain; version=0.0.4",
+                            )
                     elif route == "/status":
                         self._send_json(server.status())
                     elif route == "/events":
@@ -170,6 +191,18 @@ class OpsServer:
                             )
                         else:
                             self._send_json(view)
+                    elif route == "/traces":
+                        self._send_json(_tracestore.TRACE_STORE.index())
+                    elif route.startswith("/traces/"):
+                        waterfall = _tracestore.TRACE_STORE.waterfall(
+                            route[len("/traces/"):]
+                        )
+                        if waterfall is None:
+                            self._send_json(
+                                {"error": "no such trace"}, 404
+                            )
+                        else:
+                            self._send_json(waterfall)
                     elif route in ("/", "/healthz"):
                         self._send(200, b"ok\n", "text/plain")
                     else:
@@ -227,6 +260,7 @@ class OpsServer:
         _history.ensure_history()
         _slo.ensure_slo_engine()
         _flightrec.ensure_flight_recorder()
+        _tracestore.ensure_trace_store()
         # Only after the bind succeeded: a failed construction must not
         # leave an orphaned listener on the event stream (ensure_ops_server
         # retries on every executor init, which would accumulate them).

@@ -39,7 +39,7 @@ from .metrics import REGISTRY
 
 __all__ = [
     "Span", "span", "current_span", "SPAN_HISTOGRAM",
-    "context_of", "extract_context",
+    "context_of", "extract_context", "record_span",
 ]
 
 #: Name of the histogram every finished span observes into.
@@ -72,15 +72,29 @@ def context_of(span: "Span", **extra: Any) -> dict[str, Any]:
     return {"trace_id": span.trace_id, "span_id": span.span_id, **extra}
 
 
-def extract_context(carrier: dict | None) -> tuple[str, str] | None:
-    """``(trace_id, parent_span_id)`` from a :func:`context_of` dict."""
-    if not carrier:
+def extract_context(carrier: Any) -> tuple[str, str] | None:
+    """``(trace_id, parent_span_id)`` from a :func:`context_of` dict.
+
+    Never raises: the carrier crossed a process boundary, so anything —
+    a non-dict, ids of the wrong type, a partial dict — may arrive.  Any
+    malformed carrier yields ``None`` and the local span falls back to a
+    fresh root rather than poisoning the dispatch it instruments.
+    """
+    if not carrier or not isinstance(carrier, dict):
         return None
-    trace_id = carrier.get("trace_id")
-    span_id = carrier.get("span_id")
-    if not trace_id or not span_id:
+    try:
+        trace_id = carrier.get("trace_id")
+        span_id = carrier.get("span_id")
+        if (
+            not trace_id
+            or not span_id
+            or not isinstance(trace_id, (str, int))
+            or not isinstance(span_id, (str, int))
+        ):
+            return None
+        return str(trace_id), str(span_id)
+    except Exception:  # noqa: BLE001 - carriers come off the wire
         return None
-    return str(trace_id), str(span_id)
 
 
 class Span:
@@ -190,7 +204,9 @@ class Span:
             SPAN_HISTOGRAM,
             "Duration of instrumented control-plane spans",
             label_names=("span",),
-        ).labels(span=self.name).observe(self.duration_s)
+        ).labels(span=self.name).observe(
+            self.duration_s, trace_id=self.trace_id
+        )
         if self._emit:
             _events.emit(
                 "span",
@@ -224,6 +240,51 @@ class Span:
         out["total"] = self.total()
         out["overhead"] = self.overhead()
         return out
+
+
+def record_span(
+    name: str,
+    *,
+    trace_id: str | None = None,
+    parent_id: str | None = None,
+    span_id: str | None = None,
+    start_ts: float | None = None,
+    duration_s: float,
+    status: str = "OK",
+    attributes: dict[str, Any] | None = None,
+) -> str:
+    """Emit one span retrospectively from explicit timings.
+
+    The waterfall instrumentation measures segments with plain monotonic
+    stamps on the request object (a :class:`Span` context manager cannot
+    wrap code that spans callbacks and reconnects), and remote spans come
+    back off the wire already timed; both land here.  Fans out exactly
+    like :meth:`Span.end` — one histogram observation (exemplar-linked to
+    the trace) plus one ``span`` event — and returns the span id so
+    callers can parent further segments under it.
+    """
+    if span_id is None:
+        span_id = _new_id(8)
+    if trace_id is None:
+        trace_id = _new_id(16)
+    duration_s = max(0.0, float(duration_s))
+    REGISTRY.histogram(
+        SPAN_HISTOGRAM,
+        "Duration of instrumented control-plane spans",
+        label_names=("span",),
+    ).labels(span=name).observe(duration_s, trace_id=trace_id)
+    _events.emit(
+        "span",
+        name=name,
+        trace_id=trace_id,
+        span_id=span_id,
+        parent_id=parent_id,
+        start_ts=round(start_ts if start_ts is not None else time.time(), 6),
+        duration_s=round(duration_s, 6),
+        status=status,
+        **({"attributes": dict(attributes)} if attributes else {}),
+    )
+    return span_id
 
 
 def span(name: str, **attributes: Any) -> Span:

@@ -52,6 +52,7 @@ from typing import Any
 
 from ..cache import prune_cas_dir
 from ..obs import events as obs_events
+from ..obs.trace import context_of
 from ..utils.log import app_log
 from .metrics import (
     SERVE_DISAGG_REQUESTS_TOTAL,
@@ -262,6 +263,10 @@ class DisaggregatedSet(ReplicaSet):
             SERVE_DISAGG_REQUESTS_TOTAL.labels(path="direct").inc()
             return
         kv = await self._prefill_kv_for(request)
+        # Checkpoint even on a failed round trip: the time was spent
+        # either way, and the waterfall must attribute it to the prefill
+        # hop rather than silently folding it into the route segment.
+        request.t_prefill_done = time.monotonic()
         path = "disagg" if kv is not None else "fallback"
         self.requests_by_path[path] += 1
         SERVE_DISAGG_REQUESTS_TOTAL.labels(path=path).inc()
@@ -302,6 +307,7 @@ class DisaggregatedSet(ReplicaSet):
                     request.prompt, request.params,
                     rid=f"{request.rid}-kv{uuid.uuid4().hex[:6]}",
                     timeout_s=self.kv_timeout_s,
+                    trace=context_of(request.span, rid=request.rid),
                 ),
                 self.kv_timeout_s + 5.0,
             )

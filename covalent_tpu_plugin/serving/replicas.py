@@ -52,7 +52,7 @@ import cloudpickle
 from ..cache import bytes_digest
 from ..fleet.queue import DEFAULT_TENANT, FairWorkQueue, QueueFullError, WorkItem
 from ..obs import events as obs_events
-from ..obs.trace import Span
+from ..obs.trace import Span, record_span
 from ..utils.log import app_log
 from .metrics import (
     SERVE_REPLICAS,
@@ -719,6 +719,23 @@ class ReplicaSet:
         self.decision_s.append(elapsed)
         SERVE_ROUTER_DECISION_SECONDS.observe(elapsed)
         placed = {id(i) for i, _, _ in assignments}
+        # The router hop is its own waterfall row (distinct from the
+        # tiling ``route`` segment, which also absorbs DRR queue time):
+        # a request that waited out a full queue shows a long segment
+        # but a short hop, and the difference IS the diagnosis.
+        record_span(
+            "serve.router_hop",
+            trace_id=request.span.trace_id,
+            parent_id=request.span.span_id,
+            start_ts=time.time() - elapsed,
+            duration_s=elapsed,
+            attributes={
+                "rid": rid,
+                "outcome": (
+                    "placed" if id(item) in placed else "queued"
+                ),
+            },
+        )
         if id(item) not in placed:
             SERVE_ROUTER_DECISIONS_TOTAL.labels(outcome="queued").inc()
         await self._dispatch_assignments(assignments)

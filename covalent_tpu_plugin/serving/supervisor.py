@@ -37,6 +37,7 @@ from typing import Any, AsyncIterator, Callable
 from ..agent import HARNESS_BASENAME, AgentClient, AgentError
 from ..cache import bytes_digest, cas_path
 from ..obs import events as obs_events
+from ..obs.trace import Span, context_of, record_span
 from ..resilience import FaultClass, RetryPolicy, classify_error
 from ..transport.base import TransportError
 from ..utils.log import app_log
@@ -151,6 +152,28 @@ class ServeRequest:
         self.t_submit = time.monotonic()
         self.t_first: float | None = None
         self.t_done: float | None = None
+        #: lifecycle checkpoints (monotonic) between submit and first
+        #: token: each adjacent pair becomes one tiling waterfall segment
+        #: under :attr:`span` at finalize, so the trace store can show
+        #: where a request's TTFT went.  Stamped once — a replay or a
+        #: re-route re-sends the SAME request object, and re-stamping
+        #: would erase the latency the retry actually cost.
+        self.t_prefill_done: float | None = None
+        self.t_dispatched: float | None = None
+        self.t_sent: float | None = None
+        #: root span of this request's trace.  Entered at construction
+        #: (``activate=False``: feeding happens in callbacks, the ambient
+        #: context must not capture it) and closed LAST by
+        #: :meth:`_finalize_trace` — the root arriving is what tells the
+        #: tail-sampling store the trace is complete.  Because the span
+        #: lives on the request, not the session, one trace follows the
+        #: stream across reconnect replays, re-routes, and warm handoffs.
+        self.span = Span(
+            "serve.request",
+            {"rid": rid, "tenant": tenant} if tenant else {"rid": rid},
+            activate=False,
+        ).__enter__()
+        self._trace_done = False
         self._chunks: asyncio.Queue = asyncio.Queue()
         self._done: asyncio.Future = asyncio.get_event_loop().create_future()
         # Unawaited failures must not warn at GC: a caller may only ever
@@ -205,6 +228,7 @@ class ServeRequest:
             self.error = error
             self._chunks.put_nowait(None)
             self._done.set_result(list(self.tokens))
+            self._finalize_trace()
 
     def _fail(self, err: BaseException) -> None:
         if self._done.done():
@@ -212,6 +236,58 @@ class ServeRequest:
         self.t_done = time.monotonic()
         self._chunks.put_nowait(err)
         self._done.set_exception(err)
+        self.span.record_error(err)
+        self._finalize_trace()
+
+    def _finalize_trace(self) -> None:
+        """Close this request's trace: turn the monotonic checkpoints
+        into tiling segment spans, then end the root.
+
+        Each adjacent checkpoint pair becomes one child span tagged with
+        a ``segment`` attribute — the store's waterfall view sums those
+        into the per-request latency attribution, and because they tile
+        (every segment starts where the previous ended) the sum matches
+        the request's end-to-end latency.  Checkpoints a given request
+        never hit (no prefill tier, rejected before dispatch) simply
+        drop out; the next segment absorbs the span of wall time.  The
+        root closes LAST so a trace never finalizes in the store with
+        its segments still in flight.
+        """
+        if self._trace_done:
+            return
+        self._trace_done = True
+        span = self.span
+        cursor = self.t_submit
+        tiles: list[tuple[str, float, float]] = []
+        for name, stamp in (
+            ("prefill", self.t_prefill_done),
+            ("route", self.t_dispatched),
+            ("dispatch", self.t_sent),
+            ("ttft_wait", self.t_first),
+            ("decode_stream", self.t_done),
+            ("stream_flush", time.monotonic()),
+        ):
+            if stamp is None:
+                continue
+            tiles.append((name, cursor, stamp))
+            cursor = stamp
+        for name, t0, t1 in tiles:
+            if t1 <= t0:
+                continue
+            record_span(
+                f"serve.{name}",
+                trace_id=span.trace_id,
+                parent_id=span.span_id,
+                start_ts=span.start_ts + (t0 - self.t_submit),
+                duration_s=t1 - t0,
+                attributes={"segment": name, "rid": self.rid},
+            )
+        span.set_attribute("tokens", len(self.tokens))
+        if self.ttft_s is not None:
+            span.set_attribute("ttft_s", round(self.ttft_s, 6))
+        if self.error:
+            span.record_error(self.error)
+        span.end()
 
 
 class SessionSupervisor:
@@ -556,6 +632,9 @@ class SessionSupervisor:
                 raise ServeError(
                     f"session {self.sid} is not routable ({self.state})"
                 )
+            if request.t_dispatched is None:
+                request.t_dispatched = time.monotonic()
+            request.span.set_attribute("sid", self.sid)
             self._requests[request.rid] = request
             self._publish_in_flight()
             try:
@@ -591,6 +670,7 @@ class SessionSupervisor:
 
     async def _send_request(self, request: ServeRequest) -> None:
         assert self._client is not None
+        t_send = time.monotonic()
         kv_bytes: bytes | None = None
         kv_digest = ""
         kv_path = ""
@@ -623,7 +703,28 @@ class SessionSupervisor:
             kv_bytes=kv_bytes,
             kv_digest=kv_digest,
             kv_path=kv_path,
+            trace=context_of(request.span, rid=request.rid),
         )
+        now = time.monotonic()
+        if request.kv is not None:
+            # The KV data plane is its own waterfall row: shipping a
+            # multi-megabyte bundle (CAS stage or inline frame body) is
+            # exactly the cost disaggregation trades for prefill reuse,
+            # and it must be attributable per request.
+            record_span(
+                "serve.kv_ship",
+                trace_id=request.span.trace_id,
+                parent_id=request.span.span_id,
+                start_ts=request.span.start_ts + (t_send - request.t_submit),
+                duration_s=now - t_send,
+                attributes={
+                    "rid": request.rid,
+                    "kv_bytes": len(request.kv[0]),
+                    "staged": bool(kv_path),
+                },
+            )
+        if request.t_sent is None:
+            request.t_sent = now
 
     async def _stage_kv(self, data: bytes, digest: str) -> str:
         """Ship one KV bundle into this session's worker CAS; returns the
@@ -652,6 +753,7 @@ class SessionSupervisor:
         params: dict | None = None,
         rid: str = "",
         timeout_s: float = 60.0,
+        trace: dict | None = None,
     ) -> dict:
         """Run a prefill-only pass on this session's resident engine and
         return the ``serve_kv`` event (bundle under ``data_bytes``,
@@ -668,7 +770,7 @@ class SessionSupervisor:
         rid = rid or f"kv-{uuid.uuid4().hex[:8]}"
         return await client.serve_prefill(
             self._sid_g, rid, [int(t) for t in prompt],
-            params=params, timeout=timeout_s,
+            params=params, timeout=timeout_s, trace=trace,
         )
 
     async def _await_ready(self) -> None:
@@ -702,6 +804,36 @@ class SessionSupervisor:
             self._on_stats(data)
         elif kind == "serve.preempt":
             self._on_preempt(data)
+        elif kind == "span":
+            self._on_remote_span(data)
+
+    def _on_remote_span(self, data: dict) -> None:
+        """One worker-recorded span off the telemetry side-band.
+
+        The worker has no event sink of ours, so it times its segments
+        (queue wait, admission, decode, prefill) locally and ships them
+        as ``span`` telemetry records; re-emitting through
+        :func:`record_span` with the ORIGINAL ids preserved is what
+        makes worker time appear inside the request's own waterfall
+        rather than in a disconnected worker-local trace.
+        """
+        try:
+            record_span(
+                str(data.get("name") or "serve.worker"),
+                trace_id=data.get("trace_id") or None,
+                parent_id=data.get("parent_id") or None,
+                span_id=data.get("span_id") or None,
+                start_ts=data.get("start_ts"),
+                duration_s=float(data.get("duration_s") or 0.0),
+                status=str(data.get("status") or "OK"),
+                attributes=(
+                    data.get("attributes")
+                    if isinstance(data.get("attributes"), dict)
+                    else None
+                ),
+            )
+        except Exception:  # noqa: BLE001 - observability never fatal
+            pass
 
     def _on_preempt(self, data: dict) -> None:
         """The worker hosting this session announced a preemption notice
@@ -760,8 +892,13 @@ class SessionSupervisor:
         request._feed(fresh, done, error=error)
         if fresh:
             SERVE_TOKENS_TOTAL.inc(len(fresh))
+        # The trace id rides as the bucket exemplar: a p99 spike on the
+        # serving dashboards resolves straight to this request's
+        # waterfall at /traces/<id>.
         if first and request.ttft_s is not None:
-            SERVE_TTFT_SECONDS.observe(request.ttft_s)
+            SERVE_TTFT_SECONDS.observe(
+                request.ttft_s, trace_id=request.span.trace_id
+            )
         if done:
             outcome = "ok"
             if error == "deadline_exceeded":
@@ -770,7 +907,9 @@ class SessionSupervisor:
                 outcome = "error"
             self._finish(rid, outcome)
             if request.latency_s is not None:
-                SERVE_REQUEST_SECONDS.observe(request.latency_s)
+                SERVE_REQUEST_SECONDS.observe(
+                    request.latency_s, trace_id=request.span.trace_id
+                )
 
     def _on_reject(self, data: dict) -> None:
         rid = str(data.get("rid") or "")

@@ -929,7 +929,10 @@ class TPUExecutor(RemoteExecutor):
         state = self._op_status.get(operation_id)
         if state is not None:
             state["stage"] = stage
-        FLIGHT_RECORDER.record_stage(operation_id, stage)
+        FLIGHT_RECORDER.record_stage(
+            operation_id, stage,
+            trace_id=(state or {}).get("trace_id"),
+        )
 
     # -- RPC registry views (fleet placement + ops /status) ----------------
 

@@ -24,6 +24,14 @@ for an invoke, ``data_bytes`` for a result, ``records`` for a coalesced
 telemetry batch).  Frames and JSON lines interleave freely on one stream
 after negotiation — a reader dispatches on the first byte.
 
+Trace propagation rides the same header: a ``trace`` field (the
+``obs.trace.context_of`` carrier — ``trace_id`` + parent ``span_id``)
+on a ``serve``/``invoke`` command is opaque to this layer but lets the
+worker's per-request spans join the dispatcher's trace, and worker-
+recorded spans return as ``span`` records inside the coalesced
+``telemetry_batch`` body — causal tracing costs zero new verbs, frames,
+or round trips.
+
 Negotiation rides the agent's existing ready-banner handshake (the same
 one-round-trip pattern as the ``COVALENT_TPU_CODECS=`` pre-flight probe):
 a frame-capable runtime advertises ``"frames": 1`` in its ready event, the

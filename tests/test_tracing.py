@@ -1,0 +1,501 @@
+"""End-to-end causal tracing: carrier hardening, the tail-sampled trace
+store, waterfall assembly, exemplar-linked histograms, the ``/traces``
+ops routes, and the continuity contracts — one trace id follows an
+electron across gang retries (``op`` -> ``op.r1``) and a serving request
+across the warm handoff (ISSUE 16 acceptance).
+
+Unit tests construct private :class:`TraceStore`/:class:`Registry`
+instances with explicit bounds and sample rates (no env, no globals);
+the integration tests at the bottom drive the REAL local transport and
+read the process-wide store the ops endpoint serves.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from covalent_tpu_plugin.obs import events as obs_events
+from covalent_tpu_plugin.obs.metrics import Registry
+from covalent_tpu_plugin.obs.tracestore import TRACE_STORE, TraceStore
+from covalent_tpu_plugin.obs.trace import (
+    Span,
+    context_of,
+    extract_context,
+    record_span,
+)
+
+from .helpers import make_local_executor
+
+
+# --------------------------------------------------------------------- #
+# Carrier round-trip + malformed-carrier hardening
+# --------------------------------------------------------------------- #
+
+
+def test_context_roundtrip():
+    with Span("root", emit=False) as root:
+        carrier = context_of(root, attempt=2)
+    assert carrier["trace_id"] == root.trace_id
+    assert carrier["span_id"] == root.span_id
+    assert carrier["attempt"] == 2  # extras ride along verbatim
+    assert extract_context(carrier) == (root.trace_id, root.span_id)
+    # The round trip survives JSON (the carrier rides a frame header).
+    wired = json.loads(json.dumps(carrier))
+    assert extract_context(wired) == (root.trace_id, root.span_id)
+
+
+@pytest.mark.parametrize(
+    "carrier",
+    [
+        None,
+        "",
+        "tid:sid",
+        42,
+        [],
+        ["trace_id", "span_id"],
+        {},
+        {"trace_id": "t"},                      # span_id missing
+        {"span_id": "s"},                       # trace_id missing
+        {"trace_id": "", "span_id": "s"},       # falsy id
+        {"trace_id": None, "span_id": "s"},
+        {"trace_id": ["t"], "span_id": "s"},    # wrong type
+        {"trace_id": "t", "span_id": {"x": 1}},
+    ],
+)
+def test_extract_context_rejects_malformed_carriers(carrier):
+    assert extract_context(carrier) is None
+
+
+def test_extract_context_coerces_int_ids():
+    # JSON off an old/foreign producer may carry numeric ids; they
+    # stringify rather than poison downstream string handling.
+    assert extract_context({"trace_id": 7, "span_id": 9}) == ("7", "9")
+
+
+def test_span_adopts_remote_context():
+    carrier = {"trace_id": "t" * 32, "span_id": "p" * 16}
+    with Span("remote.child", emit=False,
+              context=extract_context(carrier)) as child:
+        pass
+    assert child.trace_id == "t" * 32
+    assert child.parent_id == "p" * 16
+    # A live LOCAL parent still wins over a remote carrier.
+    with Span("local.root", emit=False) as root:
+        with Span("leaf", emit=False,
+                  context=extract_context(carrier)) as leaf:
+            pass
+    assert leaf.trace_id == root.trace_id
+    assert leaf.parent_id == root.span_id
+
+
+def test_record_span_mints_and_preserves_ids():
+    seen: list[dict] = []
+    listener = seen.append
+    obs_events.add_listener(listener)
+    try:
+        sid = record_span("retro.minted", duration_s=-0.5)
+        record_span(
+            "retro.given",
+            trace_id="T1",
+            parent_id="P1",
+            span_id="S1",
+            start_ts=123.0,
+            duration_s=0.25,
+            status="ERROR",
+            attributes={"segment": "x"},
+        )
+    finally:
+        obs_events.remove_listener(listener)
+    minted = next(e for e in seen if e["name"] == "retro.minted")
+    assert minted["span_id"] == sid and len(sid) == 16
+    assert len(minted["trace_id"]) == 32  # fresh root trace minted
+    assert minted["duration_s"] == 0.0   # negative clamps, never raises
+    given = next(e for e in seen if e["name"] == "retro.given")
+    assert given["trace_id"] == "T1" and given["parent_id"] == "P1"
+    assert given["span_id"] == "S1" and given["start_ts"] == 123.0
+    assert given["status"] == "ERROR"
+    assert given["attributes"]["segment"] == "x"
+
+
+# --------------------------------------------------------------------- #
+# Trace store: assembly + tail-based keep decisions
+# --------------------------------------------------------------------- #
+
+
+def feed(store, trace_id, name, *, parent=None, span_id=None,
+         start_ts=100.0, duration_s=0.01, status="OK", attributes=None):
+    store.record_event({
+        "type": "span",
+        "name": name,
+        "trace_id": trace_id,
+        "span_id": span_id or f"{name}-id",
+        "parent_id": parent,
+        "start_ts": start_ts,
+        "duration_s": duration_s,
+        "status": status,
+        **({"attributes": attributes} if attributes else {}),
+    })
+
+
+def test_store_assembles_on_root_close():
+    store = TraceStore(sample=1.0)
+    feed(store, "t1", "child", parent="root-id", start_ts=100.1)
+    assert store.waterfall("t1")["keep_reason"] == "open"  # still pending
+    feed(store, "t1", "root", span_id="root-id", duration_s=0.5)
+    view = store.waterfall("t1")
+    assert view["keep_reason"] == "sampled"
+    assert view["root"] == "root" and view["duration_s"] == 0.5
+    assert view["span_count"] == 2
+    index = store.index()
+    assert index["traces"][0]["trace_id"] == "t1"
+    assert index["finalized"] == 1 and index["kept_total"] == 1
+
+
+def test_store_sampling_drops_unremarkable_traces():
+    store = TraceStore(sample=0.0)
+    feed(store, "t1", "root")
+    assert store.waterfall("t1") is None
+    assert store.index()["count"] == 0
+    # ... and the dropped memory refuses straggler resurrection.
+    feed(store, "t1", "straggler", parent="root-id")
+    assert store.waterfall("t1") is None
+    assert store.index()["pending"] == 0
+
+
+def test_store_always_keeps_errors():
+    store = TraceStore(sample=0.0)
+    feed(store, "t1", "child", parent="root-id", status="ERROR")
+    feed(store, "t1", "root", span_id="root-id")
+    assert store.waterfall("t1")["keep_reason"] == "error"
+
+
+def test_store_keeps_slo_burn_window_traces():
+    store = TraceStore(sample=0.0)
+    store.record_event({"type": "slo.burn", "slo": "serve_p95"})
+    feed(store, "t1", "root")
+    store.record_event({"type": "slo.recovered", "slo": "serve_p95"})
+    feed(store, "t2", "root")
+    assert store.waterfall("t1")["keep_reason"] == "slo_burn"
+    assert store.waterfall("t2") is None  # burn over: back to sampling
+
+
+def test_store_keeps_p99_outliers():
+    store = TraceStore(sample=0.0)
+    # Gently DECREASING durations: each root stays under the p99 of its
+    # history, so nothing trips the outlier rule while the baseline
+    # accumulates past the minimum-history gate.
+    for i in range(25):
+        feed(store, f"fast{i}", "serve.request", duration_s=0.05 - 0.001 * i)
+    assert store.index()["count"] == 0  # unremarkable, all sampled out
+    feed(store, "slow", "serve.request", duration_s=5.0)
+    assert store.waterfall("slow")["keep_reason"] == "p99_outlier"
+
+
+def test_store_splices_stragglers_into_kept_traces():
+    store = TraceStore(sample=1.0, max_spans=3)
+    feed(store, "t1", "root", span_id="root-id")
+    feed(store, "t1", "worker.decode", parent="root-id", start_ts=100.2)
+    view = store.waterfall("t1")
+    assert view["span_count"] == 2
+    assert [s["name"] for s in view["spans"]] == ["root", "worker.decode"]
+    # Splice respects the span cap: overflow is counted, not stored.
+    feed(store, "t1", "late1", parent="root-id")
+    feed(store, "t1", "late2", parent="root-id")
+    view = store.waterfall("t1")
+    assert view["span_count"] == 3
+    assert view["dropped_spans"] == 1
+
+
+def test_store_bounds_kept_and_pending():
+    store = TraceStore(sample=1.0, max_traces=2, max_pending=2)
+    for tid in ("a", "b", "c"):
+        feed(store, tid, "root")
+    ids = [t["trace_id"] for t in store.index()["traces"]]
+    assert ids == ["c", "b"]  # newest-first, LRU-evicted past the cap
+    # Pending overflow finalizes the stalest open trace as "evicted"
+    # (sampled like the rest; sample=1.0 keeps it, root unknown).
+    feed(store, "p1", "child1", parent="x")
+    feed(store, "p2", "child2", parent="y")
+    feed(store, "p3", "child3", parent="z")
+    assert store.index()["pending"] == 2
+    evicted = store.waterfall("p1")
+    assert evicted is not None and evicted["keep_reason"] == "evicted"
+    assert evicted["duration_s"] is None  # root never closed
+
+
+def test_waterfall_offsets_depths_orphans_segments_coverage():
+    store = TraceStore(sample=1.0)
+    feed(store, "t1", "serve.prefill", parent="root-id",
+         start_ts=100.0, duration_s=0.3,
+         attributes={"segment": "prefill"})
+    feed(store, "t1", "serve.ttft_wait", parent="root-id",
+         start_ts=100.3, duration_s=0.5,
+         attributes={"segment": "ttft_wait"})
+    feed(store, "t1", "worker.decode", parent="missing-parent",
+         start_ts=100.4, duration_s=0.1)
+    feed(store, "t1", "serve.request", span_id="root-id",
+         start_ts=100.0, duration_s=1.0)
+    view = store.waterfall("t1")
+    by_name = {s["name"]: s for s in view["spans"]}
+    assert by_name["serve.request"]["depth"] == 0
+    assert by_name["serve.prefill"]["depth"] == 1
+    assert by_name["serve.prefill"]["offset_s"] == 0.0
+    assert by_name["serve.ttft_wait"]["offset_s"] == pytest.approx(0.3)
+    assert by_name["worker.decode"]["orphan"] is True
+    assert not by_name["serve.prefill"]["orphan"]
+    assert view["segments"] == {
+        "prefill": {"duration_s": 0.3, "count": 1},
+        "ttft_wait": {"duration_s": 0.5, "count": 1},
+    }
+    assert view["coverage"] == pytest.approx(0.8)
+    # Spans come back start-ordered for direct waterfall rendering.
+    assert [s["name"] for s in view["spans"]][0] in (
+        "serve.request", "serve.prefill"
+    )
+    dump = store.dump()
+    assert [t["trace_id"] for t in dump["traces"]] == ["t1"]
+    json.dumps(dump)  # artifact-ready end to end
+
+
+# --------------------------------------------------------------------- #
+# Exemplars: histogram -> trace cross-link
+# --------------------------------------------------------------------- #
+
+
+def test_histogram_exemplars_in_snapshot():
+    reg = Registry()
+    h = reg.histogram("rt_seconds", "", buckets=(0.5, 2.0))
+    h.observe(0.1, trace_id="trace-fast")
+    h.observe(1.0, trace_id="trace-mid-old")
+    h.observe(1.2, trace_id="trace-mid-new")
+    h.observe(0.7)  # no trace: must not clobber the bucket's exemplar
+    series = reg.snapshot()["metrics"]["rt_seconds"]["series"][0]
+    exemplars = series["exemplars"]
+    by_trace = {e["trace_id"]: e for e in exemplars.values()}
+    assert "trace-fast" in by_trace
+    # Most-recent-per-bucket: the newer mid-bucket observation wins.
+    assert "trace-mid-new" in by_trace
+    assert "trace-mid-old" not in by_trace
+    assert by_trace["trace-mid-new"]["value"] == 1.2
+
+
+def test_openmetrics_exposition_carries_exemplars():
+    reg = Registry()
+    h = reg.histogram("rt_seconds", "round trips", buckets=(0.5,))
+    h.observe(0.1, trace_id="abc123")
+    plain = reg.prometheus_text()
+    assert "# {" not in plain and "# EOF" not in plain
+    om = reg.prometheus_text(openmetrics=True)
+    assert '# {trace_id="abc123"}' in om
+    assert om.endswith("# EOF\n")
+
+
+# --------------------------------------------------------------------- #
+# Ops routes: /traces index + waterfall, OpenMetrics negotiation
+# --------------------------------------------------------------------- #
+
+
+@pytest.fixture()
+def ops_server(monkeypatch):
+    from covalent_tpu_plugin.obs import opsserver as ops_mod
+
+    monkeypatch.setenv("COVALENT_TPU_OPS_PORT", "0")
+    server = ops_mod.OpsServer(port=0)
+    yield server
+    server.close()
+
+
+def http_get(port: int, path: str, accept: str | None = None):
+    request = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        headers={"Accept": accept} if accept else {},
+    )
+    with urllib.request.urlopen(request, timeout=10) as response:
+        return (
+            response.status,
+            response.read(),
+            response.headers.get("Content-Type", ""),
+        )
+
+
+@pytest.fixture()
+def kept_trace():
+    """One finalized trace in the process-wide store, removed after."""
+    TRACE_STORE.sample = 1.0
+    tid = "ops-route-trace"
+    try:
+        TRACE_STORE.record_event({
+            "type": "span", "name": "serve.request", "trace_id": tid,
+            "span_id": "root-id", "parent_id": None,
+            "start_ts": 100.0, "duration_s": 0.5, "status": "OK",
+        })
+        yield tid
+    finally:
+        TRACE_STORE._sample_override = None
+        with TRACE_STORE._lock:
+            TRACE_STORE._kept.pop(tid, None)
+
+
+def test_ops_traces_routes(ops_server, kept_trace):
+    status, body, _ = http_get(ops_server.port, "/traces")
+    assert status == 200
+    index = json.loads(body)
+    assert kept_trace in [t["trace_id"] for t in index["traces"]]
+    status, body, _ = http_get(ops_server.port, f"/traces/{kept_trace}")
+    assert status == 200
+    view = json.loads(body)
+    assert view["root"] == "serve.request"
+    assert view["spans"][0]["span_id"] == "root-id"
+    with pytest.raises(urllib.error.HTTPError) as err:
+        http_get(ops_server.port, "/traces/no-such-trace")
+    assert err.value.code == 404
+
+
+def test_ops_metrics_openmetrics_negotiation(ops_server):
+    status, body, ctype = http_get(ops_server.port, "/metrics")
+    assert status == 200
+    assert "openmetrics" not in ctype
+    assert not body.decode().endswith("# EOF\n")
+    for request_kwargs in (
+        {"path": "/metrics?format=openmetrics"},
+        {"path": "/metrics",
+         "accept": "application/openmetrics-text; version=1.0.0"},
+    ):
+        status, body, ctype = http_get(ops_server.port, **request_kwargs)
+        assert status == 200
+        assert "application/openmetrics-text" in ctype
+        assert body.decode().endswith("# EOF\n")
+
+
+def test_flightrec_cross_links_traces():
+    from covalent_tpu_plugin.obs.flightrec import FlightRecorder
+
+    rec = FlightRecorder()
+    rec.record_event({
+        "type": "task.state", "operation_id": "xl_0.r1",
+        "state": "submitted", "trace_id": "trace-xl",
+    })
+    view = rec.view("xl_0")  # retry records file under the base op id
+    assert view["trace_id"] == "trace-xl"
+    assert view["trace_url"] == "/traces/trace-xl"
+
+
+# --------------------------------------------------------------------- #
+# Continuity: one trace across gang retries and the warm handoff
+# --------------------------------------------------------------------- #
+
+
+@pytest.fixture()
+def events_file(tmp_path):
+    path = tmp_path / "events.jsonl"
+    obs_events.configure(str(path))
+    yield path
+    obs_events.reset()
+
+
+def read_events(path) -> list[dict]:
+    if not path.exists():
+        return []
+    return [json.loads(line) for line in path.read_text().splitlines()]
+
+
+def test_retry_keeps_one_trace_across_attempts(
+    tmp_path, run_async, events_file
+):
+    """``op`` -> ``op.r1``: the channel dies mid-poll, the gang is retried,
+    and every span + worker event of BOTH attempts shares one trace id."""
+    from covalent_tpu_plugin.transport import ChaosPlan
+
+    plan = ChaosPlan(drop_match="if test -f", max_faults=1)
+    ex = make_local_executor(
+        tmp_path, chaos=plan, max_task_retries=2,
+        retry_base_delay=0.05, retry_max_delay=0.1, poll_freq=0.1,
+    )
+
+    async def flow():
+        try:
+            return await ex.run(
+                lambda a, b: a + b, [20, 22], {},
+                {"dispatch_id": "tracecont", "node_id": 0},
+            )
+        finally:
+            await ex.close()
+
+    assert run_async(flow()) == 42
+    assert plan.faults_injected == 1 and ex.last_attempts == 2
+    events = read_events(events_file)
+    runs = [e for e in events if e["type"] == "span"
+            and e["name"] == "executor.run"]
+    assert len(runs) == 2  # one span per attempt...
+    assert len({e["trace_id"] for e in runs}) == 1  # ...one trace
+    assert [e["attributes"]["attempt"] for e in runs] == [0, 1]
+    trace_id = runs[0]["trace_id"]
+    worker = [e for e in events if e["type"].startswith("worker.")]
+    ops = {e["operation_id"] for e in worker}
+    # The retried attempt ran to completion, so its worker records are
+    # guaranteed; the killed first attempt's are racy (the gang may die
+    # before its harness wrote anything) — but whatever DID land carries
+    # the one trace id.
+    assert "tracecont_0.r1" in ops
+    assert all(e["trace_id"] == trace_id for e in worker)
+
+
+def test_warm_handoff_keeps_one_serving_trace(tmp_path, run_async):
+    """The request's root span survives the drain-and-reopen: same trace
+    id on both generations, one finalized store entry whose waterfall
+    segments tile the request end to end with zero orphan spans."""
+    from covalent_tpu_plugin.obs.tracestore import ensure_trace_store
+    from covalent_tpu_plugin.serving import open_session
+
+    from .test_serving import make_factory, make_serve_executor
+
+    store = ensure_trace_store()
+    store.sample = 1.0
+
+    async def flow():
+        ex = make_serve_executor(tmp_path)
+        try:
+            handle = await open_session(
+                ex, make_factory(step_delay=0.1, default_cap=12)
+            )
+            requests = [await handle.request([100 * i]) for i in range(2)]
+            for _ in range(200):
+                if all(len(r.tokens) >= 4 for r in requests):
+                    break
+                await asyncio.sleep(0.05)
+            before = [r.span.trace_id for r in requests]
+            moved = await handle.handoff(reason="trace-test")
+            results = [await r.result(timeout=60) for r in requests]
+            after = [r.span.trace_id for r in requests]
+            await handle.close()
+        finally:
+            await ex.close()
+        return moved, results, before, after
+
+    try:
+        moved, results, before, after = run_async(flow())
+    finally:
+        store._sample_override = None
+    assert moved is True
+    for i, tokens in enumerate(results):
+        assert tokens == [100 * i + j + 1 for j in range(12)], tokens
+    assert before == after  # continuity: the handoff never re-rooted
+    for trace_id in after:
+        view = store.waterfall(trace_id)
+        assert view is not None, f"trace {trace_id} never finalized"
+        assert view["root"] == "serve.request"
+        assert not any(s["orphan"] for s in view["spans"]), view["spans"]
+        segments = view["segments"]
+        # The streaming tiles must be there; route/dispatch tiles may
+        # collapse to zero width on the local transport and drop out.
+        assert "ttft_wait" in segments and "decode_stream" in segments
+        # Tiling covers the request end to end (within rounding).
+        assert view["coverage"] == pytest.approx(1.0, abs=0.11)
+        # Worker-side spans off BOTH generations joined the trace.
+        names = {s["name"] for s in view["spans"]}
+        assert "serve.worker.decode" in names
