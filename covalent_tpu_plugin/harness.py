@@ -2163,10 +2163,28 @@ class _ServeSession:
             for key in (
                 "prefix_hits", "prefix_misses", "prefill_positions",
                 "prefix_evictions", "kv_exports",
+                "spec_rounds", "spec_proposed", "spec_accepted",
+                "spec_refusals", "mode_refusals",
             ):
                 value = engine_stats.get(key)
                 if isinstance(value, (int, float)):
                     extra[key] = value
+            # Per-decode-mode token counters ride through verbatim (the
+            # mode set is closed, so the key space is bounded).
+            for key, value in engine_stats.items():
+                if key.startswith("mode_tokens_") and isinstance(
+                    value, (int, float)
+                ):
+                    extra[key] = value
+            # The accept rate is computed HERE (not on the dispatcher)
+            # so any engine exposing the two counters — the real one or
+            # a CI stub — feeds the gauge the same way.
+            proposed = engine_stats.get("spec_proposed")
+            if isinstance(proposed, (int, float)) and proposed > 0:
+                accepted = engine_stats.get("spec_accepted") or 0
+                extra["spec_accept_rate"] = round(
+                    float(accepted) / float(proposed), 4
+                )
         if self.kv_admits or self.kv_fallbacks:
             extra["kv_admits"] = self.kv_admits
             extra["kv_fallbacks"] = self.kv_fallbacks
@@ -2317,7 +2335,18 @@ class _ServeSession:
                 pass
 
     def _pump_engine(self) -> None:
-        """One decode chunk for every busy lane; stream fresh tokens."""
+        """One decode chunk for every busy lane; stream fresh tokens.
+
+        On speculative engines (``engine.spec_active``) the chunk's wall
+        time is attributed per-request PROPORTIONALLY to each request's
+        share of the chunk's fresh tokens, accumulated per lane and
+        attached to the final token record as ``spec_verify_s`` — the
+        dispatcher tiles it into the request's latency waterfall.  An
+        attribution, not a measurement: lanes decode fused, so a
+        per-request split of one wave is proportional by construction.
+        """
+        spec = bool(getattr(self._engine, "spec_active", False))
+        t_step = time.monotonic()
         try:
             events = self._engine.step() or []
         except BaseException as err:  # noqa: BLE001 - engine crash fails all
@@ -2326,6 +2355,10 @@ class _ServeSession:
                 self._cancel_lane(rid)
                 self.running.pop(rid, None)
             return
+        step_s = time.monotonic() - t_step
+        chunk_tokens = sum(
+            len(e.get("tokens") or ()) for e in events
+        ) if spec else 0
         for event in events:
             rid = str(event.get("rid") or "")
             state = self.running.get(rid)
@@ -2340,10 +2373,19 @@ class _ServeSession:
                 k: v for k, v in event.items()
                 if k not in ("rid", "tokens", "done")
             }
+            if spec and chunk_tokens and tokens:
+                state["spec_s"] = (
+                    state.get("spec_s", 0.0)
+                    + step_s * len(tokens) / chunk_tokens
+                )
             if done:
                 extra.setdefault(
                     "gen_s", round(time.monotonic() - state["t_admit"], 6)
                 )
+                if state.get("spec_s"):
+                    extra.setdefault(
+                        "spec_verify_s", round(state["spec_s"], 6)
+                    )
                 # Span BEFORE the final token record: the dispatcher
                 # finalizes the trace on ``done``, and the side-band is
                 # ordered — emitting after would strand the decode span

@@ -156,6 +156,52 @@ def quantize_array(w: jax.Array, n_feature_dims: int):
     return q, scale
 
 
+#: The serving tier's closed decode-mode set (the per-request ``quality``
+#: knob's values).  ``fp`` is the bit-exact reference lane every refusal
+#: falls back to; the others trade exactness for HBM bytes.
+SERVING_MODES = ("fp", "int8", "kv_quant", "full_quant")
+
+
+def mode_variant(model, params, mode: str) -> tuple[Any, Any]:
+    """``(model, params)`` twin for one serving decode mode.
+
+    * ``fp`` — the inputs, untouched (bit-exact reference lane);
+    * ``int8`` — weight-only int8 via :func:`quantize_lm`;
+    * ``kv_quant`` — same weights, int8 KV cache
+      (``TransformerConfig.quantized_kv_cache``);
+    * ``full_quant`` — both.
+
+    Raises :class:`ValueError` on an unknown mode name (a config typo —
+    callers should fail loudly) and propagates :func:`quantize_lm`'s
+    refusals (MoE / scanned / LoRA models), which the serving engine
+    treats as a per-mode refusal with fp fallback rather than an error.
+    """
+    if mode not in SERVING_MODES:
+        raise ValueError(
+            f"unknown decode mode {mode!r}; expected one of {SERVING_MODES}"
+        )
+    if mode == "fp":
+        return model, params
+    from .transformer import TransformerLM
+
+    if mode == "int8":
+        return quantize_lm(model, params)
+    if mode == "kv_quant":
+        return (
+            TransformerLM(
+                dataclasses.replace(model.config, quantized_kv_cache=True)
+            ),
+            params,
+        )
+    qmodel, qparams = quantize_lm(model, params)
+    return (
+        TransformerLM(
+            dataclasses.replace(qmodel.config, quantized_kv_cache=True)
+        ),
+        qparams,
+    )
+
+
 def quantize_lm(model, params) -> tuple[Any, Any]:
     """(quantized model, quantized params) from a trained LM.
 

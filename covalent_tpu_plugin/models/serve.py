@@ -68,7 +68,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .decode import _decode_model, _filter_top_k, init_cache
-from .speculative import _set_cursor
+from .quant import SERVING_MODES, mode_variant
+from .speculative import _set_cursor, make_lane_spec_round
 from .transformer import TransformerLM
 
 #: Wire format version of a serialized KV bundle (prefill_only's output).
@@ -275,6 +276,86 @@ def _make_kv_admit(eos_token_id, batch, g):
         return caches, buffer, pos, plen, row_cap, n_gen, done, rng
 
     return admit_wave
+
+
+@functools.lru_cache(maxsize=32)
+def _make_draft_admit(draft_decoder, batch, bucket, g):
+    """Fused DRAFT-cache admission wave for speculative decoding: one
+    batched full-prompt prefill through the draft model, lanes scattered
+    into the donated draft cache stack.
+
+    Always full-prompt (the draft skips the prefix tree — its prefill is
+    a small fraction of the target's and sharing lanes across two models
+    would double the tree's memory for little win).  Stale positions past
+    the rewound cursor stay dead until the first spec round's repair slab
+    overwrites them — the admission waves' usual exactness argument.
+    """
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def admit_wave(d_params, dcaches, padded, plens, slots):
+        def lane_prefill(tokens, pl):
+            zero = jax.tree_util.tree_map(
+                lambda c: jnp.zeros(c.shape[1:], c.dtype), dcaches
+            )
+            _, mutated = draft_decoder.apply(
+                {"params": d_params, "cache": zero}, tokens[None],
+                mutable=["cache"],
+            )
+            return _set_cursor(mutated["cache"], pl)
+
+        lanes = jax.vmap(lane_prefill)(padded, plens)
+        return jax.tree_util.tree_map(
+            lambda c, nl: c.at[slots].set(nl, mode="drop"), dcaches, lanes
+        )
+
+    return admit_wave
+
+
+@functools.lru_cache(maxsize=32)
+def _make_spec_run_steps(decoder, draft_decoder, eos_token_id, length,
+                         draft_len, rounds, batch):
+    """Jitted speculative serving chunk: ``rounds`` draft-and-verify
+    rounds across every lane per compiled call (cached on its statics,
+    like :func:`_make_run_steps`).
+
+    Each round is :func:`..speculative.make_lane_spec_round` vmapped over
+    the slots — the verify slab is ONE fused target pass per wave, every
+    lane's ``draft_len + 1`` candidate positions scored together.  The
+    serving state AND the draft cache stack are donated; the returned
+    ``(proposed, accepted)`` counters are the chunk's summed draft
+    agreement (the accept-rate numerator/denominator the serving metrics
+    export).  The rng chain rides untouched: the continuous spec path is
+    greedy-only (the engine refuses a draft on sampled sessions), so
+    unlike :func:`_make_run_steps` no keys are consumed.
+    """
+    lane_round = make_lane_spec_round(
+        decoder, draft_decoder, eos_token_id, length, draft_len
+    )
+
+    def one_round(params, draft_params, carry, _):
+        state, dcaches, proposed, accepted = carry
+        caches, buffer, pos, plen, row_cap, n_gen, done, rng = state
+        (caches, dcaches, buffer, pos, n_gen, done, prop, acc) = jax.vmap(
+            lane_round, in_axes=(None, None, 0, 0, 0, 0, 0, 0, 0)
+        )(params, draft_params, caches, dcaches, buffer, pos, row_cap,
+          n_gen, done)
+        state = (caches, buffer, pos, plen, row_cap, n_gen, done, rng)
+        return (
+            state, dcaches,
+            proposed + jnp.sum(prop), accepted + jnp.sum(acc),
+        ), None
+
+    @functools.partial(jax.jit, donate_argnums=(2, 3))
+    def run_steps(params, draft_params, state, dcaches):
+        (state, dcaches, proposed, accepted), _ = jax.lax.scan(
+            functools.partial(one_round, params, draft_params),
+            (state, dcaches, jnp.zeros((), jnp.int32),
+             jnp.zeros((), jnp.int32)),
+            None, length=rounds,
+        )
+        return state, dcaches, proposed, accepted
+
+    return run_steps
 
 
 @functools.lru_cache(maxsize=64)
@@ -794,6 +875,32 @@ class ContinuousEngine:
     a decode-tier engine composed this way stream greedy tokens
     bit-identical to one engine doing both (the serving tier's
     ``DisaggregatedSet`` rides exactly this pair through the CAS).
+
+    **Speculative decoding (``draft_model``).**  With a draft model the
+    greedy decode loop becomes draft-and-verify: each chunk runs
+    ``sync_steps // (draft_len + 1)`` rounds in which every lane drafts
+    ``draft_len`` tokens autoregressively through the small model, then
+    the target scores all lanes' ``draft_len + 1`` slabs in ONE fused
+    vmapped pass and commits the longest agreeing prefix plus its own
+    choice at the first disagreement.  Every committed token is the
+    target's greedy pick, so spec streams are **bit-identical** to the
+    same engine without a draft; ``stats`` grows
+    ``spec_proposed``/``spec_accepted`` (the accept-rate feed).  Any
+    construction-time refusal — sampled session, vocab mismatch,
+    rolling-cache draft, missing ``max_seq`` headroom for the verify
+    slab (``length + draft_len``) — silently falls back to the plain
+    loop (``spec_refusals`` counts it, ``_spec_refusal`` names it).
+
+    **Decode-mode lane groups (``decode_modes`` + per-request
+    ``quality``).**  Beyond the fp lanes, the engine can build int8 /
+    kv-quant / full-quant groups (:func:`..quant.mode_variant` twins,
+    each a private sub-engine with its own slots, prefix tree, and spec
+    loop).  A request's ``params["quality"]`` selects its group; unknown
+    or refused modes fall back to fp bit-exact (``mode_refusals``).  KV
+    bundles carry a ``quant`` fingerprint and only admit into the
+    matching group — a mismatch raises, and the session harness degrades
+    to a full prefill.  ``stats["mode_tokens_<mode>"]`` counts per-group
+    output tokens.
     """
 
     def __init__(
@@ -813,6 +920,10 @@ class ContinuousEngine:
         shared_prefix: Sequence[int] | None = None,
         prefix_cache_size: int = 8,
         prefix_min_tokens: int = 4,
+        decode_modes: Sequence[str] = ("fp",),
+        draft_model: TransformerLM | None = None,
+        draft_params: Any = None,
+        draft_len: int = 4,
     ) -> None:
         decoder = _decode_model(model)
         config = decoder.config
@@ -892,6 +1003,8 @@ class ContinuousEngine:
         self.stats: dict[str, int] = {
             "prefix_hits": 0, "prefix_misses": 0, "prefill_positions": 0,
             "prefix_evictions": 0, "kv_admits": 0, "kv_exports": 0,
+            "spec_rounds": 0, "spec_proposed": 0, "spec_accepted": 0,
+            "spec_refusals": 0, "mode_refusals": 0,
         }
         #: prefix digest -> _PrefixEntry, oldest-insert first (LRU order
         #: maintained by move_to_end on every hit).
@@ -932,21 +1045,182 @@ class ContinuousEngine:
             prefix_lane = _set_cursor(mutated["cache"], int(ptoks.size))
             self._insert_prefix(ptoks, lambda: prefix_lane, pinned=True)
 
+        # -- speculative decoding (greedy draft-and-verify) ----------------
+        # The draft proposes draft_len tokens per lane per round; the
+        # target verifies each lane's slab in the fused vmapped pass.
+        # Every committed token is the target's own greedy choice, so a
+        # spec session's streams are bit-identical to this engine without
+        # the draft — which is also the fallback on ANY refusal below
+        # (recorded in stats["spec_refusals"] + _spec_refusal, never an
+        # error: a serving session must come up degraded, not dead).
+        self._draft = None
+        self._draft_params = None
+        self._draft_caches = None
+        self._spec_run = None
+        self._spec_rounds = 0
+        self._spec_refusal: str | None = None
+        self._draft_len = int(draft_len)
+        if draft_model is not None:
+            if self._draft_len < 1:
+                raise ValueError(
+                    f"draft_len must be >= 1, got {draft_len}"
+                )
+            ddecoder = _decode_model(draft_model)
+            dconfig = ddecoder.config
+            reason = None
+            if self._temperature > 0:
+                reason = (
+                    "sampled session (the continuous verify path is "
+                    "greedy-only; use speculative_sample offline)"
+                )
+            elif dconfig.vocab_size != config.vocab_size:
+                reason = (
+                    f"draft vocab {dconfig.vocab_size} != target "
+                    f"{config.vocab_size}"
+                )
+            elif dconfig.rolling_cache:
+                reason = "draft model uses rolling_cache"
+            elif self._length + self._draft_len > config.max_seq:
+                reason = (
+                    f"target max_seq {config.max_seq} < length + "
+                    f"draft_len = {self._length + self._draft_len} "
+                    "(verify slabs need scratch headroom)"
+                )
+            elif self._length + self._draft_len > dconfig.max_seq:
+                reason = (
+                    f"draft max_seq {dconfig.max_seq} < length + "
+                    f"draft_len = {self._length + self._draft_len}"
+                )
+            if reason is None:
+                self._draft = ddecoder
+                self._draft_params = draft_params
+                dlane = init_cache(draft_model, 1)
+                self._draft_caches = jax.tree_util.tree_map(
+                    lambda leaf: jnp.broadcast_to(
+                        leaf[None], (batch,) + leaf.shape
+                    ).copy(),
+                    dlane,
+                )
+                # A plain chunk decodes sync_steps tokens; a spec chunk
+                # commits 1..draft_len+1 per round, so this many rounds
+                # keeps the admission-latency granularity comparable.
+                self._spec_rounds = max(
+                    1, self._sync // (self._draft_len + 1)
+                )
+                self._spec_run = _make_spec_run_steps(
+                    decoder, ddecoder, eos_token_id, self._length,
+                    self._draft_len, self._spec_rounds, batch,
+                )
+            else:
+                self._spec_refusal = reason
+                self.stats["spec_refusals"] += 1
+
+        # -- decode-mode lane groups (per-request quality routing) ---------
+        # Each non-fp mode is a full sub-engine over the mode_variant
+        # model twin: its own slots, prefix tree, compiled programs, and
+        # (when a draft is configured) its own spec verify loop against
+        # ITS target — so an int8 lane's spec commits the int8 model's
+        # greedy choices.  The primary stays the fp group and the single
+        # public surface; total concurrency across all groups is bounded
+        # by ``slots`` (the ``busy`` property sums the groups), trading
+        # lane memory for never refusing a routed request that the
+        # session-level admission already accepted.  A mode that REFUSES
+        # to build (quantize_lm on MoE/scanned/LoRA models) is recorded
+        # and its requests fall back to fp, bit-exact.
+        modes = tuple(dict.fromkeys(decode_modes or ("fp",)))
+        for mode in modes:
+            if mode not in SERVING_MODES:
+                raise ValueError(
+                    f"unknown decode mode {mode!r}; expected a subset "
+                    f"of {SERVING_MODES}"
+                )
+        if "fp" not in modes:
+            raise ValueError(
+                "decode_modes must include 'fp' (the bit-exact fallback "
+                "lane every refusal degrades to)"
+            )
+        self._mode = "fp"
+        self._subs: dict[str, ContinuousEngine] = {}
+        self._sub_stats_seen: dict[str, dict[str, int]] = {}
+        self._rid_mode: dict[str, str] = {}
+        self._mode_refusal: dict[str, str] = {}
+        for mode in modes:
+            if mode == "fp":
+                continue
+            try:
+                sub_model, sub_params = mode_variant(model, params, mode)
+            except ValueError as exc:
+                self._mode_refusal[mode] = str(exc)
+                self.stats["mode_refusals"] += 1
+                continue
+            sub = ContinuousEngine(
+                sub_model, sub_params,
+                max_batch=max_batch, temperature=temperature,
+                top_k=top_k, rng=rng, eos_token_id=eos_token_id,
+                pad_token_id=pad_token_id, sync_steps=sync_steps,
+                max_new_tokens=max_new_tokens, length=self._length,
+                shared_prefix=shared_prefix,
+                prefix_cache_size=prefix_cache_size,
+                prefix_min_tokens=prefix_min_tokens,
+                draft_model=draft_model, draft_params=draft_params,
+                draft_len=draft_len,
+            )
+            sub._mode = mode
+            self._subs[mode] = sub
+            self._sub_stats_seen[mode] = {}
+        for mode in modes:
+            self.stats.setdefault(f"mode_tokens_{mode}", 0)
+
     # -- serving-engine surface -------------------------------------------
+
+    def _dup(self, rid: str) -> bool:
+        """True when ``rid`` is already admitted anywhere: a live or
+        pending lane here, or routed to a mode group."""
+        return (
+            rid in self._rid_slot
+            or rid in self._rid_mode
+            or any(p[0] == rid for p in self._pending)
+            or any(p[0] == rid for p in self._pending_kv)
+        )
+
+    def _route_mode(self, params: dict) -> str:
+        """Resolve a request's ``quality`` knob to a decode mode.
+
+        ``None``/``"exact"``/``"fp"`` → the fp lane.  A known mode with a
+        built lane group → that group.  Anything else — an unknown value,
+        or a mode this session refused/never configured — falls back to
+        the bit-exact fp lane and counts a ``mode_refusals`` (a serving
+        session degrades, it does not reject a request over a knob).
+        """
+        quality = params.get("quality")
+        if quality is None:
+            return self._mode
+        mode = "fp" if quality == "exact" else str(quality)
+        if mode == self._mode or mode in self._subs:
+            return mode
+        self.stats["mode_refusals"] += 1
+        return self._mode
 
     def admit(self, rid: str, prompt, params: dict | None = None) -> None:
         """Reserve a lane for one request (flushed at the next step).
 
-        ``params`` may carry ``max_new_tokens``; everything else
-        (temperature, top_k, EOS) is session-static — the compiled
+        ``params`` may carry ``max_new_tokens`` and ``quality`` (a
+        decode-mode name — see :func:`..quant.mode_variant`; unknown or
+        unavailable modes fall back to the bit-exact fp lane); everything
+        else (temperature, top_k, EOS) is session-static — the compiled
         programs key on them.  Raises on malformed prompts, so the
         session rejects the request instead of wedging a lane.
         """
         params = params or {}
-        if rid in self._rid_slot or any(
-            p[0] == rid for p in self._pending
-        ) or any(p[0] == rid for p in self._pending_kv):
+        if self._dup(rid):
             raise ValueError(f"request id {rid!r} already admitted")
+        mode = self._route_mode(params)
+        if mode != self._mode:
+            if self.busy >= self.slots:
+                raise RuntimeError("no free lane (all slots busy)")
+            self._subs[mode].admit(rid, prompt, params)
+            self._rid_mode[rid] = mode
+            return
         tokens = np.asarray(prompt, np.int32).reshape(-1)
         if tokens.size < 1:
             raise ValueError("prompt needs at least one token")
@@ -978,8 +1252,18 @@ class ContinuousEngine:
         bundle streams greedy tokens bit-identical to one engine doing
         both phases.  Consumes one key from this engine's admission
         chain, like a normal admission.
+
+        The bundle carries a quantization fingerprint (``quant``: this
+        lane group's decode mode) validated by :meth:`admit_from_kv`
+        exactly like the sampling fingerprint; a request's ``quality``
+        knob routes the prefill to the matching mode group, so a
+        ``kv_quant``/``full_quant`` prefill ships int8 KV leaves —
+        roughly 2-4x smaller on the wire than the fp lane's f32/bf16.
         """
         params = params or {}
+        mode = self._route_mode(params)
+        if mode != self._mode:
+            return self._subs[mode].prefill_only(prompt, params)
         tokens = np.asarray(prompt, np.int32).reshape(-1)
         if tokens.size < 1:
             raise ValueError("prompt needs at least one token")
@@ -1044,6 +1328,7 @@ class ContinuousEngine:
             "temperature": self._temperature,
             "top_k": self._top_k,
             "eos": self._eos,
+            "quant": self._mode,
             "leaves": [np.asarray(leaf) for leaf in leaves],
         }
         return pickle.dumps(bundle, protocol=4)
@@ -1061,9 +1346,13 @@ class ContinuousEngine:
         statics — a bundle from a different model shape OR a
         differently-configured engine raises :class:`ValueError` so the
         session falls back to a full prefill instead of decoding a
-        stream whose first token was drawn under different rules.  No
-        admission key is consumed (the first token was drawn by the
-        prefill tier).
+        stream whose first token was drawn under different rules.  The
+        bundle's QUANTIZATION fingerprint (``quant``, default ``fp`` for
+        pre-0.17 bundles) routes it to the matching decode-mode lane
+        group; a bundle for a mode this session never built raises the
+        same way — degrade to full prefill, never decode fp tokens
+        against int8 K/V.  No admission key is consumed (the first token
+        was drawn by the prefill tier).
         """
         params = params or {}
         if isinstance(bundle, (bytes, bytearray)):
@@ -1072,6 +1361,34 @@ class ContinuousEngine:
             bundle.get("v") or 0
         ) != KV_BUNDLE_VERSION:
             raise ValueError("unrecognized KV bundle")
+        if self._dup(rid):
+            raise ValueError(f"request id {rid!r} already admitted")
+        quant = str(bundle.get("quant", "fp") or "fp")
+        if quant != self._mode:
+            sub = self._subs.get(quant)
+            if sub is None:
+                raise ValueError(
+                    f"KV bundle quantization fingerprint {quant!r} does "
+                    f"not match this engine's {self._mode!r} and no "
+                    f"{quant!r} lane group is configured"
+                )
+            if self.busy >= self.slots:
+                raise RuntimeError("no free lane (all slots busy)")
+            sub._admit_from_kv_dict(rid, bundle, params)
+            self._rid_mode[rid] = quant
+            return
+        self._admit_from_kv_dict(rid, bundle, params)
+
+    def _admit_from_kv_dict(
+        self, rid: str, bundle: dict, params: dict
+    ) -> None:
+        """Validate + queue one unpickled bundle into THIS lane group."""
+        quant = str(bundle.get("quant", "fp") or "fp")
+        if quant != self._mode:
+            raise ValueError(
+                f"KV bundle quantization fingerprint {quant!r} does not "
+                f"match this lane group's {self._mode!r}"
+            )
         fingerprint = (
             float(bundle.get("temperature", 0.0) or 0.0),
             bundle.get("top_k"),
@@ -1083,9 +1400,7 @@ class ContinuousEngine:
                 f"KV bundle sampling fingerprint {fingerprint} does not "
                 f"match this engine's {ours}"
             )
-        if rid in self._rid_slot or any(
-            p[0] == rid for p in self._pending
-        ) or any(p[0] == rid for p in self._pending_kv):
+        if self._dup(rid):
             raise ValueError(f"request id {rid!r} already admitted")
         tokens = np.asarray(bundle.get("prompt") or (), np.int32).reshape(-1)
         if tokens.size < 1:
@@ -1130,12 +1445,59 @@ class ContinuousEngine:
         ``{"rid", "tokens": [int, ...], "done": bool}`` — the first
         event includes the admission-prefill token, the final one the
         EOS (when configured), exactly the rows ``continuous_generate``
-        would return, just delivered incrementally.
+        would return, just delivered incrementally.  Busy decode-mode
+        lane groups step in the same call (their events merge in), and
+        per-mode token counters plus the groups' own stats fold into
+        :attr:`stats` here, so one dict stays the whole session's view.
         """
+        events = self._step_local()
+        fresh = sum(len(ev["tokens"]) for ev in events)
+        if fresh:
+            key = f"mode_tokens_{self._mode}"
+            self.stats[key] = self.stats.get(key, 0) + fresh
+        for mode, sub in self._subs.items():
+            if not sub.busy:
+                continue
+            for ev in sub.step():
+                if ev.get("done"):
+                    self._rid_mode.pop(ev["rid"], None)
+                events.append(ev)
+        self._sync_sub_stats()
+        return events
+
+    def _sync_sub_stats(self) -> None:
+        """Delta-merge the mode groups' counters into the primary's
+        stats dict: subs keep counting monotonically, the primary adds
+        only what is new since its last sync — ``engine.stats`` stays a
+        plain live dict covering every lane group."""
+        for mode, sub in self._subs.items():
+            seen = self._sub_stats_seen[mode]
+            for key, value in sub.stats.items():
+                if not isinstance(value, int):
+                    continue
+                delta = value - seen.get(key, 0)
+                if delta:
+                    self.stats[key] = self.stats.get(key, 0) + delta
+                    seen[key] = value
+
+    def _step_local(self) -> list[dict]:
+        """One sync chunk on THIS lane group only (plain or speculative
+        decode, whichever the session resolved to at construction)."""
         self._flush_admissions()
         if not self._rid_slot:
             return []
-        self._state = self._run_steps(self._params, self._state)
+        if self._spec_run is not None:
+            (self._state, self._draft_caches, proposed, accepted) = (
+                self._spec_run(
+                    self._params, self._draft_params, self._state,
+                    self._draft_caches,
+                )
+            )
+            self.stats["spec_rounds"] += self._spec_rounds
+            self.stats["spec_proposed"] += int(proposed)
+            self.stats["spec_accepted"] += int(accepted)
+        else:
+            self._state = self._run_steps(self._params, self._state)
         buffer_h = np.asarray(self._state[1])
         plen_h = np.asarray(self._state[3])
         n_gen_h = np.asarray(self._state[5])
@@ -1168,6 +1530,12 @@ class ContinuousEngine:
         finished row — and freed for re-admission (which resets the lane's
         cache and buffer anyway).
         """
+        mode = self._rid_mode.pop(rid, None)
+        if mode is not None:
+            sub = self._subs.get(mode)
+            if sub is not None:
+                sub.cancel(rid)
+            return
         self._pending = [p for p in self._pending if p[0] != rid]
         self._pending_kv = [p for p in self._pending_kv if p[0] != rid]
         slot = self._rid_slot.pop(rid, None)
@@ -1183,17 +1551,36 @@ class ContinuousEngine:
     def close(self) -> None:
         """Drop device state so the backend can reclaim the cache lanes."""
         self._state = None
+        self._draft_caches = None
         self._pending.clear()
         self._pending_kv.clear()
         self._prefix_tree.clear()
         self._rid_slot.clear()
         self._slot_rid = [None] * self.slots
+        for sub in self._subs.values():
+            sub.close()
+        self._rid_mode.clear()
 
     @property
     def busy(self) -> int:
         return (
-            len(self._rid_slot) + len(self._pending) + len(self._pending_kv)
+            len(self._rid_slot) + len(self._pending)
+            + len(self._pending_kv)
+            + sum(sub.busy for sub in self._subs.values())
         )
+
+    @property
+    def spec_active(self) -> bool:
+        """True when any lane group is verifying draft proposals — the
+        harness keys its ``spec_verify`` waterfall attribution on this."""
+        return self._spec_run is not None or any(
+            sub._spec_run is not None for sub in self._subs.values()
+        )
+
+    @property
+    def decode_modes(self) -> tuple[str, ...]:
+        """The built lane groups, fp first (refused modes absent)."""
+        return (self._mode,) + tuple(self._subs)
 
     # -- internals ---------------------------------------------------------
 
@@ -1399,6 +1786,47 @@ class ContinuousEngine:
                 jnp.asarray(plens), jnp.asarray(firsts),
                 jnp.asarray(slots), jnp.asarray(caps_in),
             )
+        # Draft lanes: every admission (full, prefix-hit, or KV-import)
+        # also full-prompt-prefills the DRAFT model's lane for its slot,
+        # in fused bucketed waves like the target's — the spec rounds'
+        # repair slab picks up from the parked cursor.  KV bundles ship
+        # only target K/V, so an imported admission pays this small pass
+        # too; the draft is the cheap model by construction.
+        if self._draft is not None:
+            admitted = (
+                [(slot, tokens) for slot, tokens, *_ in picked]
+                + [
+                    (slot, tokens)
+                    for _key, (_lane, group) in picked_prefix.items()
+                    for slot, tokens, _cap, _k in group
+                ]
+                + [(slot, tokens) for slot, tokens, *_ in picked_kv]
+            )
+            by_bucket: dict[int, list] = {}
+            for slot, tokens in admitted:
+                bucket = min(
+                    1 << (int(tokens.size) - 1).bit_length(),
+                    self._draft.config.max_seq,
+                )
+                by_bucket.setdefault(bucket, []).append((slot, tokens))
+            for bucket in sorted(by_bucket):
+                group = by_bucket[bucket]
+                g = 1 << (len(group) - 1).bit_length()
+                padded = np.full((g, bucket), self._pad, np.int32)
+                plens = np.ones(g, np.int32)
+                slots = np.full(g, self.slots, np.int32)  # OOB drop
+                for r, (slot, tokens) in enumerate(group):
+                    padded[r, : tokens.size] = tokens
+                    plens[r] = tokens.size
+                    slots[r] = slot
+                wave = _make_draft_admit(
+                    self._draft, int(self.slots), int(bucket), int(g)
+                )
+                self._draft_caches = wave(
+                    self._draft_params, self._draft_caches,
+                    jnp.asarray(padded), jnp.asarray(plens),
+                    jnp.asarray(slots),
+                )
         # Feed the tree: every admission's post-wave lane (cursor already
         # parked at the prompt length by its wave — or carried by the
         # imported bundle) becomes a reusable prefix for later prompts.

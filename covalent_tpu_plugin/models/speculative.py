@@ -61,6 +61,126 @@ def _set_cursor(cache: Any, value) -> Any:
     )
 
 
+def make_lane_spec_round(target_decoder, draft_decoder, eos_token_id,
+                         length, draft_len):
+    """Single-LANE greedy draft-then-verify round for the continuous
+    serving engine (``models/serve.py`` vmaps this across its slots).
+
+    One round on one slot lane: the draft proposes ``draft_len`` tokens
+    autoregressively from the committed prefix, the target scores the
+    ``draft_len + 1`` slab ``[last_committed, d_1..d_k]`` in ONE pass,
+    and the longest agreeing prefix plus the target's own choice at the
+    first disagreement is committed — every committed token is the
+    target's greedy pick, so a spec lane's stream is bit-identical to
+    the plain decode loop's (the engine's fp-fallback contract).  This
+    is :func:`_speculative_generate_traced`'s round body re-shaped for
+    the engine's per-slot state: per-lane commit (no cross-batch MIN —
+    slots are independent requests), per-request budget/EOS truncation,
+    and a masked full-row merge instead of a dynamic-slice write (donated
+    buffers; no scatter-duplicate hazards at the row tail).
+
+    Returns ``lane_round(t_params, d_params, cache, dcache, row, pos,
+    cap, n_gen, done) -> (cache, dcache, row, pos', n_gen', done',
+    proposed, accepted)`` where ``pos`` is the engine invariant cursor
+    (buffer index of the last committed token == target cache cursor),
+    and ``proposed``/``accepted`` are this round's draft-agreement
+    counters (zero for frozen lanes).  Cache-capacity contract (checked
+    by the engine, not here): the verify slab may write up to
+    ``length - 1 + draft_len`` target positions and the draft walk up to
+    ``length - 2 + draft_len``, so both models need
+    ``max_seq >= length + draft_len``.
+    """
+    k = int(draft_len)
+    steps = jnp.arange(k + 1)
+
+    def lane_round(t_params, d_params, cache, dcache, row, pos, cap,
+                   n_gen, done):
+        # Draft k tokens.  The 2-token repair slab (the last two
+        # committed tokens) rebuilds the K/V of the final committed
+        # token — produced as an output last round, never consumed —
+        # uniformly for every round shape; pos >= 1 always (admission
+        # commits the prefill token first).
+        dcache = _set_cursor(dcache, pos - 1)
+        tail = jax.lax.dynamic_slice(row, (pos - 1,), (2,))
+        dlogits, mutated = draft_decoder.apply(
+            {"params": d_params, "cache": dcache}, tail[None],
+            mutable=["cache"],
+        )
+        first = jnp.argmax(
+            dlogits[0, -1].astype(jnp.float32), axis=-1
+        ).astype(jnp.int32)
+
+        def body(i, carry):
+            dcache, token, drafts = carry
+            lg, mut = draft_decoder.apply(
+                {"params": d_params, "cache": dcache}, token[None, None],
+                mutable=["cache"],
+            )
+            nxt = jnp.argmax(
+                lg[0, -1].astype(jnp.float32), axis=-1
+            ).astype(jnp.int32)
+            return mut["cache"], nxt, drafts.at[i].set(nxt)
+
+        dcache, _, drafts = jax.lax.fori_loop(
+            1, k, body,
+            (mutated["cache"], first,
+             jnp.zeros((k,), jnp.int32).at[0].set(first)),
+        )
+
+        # Verify slab: the target cursor sits at ``pos`` (cache valid
+        # through pos-1), so feeding [row[pos], d_1..d_k] yields its
+        # greedy choice for k+1 positions — the (k+1)-th is the bonus
+        # token when every draft agrees.
+        cur = jax.lax.dynamic_slice(row, (pos,), (1,))
+        slab = jnp.concatenate([cur, drafts])
+        tlogits, mutated = target_decoder.apply(
+            {"params": t_params, "cache": cache}, slab[None],
+            mutable=["cache"],
+        )
+        cache = mutated["cache"]
+        greedy = jnp.argmax(
+            tlogits[0].astype(jnp.float32), axis=-1
+        ).astype(jnp.int32)  # (k+1,)
+
+        match = (drafts == greedy[:k]).astype(jnp.int32)
+        run = jnp.sum(jnp.cumprod(match))  # leading agreement, 0..k
+        new = jnp.where(
+            steps < run,
+            jnp.concatenate([drafts, jnp.zeros((1,), jnp.int32)]),
+            greedy,
+        )
+        live = ~done
+        commit = jnp.minimum(run + 1, cap - n_gen)
+        if eos_token_id is not None:
+            hits = (new == eos_token_id) & (steps < commit)
+            any_eos = jnp.any(hits)
+            commit = jnp.where(any_eos, jnp.argmax(hits) + 1, commit)
+        else:
+            any_eos = jnp.zeros((), bool)
+        commit = jnp.where(live, commit, 0)
+
+        # Full-row masked merge: token ``new[i]`` lands at row position
+        # ``pos + 1 + i`` for i < commit.  A where over the whole row
+        # (instead of a scatter) cannot alias clipped tail indices.
+        rel = jnp.arange(length) - (pos + 1)
+        gathered = new[jnp.clip(rel, 0, k)]
+        row = jnp.where((rel >= 0) & (rel < commit), gathered, row)
+
+        n_gen = n_gen + commit
+        done = done | (live & ((n_gen >= cap) | any_eos))
+        pos = pos + commit
+        # Rewind the target cursor onto the new last-committed token:
+        # cache slots pos..pos-1+k hold draft K/V past the commit point,
+        # dead until the next round's slab overwrites them (the same
+        # exactness argument admission's pad positions ride).
+        cache = _set_cursor(cache, pos)
+        proposed = jnp.where(live, k, 0).astype(jnp.int32)
+        accepted = jnp.where(live, run, 0).astype(jnp.int32)
+        return cache, dcache, row, pos, n_gen, done, proposed, accepted
+
+    return lane_round
+
+
 def _speculative_generate_traced(
     target_model: TransformerLM,
     target_params: Any,

@@ -33,6 +33,14 @@ it already is:
   back to a full prefill on the decode replica; the caller's stream is
   byte-identical either way, only slower.  Short prompts
   (< ``min_prompt_tokens``) skip the KV road entirely.
+* **Quantized lanes compose** — request ``params`` (the per-request
+  ``quality`` knob included) ride the prefill round trip verbatim, so a
+  ``quality="kv_quant"`` request prefills on the prefill tier's matching
+  lane group and ships an **int8 KV bundle** (~2-4x fewer bytes).  The
+  bundle carries a quantization fingerprint next to the sampling
+  fingerprint; a decode replica with no matching lane group refuses it
+  and the request degrades to a full prefill there — same
+  byte-identical-stream contract as every other degrade road.
 
 ``COVALENT_TPU_SERVE_DISAGG=0`` routes everything direct (kill switch);
 ``COVALENT_TPU_SERVE_DISAGG_MIN_PROMPT`` / ``_KV_TIMEOUT_S`` /

@@ -160,6 +160,28 @@ SERVE_PREFILL_POSITIONS = REGISTRY.gauge(
     ("session",),
 )
 
+# -- speculative + quantized decoding ---------------------------------------
+# Per-session series fed by the engine's spec/mode counters through the
+# worker stats backhaul, and reaped by the supervisor's ``_drop_live``
+# with the other per-session gauges (the PR-10 stale-series contract —
+# ``mode`` is a CLOSED set (models/quant.py SERVING_MODES), so the reap
+# can enumerate it).  The accept rate is draft agreement
+# (spec_accepted / spec_proposed), cumulative over the session.
+
+SERVE_SPEC_ACCEPT_RATE = REGISTRY.gauge(
+    "covalent_tpu_serve_spec_accept_rate",
+    "Speculative-decode draft accept rate per serving session "
+    "(accepted / proposed draft tokens, cumulative)",
+    ("session",),
+)
+
+SERVE_MODE_TOKENS = REGISTRY.gauge(
+    "covalent_tpu_serve_mode_tokens",
+    "Output tokens per serving session by decode-mode lane group "
+    "(fp / int8 / kv_quant / full_quant)",
+    ("session", "mode"),
+)
+
 # -- disaggregated prefill/decode -------------------------------------------
 # The KV transfer plane: prefill replicas package admission prefill as
 # content-addressed KV bundles; decode replicas import them and go

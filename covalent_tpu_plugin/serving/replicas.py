@@ -652,6 +652,14 @@ class ReplicaSet:
         suspension (mid-teardown requests are never dropped), opens a
         fresh replica, and streams normally — cold-start latency, no
         error.
+
+        ``params`` ride to the engine verbatim; beyond the sampling
+        knobs this includes the per-request ``quality`` selector
+        (``"exact"`` or a decode-mode name — see
+        ``models.serve.ContinuousEngine``): engines with lane groups
+        route the request to the matching quantized lane, and ANY
+        refusal (unknown name, unbuilt group) falls back to the
+        bit-exact fp lane rather than rejecting.
         """
         if self._closed:
             raise ServeError(f"replica set {self.name} is closed")

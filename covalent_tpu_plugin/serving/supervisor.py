@@ -43,6 +43,7 @@ from ..transport.base import TransportError
 from ..utils.log import app_log
 from .metrics import (
     SERVE_HANDOFFS_TOTAL,
+    SERVE_MODE_TOKENS,
     SERVE_PREFILL_POSITIONS,
     SERVE_PREFIX_HITS,
     SERVE_PREFIX_MISSES,
@@ -53,11 +54,20 @@ from .metrics import (
     SERVE_REQUEST_SECONDS,
     SERVE_REQUESTS_TOTAL,
     SERVE_SESSIONS,
+    SERVE_SPEC_ACCEPT_RATE,
     SERVE_TOKENS_PER_S,
     SERVE_TOKENS_TOTAL,
     SERVE_TTFT_SECONDS,
     SERVE_WORKER_SLOTS,
 )
+
+#: Mirror of ``models.quant.SERVING_MODES``: the closed decode-mode set
+#: the per-mode token gauge is labelled with.  Mirrored rather than
+#: imported — the dispatcher-side serving tier deliberately never
+#: imports the models package (it would drag jax into processes that
+#: only route) — and the reap in :meth:`SessionSupervisor._drop_live`
+#: enumerates it, which is only sound because the set is closed.
+_SERVING_MODES = ("fp", "int8", "kv_quant", "full_quant")
 
 __all__ = [
     "ServeError",
@@ -161,6 +171,12 @@ class ServeRequest:
         self.t_prefill_done: float | None = None
         self.t_dispatched: float | None = None
         self.t_sent: float | None = None
+        #: wall time the engine spent in fused speculative verify steps
+        #: on this request's behalf (harness-attributed share, rides the
+        #: final token chunk).  Not a checkpoint stamp: it becomes a
+        #: ``spec_verify`` waterfall tile carved out of the decode-stream
+        #: window at finalize.
+        self.spec_verify_s: float | None = None
         #: root span of this request's trace.  Entered at construction
         #: (``activate=False``: feeding happens in callbacks, the ambient
         #: context must not capture it) and closed LAST by
@@ -258,12 +274,25 @@ class ServeRequest:
         self._trace_done = True
         span = self.span
         cursor = self.t_submit
+        # The spec_verify tile is synthesized, not stamped: the engine's
+        # fused verify passes interleave with streaming, so the harness
+        # ships an attributed duration and the tile carves that much out
+        # of the FRONT of the decode window.  Clamped to t_done so the
+        # tiling sum still equals end-to-end latency exactly.
+        t_spec: float | None = None
+        if (
+            self.spec_verify_s is not None
+            and self.t_first is not None
+            and self.t_done is not None
+        ):
+            t_spec = min(self.t_first + self.spec_verify_s, self.t_done)
         tiles: list[tuple[str, float, float]] = []
         for name, stamp in (
             ("prefill", self.t_prefill_done),
             ("route", self.t_dispatched),
             ("dispatch", self.t_sent),
             ("ttft_wait", self.t_first),
+            ("spec_verify", t_spec),
             ("decode_stream", self.t_done),
             ("stream_flush", time.monotonic()),
         ):
@@ -889,6 +918,12 @@ class SessionSupervisor:
         first = request.t_first is None and bool(fresh)
         done = bool(data.get("done"))
         error = str(data.get("error") or "")
+        spec_s = data.get("spec_verify_s")
+        if spec_s is not None:
+            # Rides the final chunk from a speculative engine's harness;
+            # captured BEFORE _feed so _finalize_trace (which _feed calls
+            # on done) sees it and tiles the spec_verify segment.
+            request.spec_verify_s = float(spec_s)
         request._feed(fresh, done, error=error)
         if fresh:
             SERVE_TOKENS_TOTAL.inc(len(fresh))
@@ -937,7 +972,12 @@ class SessionSupervisor:
                 "prefix_hits", "prefix_misses", "prefill_positions",
                 "prefix_evictions", "kv_admits", "kv_fallbacks",
                 "kv_exports", "prefills",
+                "spec_rounds", "spec_proposed", "spec_accepted",
+                "spec_refusals", "spec_accept_rate", "mode_refusals",
             )
+            # Per-lane token counters arrive as one key per configured
+            # mode; pass the family through rather than enumerating it.
+            or k.startswith("mode_tokens_")
         }
         SERVE_QUEUE_DEPTH.labels(session=self.sid).set(
             float(self.stats.get("queued") or 0)
@@ -957,6 +997,18 @@ class SessionSupervisor:
                 gauge.labels(session=self.sid).set(
                     float(self.stats[key] or 0)
                 )
+        # Speculative / lane-mode series: again only engines that report
+        # them create the series (stale-series reap in _drop_live must
+        # enumerate modes, which is fine — the mode set is closed).
+        if "spec_accept_rate" in self.stats:
+            SERVE_SPEC_ACCEPT_RATE.labels(session=self.sid).set(
+                float(self.stats["spec_accept_rate"] or 0.0)
+            )
+        for key, value in self.stats.items():
+            if key.startswith("mode_tokens_"):
+                SERVE_MODE_TOKENS.labels(
+                    session=self.sid, mode=key[len("mode_tokens_"):]
+                ).set(float(value or 0))
 
     def _finish(self, rid: str, outcome: str) -> None:
         if self._requests.pop(rid, None) is not None:
@@ -1256,6 +1308,9 @@ class SessionSupervisor:
         SERVE_PREFIX_HITS.remove(session=self.sid)
         SERVE_PREFIX_MISSES.remove(session=self.sid)
         SERVE_PREFILL_POSITIONS.remove(session=self.sid)
+        SERVE_SPEC_ACCEPT_RATE.remove(session=self.sid)
+        for mode in _SERVING_MODES:
+            SERVE_MODE_TOKENS.remove(session=self.sid, mode=mode)
         if self.replica_of is not None:
             SERVE_REPLICA_IN_FLIGHT.remove(
                 set=self.replica_of[0], replica=self.replica_of[1]

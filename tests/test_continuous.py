@@ -647,3 +647,269 @@ def test_engine_admit_from_kv_validation():
     want = oracle(model, params, prompt, 4)[prompt.size:]
     np.testing.assert_array_equal(got, want)
     engine.close()
+
+
+# ---------------------------------------------------------------------------
+# Speculative decoding + decode-mode lane groups (0.17)
+# ---------------------------------------------------------------------------
+
+
+DRAFT_CFG = dataclasses.replace(
+    CFG, d_model=16, n_layers=1, n_heads=2, d_ff=32
+)
+
+
+def build_draft(seed=7):
+    draft = TransformerLM(DRAFT_CFG)
+    dparams = draft.init(
+        jax.random.PRNGKey(seed), jnp.zeros((1, 4), jnp.int32)
+    )["params"]
+    return draft, dparams
+
+
+def test_engine_spec_self_draft_bit_equal_full_accept():
+    """Draft == target: every proposal agrees, so the accept rate is
+    exactly 1.0 — and the streams are STILL the plain engine's, token
+    for token (spec commits only the target's greedy picks)."""
+    from covalent_tpu_plugin.models.serve import ContinuousEngine
+
+    model, params = shared()
+    prompts = ragged_prompts(5, base_seed=91)
+    engine = ContinuousEngine(
+        model, params, max_batch=2, sync_steps=6, max_new_tokens=8,
+        length=40, draft_model=model, draft_params=params, draft_len=3,
+    )
+    assert engine._spec_refusal is None
+    streams, _ = drive_engine(
+        engine, {f"r{i}": (p, 8) for i, p in enumerate(prompts)},
+    )
+    for i, p in enumerate(prompts):
+        want = oracle(model, params, p, 8)[p.size:]
+        np.testing.assert_array_equal(streams[f"r{i}"], want)
+    assert engine.stats["spec_rounds"] > 0
+    assert engine.stats["spec_proposed"] > 0
+    assert engine.stats["spec_accepted"] == engine.stats["spec_proposed"]
+    assert engine.stats["spec_refusals"] == 0
+    engine.close()
+
+
+def test_engine_spec_disagreeing_draft_bit_equal_and_prefix_compose():
+    """An unrelated tiny draft (worst case for speedup): streams stay
+    bit-equal to the oracle, and a second pass over the same prompts
+    rides the prefix tree (hits > 0) with identical streams — spec
+    composes with warm-KV admission."""
+    from covalent_tpu_plugin.models.serve import ContinuousEngine
+
+    model, params = shared()
+    draft, dparams = build_draft()
+    prompts = ragged_prompts(4, base_seed=17)
+    engine = ContinuousEngine(
+        model, params, max_batch=2, sync_steps=6, max_new_tokens=6,
+        length=40, draft_model=draft, draft_params=dparams, draft_len=2,
+    )
+    assert engine._spec_refusal is None
+    first, _ = drive_engine(
+        engine, {f"a{i}": (p, 6) for i, p in enumerate(prompts)},
+    )
+    hits_before = engine.stats["prefix_hits"]
+    second, _ = drive_engine(
+        engine, {f"b{i}": (p, 6) for i, p in enumerate(prompts)},
+    )
+    for i, p in enumerate(prompts):
+        want = oracle(model, params, p, 6)[p.size:]
+        np.testing.assert_array_equal(first[f"a{i}"], want)
+        np.testing.assert_array_equal(second[f"b{i}"], want)
+    assert engine.stats["prefix_hits"] > hits_before
+    assert engine.stats["spec_proposed"] >= engine.stats["spec_accepted"]
+    engine.close()
+
+
+def test_engine_sampled_spec_refuses_and_matches_plain_sampled():
+    """A sampled session refuses the draft (the continuous verify path
+    is greedy-only; ``speculative_sample`` is the offline sampled road,
+    distribution-tested in test_speculative.py) — and the fallback is
+    byte-equal to the same engine built without a draft, because the
+    rng chains are untouched by the refusal."""
+    from covalent_tpu_plugin.models.serve import ContinuousEngine
+
+    model, params = shared()
+    draft, dparams = build_draft()
+    prompts = ragged_prompts(3, base_seed=55)
+    requests = {f"r{i}": (p, 6) for i, p in enumerate(prompts)}
+
+    kwargs = dict(
+        max_batch=2, sync_steps=4, max_new_tokens=6, length=40,
+        temperature=0.8, rng=jax.random.PRNGKey(11),
+    )
+    spec = ContinuousEngine(
+        model, params, draft_model=draft, draft_params=dparams,
+        draft_len=2, **kwargs,
+    )
+    assert spec._spec_refusal is not None and "sampled" in spec._spec_refusal
+    assert spec.stats["spec_refusals"] == 1
+    spec_streams, _ = drive_engine(spec, dict(requests))
+    spec.close()
+
+    plain = ContinuousEngine(model, params, **kwargs)
+    plain_streams, _ = drive_engine(plain, dict(requests))
+    plain.close()
+    assert spec_streams == plain_streams
+
+
+def test_engine_spec_headroom_refusal_falls_back_bit_equal():
+    """length == max_seq leaves no scratch room for the verify slab:
+    the draft is refused by name and the session serves the plain loop,
+    oracle-exact."""
+    from covalent_tpu_plugin.models.serve import ContinuousEngine
+
+    model, params = shared()
+    draft, dparams = build_draft()
+    prompts = ragged_prompts(2, base_seed=23)
+    engine = ContinuousEngine(
+        model, params, max_batch=2, sync_steps=4, max_new_tokens=6,
+        draft_model=draft, draft_params=dparams, draft_len=4,
+    )
+    assert engine._spec_refusal is not None
+    assert "max_seq" in engine._spec_refusal
+    assert engine.stats["spec_refusals"] == 1
+    streams, _ = drive_engine(
+        engine, {f"r{i}": (p, 6) for i, p in enumerate(prompts)},
+    )
+    for i, p in enumerate(prompts):
+        want = oracle(model, params, p, 6)[p.size:]
+        np.testing.assert_array_equal(streams[f"r{i}"], want)
+    engine.close()
+
+
+def test_engine_quality_routing_unknown_and_refused_fall_back():
+    """The quality knob never rejects: an unknown mode and a mode whose
+    lane group refused to build (int8 on this scanned model) both land
+    on the fp lane bit-exact, each counting a mode_refusal; kv_quant
+    requests land on their own group and its tokens are counted."""
+    from covalent_tpu_plugin.models.serve import ContinuousEngine
+
+    model, params = shared()
+    assert model.config.scan_layers  # int8 must refuse on this model
+    prompts = ragged_prompts(3, base_seed=31)
+    engine = ContinuousEngine(
+        model, params, max_batch=4, sync_steps=4, max_new_tokens=6,
+        decode_modes=("fp", "int8", "kv_quant"),
+    )
+    # int8's variant refused at construction (scan_layers), kv_quant up.
+    assert "int8" in engine._mode_refusal
+    assert "kv_quant" in engine._subs
+
+    streams = {}
+    done = set()
+    quality = {"exact": "exact", "weird": "int4", "i8": "int8",
+               "qkv": "kv_quant"}
+    for rid, q in quality.items():
+        engine.admit(
+            rid, prompts[hash(rid) % 3], {"max_new_tokens": 6, "quality": q}
+        )
+        streams[rid] = []
+    for _ in range(200):
+        for event in engine.step():
+            streams[event["rid"]].extend(event["tokens"])
+            if event["done"]:
+                done.add(event["rid"])
+        if len(done) == len(quality):
+            break
+    assert done == set(quality)
+    # exact/unknown/refused-int8 are all the fp lane: oracle-exact.
+    for rid in ("exact", "weird", "i8"):
+        p = prompts[hash(rid) % 3]
+        want = oracle(model, params, p, 6)[p.size:]
+        np.testing.assert_array_equal(streams[rid], want)
+    # unknown + refused-mode requests each counted a refusal.
+    assert engine.stats["mode_refusals"] >= 2
+    assert engine.stats["mode_tokens_fp"] >= 18
+    assert engine.stats["mode_tokens_kv_quant"] >= 6
+    assert len(streams["qkv"]) == 6
+    engine.close()
+
+
+def test_engine_kv_quant_bundle_fingerprint_mismatch_degrades():
+    """The disagg quantization fingerprint: a kv_quant prefill bundle
+    ships int8 KV (smaller on the wire), a decode engine WITHOUT that
+    lane group refuses it by fingerprint, and the caller-side degrade —
+    a plain full-prefill admit — streams byte-equal to the fp oracle.
+    A decode engine WITH the group admits it and streams byte-equal to
+    a joint kv_quant engine (same-mode disagg exactness)."""
+    import pickle
+
+    from covalent_tpu_plugin.models.serve import ContinuousEngine
+
+    model, params = shared()
+    prompt = np.asarray([7, 3, 9, 2, 6], np.int32)
+    mk = lambda modes: ContinuousEngine(
+        model, params, max_batch=2, sync_steps=3, max_new_tokens=6,
+        decode_modes=modes,
+    )
+
+    prefill = mk(("fp", "kv_quant"))
+    raw_fp = prefill.prefill_only(prompt)
+    raw_q = prefill.prefill_only(prompt, {"quality": "kv_quant"})
+    bundle_q = pickle.loads(raw_q)
+    assert bundle_q["quant"] == "kv_quant"
+    assert pickle.loads(raw_fp)["quant"] == "fp"
+    # int8 KV leaves make the quantized bundle smaller on the wire.
+    assert any(
+        np.asarray(leaf).dtype == np.int8 for leaf in bundle_q["leaves"]
+    )
+    assert len(raw_q) < len(raw_fp)
+    prefill.close()
+
+    # fp-only decode tier: fingerprint mismatch refuses, degrade path
+    # (full prefill) is byte-equal to the oracle.
+    fp_only = mk(("fp",))
+    with pytest.raises(ValueError, match="quantization fingerprint"):
+        fp_only.admit_from_kv("r1", raw_q)
+    fp_only.admit("r1", prompt, {"max_new_tokens": 6})
+    got = []
+    for _ in range(100):
+        for event in fp_only.step():
+            got.extend(event["tokens"])
+            if event["done"]:
+                break
+        else:
+            continue
+        break
+    want = oracle(model, params, prompt, 6)[prompt.size:]
+    np.testing.assert_array_equal(got, want)
+    assert fp_only.stats["kv_admits"] == 0
+    fp_only.close()
+
+    # Matching decode tier: the bundle routes to the kv_quant group and
+    # streams byte-equal to a joint (non-disagg) kv_quant engine.
+    joint = mk(("fp", "kv_quant"))
+    joint_streams, _ = drive_engine(joint, {"j": (prompt, 6)})
+    joint.close()
+    joint_q = mk(("fp", "kv_quant"))
+    streams = {}
+    done = set()
+    joint_q.admit("q", prompt, {"max_new_tokens": 6, "quality": "kv_quant"})
+    streams["q"] = []
+    for _ in range(100):
+        for event in joint_q.step():
+            streams[event["rid"]].extend(event["tokens"])
+            if event["done"]:
+                done.add(event["rid"])
+        if done:
+            break
+    joint_q.close()
+
+    decode = mk(("fp", "kv_quant"))
+    decode.admit_from_kv("d", raw_q, {"max_new_tokens": 6})
+    dstream = []
+    for _ in range(100):
+        for event in decode.step():
+            dstream.extend(event["tokens"])
+            if event["done"]:
+                break
+        else:
+            continue
+        break
+    assert decode.stats["kv_admits"] == 1
+    np.testing.assert_array_equal(dstream, streams["q"])
+    decode.close()

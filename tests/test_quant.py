@@ -18,7 +18,11 @@ from covalent_tpu_plugin.models import (
     generate,
     quantize_lm,
 )
-from covalent_tpu_plugin.models.quant import quantize_array
+from covalent_tpu_plugin.models.quant import (
+    SERVING_MODES,
+    mode_variant,
+    quantize_array,
+)
 
 BASE = TransformerConfig(
     vocab_size=64,
@@ -100,6 +104,81 @@ def test_quantize_lm_rejects_scanned_and_moe():
     moe_model = TransformerLM(dataclasses.replace(BASE, moe_experts=2))
     with pytest.raises(ValueError, match="MoE"):
         quantize_lm(moe_model, {})
+
+
+def test_quantize_lm_copies_non_dense_leaves_verbatim():
+    # Round-trip structure: embeddings and norm scales must cross the
+    # conversion untouched — only dense kernels change representation.
+    model = TransformerLM(BASE)
+    tokens = jnp.zeros((1, 4), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    from covalent_tpu_plugin.parallel.sharding import unbox
+
+    params = unbox(params)
+    _, qparams = quantize_lm(model, params)
+    np.testing.assert_array_equal(
+        np.asarray(qparams["embedding"]), np.asarray(params["embedding"])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(qparams["ln_final"]["scale"]),
+        np.asarray(params["ln_final"]["scale"]),
+    )
+
+
+def test_mode_variant_fp_is_identity():
+    model = TransformerLM(BASE)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))[
+        "params"
+    ]
+    vmodel, vparams = mode_variant(model, params, "fp")
+    assert vmodel is model and vparams is params
+
+
+def test_mode_variant_kv_quant_shares_weights():
+    model = TransformerLM(BASE)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))[
+        "params"
+    ]
+    vmodel, vparams = mode_variant(model, params, "kv_quant")
+    # Same weight tree by identity — kv_quant only changes the cache.
+    assert vparams is params
+    assert vmodel.config.quantized_kv_cache and not vmodel.config.quantized
+
+
+def test_mode_variant_int8_and_full_quant():
+    model = TransformerLM(BASE)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))[
+        "params"
+    ]
+    i8_model, i8_params = mode_variant(model, params, "int8")
+    assert i8_model.config.quantized and not i8_model.config.quantized_kv_cache
+    fq_model, fq_params = mode_variant(model, params, "full_quant")
+    assert fq_model.config.quantized and fq_model.config.quantized_kv_cache
+    for qparams in (i8_params, fq_params):
+        kernels = [
+            leaf
+            for path, leaf in jax.tree_util.tree_flatten_with_path(qparams)[0]
+            if any(getattr(e, "key", None) == "kernel" for e in path)
+        ]
+        assert kernels and all(k.dtype == jnp.int8 for k in kernels)
+
+
+def test_mode_variant_rejects_unknown_and_propagates_refusal():
+    model = TransformerLM(BASE)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))[
+        "params"
+    ]
+    with pytest.raises(ValueError, match="unknown decode mode"):
+        mode_variant(model, params, "int4")
+    assert "fp" in SERVING_MODES and len(SERVING_MODES) == 4
+    # quantize_lm's scan_layers refusal surfaces through mode_variant —
+    # the engine catches it and falls back to the fp lane.
+    scan_model = TransformerLM(dataclasses.replace(BASE, scan_layers=True))
+    scan_params = scan_model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+    )["params"]
+    with pytest.raises(ValueError, match="scan_layers"):
+        mode_variant(scan_model, scan_params, "int8")
 
 
 def test_quantized_gqa_attention_shapes():

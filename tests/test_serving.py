@@ -833,3 +833,87 @@ def test_serve_preempt_notice_triggers_auto_handoff(tmp_path, run_async):
         assert tokens == [100 * i + j + 1 for j in range(12)], tokens
     assert handoffs == 1
     assert state == "open"
+
+
+def test_serve_session_spec_greedy_bit_equal_to_fp(tmp_path, run_async):
+    """Greedy spec-decode through a REAL open_session: a tiny LM served
+    with a self-draft (full acceptance) streams token-for-token what the
+    same model's fp session streams, and the supervisor's stats records
+    carry the spec accept-rate feed the metrics plane exports."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    from covalent_tpu_plugin.models import TransformerConfig, TransformerLM
+    from covalent_tpu_plugin.models.serve import lm_engine_factory
+
+    cfg = TransformerConfig(
+        vocab_size=32, d_model=16, n_layers=1, n_heads=2, d_ff=32,
+        max_seq=32, dtype=jnp.float32, attention="reference",
+    )
+    model = TransformerLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+    )["params"]
+    prompts = [[3, 9, 1], [7, 2], [5, 5, 5, 5]]
+
+    async def flow():
+        cloudpickle.register_pickle_by_value(
+            sys.modules["covalent_tpu_plugin.models.serve"]
+        )
+        repo_root = os.path.dirname(os.path.dirname(__file__))
+        ex = make_serve_executor(
+            tmp_path,
+            task_env={
+                "PYTHONPATH": repo_root + os.pathsep
+                + os.environ.get("PYTHONPATH", ""),
+            },
+        )
+        results, spec_stats = {}, None
+        try:
+            for tag, extra in (
+                ("fp", {}),
+                ("spec", dict(
+                    draft_model=model, draft_params=params, draft_len=2,
+                )),
+            ):
+                factory = lm_engine_factory(
+                    model, params, max_batch=2, sync_steps=3,
+                    max_new_tokens=6, length=24, **extra,
+                )
+                handle = await open_session(
+                    ex, factory, name=f"lm-{tag}",
+                    stats_interval_s=0.1, open_timeout_s=180.0,
+                )
+                requests = [
+                    await handle.request(p, params={"max_new_tokens": 5})
+                    for p in prompts
+                ]
+                results[tag] = [
+                    await r.result(timeout=120.0) for r in requests
+                ]
+                if tag == "spec":
+                    # The 0.1s stats cadence must surface the engine's
+                    # accept counters before close.
+                    for _ in range(100):
+                        if handle.supervisor.stats.get("spec_accepted"):
+                            break
+                        await asyncio.sleep(0.05)
+                    spec_stats = dict(handle.supervisor.stats)
+                await handle.close()
+        finally:
+            await ex.close()
+        return results, spec_stats
+
+    results, spec_stats = run_async(flow())
+    assert len(results["fp"]) == len(prompts)
+    assert all(len(t) == 5 for t in results["fp"])
+    # The oracle: spec streams ARE the fp streams, bit for bit.
+    assert results["spec"] == results["fp"]
+    assert spec_stats is not None
+    assert spec_stats.get("spec_proposed", 0) > 0
+    # Self-draft: every proposal agrees, accept rate exactly 1.0.
+    assert spec_stats["spec_accepted"] == spec_stats["spec_proposed"]
+    assert float(spec_stats.get("spec_accept_rate") or 0.0) == 1.0
+    assert spec_stats.get("spec_refusals", 0) == 0

@@ -274,3 +274,70 @@ def test_speculative_edge_cases_and_validation():
     other, oparams = build(small_vocab, 3, prompt)
     with pytest.raises(ValueError, match="vocabulary"):
         speculative_generate(target, tparams, other, oparams, prompt, 4)
+
+
+def test_lane_spec_round_commits_target_greedy_and_freezes_done():
+    """The continuous engine's per-lane round body, driven standalone:
+    iterated rounds reproduce the target's greedy continuation exactly
+    (whatever the draft proposes), each live round proposes draft_len
+    tokens, and a frozen (done) lane is a strict no-op."""
+    from covalent_tpu_plugin.models import init_cache
+    from covalent_tpu_plugin.models.decode import _decode_model
+    from covalent_tpu_plugin.models.speculative import make_lane_spec_round
+
+    prompt = jnp.asarray([[5, 11, 3]], jnp.int32)
+    target, tparams = build(TARGET_CFG, 0, prompt)
+    draft, dparams = build(DRAFT_CFG, 7, prompt)
+    tdec, ddec = _decode_model(target), _decode_model(draft)
+    length, k, cap = 24, 3, 9
+    lane_round = make_lane_spec_round(tdec, ddec, None, length, k)
+
+    # Admission-equivalent setup: prefill both caches, commit the
+    # target's first token at row[plen] with the cursor parked on it.
+    cache = init_cache(target, 1)
+    dcache = init_cache(draft, 1)
+    tlogits, mut = tdec.apply(
+        {"params": tparams, "cache": cache}, prompt, mutable=["cache"]
+    )
+    cache = mut["cache"]
+    first = jnp.argmax(tlogits[0, -1].astype(jnp.float32)).astype(jnp.int32)
+    _, dmut = ddec.apply(
+        {"params": dparams, "cache": dcache}, prompt, mutable=["cache"]
+    )
+    dcache = dmut["cache"]
+
+    plen = prompt.shape[1]
+    row = (
+        jnp.zeros((length,), jnp.int32)
+        .at[:plen].set(prompt[0])
+        .at[plen].set(first)
+    )
+    pos = jnp.asarray(plen, jnp.int32)
+    n_gen = jnp.asarray(1, jnp.int32)
+    done = jnp.asarray(False)
+    cap_arr = jnp.asarray(cap, jnp.int32)
+
+    rounds = 0
+    while not bool(done):
+        (cache, dcache, row, pos, n_gen, done, proposed, accepted) = (
+            lane_round(
+                tparams, dparams, cache, dcache, row, pos, cap_arr,
+                n_gen, done,
+            )
+        )
+        rounds += 1
+        assert int(proposed) == k and 0 <= int(accepted) <= k
+        assert rounds <= cap, "round never converged on the budget"
+
+    want = np.asarray(generate(target, tparams, prompt, cap))[0]
+    np.testing.assert_array_equal(np.asarray(row)[: plen + cap], want)
+    assert int(n_gen) == cap
+
+    # Frozen lane: zero proposals, state untouched.
+    before = (int(pos), int(n_gen))
+    (_c, _d, row2, pos, n_gen, done, proposed, accepted) = lane_round(
+        tparams, dparams, cache, dcache, row, pos, cap_arr, n_gen, done,
+    )
+    assert int(proposed) == 0 and int(accepted) == 0
+    assert (int(pos), int(n_gen)) == before and bool(done)
+    np.testing.assert_array_equal(np.asarray(row2), np.asarray(row))
