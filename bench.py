@@ -46,7 +46,8 @@ BENCH_PHASES = {
         "BENCH_PHASES",
         "overhead,obs_tax,fanout,cached_fanout,bundled_fanout,"
         "rpc_overhead,serve_traffic,serve_scale,serve_disagg,serve_spec,"
-        "chaos_fanout,preemption_chaos,sched_fanout,traffic_ramp,tpu",
+        "chaos_fanout,preemption_chaos,dispatcher_crash,sched_fanout,"
+        "traffic_ramp,tpu",
     ).split(",")
     if phase.strip()
 }
@@ -429,37 +430,119 @@ def load_last_known_good() -> dict | None:
     return None
 
 
+def tpu_host_signals() -> dict:
+    """Host-level evidence of TPU hardware, gathered WITHOUT importing jax.
+
+    The r03+ hang lives below jax: on a host with no TPU device nodes,
+    libtpu's backend init blocks indefinitely instead of failing.  These
+    signals are what a TPU VM actually exposes, so their absence turns a
+    45 s-per-attempt hang into an instant, actionable verdict.
+    """
+    import glob
+
+    try:
+        from importlib import metadata
+        libtpus = sorted(
+            d.metadata["Name"]
+            for d in metadata.distributions()
+            if (d.metadata["Name"] or "").lower().startswith("libtpu")
+        )
+    except Exception:  # noqa: BLE001 - diagnostics must not fail the probe
+        libtpus = []
+    return {
+        "accel_devices": sorted(glob.glob("/dev/accel*")),
+        "vfio": os.path.exists("/dev/vfio"),
+        "tpu_env": bool(
+            os.environ.get("TPU_NAME")
+            or os.environ.get("TPU_WORKER_ID")
+            or os.environ.get("TPU_WORKER_HOSTNAMES")
+        ),
+        "libtpu_dists": libtpus,
+    }
+
+
+#: Failure reasons that no amount of retrying will change (the host
+#: itself lacks TPU hardware); the retry loop breaks on this marker.
+PREFLIGHT_PERMANENT = "not a TPU host"
+
+
 def tpu_preflight(timeout_s: float) -> tuple[bool, float, str]:
-    """Cheap tunnel-health probe in a throwaway subprocess.
+    """Staged tunnel-health probe in a throwaway subprocess.
 
     Round 3 lost its entire TPU evidence to a hung backend init: both
     attempts burned the full 360 s + 120 s budgets inside
     ``jax.devices()`` (BENCH_r03: two ``TimeoutError()`` lines, ~30 null
-    metrics).  A hung *subprocess* costs only ``timeout_s`` and is
-    killable, so the big electron budget is now committed only after one
-    of these succeeds.  The probe jits a tiny matmul and fetches the
-    result — device handshake, compile path, and data path all proven,
-    in seconds on a healthy tunnel.
+    metrics), and every round since has reported the unactionable
+    ``timeout after Ns``.  Diagnosis (reproduced under
+    ``JAX_PLATFORMS=tpu`` on a TPU-less host): libtpu's backend init
+    BLOCKS — it never errors — when the host has no ``/dev/accel*``
+    device nodes, so the old single-shot probe could only ever time out
+    with no stage attribution.  Three fixes ride here:
+
+    * **Fail fast off-TPU** — when the env pins a TPU platform and the
+      host shows none of a TPU VM's signals, refuse in milliseconds with
+      the actionable reason (and the installed libtpu dists, since a
+      ``libtpu`` + ``libtpu_nightly`` double-install is itself a known
+      init-breaker).  The retry loop treats this as permanent.
+    * **Stage markers** — the child prints a progress line per stage
+      (import / backend / compile), and a timeout's partial stdout names
+      the stage that hung instead of just the budget that died.
+    * **No silent CPU pass** — a probe that settles on a platform other
+      than the TPU the env requested is a FAILURE with the settled
+      platform in the reason; previously it passed, misreporting a CPU
+      fallback as live TPU health.
     """
     import subprocess
 
+    t0 = time.monotonic()
+    requested = (os.environ.get("JAX_PLATFORMS") or "").lower()
+    if "tpu" in requested:
+        signals = tpu_host_signals()
+        if not (
+            signals["accel_devices"] or signals["vfio"] or signals["tpu_env"]
+        ):
+            return False, time.monotonic() - t0, (
+                f"{PREFLIGHT_PERMANENT}: JAX_PLATFORMS={requested!r} but no "
+                "/dev/accel* nodes, no /dev/vfio, no TPU_* env — libtpu "
+                "backend init would hang, not fail "
+                f"(libtpu dists installed: {signals['libtpu_dists'] or 'none'})"
+            )
     code = (
         # Pin the platform from the env like the electron harness does —
         # site hooks (e.g. the axon TPU plugin) re-pin after interpreter
         # start, so a JAX_PLATFORMS=cpu validation run would otherwise
-        # probe the TPU tunnel it was explicitly avoiding.
-        "import os, jax, jax.numpy as jnp\n"
+        # probe the TPU tunnel it was explicitly avoiding.  Stage lines
+        # flush eagerly: they are the hang's attribution.
+        "import os\n"
+        "print('PREFLIGHT_STAGE import', flush=True)\n"
+        "import jax, jax.numpy as jnp\n"
         "plat = os.environ.get('JAX_PLATFORMS')\n"
         "if plat:\n"
         "    try:\n"
         "        jax.config.update('jax_platforms', plat)\n"
         "    except RuntimeError:\n"
         "        pass  # backend already initialized by a site hook\n"
+        "print('PREFLIGHT_STAGE backend', flush=True)\n"
+        "devs = jax.devices()\n"
+        "print('PREFLIGHT_STAGE compile', devs[0].platform, len(devs),"
+        " flush=True)\n"
         "x = jnp.ones((256, 256), jnp.bfloat16)\n"
         "out = jax.jit(lambda a: a @ a)(x)\n"
-        "print('PREFLIGHT_OK', float(out[0, 0]), jax.devices()[0].platform)\n"
+        "print('PREFLIGHT_OK', float(out[0, 0]), devs[0].platform,"
+        " flush=True)\n"
     )
-    t0 = time.monotonic()
+
+    def last_stage(stdout: str | bytes | None) -> str:
+        text = stdout or ""
+        if isinstance(text, bytes):
+            text = text.decode(errors="replace")
+        stages = [
+            line.split()[1]
+            for line in text.splitlines()
+            if line.startswith("PREFLIGHT_STAGE ") and len(line.split()) > 1
+        ]
+        return stages[-1] if stages else "interpreter-start"
+
     try:
         proc = subprocess.run(
             [sys.executable, "-c", code],
@@ -467,17 +550,273 @@ def tpu_preflight(timeout_s: float) -> tuple[bool, float, str]:
         )
         took = time.monotonic() - t0
         if proc.returncode == 0 and "PREFLIGHT_OK 256" in proc.stdout:
+            settled = proc.stdout.rsplit("PREFLIGHT_OK 256", 1)[-1].split()
+            platform = (settled[-1] if settled else "").lower()
+            if "tpu" in requested and platform != "tpu":
+                return False, took, (
+                    f"backend settled on {platform!r}, not the requested "
+                    f"'tpu' — silent platform fallback, not TPU health"
+                )
             return True, took, ""
         tail = (proc.stderr or proc.stdout or "")[-300:]
-        return False, took, f"rc={proc.returncode}: {tail}"
-    except subprocess.TimeoutExpired:
-        return False, time.monotonic() - t0, f"timeout after {timeout_s}s"
+        return False, took, (
+            f"rc={proc.returncode} in stage {last_stage(proc.stdout)!r}: "
+            f"{tail}"
+        )
+    except subprocess.TimeoutExpired as error:
+        stage = last_stage(error.stdout)
+        hint = (
+            " (TPU backend init blocked: check /dev/accel* visibility and "
+            "for conflicting libtpu installs)"
+            if stage == "backend"
+            else ""
+        )
+        return False, time.monotonic() - t0, (
+            f"timeout after {timeout_s}s, hung in stage {stage!r}{hint}"
+        )
     except Exception as error:  # noqa: BLE001
         return False, time.monotonic() - t0, repr(error)
 
 
 def trivial_electron(i: int) -> int:
     return i * i
+
+
+# --------------------------------------------------------------------------
+# dispatcher_crash drill: two processes play dispatcher incarnations.
+#
+# The phase cannot SIGKILL *itself*, so the drill runs the dispatcher in a
+# child: `bench.py --dispatcher-drill serve <dir>` journals two serving
+# sessions with one slow stream each and reports delivered-token progress
+# on stdout until the phase kills it -9 mid-stream; `--dispatcher-drill
+# recover <dir>` is the successor incarnation — journal replay, orphan
+# adoption over the rendezvous socket, stream resume from the journaled
+# high-water marks — and prints one summary line the phase asserts on.
+# --------------------------------------------------------------------------
+
+DRILL_SESSIONS = 2
+DRILL_TOKENS = 40
+
+
+def _drill_engine_factory(step_delay: float = 0.15):
+    """Deterministic slow engine (closure-local: workers can't import
+    bench).  Prompt ``[base]`` streams ``base+1 .. base+DRILL_TOKENS`` —
+    byte-checkable across the crash."""
+
+    def factory():
+        import time as time_mod
+
+        class Engine:
+            def __init__(self):
+                self.slots = 2
+                self.lanes = {}
+
+            def admit(self, rid, prompt, params):
+                cap = int((params or {}).get("max_new_tokens", 8))
+                base = int(prompt[-1])
+                self.lanes[rid] = [base + i + 1 for i in range(cap)]
+
+            def step(self):
+                time_mod.sleep(step_delay)
+                events = []
+                for rid in list(self.lanes):
+                    taken = self.lanes[rid][:2]
+                    self.lanes[rid] = self.lanes[rid][2:]
+                    done = not self.lanes[rid]
+                    if done:
+                        del self.lanes[rid]
+                    events.append({"rid": rid, "tokens": taken, "done": done})
+                return events
+
+            def cancel(self, rid):
+                self.lanes.pop(rid, None)
+
+        return Engine()
+
+    return factory
+
+
+def _drill_executor(dwork: str):
+    root = os.path.dirname(os.path.abspath(__file__))
+    return TPUExecutor(
+        transport="local",
+        cache_dir=f"{dwork}/cache",
+        remote_cache=f"{dwork}/remote",
+        python_path=sys.executable,
+        poll_freq=0.2,
+        use_agent="pool",
+        heartbeat_interval=0.0,
+        prewarm=False,
+        task_env={
+            "PYTHONPATH": root + os.pathsep
+            + os.environ.get("PYTHONPATH", ""),
+            "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu"),
+        },
+    )
+
+
+async def _drill_serve(dwork: str) -> None:
+    """Incarnation 1: journal, stream, report progress, die by SIGKILL."""
+    from covalent_tpu_plugin.fleet import journal as journal_mod
+    from covalent_tpu_plugin.serving import open_session
+
+    journal_mod.configure(f"{dwork}/journal")
+    ex = _drill_executor(dwork)
+    # Both sessions warm BEFORE either request: stream 0 must not run to
+    # completion while session 1 is still cold-starting its worker.
+    handles = await asyncio.gather(*(
+        open_session(
+            ex, _drill_engine_factory(step_delay=0.2),
+            name=f"dcrash-s{i}", stats_interval_s=0.2,
+        )
+        for i in range(DRILL_SESSIONS)
+    ))
+    streams = []
+    for i, handle in enumerate(handles):
+        base = 1000 * (i + 1)
+        req = await handle.request(
+            [base], params={"max_new_tokens": DRILL_TOKENS}
+        )
+        streams.append((handle.sid, base, req))
+    deadline = time.monotonic() + 120  # safety: the kill should come first
+    while time.monotonic() < deadline:
+        for sid, base, req in streams:
+            print(json.dumps({
+                "drill": "progress", "sid": sid, "rid": req.rid,
+                "base": base, "tokens": list(req.tokens),
+            }), flush=True)
+        await asyncio.sleep(0.1)
+
+
+async def _drill_recover(dwork: str) -> None:
+    """Incarnation 2: replay, re-adopt, resume, report, exit clean."""
+    from covalent_tpu_plugin.fleet import journal as journal_mod
+    from covalent_tpu_plugin.fleet import recovery as recovery_mod  # noqa: F401
+
+    journal_mod.configure(f"{dwork}/journal")
+    ex = _drill_executor(dwork)
+    report = await ex.recover()
+    streams = {}
+    for (sid, rid), req in report.requests.items():
+        tail = await req.result(timeout=90)
+        streams[f"{sid}/{rid}"] = {
+            "from": req.resumed_from, "tail": list(tail),
+        }
+    totals = metrics_totals()
+    print(json.dumps({
+        "drill": "recovered",
+        "epoch": report["epoch"],
+        "duration_s": report["duration_s"],
+        "adopted": len(report["adopted_sessions"]),
+        "orphaned": len(report["orphaned_sessions"]),
+        "streams": streams,
+        "metrics": {
+            k: v for k, v in totals.items()
+            if "recovery" in k or "journal" in k or "fallback_local" in k
+        },
+    }), flush=True)
+    for sup in report.supervisors.values():
+        await sup.close()
+    await ex.close()
+
+
+def run_dispatcher_crash_drill(dwork: str) -> dict:
+    """Phase orchestrator (sync, called off-loop): serve → kill -9 →
+    recover, returning the composed evidence."""
+    import signal as signal_mod
+    import subprocess
+
+    os.makedirs(dwork, exist_ok=True)
+    env = dict(os.environ)
+    env["COVALENT_TPU_ORPHAN_TTL_S"] = "120"
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    argv = [sys.executable, os.path.abspath(__file__), "--dispatcher-drill"]
+    serve = subprocess.Popen(
+        argv + ["serve", dwork],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=env,
+    )
+    prefixes: dict[str, dict] = {}
+    try:
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            line = serve.stdout.readline()
+            if not line:
+                break
+            try:
+                msg = json.loads(line)
+            except ValueError:
+                continue
+            if msg.get("drill") != "progress":
+                continue
+            prefixes[f"{msg['sid']}/{msg['rid']}"] = msg
+            # Mid-stream on every session: tokens flowed, none finished.
+            if len(prefixes) >= DRILL_SESSIONS and all(
+                4 <= len(p["tokens"]) < DRILL_TOKENS
+                for p in prefixes.values()
+            ):
+                break
+        t_kill = time.monotonic()
+        serve.send_signal(signal_mod.SIGKILL)
+        serve.wait(timeout=30)
+    finally:
+        if serve.poll() is None:
+            serve.kill()
+    mid_flight = bool(prefixes) and all(
+        0 < len(p["tokens"]) < DRILL_TOKENS for p in prefixes.values()
+    )
+    rec = subprocess.run(
+        argv + ["recover", dwork],
+        capture_output=True, text=True, env=env, timeout=240,
+    )
+    recovered = None
+    for line in (rec.stdout or "").splitlines():
+        try:
+            msg = json.loads(line)
+        except ValueError:
+            continue
+        if msg.get("drill") == "recovered":
+            recovered = msg
+    if recovered is None:
+        raise AssertionError(
+            f"recover drill produced no summary (rc={rec.returncode}): "
+            f"{(rec.stderr or rec.stdout or '')[-400:]}"
+        )
+    # Exactly-once across the crash, per stream: the killed dispatcher's
+    # last-reported prefix must be a prefix of the oracle, the journaled
+    # splice point can exceed it only by the kill window (chunks delivered
+    # between the last progress line and the SIGKILL), and the resumed
+    # tail must complete the oracle byte-for-byte from that splice point.
+    streams_exact = bool(recovered["streams"]) and mid_flight
+    checks = []
+    for key, got in recovered["streams"].items():
+        progress = prefixes.get(key)
+        base = progress["base"] if progress else 0
+        oracle = [base + i + 1 for i in range(DRILL_TOKENS)]
+        prefix = progress["tokens"] if progress else []
+        splice = int(got["from"])
+        ok = (
+            progress is not None
+            and prefix == oracle[:len(prefix)]
+            and len(prefix) <= splice <= DRILL_TOKENS
+            and got["tail"] == oracle[splice:]
+        )
+        streams_exact = streams_exact and ok
+        checks.append({
+            "stream": key, "prefix_tokens": len(prefix), "splice": splice,
+            "tail_tokens": len(got["tail"]), "exact": ok,
+        })
+    return {
+        "mid_flight_at_kill": mid_flight,
+        "sessions_adopted": recovered["adopted"],
+        "sessions_orphaned": recovered["orphaned"],
+        "recovery_duration_s": recovered["duration_s"],
+        "recovery_epoch": recovered["epoch"],
+        "recovery_wall_s": round(time.monotonic() - t_kill, 3),
+        "streams": checks,
+        "streams_exact": streams_exact,
+        "metrics": recovered["metrics"],
+    }
 
 
 def preemptible_train(steps: int, step_s: float, progress_path: str):
@@ -3933,6 +4272,39 @@ async def main() -> None:
     except Exception as error:  # noqa: BLE001
         emit({"phase": "preemption_chaos", "error": repr(error)})
 
+    # ---- phase 2c'': dispatcher crash recovery ---------------------------
+    # SIGKILL the *dispatcher* (not a worker) mid-stream and prove the
+    # successor incarnation replays the journal, re-adopts the surviving
+    # pool servers and serving sessions, and resumes every in-flight
+    # stream exactly once — the resumed tail splices byte-for-byte onto
+    # the journaled high-water mark, no duplicate and no lost token.
+    # Drill children carry the actual kill (a process cannot -9 itself
+    # and keep benching); see run_dispatcher_crash_drill.
+    try:
+        if "dispatcher_crash" not in BENCH_PHASES:
+            raise _PhaseSkipped
+        # Overridable so CI can land the journal inside its artifact dir.
+        drill_dir = (
+            os.environ.get("BENCH_DISPATCHER_CRASH_DIR")
+            or f"{workdir}/dispatcher_crash"
+        )
+        drill = await asyncio.get_running_loop().run_in_executor(
+            None, run_dispatcher_crash_drill, drill_dir
+        )
+        summary["dispatcher_crash_recovery_s"] = drill["recovery_duration_s"]
+        summary["dispatcher_crash_adopted"] = drill["sessions_adopted"]
+        summary["dispatcher_crash_orphaned"] = drill["sessions_orphaned"]
+        summary["dispatcher_crash_fallback_local"] = sum(
+            value for key, value in drill["metrics"].items()
+            if "fallback_local" in key
+        )
+        summary["recovery_streams_exact"] = drill["streams_exact"]
+        emit({"phase": "dispatcher_crash", **drill})
+    except _PhaseSkipped:
+        emit({"phase": "dispatcher_crash", "skipped": "BENCH_PHASES"})
+    except Exception as error:  # noqa: BLE001
+        emit({"phase": "dispatcher_crash", "error": repr(error)})
+
     # ---- phase 2d: fleet scheduler fan-out vs naive 1:1 dispatch ---------
     # 16 electrons, 2 tenants, through the fleet work queue onto 2 warm
     # local pools (bin-packed onto pooled gangs, deficit-round-robin
@@ -4446,10 +4818,20 @@ async def main() -> None:
             if ok:
                 healthy = True
                 break
+            # A host with no TPU hardware will not grow any between
+            # attempts: retrying a permanent refusal just burns the
+            # deadline the electron could still use.
+            if PREFLIGHT_PERMANENT in err:
+                break
             # Leave enough deadline for one more probe + a minimal electron.
             if phase3_left() < 90:
                 break
-            await asyncio.sleep(min(15.0, max(phase3_left() - 60, 1.0)))
+            # Exponential backoff: transient tunnel faults (agent restart,
+            # libtpu grabbing the chip lock) clear in seconds, real
+            # outages in minutes — back off toward 30 s instead of
+            # hammering a fixed cadence.
+            backoff = min(30.0, 2.0 ** attempt)
+            await asyncio.sleep(min(backoff, max(phase3_left() - 60, 1.0)))
         if skipped_tpu:
             emit({"phase": "tpu", "skipped": "BENCH_PHASES"})
         elif not healthy:
@@ -4721,6 +5103,15 @@ def stage_histogram_summary() -> dict:
 
 
 if __name__ == "__main__":
+    if len(sys.argv) >= 4 and sys.argv[1] == "--dispatcher-drill":
+        # Child modes of the dispatcher_crash phase, not a bench run.
+        mode, dwork = sys.argv[2], sys.argv[3]
+        if mode == "serve":
+            asyncio.run(_drill_serve(dwork))
+        else:
+            asyncio.run(_drill_recover(dwork))
+        sys.stdout.flush()
+        os._exit(0)
     asyncio.run(main())
     # Non-daemon helper threads from transport/agent internals must not keep
     # a finished bench alive into the driver's timeout.

@@ -196,6 +196,96 @@ async def start_pool_server(
     return client
 
 
+def orphan_rendezvous_path(remote_cache: str) -> str:
+    """Where an orphaned pool server publishes its adoption coordinates."""
+    return f"{remote_cache}/pool_orphan.json"
+
+
+async def read_orphan_rendezvous(
+    conn: Transport, remote_cache: str
+) -> dict | None:
+    """The worker's ``pool_orphan.json``, or None when no orphan waits."""
+    import tempfile
+
+    path = orphan_rendezvous_path(remote_cache)
+    with tempfile.TemporaryDirectory(prefix="covalent-orphan-") as tmp:
+        local = f"{tmp}/pool_orphan.json"
+        try:
+            await conn.get(path, local)
+            with open(local, "r", encoding="utf-8") as fh:
+                meta = json.load(fh)
+        except (TransportError, OSError, ValueError):
+            return None
+    if not isinstance(meta, dict) or not meta.get("sock"):
+        return None
+    return meta
+
+
+async def attach_pool_server(
+    conn: Transport,
+    remote_cache: str,
+    python_path: str,
+    sock_path: str,
+    epoch: int,
+    conda_env: str = "",
+    timeout: float = 30.0,
+    frames_enabled: bool | None = None,
+    frames_codec: str = "",
+) -> "AgentClient":
+    """Adopt an orphaned pool server instead of starting a fresh one.
+
+    Spawns the ``--attach`` stdio relay through the normal transport (so
+    adoption works identically over SSH and local), sends the epoch-fenced
+    adopt line, and waits for the orphan's re-attach ready banner.  The
+    orphan refuses a stale epoch with an error event — surfaced here as an
+    AgentError so the caller falls back to a fresh server.
+    """
+    remote_harness = f"{remote_cache}/{HARNESS_BASENAME}"
+    command = f"{python_path} {shlex.quote(remote_harness)} --attach " \
+              f"{shlex.quote(sock_path)}"
+    if conda_env:
+        command = (
+            f'eval "$(conda shell.bash hook)" && conda activate '
+            f"{shlex.quote(conda_env)} && {command}"
+        )
+    try:
+        process = await conn.start_process(
+            command, describe=f"adopt@{conn.address}"
+        )
+    except TransportError as err:
+        raise AgentError(
+            f"cannot start attach relay on {conn.address}: {err}"
+        ) from err
+    client = AgentClient(process, conn.address)
+    client.mode = "pool"
+    try:
+        await client._send({"cmd": "adopt", "epoch": int(epoch)})
+
+        def adopted(c: "AgentClient"):
+            if c._banner.get("reattach"):
+                return c._banner
+            if c._error_codes.get("") == "stale_epoch":
+                message = c._errors.pop("", "stale epoch")
+                c._error_codes.pop("", None)
+                raise AgentError(f"agent@{c.address}: adopt refused: "
+                                 f"{message}")
+            if c._error_codes.get("") == "attach_failed":
+                message = c._errors.pop("", "attach failed")
+                c._error_codes.pop("", None)
+                raise AgentError(f"agent@{c.address}: {message}")
+            return None
+
+        await client._wait(adopted, timeout)
+        await client.ping(timeout)
+        await client.negotiate_frames(
+            enabled=frames_enabled, codec=frames_codec
+        )
+    except AgentError:
+        await client.close()
+        raise
+    return client
+
+
 class AgentClient:
     """One agent channel to one worker, demultiplexing pushed events.
 
@@ -243,6 +333,13 @@ class AgentClient:
         #: "sid/rid" -> pushed ``serve_kv`` event (disaggregated prefill
         #: answers: KV bundle bytes as a raw frame body, or an error).
         self._serve_kv: dict[str, dict] = {}
+        #: "sid/rid" -> pushed ``serve_resumed`` ack (recovery path).
+        self._serve_resumed: dict[str, dict] = {}
+        #: "serve"/"task" -> latest pushed inventory answer (recovery path;
+        #: one outstanding request per kind — the slot is cleared on send).
+        self._inventories: dict[str, dict] = {}
+        #: last ``epoch_ok`` ack from declare_epoch (worker-side fence).
+        self._epoch_ack: dict | None = None
         #: resident-mode profiling: profile id -> pushed profile_started /
         #: profile_stopped / profile_error events.
         self._profile_started: dict[str, dict] = {}
@@ -302,6 +399,12 @@ class AgentClient:
     @property
     def alive(self) -> bool:
         return self._dead is None and not self._reader.done()
+
+    @property
+    def banner_sessions(self) -> list[str]:
+        """Session ids a re-adopted pool server announced in its banner
+        (empty for a fresh start — only ``reattach`` banners carry them)."""
+        return [str(s) for s in (self._banner.get("sessions") or [])]
 
     async def close(self) -> None:
         try:
@@ -439,6 +542,20 @@ class AgentClient:
                             self._serve_kv.pop(
                                 next(iter(self._serve_kv))
                             )
+                    elif kind == "serve_resumed":
+                        self._serve_resumed[
+                            f"{task_id}/{event.get('rid') or ''}"
+                        ] = event
+                        while len(self._serve_resumed) > 1024:
+                            self._serve_resumed.pop(
+                                next(iter(self._serve_resumed))
+                            )
+                    elif kind == "serve_inventory":
+                        self._inventories["serve"] = event
+                    elif kind == "task_inventory":
+                        self._inventories["task"] = event
+                    elif kind == "epoch_ok":
+                        self._epoch_ack = event
                     elif kind == "profile_started":
                         self._profile_started[task_id] = event
                     elif kind == "profile_stopped":
@@ -464,7 +581,12 @@ class AgentClient:
                     elif kind == "pong":
                         self._pongs += 1
                     elif kind == "error":
-                        if task_id:  # id-less errors are log-only, not stored
+                        # id-less errors are log-only — EXCEPT the epoch
+                        # fence refusal and a failed attach relay, which
+                        # declare_epoch / attach_pool_server wait on.
+                        if task_id or event.get("code") in (
+                            "stale_epoch", "attach_failed"
+                        ):
                             self._errors[task_id] = str(event.get("message", "?"))
                             if event.get("code"):
                                 self._error_codes[task_id] = str(event["code"])
@@ -1109,6 +1231,73 @@ class AgentClient:
             return c._serve_closed.pop(sid, None)
 
         return await self._wait(settled, timeout)
+
+    # -- crash recovery (epoch fence, inventories, stream resume) ------------
+
+    async def declare_epoch(self, epoch: int, timeout: float = 15.0) -> dict:
+        """Declare this dispatcher's journal epoch on the channel.
+
+        The worker records the highest epoch it has ever seen and refuses
+        mutating commands from channels that declared a lower one — the
+        split-brain fence.  Raises when THIS channel is the stale one.
+        """
+        self._epoch_ack = None
+        self._errors.pop("", None)
+        self._error_codes.pop("", None)
+        await self._send({"cmd": "epoch", "epoch": int(epoch)})
+
+        def settled(c: "AgentClient"):
+            if c._epoch_ack is not None:
+                return c._epoch_ack
+            if c._error_codes.get("") == "stale_epoch":
+                message = c._errors.pop("", "stale epoch")
+                c._error_codes.pop("", None)
+                raise AgentError(
+                    f"agent@{c.address}: {message}"
+                )
+            return None
+
+        return await self._wait(settled, timeout)
+
+    async def serve_inventory(self, timeout: float = 30.0) -> dict:
+        """Ask the worker which serving sessions survive in-process.
+
+        Returns the ``serve_inventory`` event: per-session sid, factory
+        digest, running rids with emitted-token counts, and the finished
+        ring — everything the recovery path needs to re-adopt streams.
+        """
+        self._inventories.pop("serve", None)
+        await self._send({"cmd": "serve_inventory"})
+        return await self._wait(
+            lambda c: c._inventories.pop("serve", None), timeout
+        )
+
+    async def task_inventory(self, timeout: float = 30.0) -> dict:
+        """Ask the worker which forked task children are still running."""
+        self._inventories.pop("task", None)
+        await self._send({"cmd": "task_inventory"})
+        return await self._wait(
+            lambda c: c._inventories.pop("task", None), timeout
+        )
+
+    async def serve_resume(
+        self, sid: str, rid: str, start: int, timeout: float = 30.0
+    ) -> dict:
+        """Resume one stream from token ``start`` after re-adoption.
+
+        The worker re-emits ``history[start:]`` on the side-band (under
+        the same lock as live chunks, so no gap is possible) and answers
+        ``serve_resumed`` with what it knows about the rid: streaming,
+        done, pending, or unknown.
+        """
+        key = f"{sid}/{rid}"
+        self._serve_resumed.pop(key, None)
+        await self._send({
+            "cmd": "serve_resume", "id": sid, "rid": rid, "from": int(start),
+        })
+        return await self._wait(
+            lambda c: c._serve_resumed.pop(key, None), timeout
+        )
 
     # -- resident-mode profiling ---------------------------------------------
 

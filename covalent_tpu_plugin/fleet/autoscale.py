@@ -77,6 +77,7 @@ from ..obs.opsserver import (
     unregister_status_provider,
 )
 from ..utils.log import app_log
+from . import journal
 from .pools import Pool, PoolRegistry
 
 __all__ = [
@@ -532,6 +533,11 @@ class AutoscaleController:
             "ts": round(now, 3),
         }
         AUTOSCALE_DECISIONS_TOTAL.labels(action=action).inc()
+        if target is not None:
+            # Durable intent: a restarted dispatcher restores the last
+            # journaled target instead of re-deriving it from a history
+            # ring that died with the process.
+            journal.record("pool_target", name=resource, capacity=target)
         self.decision_counts[action] = (
             self.decision_counts.get(action, 0) + 1
         )

@@ -32,6 +32,7 @@ from typing import Any, Callable
 from ..obs.metrics import REGISTRY
 from ..utils.config import get_config
 from ..utils.log import app_log
+from . import journal
 
 POOL_SLOTS = REGISTRY.gauge(
     "covalent_tpu_pool_slots",
@@ -189,6 +190,9 @@ class Pool:
     def capacity(self, value: int) -> None:
         """Autoscale hooks resize pools by writing this (min 1)."""
         self.spec.capacity = max(1, int(value))
+        journal.record(
+            "pool_target", name=self.name, capacity=self.spec.capacity
+        )
         self._publish_slots()
 
     @property
@@ -564,6 +568,12 @@ class PoolRegistry:
         displaced = self._pools.get(spec.name)
         pool = Pool(spec, executor_factory=self._factory, executor=executor)
         self._pools[spec.name] = pool
+        try:
+            from dataclasses import asdict
+
+            journal.record("pool", name=spec.name, spec=asdict(spec))
+        except TypeError:
+            journal.record("pool", name=spec.name, spec={})
         if displaced is not None and displaced.started:
             try:
                 loop = asyncio.get_running_loop()

@@ -1,0 +1,277 @@
+"""Dispatcher crash recovery: replayed journal state → re-adopted fleet.
+
+The journal (:mod:`.journal`) records what the dead dispatcher *meant*
+to be true; the workers themselves know what *survived* (orphan-mode
+pool servers hold their sessions through the dispatcher's death).  This
+module reconciles the two on restart:
+
+1. ``lease_gang()`` re-dials every worker.  The agent warm-up path
+   tries orphan adoption first (``_try_adopt_orphan`` reads the
+   rendezvous file, fence-checks the epoch, and splices the successor's
+   channel onto the surviving process) and declares the new epoch on
+   every channel — so by the time the lease returns, stale-dispatcher
+   fencing is up and surviving pool servers are back on live pipes.
+2. ``serve_inventory`` / ``task_inventory`` ask each worker what it
+   still holds: sessions by generation sid, running rids with
+   emitted-token counts, forked task children.
+3. Each journaled session found in an inventory is re-adopted into a
+   fresh :class:`~..serving.supervisor.SessionSupervisor`
+   (:meth:`~..serving.supervisor.SessionSupervisor.adopt`), and each
+   journaled in-flight stream is re-attached with
+   :meth:`~..serving.supervisor.SessionSupervisor.resume_stream` from
+   its journaled token high-water mark — the worker re-emits history
+   from that offset and the supervisor's idx-splice keeps delivery
+   exactly-once.  Journaled sessions NO worker still holds are reaped:
+   counted, journaled closed, reported.
+4. Journaled in-flight electrons are *reported*, not re-run: Covalent's
+   own retry re-dispatches them, and the checkpoint-resume discovery
+   path (``_discover_resume``) picks up whatever step the orphaned run
+   reached.
+
+The whole pass is fenced by the epoch bump :meth:`Journal.open` already
+performed — a zombie predecessor that wakes up mid-recovery finds every
+worker refusing its commands.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any
+
+from . import journal as journal_mod
+from ..obs import events as obs_events
+from ..obs.metrics import REGISTRY
+from ..utils.log import app_log
+
+__all__ = ["recover", "RecoveryReport", "last_report"]
+
+RECOVERY_DURATION = REGISTRY.histogram(
+    "covalent_tpu_recovery_duration_seconds",
+    "Wall time of one dispatcher crash-recovery pass",
+    buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0),
+)
+RECOVERY_ADOPTED = REGISTRY.counter(
+    "covalent_tpu_recovery_adopted_total",
+    "Surviving sessions re-adopted from orphaned workers after a "
+    "dispatcher restart",
+)
+RECOVERY_ORPHANED = REGISTRY.counter(
+    "covalent_tpu_recovery_orphaned_total",
+    "Journaled sessions no surviving worker still held (reaped)",
+)
+RECOVERY_STREAMS = REGISTRY.counter(
+    "covalent_tpu_recovery_streams_total",
+    "In-flight streams re-attached from journaled high-water marks",
+    ("state",),
+)
+
+#: The last completed recovery pass, for the ``/status`` recovery
+#: section and the bench drill's assertions.  One dispatcher process
+#: recovers at most once per incarnation, so a module global is enough.
+_LAST_REPORT: dict | None = None
+
+
+def last_report() -> dict | None:
+    """The most recent recovery report (``None`` before any recovery)."""
+    return _LAST_REPORT
+
+
+class RecoveryReport(dict):
+    """The recovery pass's outcome — a dict, plus the live handles.
+
+    The dict half is JSON-safe (it feeds ``/status`` and the bench
+    drill's artifact); ``supervisors`` and ``requests`` carry the
+    re-adopted runtime objects so the caller can await the resumed
+    streams' results directly.
+    """
+
+    def __init__(self) -> None:
+        super().__init__()
+        #: sid -> the re-adopted SessionSupervisor
+        self.supervisors: dict[str, Any] = {}
+        #: (sid, rid) -> the resumed ServeRequest
+        self.requests: dict[tuple[str, str], Any] = {}
+
+
+def _status_section() -> dict:
+    report = _LAST_REPORT
+    if report is None:
+        return {"recovered": False}
+    return dict(report)
+
+
+async def recover(executor: Any, timeout_s: float = 120.0) -> RecoveryReport:
+    """Run one crash-recovery pass for ``executor``.
+
+    Reads the journal's *replayed* state (``journal.recovered`` — the
+    dead incarnation's world, captured before the epoch bump), re-dials
+    the fleet, and re-adopts everything that survived.  Safe to call
+    when journaling is off or the journal was empty: returns a report
+    with ``recovered=False`` and touches nothing.
+    """
+    global _LAST_REPORT
+    report = RecoveryReport()
+    journal = journal_mod.get_journal()
+    prior = dict(journal.recovered) if journal is not None else {}
+    sessions: dict[str, dict] = dict(prior.get("sessions") or {})
+    streams: dict[str, dict] = dict(prior.get("streams") or {})
+    tasks: dict[str, dict] = dict(prior.get("tasks") or {})
+    report.update({
+        "recovered": False,
+        "epoch": journal.epoch if journal is not None else 0,
+        "journaled_sessions": len(sessions),
+        "journaled_streams": len(streams),
+        "journaled_tasks": len(tasks),
+        "adopted_sessions": [],
+        "orphaned_sessions": [],
+        "resumed_streams": [],
+        "pending_tasks": sorted(tasks),
+        "pools": dict(prior.get("pools") or {}),
+        "pool_targets": dict(prior.get("pool_targets") or {}),
+        "replica_sets": dict(prior.get("replica_sets") or {}),
+        "workers": [],
+        "duration_s": 0.0,
+    })
+    if journal is None or not (sessions or streams or tasks):
+        _LAST_REPORT = dict(report)
+        return report
+
+    t0 = time.monotonic()
+    app_log.info(
+        "recovery: epoch %d, replayed %d session(s) / %d stream(s) / "
+        "%d task(s) from journal",
+        journal.epoch, len(sessions), len(streams), len(tasks),
+    )
+
+    # -- 1. re-dial.  lease_gang's warm-up adopts orphaned pool servers
+    # (rendezvous + fence-checked attach) and declares the new epoch on
+    # every channel before this returns.
+    lease = await asyncio.wait_for(executor.lease_gang(), timeout_s)
+
+    # -- 2. inventory every live channel.
+    by_sidg: dict[str, tuple[Any, Any, str, dict]] = {}
+    running_tasks: list[dict] = []
+    for conn, address in zip(lease.conns, lease.addresses):
+        client = executor._agents.get(conn.address)
+        if client is None:
+            continue
+        worker: dict = {"address": address, "sessions": [], "tasks": 0}
+        try:
+            inv = await client.serve_inventory()
+            tinv = await client.task_inventory()
+        except Exception as err:  # noqa: BLE001 - a dead worker is data
+            worker["error"] = repr(err)
+            report["workers"].append(worker)
+            continue
+        for entry in inv.get("sessions") or []:
+            sid_g = str(entry.get("sid") or "")
+            if sid_g:
+                by_sidg[sid_g] = (client, conn, address, dict(entry))
+                worker["sessions"].append(sid_g)
+        children = list(tinv.get("tasks") or [])
+        worker["tasks"] = len(children)
+        running_tasks.extend(children)
+        report["workers"].append(worker)
+
+    # -- 3. re-adopt each journaled session a worker still holds; resume
+    # its journaled streams from their high-water marks.
+    from ..serving.supervisor import ServeRequest, SessionSupervisor
+
+    for sid, meta in sessions.items():
+        sid_g = str(meta.get("sid_g") or "")
+        found = by_sidg.pop(sid_g, None)
+        if found is None:
+            report["orphaned_sessions"].append(sid)
+            RECOVERY_ORPHANED.inc()
+            # Journal the reap so the NEXT replay doesn't resurrect it.
+            journal_mod.record("session_closed", sid=sid, sync=True)
+            continue
+        client, conn, address, entry = found
+        supervisor = SessionSupervisor(
+            executor,
+            sid=sid,
+            queue_max=meta.get("queue_max"),
+            default_deadline_s=meta.get("default_deadline_s"),
+            stats_interval_s=meta.get("stats_interval_s"),
+        )
+        try:
+            await supervisor.adopt(
+                client=client,
+                conns=[conn],
+                address=address,
+                sid_g=sid_g,
+                slots=int(entry.get("slots") or meta.get("slots") or 1),
+                digest=str(meta.get("digest") or entry.get("digest") or ""),
+                payload_path=str(meta.get("payload") or ""),
+            )
+        except Exception as err:  # noqa: BLE001 - keep recovering others
+            app_log.warning("recovery: adopt of %s failed: %r", sid, err)
+            report["orphaned_sessions"].append(sid)
+            RECOVERY_ORPHANED.inc()
+            continue
+        report["adopted_sessions"].append(sid)
+        report.supervisors[sid] = supervisor
+        RECOVERY_ADOPTED.inc()
+        for key, srec in streams.items():
+            ssid, _, rid = key.partition("\x00")
+            if ssid != sid or not rid:
+                continue
+            request = ServeRequest(
+                rid,
+                list(srec.get("prompt") or []),
+                dict(srec.get("params") or {}),
+                float(srec.get("deadline_s") or 0.0),
+                str(srec.get("tenant") or ""),
+            )
+            request.resumed_from = int(srec.get("hwm") or 0)
+            try:
+                state = await supervisor.resume_stream(request)
+            except Exception as err:  # noqa: BLE001
+                app_log.warning(
+                    "recovery: resume of %s/%s failed: %r", sid, rid, err
+                )
+                RECOVERY_STREAMS.labels(state="error").inc()
+                report["resumed_streams"].append({
+                    "sid": sid, "rid": rid, "state": "error",
+                    "from": request.resumed_from,
+                })
+                continue
+            RECOVERY_STREAMS.labels(state=state or "unknown").inc()
+            report.requests[(sid, rid)] = request
+            report["resumed_streams"].append({
+                "sid": sid, "rid": rid, "state": state,
+                "from": request.resumed_from,
+            })
+
+    # Surviving sessions the journal never heard of (journaling enabled
+    # mid-flight, or a torn tail ate the open record): count them so the
+    # operator sees the mismatch, but leave them alone — their worker
+    # keeps serving whoever still holds the other end.
+    report["unjournaled_sessions"] = sorted(by_sidg)
+    report["running_task_children"] = len(running_tasks)
+    report["recovered"] = True
+    report["duration_s"] = round(time.monotonic() - t0, 3)
+    RECOVERY_DURATION.observe(report["duration_s"])
+    _LAST_REPORT = dict(report)
+    try:
+        from ..obs.opsserver import register_status_provider
+
+        register_status_provider("recovery", _status_section)
+    except Exception:  # noqa: BLE001 - ops server is optional
+        pass
+    obs_events.emit(
+        "recovery.complete",
+        epoch=report["epoch"],
+        adopted=len(report["adopted_sessions"]),
+        orphaned=len(report["orphaned_sessions"]),
+        streams=len(report["resumed_streams"]),
+        duration_s=report["duration_s"],
+    )
+    app_log.info(
+        "recovery: adopted %d session(s), reaped %d, resumed %d "
+        "stream(s) in %.3fs",
+        len(report["adopted_sessions"]), len(report["orphaned_sessions"]),
+        len(report["resumed_streams"]), report["duration_s"],
+    )
+    return report

@@ -41,6 +41,7 @@ from ..obs.opsserver import (
     unregister_status_provider,
 )
 from ..utils.log import app_log
+from . import journal
 from .pools import Pool, PoolRegistry
 from .queue import DEFAULT_TENANT, FairWorkQueue, QueueFullError, WorkItem
 
@@ -380,6 +381,10 @@ class FleetScheduler:
         outcome = "rerouted" if rerouted else "placed"
         self._count(outcome)
         queue_wait_s = max(0.0, self._clock() - item.enqueued_at)
+        journal.record(
+            "task", op=item.operation_id, pool=pool.name,
+            tenant=item.tenant, rerouted=rerouted,
+        )
         obs_events.emit(
             "fleet.placed",
             operation_id=item.operation_id,

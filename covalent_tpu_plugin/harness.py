@@ -853,8 +853,15 @@ _EMIT_LOCK = threading.Lock()
 
 def _emit(obj: dict) -> None:
     with _EMIT_LOCK:
-        sys.stdout.write(json.dumps(obj) + "\n")
-        sys.stdout.flush()
+        try:
+            sys.stdout.write(json.dumps(obj) + "\n")
+            sys.stdout.flush()
+        except OSError:
+            # Dead channel (dispatcher gone, SIGPIPE ignored): swallowing
+            # keeps session/RPC threads alive so the serve loop's orphan
+            # path can hold their state for re-adoption instead of dying
+            # on the first post-crash write.
+            pass
 
 
 # --------------------------------------------------------------------------
@@ -924,15 +931,22 @@ def _emit_frame(verb: int, header: dict, body: bytes = b"") -> None:
             body, flags = packed, _FRAME_FLAG_ZLIB
     head = json.dumps(header, separators=(",", ":")).encode()
     with _EMIT_LOCK:
-        sys.stdout.flush()  # any pending text shares the one byte stream
-        out = sys.stdout.buffer
-        out.write(_FRAME_HEADER.pack(
-            _FRAME_MAGIC, _FRAME_VERSION, verb, flags, len(head), len(body)
-        ))
-        out.write(head)
-        if body:
-            out.write(body)
-        out.flush()
+        try:
+            sys.stdout.flush()  # any pending text shares the one byte stream
+            out = sys.stdout.buffer
+            out.write(_FRAME_HEADER.pack(
+                _FRAME_MAGIC, _FRAME_VERSION, verb, flags, len(head),
+                len(body)
+            ))
+            out.write(head)
+            if body:
+                out.write(body)
+            out.flush()
+        except OSError:
+            # Dead channel: same contract as _emit — stay alive for the
+            # orphan/re-adoption path (a torn frame on a dead pipe is
+            # unobservable; the adopted channel restarts on JSONL).
+            pass
 
 
 def _handle_frames_cmd(command: dict) -> None:
@@ -1862,6 +1876,83 @@ def _profile_finish(
 # --------------------------------------------------------------------------
 
 
+#: Dispatcher epoch fence (split-brain guard).  ``value`` is the highest
+#: epoch this worker has EVER seen (epoch command, or the adopt handshake);
+#: ``channel`` is the epoch the CURRENT channel declared.  A channel whose
+#: declared epoch is below the high-water mark belongs to a dispatcher that
+#: crashed and was succeeded — its mutating commands are refused with
+#: ``stale_epoch`` so a zombie controller can never double-dispatch work a
+#: newer incarnation already owns.  Both start at 0: a dispatcher that
+#: never declares an epoch (journaling off, old client) is unfenced.
+_EPOCH = {"value": 0, "channel": 0}
+
+#: Commands that mutate worker state and must be epoch-fenced.  Reads
+#: (ping, inventories, watch) stay open to any dispatcher — a stale one
+#: can look, not touch.
+_FENCED_CMDS = frozenset((
+    "run", "register_fn", "invoke", "multi_invoke", "serve_open",
+    "serve_request", "serve_prefill", "serve_close", "serve_resume", "kill",
+))
+
+
+def _epoch_ok() -> bool:
+    return _EPOCH["channel"] >= _EPOCH["value"]
+
+
+def _handle_epoch_cmd(command: dict) -> None:
+    try:
+        declared = int(command.get("epoch") or 0)
+    except (TypeError, ValueError):
+        declared = 0
+    _EPOCH["channel"] = declared
+    if declared >= _EPOCH["value"]:
+        _EPOCH["value"] = declared
+        _emit({"event": "epoch_ok", "epoch": declared})
+    else:
+        _emit({
+            "event": "error", "id": "", "code": "stale_epoch",
+            "message": (
+                f"dispatcher epoch {declared} is stale "
+                f"(worker has seen {_EPOCH['value']})"
+            ),
+        })
+
+
+def _refuse_stale(name: str, command: dict) -> None:
+    """Answer one fenced command from a stale dispatcher.
+
+    The refusal rides whatever event shape that command's waiter settles
+    on, so the stale dispatcher fails fast instead of timing out."""
+    message = (
+        f"stale dispatcher epoch {_EPOCH['channel']} "
+        f"(worker fenced at {_EPOCH['value']})"
+    )
+    sid = str(command.get("id") or "")
+    if name == "serve_request":
+        _emit({
+            "event": "telemetry", "id": sid,
+            "data": _build_worker_event(
+                {}, "serve.reject", rpc=True,
+                rid=str(command.get("rid") or ""),
+                code="stale_epoch", message=message,
+            ),
+        })
+    elif name == "serve_prefill":
+        _emit({"event": "serve_kv", "id": sid,
+               "rid": str(command.get("rid") or ""),
+               "code": "stale_epoch", "message": message})
+    elif name in ("serve_open", "serve_close"):
+        _emit({"event": "serve_error", "id": sid, "code": "stale_epoch",
+               "message": message, "permanent": True})
+    elif name == "serve_resume":
+        _emit({"event": "serve_resumed", "id": sid,
+               "rid": str(command.get("rid") or ""),
+               "state": "refused", "code": "stale_epoch"})
+    else:
+        _emit({"event": "error", "id": sid, "code": "stale_epoch",
+               "message": message})
+
+
 #: sid -> live _ServeSession; read by the heartbeat payload so a serving
 #: worker's beats carry slot occupancy.
 _SERVE_SESSIONS: dict = {}
@@ -1920,6 +2011,23 @@ class _ServeSession:
         self.prefill_queue: "queue_mod.Queue" = queue_mod.Queue()
         #: rid -> {"deadline": abs_ts|None, "emitted": n, "t_admit": ts}
         self.running: dict = {}
+        #: rid -> full emitted-token list for RUNNING lanes; the recovery
+        #: path's `serve_resume` re-emits `history[from:]` so a restarted
+        #: dispatcher can splice a surviving stream exactly-once from the
+        #: client-held high-water mark.  Guarded by ``_history_lock``
+        #: together with the emit, so a resume re-emission and a live
+        #: chunk can never interleave with a gap between them.
+        self.history: dict = {}
+        #: rid -> {"tokens": [...], "error": str} for FINISHED requests
+        #: (bounded FIFO): a stream that completed while the dispatcher
+        #: was dead resumes to its full final answer instead of "unknown".
+        self.finished: dict = {}
+        self.finished_max = 256
+        #: every rid ever accepted into the queue — distinguishes a
+        #: queued-but-unadmitted request ("pending") from one this worker
+        #: never saw ("unknown") at resume time.
+        self.submitted: set = set()
+        self._history_lock = threading.Lock()
         self.slots = 1
         self.served = 0
         self.tokens_total = 0
@@ -1956,6 +2064,7 @@ class _ServeSession:
             return
         command = dict(command)
         command["_enqueued"] = time.monotonic()
+        self.submitted.add(rid)
         self.queue.put(command)
 
     def submit_prefill(self, command: dict) -> None:
@@ -2334,6 +2443,84 @@ class _ServeSession:
             except BaseException:  # noqa: BLE001 - best-effort free
                 pass
 
+    def _finish_history(self, rid: str, error: str = "") -> None:
+        """Move one rid's history into the bounded finished ring.
+
+        Caller holds ``_history_lock``.  The ring exists for the crash
+        window: a stream that completes while no dispatcher is listening
+        must still resume to its full final answer, but memory for dead
+        streams cannot grow forever."""
+        tokens = self.history.pop(rid, [])
+        self.finished[rid] = {"tokens": tokens, "error": error}
+        while len(self.finished) > self.finished_max:
+            self.finished.pop(next(iter(self.finished)))
+
+    def resume(self, rid: str, start: int) -> None:
+        """Re-emit one stream's tokens from ``start`` (recovery path).
+
+        Called on the command-loop thread by ``serve_resume`` after a
+        dispatcher restart re-adopts this session.  The re-emission and
+        any concurrent live chunk serialize on ``_history_lock``, so the
+        wire sees ``history[start:]`` at some idx==start followed by
+        chunks whose idx continues from the re-emitted end — the
+        dispatcher's existing splice dedups any overlap and a gap is
+        impossible.  The ``serve_resumed`` ack tells the dispatcher what
+        this worker knows: ``streaming`` (live lane, tokens re-emitted),
+        ``done`` (finished ring hit, full tail + done re-emitted),
+        ``pending`` (queued, nothing emitted yet), ``unknown`` (never
+        seen — the dispatcher re-sends the full request).
+        """
+        start = max(0, int(start or 0))
+        with self._history_lock:
+            if rid in self.running:
+                tokens = list(self.history.get(rid, ())[start:])
+                self._emit_serve(
+                    "serve.token", rid=rid, idx=start, tokens=tokens,
+                    done=False, resumed=True,
+                )
+                state, sent = "streaming", len(tokens)
+            elif rid in self.finished:
+                entry = self.finished[rid]
+                tokens = list(entry["tokens"][start:])
+                extra = (
+                    {"error": entry["error"]} if entry.get("error") else {}
+                )
+                self._emit_serve(
+                    "serve.token", rid=rid, idx=start, tokens=tokens,
+                    done=True, resumed=True, **extra,
+                )
+                state, sent = "done", len(tokens)
+            elif rid in self.submitted:
+                state, sent = "pending", 0
+            else:
+                state, sent = "unknown", 0
+        _emit({
+            "event": "serve_resumed", "id": self.sid, "rid": rid,
+            "state": state, "from": start, "sent": sent,
+        })
+
+    def inventory(self) -> dict:
+        """This session's entry in the ``serve_inventory`` answer."""
+        with self._history_lock:
+            running = {
+                rid: int(state.get("emitted") or 0)
+                for rid, state in self.running.items()
+            }
+            finished = {
+                rid: {"tokens": len(entry["tokens"]),
+                      "error": entry.get("error") or ""}
+                for rid, entry in self.finished.items()
+            }
+        return {
+            "sid": self.sid,
+            "digest": self.digest,
+            "slots": self.slots,
+            "served": self.served,
+            "queued": self.queue.qsize(),
+            "running": running,
+            "finished": finished,
+        }
+
     def _pump_engine(self) -> None:
         """One decode chunk for every busy lane; stream fresh tokens.
 
@@ -2366,9 +2553,6 @@ class _ServeSession:
                 continue
             tokens = list(event.get("tokens") or ())
             done = bool(event.get("done"))
-            idx = state["emitted"]
-            state["emitted"] += len(tokens)
-            self.tokens_total += len(tokens)
             extra = {
                 k: v for k, v in event.items()
                 if k not in ("rid", "tokens", "done")
@@ -2393,15 +2577,26 @@ class _ServeSession:
                 self._emit_span(
                     "serve.worker.decode", state.get("trace"),
                     state["t_admit"], rid=rid,
-                    tokens=state["emitted"],
+                    tokens=state["emitted"] + len(tokens),
                 )
-            self._emit_serve(
-                "serve.token", rid=rid, idx=idx, tokens=tokens, done=done,
-                **extra,
-            )
-            if done:
-                self.served += 1
-                self.running.pop(rid, None)
+            # History extend + emit are one atomic unit under the lock a
+            # serve_resume re-emission also takes: either the resume
+            # snapshot includes this chunk, or this chunk's idx lands at
+            # (or past) the resume's end — never a gap between them.
+            with self._history_lock:
+                idx = state["emitted"]
+                state["emitted"] += len(tokens)
+                self.tokens_total += len(tokens)
+                if tokens:
+                    self.history.setdefault(rid, []).extend(tokens)
+                self._emit_serve(
+                    "serve.token", rid=rid, idx=idx, tokens=tokens,
+                    done=done, **extra,
+                )
+                if done:
+                    self.served += 1
+                    self.running.pop(rid, None)
+                    self._finish_history(rid, str(extra.get("error") or ""))
         # Mid-generation deadline enforcement: a lane past its budget is
         # cancelled and finalized with an error marker, freeing the slot.
         now = time.monotonic()
@@ -2413,12 +2608,14 @@ class _ServeSession:
                     state["t_admit"], rid=rid,
                     tokens=state["emitted"], error="deadline_exceeded",
                 )
-                self._emit_serve(
-                    "serve.token", rid=rid, idx=state["emitted"],
-                    tokens=[], done=True, error="deadline_exceeded",
-                )
-                self.served += 1
-                self.running.pop(rid, None)
+                with self._history_lock:
+                    self._emit_serve(
+                        "serve.token", rid=rid, idx=state["emitted"],
+                        tokens=[], done=True, error="deadline_exceeded",
+                    )
+                    self.served += 1
+                    self.running.pop(rid, None)
+                    self._finish_history(rid, "deadline_exceeded")
 
     def _loop(self) -> None:
         _apply_spec_env(self.spec)
@@ -2556,6 +2753,296 @@ def _serve_close(command: dict, sessions: dict) -> None:
     # block on here — the command loop must stay live.
 
 
+def _serve_resume(command: dict, sessions: dict) -> None:
+    sid = str(command.get("id") or "")
+    rid = str(command.get("rid") or "")
+    session = sessions.get(sid)
+    if session is None:
+        _emit({"event": "serve_resumed", "id": sid, "rid": rid,
+               "state": "unknown", "from": 0, "sent": 0})
+        return
+    try:
+        start = int(command.get("from") or 0)
+    except (TypeError, ValueError):
+        start = 0
+    session.resume(rid, start)
+
+
+def _serve_inventory(sessions: dict) -> None:
+    entries = []
+    for session in list(sessions.values()):
+        if session._closed.is_set():
+            continue
+        try:
+            entries.append(session.inventory())
+        except Exception:  # noqa: BLE001 - one bad session must not hide rest
+            pass
+    _emit({
+        "event": "serve_inventory", "pid": os.getpid(),
+        "epoch": _EPOCH["value"], "sessions": entries,
+    })
+
+
+def _task_inventory(children: dict) -> None:
+    _emit({
+        "event": "task_inventory", "pid": os.getpid(),
+        "epoch": _EPOCH["value"],
+        "tasks": [
+            {"id": task_id, "pid": pid}
+            for pid, task_id in children.items()
+        ],
+    })
+
+
+# --------------------------------------------------------------------------
+# Orphan self-defense + live re-adoption.
+#
+# A pool server's only channel is the stdin/stdout pipe of the process the
+# dispatcher spawned — when the dispatcher dies, so does the channel, while
+# the resident sessions (model weights, running decodes) live on.  Instead
+# of tearing them down, a server with live sessions and a configured grace
+# TTL (COVALENT_TPU_ORPHAN_TTL_S) goes into *orphan mode*: it silences its
+# dead stdout, opens a unix rendezvous socket next to this file (the remote
+# cache directory the dispatcher stages into), publishes its coordinates in
+# `pool_orphan.json`, and keeps decoding — growing each stream's token
+# history — until either a successor dispatcher adopts it (one `adopt`
+# line, epoch-fenced, then the socket BECOMES fds 0/1 and a fresh ready
+# banner starts the protocol over) or the TTL expires and it drains and
+# exits rather than leaking model memory forever.
+# --------------------------------------------------------------------------
+
+ORPHAN_RENDEZVOUS = "pool_orphan.json"
+
+
+def _orphan_dir() -> str:
+    return os.path.dirname(os.path.abspath(__file__))
+
+
+def _orphan_ttl_s() -> float:
+    try:
+        return float(os.environ.get("COVALENT_TPU_ORPHAN_TTL_S", "0") or 0)
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def _enter_orphan_mode(sel, serve_sessions: dict):
+    """Switch a channel-dead pool server into adoption-wait; returns the
+    orphan state dict, or None when orphan mode does not apply (no live
+    sessions, no TTL, or the socket cannot be created)."""
+    import selectors
+    import socket
+
+    ttl = _orphan_ttl_s()
+    live = {
+        sid: s for sid, s in serve_sessions.items()
+        if not s._closed.is_set()
+    }
+    if ttl <= 0 or not live:
+        return None
+    base = _orphan_dir()
+    sock_path = os.path.join(base, f"pool_orphan.{os.getpid()}.sock")
+    try:
+        os.unlink(sock_path)
+    except OSError:
+        pass
+    try:
+        listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        listener.bind(sock_path)
+        listener.listen(2)
+        listener.setblocking(False)
+    except OSError as err:
+        print(f"orphan socket failed: {err}", file=sys.stderr)
+        return None
+    meta = {
+        "pid": os.getpid(), "sock": sock_path, "epoch": _EPOCH["value"],
+        "sessions": sorted(live), "ttl_s": ttl, "t_orphaned": time.time(),
+    }
+    rendezvous = os.path.join(base, ORPHAN_RENDEZVOUS)
+    tmp = f"{rendezvous}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(meta, fh)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, rendezvous)
+    except OSError as err:
+        print(f"orphan rendezvous failed: {err}", file=sys.stderr)
+        listener.close()
+        return None
+    # Silence the dead pipe: every emitter (session threads included)
+    # keeps running, but writes land in /dev/null instead of raising.
+    devnull = os.open(os.devnull, os.O_WRONLY)
+    with _EMIT_LOCK:
+        try:
+            sys.stdout.flush()
+        except OSError:
+            pass
+        os.dup2(devnull, 1)
+    os.close(devnull)
+    try:
+        _BATCHER.flush()
+    except Exception:  # noqa: BLE001 - buffers now drain to /dev/null
+        pass
+    sel.register(listener, selectors.EVENT_READ, "orphan")
+    return {
+        "listener": listener, "sock_path": sock_path,
+        "rendezvous": rendezvous, "deadline": time.monotonic() + ttl,
+    }
+
+
+def _orphan_cleanup(sel, orphan: dict) -> None:
+    try:
+        sel.unregister(orphan["listener"])
+    except (KeyError, ValueError):
+        pass
+    try:
+        orphan["listener"].close()
+    except OSError:
+        pass
+    for path in (orphan["sock_path"], orphan["rendezvous"]):
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+
+def _orphan_try_adopt(sel, orphan: dict, serve_sessions: dict) -> bool:
+    """Accept one adoption attempt; True when the socket became the new
+    channel (caller restarts the protocol), False to keep waiting."""
+    try:
+        conn, _ = orphan["listener"].accept()
+    except OSError:
+        return False
+    try:
+        conn.setblocking(True)
+        conn.settimeout(10.0)
+        data = b""
+        while not data.endswith(b"\n") and len(data) < 65536:
+            chunk = conn.recv(4096)
+            if not chunk:
+                break
+            data += chunk
+        try:
+            adopt = json.loads(data.decode("utf-8", "replace"))
+        except ValueError:
+            adopt = {}
+        epoch = 0
+        try:
+            epoch = int(adopt.get("epoch") or 0)
+        except (TypeError, ValueError):
+            pass
+        if adopt.get("cmd") != "adopt" or epoch < _EPOCH["value"]:
+            # Fence: a stale dispatcher (or garbage) does not get the
+            # sessions — answer and keep waiting for the real successor.
+            try:
+                conn.sendall((json.dumps({
+                    "event": "error", "code": "stale_epoch",
+                    "message": (
+                        f"adopt epoch {epoch} < fence {_EPOCH['value']}"
+                    ),
+                }) + "\n").encode())
+            except OSError:
+                pass
+            conn.close()
+            return False
+        _EPOCH["value"] = epoch
+        _EPOCH["channel"] = epoch
+        conn.settimeout(None)
+        fd = conn.fileno()
+        with _EMIT_LOCK:
+            try:
+                sys.stdout.flush()
+            except OSError:
+                pass
+            os.dup2(fd, 0)
+            os.dup2(fd, 1)
+            # The adopted channel starts over on JSONL; the successor
+            # re-negotiates frames off the fresh banner like any client.
+            _FRAMES["out"] = False
+            _FRAMES["codec"] = ""
+        conn.close()  # fds 0/1 hold the socket now
+    except OSError:
+        try:
+            conn.close()
+        except OSError:
+            pass
+        return False
+    _orphan_cleanup(sel, orphan)
+    banner = {
+        "event": "ready", "pid": os.getpid(), "mode": "pool",
+        "reattach": True, "epoch": epoch,
+        "sessions": sorted(
+            sid for sid, s in serve_sessions.items()
+            if not s._closed.is_set()
+        ),
+    }
+    if _frames_enabled():
+        banner["frames"] = _FRAME_VERSION
+        banner["codecs"] = ["zlib"]
+    _emit(banner)
+    return True
+
+
+def attach_relay(sock_path: str) -> int:
+    """``harness.py --attach <sock>``: bridge stdio onto an orphan socket.
+
+    The successor dispatcher cannot dial a unix socket on a remote worker
+    directly, but it CAN spawn processes there — so re-adoption rides the
+    same road as a fresh pool server: spawn this relay via the transport,
+    and the relay splices its stdin/stdout onto the orphan's socket.  The
+    relay is a dumb pump — the adopt handshake, epoch fence, and banner
+    all flow through it verbatim, keeping protocol logic in one place.
+    """
+    import select as select_mod
+    import socket
+
+    try:
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.connect(sock_path)
+    except OSError as err:
+        sys.stdout.write(json.dumps({
+            "event": "error", "code": "attach_failed",
+            "message": f"connect {sock_path}: {err}",
+        }) + "\n")
+        sys.stdout.flush()
+        return 3
+    sock.setblocking(True)
+    sfd = sock.fileno()
+
+    def _write_all(fd: int, data: bytes) -> bool:
+        while data:
+            try:
+                n = os.write(fd, data)
+            except OSError:
+                return False
+            data = data[n:]
+        return True
+
+    try:
+        while True:
+            ready, _, _ = select_mod.select([0, sfd], [], [])
+            if 0 in ready:
+                data = os.read(0, 65536)
+                if not data:
+                    break  # dispatcher hung up: orphan re-enters wait
+                try:
+                    sock.sendall(data)
+                except OSError:
+                    break
+            if sfd in ready:
+                data = sock.recv(65536)
+                if not data:
+                    break  # worker side closed (refused or exited)
+                if not _write_all(1, data):
+                    break
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass
+    return 0
+
+
 def _announce_preemption(reason: str = "sigterm") -> None:
     """Emit ``serve.preempt`` on every live session's side-band."""
     for session in list(_SERVE_SESSIONS.values()):
@@ -2632,6 +3119,10 @@ def serve_child() -> int:
                     opened.append(session)
             elif name == "serve_request":
                 _serve_request(command, sessions)
+            elif name == "serve_resume":
+                _serve_resume(command, sessions)
+            elif name == "serve_inventory":
+                _serve_inventory(sessions)
             elif name == "serve_prefill":
                 _serve_prefill(command, sessions)
             elif name == "profile_start":
@@ -2771,6 +3262,8 @@ def serve() -> int:
     buffer = bytearray()
     running = True
     stdin_open = True
+    #: Non-None while waiting out the orphan grace TTL for re-adoption.
+    orphan: dict | None = None
     banner: dict = {"event": "ready", "pid": os.getpid(), "mode": "pool"}
     if _frames_enabled():
         # Capability advertisement: the client answers with a `frames`
@@ -2779,10 +3272,11 @@ def serve() -> int:
         banner["codecs"] = ["zlib"]
     _emit(banner)
 
-    while running and (stdin_open or children):
-        # With live watchers the select wakes on a short tick so telemetry
-        # lines flow without any inbound traffic; otherwise block freely.
-        for key, _ in sel.select(timeout=0.25 if watchers else None):
+    while running and (stdin_open or children or orphan is not None):
+        # With live watchers (or an orphan TTL ticking down) the select
+        # wakes on a short tick; otherwise block freely.
+        tick = 0.25 if (watchers or orphan is not None) else None
+        for key, _ in sel.select(timeout=tick):
             if key.data == "sigchld":
                 try:
                     while os.read(rpipe, 512):
@@ -2791,19 +3285,33 @@ def serve() -> int:
                     pass
                 _reap(children, watchers)
                 continue
+            if key.data == "orphan":
+                if orphan is not None and _orphan_try_adopt(
+                    sel, orphan, serve_sessions
+                ):
+                    # The orphan socket IS fds 0/1 now: restart the
+                    # protocol on it (stale inbound bytes discarded).
+                    orphan = None
+                    stdin_open = True
+                    buffer.clear()
+                    sel.register(0, selectors.EVENT_READ, "stdin")
+                continue
             data = os.read(0, 65536)
             if not data:
                 # Channel dropped: children keep running in their own
                 # sessions; serve until they are all reaped, then exit.
-                # Serving sessions, by contrast, die with the channel: no
-                # client can reach them anymore (a reconnecting dispatcher
-                # re-opens on a fresh server), so stop their loops instead
-                # of holding model memory forever.
+                # Serving sessions historically died with the channel —
+                # but with an orphan grace TTL configured they are held
+                # (still decoding, token history growing) for a successor
+                # dispatcher to re-adopt; only when no TTL/no sessions
+                # do they drain immediately as before.
                 stdin_open = False
                 sel.unregister(0)
-                for session in list(serve_sessions.values()):
-                    session.close()
-                serve_sessions.clear()
+                orphan = _enter_orphan_mode(sel, serve_sessions)
+                if orphan is None:
+                    for session in list(serve_sessions.values()):
+                        session.close()
+                    serve_sessions.clear()
                 continue
             buffer.extend(data)
             for command in _extract_commands(buffer):
@@ -2812,6 +3320,16 @@ def serve() -> int:
                     _emit({"event": "pong"})
                 elif name == "frames":
                     _handle_frames_cmd(command)
+                elif name == "epoch":
+                    _handle_epoch_cmd(command)
+                elif name == "serve_inventory":
+                    _serve_inventory(serve_sessions)
+                elif name == "task_inventory":
+                    _task_inventory(children)
+                elif name in _FENCED_CMDS and not _epoch_ok():
+                    _refuse_stale(name, command)
+                elif name == "serve_resume":
+                    _serve_resume(command, serve_sessions)
                 elif name == "run":
                     _spawn_task(command, children)
                 elif name == "register_fn":
@@ -2875,6 +3393,14 @@ def serve() -> int:
                 else:
                     _emit({"event": "error",
                            "message": f"unknown cmd: {name}"})
+        if orphan is not None and time.monotonic() >= orphan["deadline"]:
+            # Grace TTL spent with no successor: drain and exit instead of
+            # leaking model memory (and a TPU reservation) forever.
+            _orphan_cleanup(sel, orphan)
+            orphan = None
+            for session in list(serve_sessions.values()):
+                session.close()
+            serve_sessions.clear()
         _pump_watchers(watchers)
         _reap(children, watchers)  # belt-and-braces against missed wakeups
     return 0
@@ -2887,10 +3413,12 @@ def main(argv: list[str]) -> int:
         return rpc_child()
     if len(argv) >= 2 and argv[1] == "--serve-child":
         return serve_child()
+    if len(argv) >= 3 and argv[1] == "--attach":
+        return attach_relay(argv[2])
     if len(argv) != 2:
         print(
             "usage: harness.py <task_spec.json> | --serve | --rpc-child"
-            " | --serve-child",
+            " | --serve-child | --attach <socket>",
             file=sys.stderr,
         )
         return 2

@@ -45,6 +45,14 @@
 //   <- {"event":"profile_stopped","id":"<pid>","path":"...",
 //       "digest":"<sha256>","bytes":N}                        (runner)
 //   <- {"event":"profile_error","id":"<pid>","code":"...",...}
+//   -> {"cmd":"epoch","epoch":N}
+//   <- {"event":"epoch_ok","epoch":N} | {"event":"error","id":"",
+//       "code":"stale_epoch",...}
+//   -> {"cmd":"serve_resume","id":"<sid>","rid":"<rid>","from":N} (forwarded)
+//   -> {"cmd":"serve_inventory"}
+//   <- {"event":"serve_inventory","pid":N,"epoch":N,"sessions":[...]}
+//   -> {"cmd":"task_inventory"}
+//   <- {"event":"task_inventory","pid":N,"epoch":N,"tasks":[...]}
 //   -> {"cmd":"shutdown"}
 //   <- {"event":"bye"}
 //   <- {"event":"error","message":"..."}  (malformed input, unknown id, ...)
@@ -1189,6 +1197,127 @@ static void reap_children() {
 }
 
 // ---------------------------------------------------------------------------
+// Dispatcher epoch fencing + crash-recovery inventories.
+//
+// Mirrors the pool server's contract (harness.py `_EPOCH`/`_FENCED_CMDS`):
+// the worker remembers the highest journal epoch any dispatcher ever
+// declared and refuses mutating commands from a channel that declared a
+// lower one.  This agent's process dies with its channel (EOF ends the
+// pump; orphan mode lives in the Python pool server), so the fence here
+// exists for protocol parity and for the degenerate zombie case — a
+// channel re-declaring an older epoch after a newer one was seen.
+// Inventories are read-only and stay open to any dispatcher: a stale one
+// can look, not touch.
+// ---------------------------------------------------------------------------
+
+static long long g_epoch_max = 0;
+static long long g_epoch_channel = 0;
+
+static void handle_epoch(const Json& cmd) {
+  const Json* e = cmd.get("epoch");
+  long long declared = (e && e->type == Json::Int) ? e->i : 0;
+  g_epoch_channel = declared;
+  if (declared >= g_epoch_max) {
+    g_epoch_max = declared;
+    emit("{\"event\":\"epoch_ok\",\"epoch\":" + std::to_string(declared) +
+         "}");
+  } else {
+    emit("{\"event\":\"error\",\"id\":\"\",\"code\":\"stale_epoch\","
+         "\"message\":\"dispatcher epoch " + std::to_string(declared) +
+         " is stale (worker has seen " + std::to_string(g_epoch_max) +
+         ")\"}");
+  }
+}
+
+static bool is_fenced_cmd(const std::string& n) {
+  return n == "run" || n == "register_fn" || n == "invoke" ||
+         n == "serve_open" || n == "serve_request" ||
+         n == "serve_prefill" || n == "serve_close" ||
+         n == "serve_resume" || n == "kill";
+}
+
+// Refuse a fenced command from a stale channel, in the SHAPE the caller's
+// waiter settles on (a generic error would stall a serve_open waiter for
+// its whole timeout).  Returns true when the command was consumed.
+static bool fence_refuse(const std::string& name, const Json& cmd) {
+  if (g_epoch_channel >= g_epoch_max || !is_fenced_cmd(name)) return false;
+  const Json* id_field = cmd.get("id");
+  const std::string id =
+      (id_field && id_field->type == Json::Str) ? id_field->s : "";
+  const Json* rid_field = cmd.get("rid");
+  const std::string rid =
+      (rid_field && rid_field->type == Json::Str) ? rid_field->s : "";
+  const std::string message =
+      "dispatcher epoch " + std::to_string(g_epoch_channel) +
+      " is stale (worker has seen " + std::to_string(g_epoch_max) + ")";
+  if (name == "serve_open" || name == "serve_close") {
+    emit_serve_error(id, "stale_epoch", message, true);
+  } else if (name == "serve_request") {
+    emit("{\"event\":\"telemetry\",\"id\":\"" + json_escape(id) +
+         "\",\"data\":{\"type\":\"serve.reject\",\"rid\":\"" +
+         json_escape(rid) + "\",\"code\":\"stale_epoch\",\"message\":\"" +
+         json_escape(message) + "\"}}");
+  } else if (name == "serve_prefill") {
+    emit("{\"event\":\"serve_kv\",\"id\":\"" + json_escape(id) +
+         "\",\"rid\":\"" + json_escape(rid) +
+         "\",\"code\":\"stale_epoch\",\"message\":\"" +
+         json_escape(message) + "\"}");
+  } else if (name == "serve_resume") {
+    emit("{\"event\":\"serve_resumed\",\"id\":\"" + json_escape(id) +
+         "\",\"rid\":\"" + json_escape(rid) +
+         "\",\"state\":\"refused\",\"code\":\"stale_epoch\"}");
+  } else if (name == "register_fn") {
+    const Json* d = cmd.get("digest");
+    emit("{\"event\":\"register_error\",\"digest\":\"" +
+         json_escape(d && d->type == Json::Str ? d->s : "") +
+         "\",\"code\":\"stale_epoch\",\"message\":\"" +
+         json_escape(message) + "\"}");
+  } else {
+    emit("{\"event\":\"error\",\"id\":\"" + json_escape(id) +
+         "\",\"code\":\"stale_epoch\",\"message\":\"" +
+         json_escape(message) + "\"}");
+  }
+  return true;
+}
+
+// What survives in THIS worker: session runner children (sid + pid; the
+// stream detail lives in the runner — the recovering dispatcher resumes
+// through serve_resume, which is forwarded), and forked task children.
+static void serve_inventory_cmd() {
+  std::string out =
+      "{\"event\":\"serve_inventory\",\"pid\":" + std::to_string(getpid()) +
+      ",\"epoch\":" + std::to_string(g_epoch_max) + ",\"sessions\":[";
+  bool first = true;
+  for (const auto& kv : g_serve_children) {
+    if (!first) out += ",";
+    first = false;
+    out += "{\"sid\":\"" + json_escape(kv.first) +
+           "\",\"pid\":" + std::to_string(kv.second.pid) + "}";
+  }
+  out += "]}";
+  emit(out);
+}
+
+static void task_inventory_cmd() {
+  std::string out =
+      "{\"event\":\"task_inventory\",\"pid\":" + std::to_string(getpid()) +
+      ",\"epoch\":" + std::to_string(g_epoch_max) + ",\"tasks\":[";
+  bool first = true;
+  for (const auto& kv : g_tasks) {
+    bool is_serve = false;
+    for (const auto& sc : g_serve_children)
+      if (sc.second.pid == kv.first) { is_serve = true; break; }
+    if (is_serve) continue;
+    if (!first) out += ",";
+    first = false;
+    out += "{\"id\":\"" + json_escape(kv.second.id) +
+           "\",\"pid\":" + std::to_string(kv.first) + "}";
+  }
+  out += "]}";
+  emit(out);
+}
+
+// ---------------------------------------------------------------------------
 // Main loop: poll stdin + the SIGCHLD self-pipe.
 // ---------------------------------------------------------------------------
 
@@ -1216,11 +1345,16 @@ static void handle_line(const std::string& line, bool& running) {
       emit("{\"event\":\"frames\",\"version\":0}");
     }
   }
+  else if (name == "epoch") handle_epoch(cmd);
+  else if (name == "serve_inventory") serve_inventory_cmd();
+  else if (name == "task_inventory") task_inventory_cmd();
+  else if (fence_refuse(name, cmd)) return;
   else if (name == "run") spawn(cmd);
   else if (name == "register_fn") register_fn(cmd);
   else if (name == "invoke") invoke_task(cmd, line + "\n");
   else if (name == "serve_open") serve_open(cmd, line);
   else if (name == "serve_request") serve_forward(cmd, line + "\n", false);
+  else if (name == "serve_resume") serve_forward(cmd, line + "\n", false);
   else if (name == "serve_prefill") serve_prefill_forward(cmd, line + "\n");
   else if (name == "serve_close") serve_forward(cmd, line + "\n", true);
   else if (name == "profile_start") profile_forward(cmd, line, false);
@@ -1247,6 +1381,7 @@ static void handle_frame(const std::string& header, const std::string& raw,
   const Json* cmd_field = cmd.get("cmd");
   const std::string name =
       (cmd_field && cmd_field->type == Json::Str) ? cmd_field->s : "";
+  if (fence_refuse(name, cmd)) return;
   if (name == "invoke") {
     invoke_task(cmd, raw);
   } else if (name == "multi_invoke") {

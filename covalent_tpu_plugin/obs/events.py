@@ -54,6 +54,26 @@ def _env_int(name: str, default: int) -> int:
         return default
 
 
+#: Event types that must survive a SIGKILL landing right after the emit:
+#: flushed to the OS *and* fsynced to disk inline.  Everything else stays
+#: flush-only — a killed process loses nothing (the page cache survives
+#: it), and per-event fsync on the hot path would throttle dispatch.
+_DURABLE_TYPES = frozenset(("slo.burn", "recovery.complete"))
+
+#: ``task.state`` values that are progress edges, not terminal outcomes.
+#: Any other state (completed/failed/cached/retried/fallback_local/...)
+#: is a terminal record an operator must find on disk after ANY crash.
+_PROGRESS_STATES = frozenset(("starting", "submitted", "running", "polling"))
+
+
+def _durable_event(type: str, fields: dict) -> bool:
+    if type in _DURABLE_TYPES:
+        return True
+    return type == "task.state" and (
+        str(fields.get("state") or "") not in _PROGRESS_STATES
+    )
+
+
 class EventSink:
     """Thread-safe JSONL appender bound to one path (or disabled)."""
 
@@ -116,6 +136,8 @@ class EventSink:
                     self._fh = open(self.path, "a", encoding="utf-8")
                 self._fh.write(line)
                 self._fh.flush()
+                if _durable_event(type, fields):
+                    os.fsync(self._fh.fileno())
                 if self.max_bytes > 0 and self._fh.tell() >= self.max_bytes:
                     self._rotate_locked()
             except OSError as err:

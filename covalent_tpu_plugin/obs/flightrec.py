@@ -229,6 +229,11 @@ class FlightRecorder:
             tmp = f"{path}.tmp.{os.getpid()}"
             with open(tmp, "w", encoding="utf-8") as f:
                 json.dump(payload, f, default=repr, indent=2)
+                # The black box exists BECAUSE something is crashing:
+                # fsync before the atomic publish or the dump can vanish
+                # with the machine while the rename survives.
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, path)
         except (OSError, TypeError, ValueError):
             return None

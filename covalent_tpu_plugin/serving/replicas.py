@@ -50,6 +50,7 @@ from typing import Any, Callable
 import cloudpickle
 
 from ..cache import bytes_digest
+from ..fleet import journal as journal_mod
 from ..fleet.queue import DEFAULT_TENANT, FairWorkQueue, QueueFullError, WorkItem
 from ..obs import events as obs_events
 from ..obs.trace import Span, record_span
@@ -546,6 +547,9 @@ class ReplicaSet:
         else:
             self.router.set_queue_max(self._router_queue_max)
         self._publish_replica_states()
+        journal_mod.record(
+            "replica_set", name=self.name, replicas=self.replicas_wanted
+        )
         obs_events.emit(
             "serve.replica_set_opened",
             set=self.name,
@@ -625,6 +629,9 @@ class ReplicaSet:
             self._replicas.pop(replica_id, None)
             self._placements.pop(replica_id, None)
             raise
+        journal_mod.record(
+            "replica", set=self.name, sid=supervisor.sid, replica=index
+        )
         self._publish_replica_states()
         return supervisor
 
@@ -911,7 +918,11 @@ class ReplicaSet:
         if replicas < 0:
             raise ValueError(f"replicas must be >= 0, got {replicas}")
         async with self._scale_lock:
-            return await self._scale_locked(replicas)
+            count = await self._scale_locked(replicas)
+        journal_mod.record(
+            "replica_set", name=self.name, replicas=self.replicas_wanted
+        )
+        return count
 
     async def _scale_locked(self, replicas: int) -> int:
         live = {
@@ -1030,6 +1041,9 @@ class ReplicaSet:
         if supervisor is None:
             return
         self.router.forget_replica(replica_id)
+        journal_mod.record(
+            "replica", set=self.name, sid=supervisor.sid, state="closed"
+        )
         try:
             await supervisor.close()
         except Exception as err:  # noqa: BLE001 - teardown is best-effort

@@ -36,6 +36,7 @@ from typing import Any, AsyncIterator, Callable
 
 from ..agent import HARNESS_BASENAME, AgentClient, AgentError
 from ..cache import bytes_digest, cas_path
+from ..fleet import journal as journal_mod
 from ..obs import events as obs_events
 from ..obs.trace import Span, context_of, record_span
 from ..resilience import FaultClass, RetryPolicy, classify_error
@@ -158,6 +159,12 @@ class ServeRequest:
         #: whose engine-side prefix tree is already warm for it.
         self.prefix_key = ""
         self.tokens: list[int] = []
+        #: absolute stream offset this request resumed from (crash
+        #: recovery): the prefix ``[0, resumed_from)`` was delivered by a
+        #: PRIOR dispatcher incarnation and is not re-collected here, so
+        #: every splice compares worker ``idx`` against
+        #: ``resumed_from + len(tokens)``, not ``len(tokens)`` alone.
+        self.resumed_from = 0
         self.error: str = ""
         self.t_submit = time.monotonic()
         self.t_first: float | None = None
@@ -520,6 +527,106 @@ class SessionSupervisor:
         )
         return self
 
+    async def adopt(
+        self,
+        *,
+        client: AgentClient,
+        conns: list,
+        address: str,
+        sid_g: str,
+        slots: int = 1,
+        digest: str = "",
+        payload_path: str = "",
+    ) -> "SessionSupervisor":
+        """Bind to a SURVIVING remote session instead of opening one.
+
+        The crash-recovery path: the worker held this session through
+        the dispatcher's death (orphan mode) and a successor dispatcher
+        re-attached its channel; the supervisor adopts the existing
+        ``sid_g`` — no lease, no staging, no ``serve_open`` — and the
+        usual supervision (reconnect, replay, stats, close) takes over
+        from there.  Journaled in-flight streams are re-attached one by
+        one via :meth:`resume_stream`.
+        """
+        self._digest = digest
+        self._local_payload = payload_path
+        self._client = client
+        self._conns = list(conns)
+        self._sid_g = sid_g
+        self.address = address
+        self.slots = int(slots or 1)
+        self.generation = 1
+        # Future reconnects mint fresh generation sids AFTER the adopted
+        # one: "serve-x.g2" resumes counting at 3, not at a collision.
+        tail = sid_g.rsplit(".g", 1)
+        try:
+            self._gen_counter = int(tail[1]) + 1 if len(tail) == 2 else 1
+        except ValueError:
+            self._gen_counter = 1
+        client.watch_serve(sid_g, self._sink)
+        self.opened_at = time.time()
+        handles = getattr(self.executor, "_serve_handles", None)
+        if handles is not None:
+            handles[self.sid] = self
+        if self._pool is not None:
+            self._pool.place()
+        SERVE_SESSIONS.inc()
+        self._counted_live = True
+        if self.replica_of is not None:
+            SERVE_REPLICA_IN_FLIGHT.labels(
+                set=self.replica_of[0], replica=self.replica_of[1]
+            ).set(0)
+        self._journal_binding()
+        self._supervisor = asyncio.ensure_future(self._supervise())
+        self._ready.set()
+        obs_events.emit(
+            "serve.session_adopted",
+            sid=self.sid,
+            address=self.address,
+            sid_g=sid_g,
+            slots=self.slots,
+        )
+        return self
+
+    async def resume_stream(self, request: ServeRequest) -> str:
+        """Re-attach one journaled in-flight stream to this session.
+
+        ``request.resumed_from`` holds the journal's token high-water
+        mark; the worker re-emits its history from that offset (the
+        splice in :meth:`_on_token` guards the overlap) and live chunks
+        follow.  Returns the worker's resume state — a stream the worker
+        never saw (``unknown``: it died in the dead pipe between journal
+        and wire) is re-sent in full from the journaled prompt.
+        """
+        if self._client is None:
+            raise ServeError(f"session {self.sid} has no live runtime")
+        request.span.set_attribute("sid", self.sid)
+        # Register BEFORE the wire write: re-emitted history races the
+        # resume ack on the side-band.
+        self._requests[request.rid] = request
+        self._publish_in_flight()
+        try:
+            ack = await self._client.serve_resume(
+                self._sid_g, request.rid, request.resumed_from
+            )
+        except BaseException:
+            self._requests.pop(request.rid, None)
+            self._publish_in_flight()
+            raise
+        state = str(ack.get("state") or "")
+        if state == "refused":
+            self._finish(request.rid, "error")
+            request._fail(ServeError(
+                f"resume of {request.rid} refused: worker fenced this "
+                "dispatcher as stale"
+            ))
+        elif state == "unknown":
+            # The prior dispatcher journaled the intent but died before
+            # (or during) the wire write: send it as a fresh stream.
+            request.resumed_from = 0
+            await self._send_request(request)
+        return state
+
     @staticmethod
     def _write_payload(path: str, payload: bytes) -> None:
         if os.path.exists(path):
@@ -565,6 +672,21 @@ class SessionSupervisor:
         self.address = binding["address"]
         self.slots = binding["slots"]
         self.generation += 1
+        self._journal_binding()
+
+    def _journal_binding(self) -> None:
+        """Journal this session's current remote binding — everything a
+        successor dispatcher needs to find (or re-open) the session."""
+        journal_mod.record(
+            "session", sid=self.sid, sid_g=self._sid_g,
+            address=self.address, digest=self._digest,
+            payload=self._local_payload, slots=self.slots,
+            queue_max=self.queue_max,
+            default_deadline_s=self.default_deadline_s,
+            stats_interval_s=self.stats_interval_s,
+            replica_of=list(self.replica_of) if self.replica_of else None,
+            sync=True,
+        )
 
     async def _dial_generation_on(self, dialed: list) -> dict:
         executor = self.executor
@@ -666,6 +788,15 @@ class SessionSupervisor:
             request.span.set_attribute("sid", self.sid)
             self._requests[request.rid] = request
             self._publish_in_flight()
+            # Write-ahead: the intent is durable BEFORE the wire write,
+            # so a dispatcher crash between the two replays the request
+            # rather than losing it.
+            journal_mod.record(
+                "stream", sid=self.sid, rid=request.rid,
+                prompt=list(request.prompt), params=request.params,
+                deadline_s=request.deadline_s, tenant=request.tenant,
+                resumed_from=request.resumed_from,
+            )
             try:
                 await self._send_request(request)
             except BaseException:
@@ -899,7 +1030,7 @@ class SessionSupervisor:
             return
         idx = int(data.get("idx") or 0)
         tokens = list(data.get("tokens") or ())
-        have = len(request.tokens)
+        have = request.resumed_from + len(request.tokens)
         if idx > have:
             # A chunk went missing (idx jumped past our high-water mark):
             # the exactly-once contract is broken for this stream, fail
@@ -927,6 +1058,12 @@ class SessionSupervisor:
         request._feed(fresh, done, error=error)
         if fresh:
             SERVE_TOKENS_TOTAL.inc(len(fresh))
+            # The stream's durable high-water mark: a successor
+            # dispatcher resumes the stream from here exactly-once.
+            journal_mod.record(
+                "stream_hwm", sid=self.sid, rid=rid,
+                hwm=request.resumed_from + len(request.tokens),
+            )
         # The trace id rides as the bucket exemplar: a p99 spike on the
         # serving dashboards resolves straight to this request's
         # waterfall at /traces/<id>.
@@ -1014,6 +1151,10 @@ class SessionSupervisor:
         if self._requests.pop(rid, None) is not None:
             self.served += 1
             SERVE_REQUESTS_TOTAL.labels(outcome=outcome).inc()
+            journal_mod.record(
+                "stream_done", sid=self.sid, rid=rid, outcome=outcome,
+                sync=True,
+            )
             self._publish_in_flight()
             self._changed()
 
@@ -1273,6 +1414,7 @@ class SessionSupervisor:
         handles = getattr(self.executor, "_serve_handles", None)
         if handles is not None:
             handles.pop(self.sid, None)
+        journal_mod.record("session_closed", sid=self.sid, sync=True)
         self._drop_live()
         obs_events.emit(
             "serve.session_closed",

@@ -51,7 +51,9 @@ from .agent import (
     AGENT_RESTARTS_TOTAL,
     AgentClient,
     AgentError,
+    attach_pool_server,
     ensure_agent_binary,
+    read_orphan_rendezvous,
     start_pool_server,
 )
 from .cache import (
@@ -67,6 +69,7 @@ from .cache import (
     harness_digest,
 )
 from .executor_base import RemoteExecutor
+from .fleet import journal as journal_mod
 from .fleet.lease import GangLease
 from .obs import events as obs_events
 from .obs.flightrec import FLIGHT_RECORDER, ensure_flight_recorder
@@ -1247,6 +1250,20 @@ class TPUExecutor(RemoteExecutor):
             )
         return GangLease(self, conns, addresses)
 
+    async def recover(self, timeout_s: float = 120.0) -> dict:
+        """Crash-recovery pass: re-adopt what survived the predecessor.
+
+        Replays the journal's picture of the dead dispatcher's world,
+        re-dials the fleet (adopting orphaned pool servers and fencing
+        the channels with this incarnation's epoch on the way), and
+        re-attaches surviving sessions and their in-flight streams.  A
+        no-op returning ``recovered=False`` when journaling is off or
+        the journal held nothing.  See :mod:`.fleet.recovery`.
+        """
+        from .fleet import recovery as recovery_mod
+
+        return await recovery_mod.recover(self, timeout_s=timeout_s)
+
     @property
     def is_warm(self) -> bool:
         """Whether at least one pooled channel has passed pre-flight.
@@ -1905,6 +1922,13 @@ class TPUExecutor(RemoteExecutor):
                         )
                 await client.close()  # dead/stale channel; rebuild below
                 self._agents.pop(conn.address, None)
+            adopted = await self._try_adopt_orphan(conn)
+            if adopted is not None:
+                self._agents[conn.address] = adopted
+                obs_events.emit(
+                    "agent.adopted", address=conn.address, mode=adopted.mode
+                )
+                return adopted
             for mode in modes:
                 try:
                     # Frame-body compression mirrors the staging codec's
@@ -1938,6 +1962,7 @@ class TPUExecutor(RemoteExecutor):
                     )
                     continue
                 self._agents[conn.address] = client
+                await self._declare_epoch(client)
                 obs_events.emit(
                     "agent.started", address=conn.address, mode=client.mode
                 )
@@ -1951,6 +1976,75 @@ class TPUExecutor(RemoteExecutor):
             )
             self._agents[conn.address] = None
             return None
+
+    async def _declare_epoch(self, client: AgentClient) -> None:
+        """Fence this channel with the journal's dispatcher epoch.
+
+        Best-effort on workers that predate the verb (the native agent
+        forwards unknown commands to its child, old pool servers answer
+        with a plain error) — fencing is a recovery guarantee, not a
+        dispatch prerequisite.
+        """
+        epoch = journal_mod.epoch()
+        if not epoch:
+            return
+        try:
+            await client.declare_epoch(epoch, timeout=10.0)
+        except (AgentError, TransportError, asyncio.TimeoutError) as err:
+            app_log.debug(
+                "epoch declaration on %s failed (%s); channel unfenced",
+                client.address, err,
+            )
+
+    async def _try_adopt_orphan(self, conn: Transport) -> AgentClient | None:
+        """Re-attach a pool server orphaned by a prior dispatcher.
+
+        Only engages with a journal configured (a journal-less dispatcher
+        has no epoch to out-rank the orphan's): reads the worker's
+        ``pool_orphan.json`` rendezvous from the remote cache, dials the
+        unix socket through the normal transport (``--attach`` relay),
+        and fences the adopted channel with OUR epoch.  Any failure —
+        no rendezvous, stale socket, refused epoch — falls through to
+        the fresh-start path, which is always correct.
+        """
+        journal = journal_mod.get_journal()
+        if journal is None:
+            return None
+        meta = await read_orphan_rendezvous(conn, self.remote_cache)
+        if not meta:
+            return None
+        if int(meta.get("epoch") or 0) >= journal.epoch:
+            app_log.warning(
+                "worker %s: orphan rendezvous carries epoch %s >= ours "
+                "(%s); not adopting", conn.address, meta.get("epoch"),
+                journal.epoch,
+            )
+            return None
+        try:
+            client = await attach_pool_server(
+                conn,
+                self.remote_cache,
+                self.python_path,
+                str(meta.get("sock") or ""),
+                journal.epoch,
+                conda_env=self.conda_env,
+                frames_enabled=self.agent_frames,
+                frames_codec=(
+                    "zlib" if self.compress in ("zlib", "zstd") else ""
+                ),
+            )
+        except (AgentError, TransportError, asyncio.TimeoutError) as err:
+            app_log.info(
+                "worker %s: orphan adoption failed (%s); starting fresh",
+                conn.address, err,
+            )
+            return None
+        app_log.info(
+            "worker %s: adopted orphaned pool server pid=%s with %d "
+            "surviving session(s)", conn.address, meta.get("pid"),
+            len(client.banner_sessions),
+        )
+        return client
 
     async def _submit_via_agent(
         self, client: AgentClient, staged: StagedTask, process_id: int
@@ -3298,11 +3392,35 @@ class TPUExecutor(RemoteExecutor):
         base_operation_id = f"{dispatch_id}_{node_id}"
         policy = self._retry_policy
         deadline = Deadline(policy.wall_budget)
+        # Write-ahead dispatch intent: a dispatcher that dies mid-run
+        # leaves this electron discoverable (with its retry lineage) for
+        # the successor's recovery report; the terminal record clears it.
+        journal_mod.record(
+            "task", op=base_operation_id, dispatch_id=dispatch_id,
+            node=node_id, t_dispatch=time.time(),
+        )
         try:
-            return await self._run_with_retries(
+            result = await self._run_with_retries(
                 function, args, kwargs, task_metadata,
                 base_operation_id, policy, deadline,
             )
+        except BaseException as err:
+            journal_mod.record(
+                "task_terminal", op=base_operation_id,
+                outcome=(
+                    "cancelled"
+                    if isinstance(err, asyncio.CancelledError)
+                    else "error"
+                ),
+                error=repr(err), sync=True,
+            )
+            raise
+        else:
+            journal_mod.record(
+                "task_terminal", op=base_operation_id, outcome="ok",
+                sync=True,
+            )
+            return result
         finally:
             # cancel(base_id) marks the base id so whichever attempt is in
             # flight sees it; the per-attempt finally only clears attempt
@@ -3353,6 +3471,10 @@ class TPUExecutor(RemoteExecutor):
                 if len(self._op_attempts) > 1024:  # unread (direct API use)
                     self._op_attempts.pop(next(iter(self._op_attempts)))
                 self._op_attempts[base_operation_id] = attempt + 1
+                journal_mod.record(
+                    "task", op=base_operation_id,
+                    operation_id=operation_id, attempt=attempt + 1,
+                )
                 try:
                     if self._rpc_preselect(task_metadata):
                         try:

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import os
 import sys
+import time
 
 import pytest
 
@@ -74,6 +75,28 @@ def test_tpu_preflight_timeout_reports_false():
     ok, took, err = bench.tpu_preflight(0.01)
     assert not ok
     assert "timeout" in err
+    # The staged probe attributes WHERE the budget died, not just that
+    # it did — the r03 diagnosis in one field.
+    assert "stage" in err
+
+
+def test_tpu_preflight_fails_fast_off_tpu_host(monkeypatch):
+    # The r03+ root cause: JAX_PLATFORMS=tpu on a host with no TPU
+    # device nodes hangs inside libtpu backend init for the full budget.
+    # The probe must now refuse in milliseconds with the actionable
+    # reason, flagged permanent so the retry loop stops.
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    monkeypatch.delenv("TPU_NAME", raising=False)
+    monkeypatch.delenv("TPU_WORKER_ID", raising=False)
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+    if bench.tpu_host_signals()["accel_devices"]:
+        pytest.skip("running on a real TPU host")
+    t0 = time.monotonic()
+    ok, took, err = bench.tpu_preflight(45.0)
+    assert not ok
+    assert time.monotonic() - t0 < 5.0  # no hang, no subprocess
+    assert bench.PREFLIGHT_PERMANENT in err
+    assert "libtpu" in err  # the double-install diagnostic rides along
 
 
 def test_last_known_good_is_stamped_and_never_live_shaped():

@@ -285,6 +285,36 @@ def test_event_sink_serializes_unserializable_payloads(events_file):
     assert event["type"] == "weird"  # repr fallback, never a crash
 
 
+def test_terminal_events_survive_sigkill(tmp_path):
+    """Terminal ``task.state`` and ``slo.burn`` events fsync inline: a
+    process SIGKILLed the instant after the emit still leaves them on
+    disk (the whole point of a crash record)."""
+    import subprocess
+    import sys as sys_mod
+
+    path = tmp_path / "events.jsonl"
+    code = (
+        "import os, signal\n"
+        "from covalent_tpu_plugin.obs import events\n"
+        f"events.configure({str(path)!r})\n"
+        "events.emit('task.state', operation_id='op-1', state='starting')\n"
+        "events.emit('task.state', operation_id='op-1', state='failed')\n"
+        "events.emit('slo.burn', slo='serve_ttft', burn=14.4)\n"
+        "os.kill(os.getpid(), signal.SIGKILL)\n"
+    )
+    proc = subprocess.run(
+        [sys_mod.executable, "-c", code], timeout=60,
+        cwd="/root/repo", capture_output=True,
+    )
+    assert proc.returncode == -9  # died by SIGKILL, no cleanup ran
+    types = [e["type"] for e in read_events(path)]
+    assert "slo.burn" in types
+    states = [
+        e["state"] for e in read_events(path) if e["type"] == "task.state"
+    ]
+    assert "failed" in states
+
+
 def test_event_listener_sees_events_without_a_path():
     obs_events.configure(None)
     seen: list[dict] = []

@@ -1,0 +1,205 @@
+"""Dispatcher crash recovery: journal replay → re-adoption, end to end.
+
+The worker-side half (orphan mode, epoch fencing, ``serve_resume``,
+inventories) is covered process-level in ``test_recovery_worker.py``;
+the journal's framing/replay in ``test_journal.py``.  This file covers
+the dispatcher side: a first executor incarnation journals its world
+and "crashes" (channels torn down with no close handshake, supervision
+tasks cancelled), a second incarnation replays the journal, re-dials,
+adopts the orphaned pool server through the rendezvous + ``--attach``
+splice, and resumes the in-flight stream from its journaled high-water
+mark with exactly-once delivery.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from covalent_tpu_plugin.fleet import journal as journal_mod
+from covalent_tpu_plugin.fleet import recovery as recovery_mod
+from covalent_tpu_plugin.obs.metrics import REGISTRY
+from covalent_tpu_plugin.serving import open_session
+
+from .test_serving import make_factory, make_serve_executor
+
+
+def counter_value(name: str, **labels) -> float:
+    metric = REGISTRY.get(name)
+    if metric is None:
+        return 0.0
+    total = 0.0
+    for series_labels, series in metric._series():
+        if all(series_labels.get(k) == v for k, v in labels.items()):
+            total += series.value
+    return total
+
+
+def crash_dispatcher(ex) -> None:
+    """Tear the first incarnation down the way SIGKILL would.
+
+    No ``serve_close``, no channel shutdown handshake: supervision tasks
+    are cancelled and each agent channel's pipes are dropped cold, so
+    the worker sees a bare stdin EOF — the orphan-mode trigger — while
+    this process (standing in for the successor dispatcher) lives on.
+    The writer is closed FIRST and the reader cancelled in the same
+    synchronous block, so no supervision code can run a graceful
+    teardown in between.
+    """
+    for handle in list(ex._serve_handles.values()):
+        sup = getattr(handle, "supervisor", handle)
+        task = getattr(sup, "_supervisor", None)
+        if task is not None:
+            task.cancel()
+    for client in list(ex._agents.values()):
+        client._process._writer.close()
+        client._reader.cancel()
+    ex._serve_handles.clear()
+    ex._agents.clear()
+
+
+@pytest.fixture()
+def journal_dir(tmp_path, monkeypatch):
+    path = tmp_path / "journal"
+    monkeypatch.setenv("COVALENT_TPU_JOURNAL_DIR", str(path))
+    monkeypatch.setenv("COVALENT_TPU_ORPHAN_TTL_S", "90")
+    journal_mod.reset()
+    yield str(path)
+    journal_mod.reset()
+
+
+def test_recover_is_noop_without_journal(tmp_path, run_async, monkeypatch):
+    """With journaling off the recovery pass touches nothing — no dial,
+    no subprocess, just a ``recovered=False`` report."""
+    monkeypatch.delenv("COVALENT_TPU_JOURNAL_DIR", raising=False)
+    journal_mod.reset()
+
+    async def flow():
+        ex = make_serve_executor(tmp_path)
+        try:
+            return await ex.recover()
+        finally:
+            await ex.close()
+
+    report = run_async(flow())
+    assert report["recovered"] is False
+    assert report["adopted_sessions"] == []
+    assert recovery_mod.last_report() is not None
+
+
+def test_recover_adopts_orphan_and_resumes_stream_exactly_once(
+    tmp_path, run_async, journal_dir
+):
+    """The full arc: journal → crash → replay → orphan adoption →
+    stream resume.  The journaled prefix plus the resumed tail must be
+    byte-equal to the uninterrupted stream, with no token repeated."""
+    adopted0 = counter_value("covalent_tpu_recovery_adopted_total")
+    orphaned0 = counter_value("covalent_tpu_recovery_orphaned_total")
+
+    async def flow():
+        # -- incarnation 1: open a session, get a stream mid-flight.
+        journal_mod.configure(journal_dir)
+        assert journal_mod.epoch() == 1
+        ex_a = make_serve_executor(tmp_path)
+        handle = await open_session(
+            ex_a, make_factory(step_delay=0.2, chunk=2, default_cap=30),
+            stats_interval_s=0.1,
+        )
+        sid = handle.sid
+        req_a = await handle.request([100], params={"max_new_tokens": 30})
+        deadline = time.monotonic() + 20
+        while len(req_a.tokens) < 4:
+            if time.monotonic() > deadline:
+                raise AssertionError("stream never started")
+            await asyncio.sleep(0.05)
+        # A journaled session NO worker holds (its worker is long dead):
+        # recovery must reap it, not hang on it.
+        journal_mod.record(
+            "session", sid="ghost", sid_g="serve-ghost.g0",
+            address="ghost-host", digest="x", payload="", slots=1,
+            sync=True,
+        )
+        crash_dispatcher(ex_a)
+        prefix = list(req_a.tokens)
+
+        # -- incarnation 2: fresh journal handle over the same directory
+        # replays the dead incarnation's world and bumps the epoch.
+        journal_mod.reset()
+        journal = journal_mod.configure(journal_dir)
+        assert journal.epoch == 2
+        assert sid in (journal.recovered.get("sessions") or {})
+        ex_b = make_serve_executor(tmp_path)
+        try:
+            report = await ex_b.recover()
+            rid = next(
+                r for s, r in report.requests if s == sid
+            )
+            req_b = report.requests[(sid, rid)]
+            resumed = await req_b.result(timeout=60)
+        finally:
+            await ex_b.close()
+        return sid, prefix, report, resumed
+
+    sid, prefix, report, resumed = run_async(flow())
+
+    assert report["recovered"] is True
+    assert report["epoch"] == 2
+    assert sid in report["adopted_sessions"]
+    assert "ghost" in report["orphaned_sessions"]
+    entry = next(r for r in report["resumed_streams"] if r["sid"] == sid)
+    assert entry["state"] in ("streaming", "done")
+    # The journaled high-water mark is exactly what incarnation 1 had
+    # delivered — the splice point.
+    assert entry["from"] == len(prefix)
+    # Exactly-once across the crash: prefix + resumed tail, no overlap,
+    # no gap, byte-equal to the uninterrupted stream.
+    assert prefix + resumed == [100 + i + 1 for i in range(30)]
+
+    assert counter_value("covalent_tpu_recovery_adopted_total") == adopted0 + 1
+    assert counter_value("covalent_tpu_recovery_orphaned_total") >= orphaned0 + 1
+    last = recovery_mod.last_report()
+    assert last is not None and last["recovered"] is True
+    assert last["duration_s"] > 0
+
+
+def test_recovered_session_serves_new_requests(
+    tmp_path, run_async, journal_dir
+):
+    """A re-adopted session is a first-class citizen: new requests stream
+    through it after recovery (the supervisor owns reconnects, stats and
+    close exactly as if it had opened the session itself)."""
+
+    async def flow():
+        journal_mod.configure(journal_dir)
+        ex_a = make_serve_executor(tmp_path)
+        handle = await open_session(
+            ex_a, make_factory(step_delay=0.1, chunk=2, default_cap=6),
+            stats_interval_s=0.1,
+        )
+        sid = handle.sid
+        req_a = await handle.request([100], params={"max_new_tokens": 20})
+        while len(req_a.tokens) < 2:
+            await asyncio.sleep(0.05)
+        crash_dispatcher(ex_a)
+
+        journal_mod.reset()
+        journal_mod.configure(journal_dir)
+        ex_b = make_serve_executor(tmp_path)
+        try:
+            report = await ex_b.recover()
+            sup = report.supervisors[sid]
+            from covalent_tpu_plugin.serving.supervisor import ServeRequest
+
+            fresh = ServeRequest(
+                "r-fresh", [500], {"max_new_tokens": 3}, 0.0, ""
+            )
+            await sup.submit(fresh)
+            fresh_tokens = await fresh.result(timeout=30)
+            closed = await sup.close()
+        finally:
+            await ex_b.close()
+        return fresh_tokens, closed
+
+    fresh_tokens, closed = run_async(flow())
+    assert fresh_tokens == [501, 502, 503]
+    assert isinstance(closed, dict)
