@@ -46,7 +46,8 @@ BENCH_PHASES = {
         "BENCH_PHASES",
         "overhead,obs_tax,fanout,cached_fanout,bundled_fanout,"
         "rpc_overhead,serve_traffic,serve_scale,serve_disagg,serve_spec,"
-        "chaos_fanout,preemption_chaos,dispatcher_crash,sched_fanout,"
+        "gray_failure,chaos_fanout,preemption_chaos,dispatcher_crash,"
+        "sched_fanout,"
         "traffic_ramp,tpu",
     ).split(",")
     if phase.strip()
@@ -150,6 +151,25 @@ SERVE_SPEC_SPEEDUP_MIN = float(
 SERVE_SPEC_BUDGET_S = float(
     os.environ.get("BENCH_SERVE_SPEC_BUDGET_S", "240")
 )
+#: gray_failure phase knobs: three replica-set arms under the SAME
+#: open-loop load — healthy (3 good replicas), brownout-unhedged (one
+#: replica slowed GRAY_SLOW_S per engine step via worker-side chaos,
+#: health scoring + hedging OFF: the pre-defense baseline), and
+#: brownout-hedged (same brownout, full gray-failure defense ON).
+#: SLOs: the hedged arm's measured p99 stays within GRAY_HEDGED_MAX of
+#: the healthy arm's (floored at GRAY_P99_FLOOR_S against timer noise)
+#: while the unhedged arm degrades by at least GRAY_UNHEDGED_MIN; every
+#: stream byte-equal across all arms; zero requests shed; hedges fired.
+GRAY_REQUESTS = int(os.environ.get("BENCH_GRAY_REQUESTS", "16"))
+GRAY_WARMUP = int(os.environ.get("BENCH_GRAY_WARMUP", "12"))
+GRAY_TOKENS = int(os.environ.get("BENCH_GRAY_TOKENS", "12"))
+GRAY_STEP_S = float(os.environ.get("BENCH_GRAY_STEP_S", "0.04"))
+GRAY_SLOW_S = float(os.environ.get("BENCH_GRAY_SLOW_S", "2.0"))
+GRAY_ARRIVAL_S = float(os.environ.get("BENCH_GRAY_ARRIVAL_S", "0.03"))
+GRAY_HEDGED_MAX = float(os.environ.get("BENCH_GRAY_HEDGED_MAX", "1.5"))
+GRAY_UNHEDGED_MIN = float(os.environ.get("BENCH_GRAY_UNHEDGED_MIN", "2.0"))
+GRAY_P99_FLOOR_S = float(os.environ.get("BENCH_GRAY_P99_FLOOR_S", "0.3"))
+GRAY_BUDGET_S = float(os.environ.get("BENCH_GRAY_BUDGET_S", "240"))
 #: traffic_ramp phase knobs: the SAME ramping open-loop load (a light
 #: warm phase, a surge past one replica's throughput, a cool tail)
 #: offered to a statically over-provisioned replica set and to a
@@ -3393,6 +3413,258 @@ async def main() -> None:
         emit({"phase": "serve_scale", "skipped": "BENCH_PHASES"})
     except Exception as error:  # noqa: BLE001
         emit({"phase": "serve_scale", "error": repr(error)})
+
+    # ---- phase 2b4: gray-failure defense (health + hedging) --------------
+    # One replica of three is browned out (every engine step pays a
+    # GRAY_SLOW_S chaos sleep — alive, heartbeating, just 50x slower:
+    # the gray failure a crash-stop breaker never sees).  Three arms
+    # under the SAME open-loop load: healthy baseline, brownout with the
+    # defense OFF (pre-defense behavior: ~1/3 of requests eat the
+    # brownout), and brownout with health scoring + tail hedging ON.
+    # Asserted: hedged p99 recovers to within GRAY_HEDGED_MAX of
+    # healthy, unhedged degrades >= GRAY_UNHEDGED_MIN, every stream
+    # byte-equal across arms (the hedge's exactly-once splice), zero
+    # shed, hedges actually fired, and health transitions are in the
+    # archived metrics.
+    try:
+        if "gray_failure" not in BENCH_PHASES:
+            raise _PhaseSkipped
+        from covalent_tpu_plugin.fleet.health import HEALTH
+        from covalent_tpu_plugin.serving import open_replica_set
+
+        def make_gray_factory(step_s: float, slots: int = 4):
+            def factory():
+                import time as _time
+
+                class Engine:
+                    def __init__(self):
+                        self.slots = slots
+                        self.lanes = {}
+
+                    def admit(self, rid, prompt, params):
+                        seed = int(prompt[-1])
+                        cap = int((params or {}).get(
+                            "max_new_tokens", GRAY_TOKENS
+                        ))
+                        self.lanes[rid] = [
+                            seed * 100 + j + 1 for j in range(cap)
+                        ]
+
+                    def step(self):
+                        _time.sleep(step_s)
+                        events = []
+                        for rid in list(self.lanes):
+                            chunk = self.lanes[rid][:4]
+                            self.lanes[rid] = self.lanes[rid][4:]
+                            done = not self.lanes[rid]
+                            if done:
+                                del self.lanes[rid]
+                            events.append({
+                                "rid": rid, "tokens": chunk, "done": done,
+                            })
+                        return events
+
+                    def cancel(self, rid):
+                        self.lanes.pop(rid, None)
+
+                return Engine()
+
+            return factory
+
+        # The brownout rides the worker-side gray-chaos hook: the slow
+        # replica's harness parses COVALENT_TPU_CHAOS from its process
+        # env and pays a seeded slow-tail sleep per engine pump.
+        # slow_s = slow_factor * max(jitter, 0.01).
+        gray_chaos = (
+            f"seed=11,jitter=0.02,p_slow=1.0,"
+            f"slow_factor={GRAY_SLOW_S / 0.02:.0f}"
+        )
+
+        def gray_executor(tag: str, brownout: bool):
+            env = {
+                "PYTHONPATH": repo_root + os.pathsep
+                + os.environ.get("PYTHONPATH", ""),
+            }
+            if brownout:
+                env["COVALENT_TPU_CHAOS"] = gray_chaos
+            return TPUExecutor(
+                transport="local",
+                cache_dir=f"{workdir}/cache_gray_{tag}",
+                remote_cache=f"{workdir}/remote_gray_{tag}",
+                python_path=sys.executable,
+                poll_freq=0.2,
+                use_agent="pool",
+                pool_preload="cloudpickle",
+                prewarm=False,
+                heartbeat_interval=0.0,
+                task_env=env,
+            )
+
+        async def gray_arm(tag: str, brownout: bool, defended: bool) -> dict:
+            # Arm-scoped env: the defense toggles read os.environ at
+            # ReplicaSet construction / per judge call.
+            overrides = {
+                "COVALENT_TPU_HEDGE": "on" if defended else "off",
+                "COVALENT_TPU_HEALTH": "" if defended else "off",
+                "COVALENT_TPU_HEDGE_BUDGET_PCT": "60",
+                "COVALENT_TPU_HEDGE_PERCENTILE": "90",
+            }
+            saved = {k: os.environ.get(k) for k in overrides}
+            saved_min_samples = HEALTH.min_samples
+            HEALTH.reset()
+            HEALTH.min_samples = 3
+            for k, v in overrides.items():
+                os.environ[k] = v
+            executors = [
+                gray_executor(f"{tag}_{i}", brownout and i == 2)
+                for i in range(3)
+            ]
+            try:
+                rset = await open_replica_set(
+                    executors,
+                    make_gray_factory(GRAY_STEP_S),
+                    name=f"gray_{tag}",
+                    stats_interval_s=0.2,
+                )
+                shed = 0
+
+                async def offer(n: int, base: int) -> list:
+                    nonlocal shed
+                    out = []
+                    for i in range(n):
+                        try:
+                            out.append(await rset.request(
+                                [base + i],
+                                params={"max_new_tokens": GRAY_TOKENS},
+                                tenant=f"t{i % 2}",
+                            ))
+                        except Exception:  # noqa: BLE001 - shed counts
+                            shed += 1
+                        await asyncio.sleep(GRAY_ARRIVAL_S)
+                    return out
+
+                # Warm-up: trains the hedge TTFT ring and lets the
+                # health monitor learn the brownout (lost hedges charge
+                # the straggling primary); excluded from the measurement.
+                warm = await offer(GRAY_WARMUP, 100)
+                await asyncio.gather(
+                    *(r.result(timeout=GRAY_BUDGET_S) for r in warm)
+                )
+                if brownout and defended:
+                    # Measure the RECOVERED steady state, not the
+                    # detection window: wait (bounded) until the health
+                    # monitor has actually demoted the browned-out
+                    # replica before offering the measured batch.
+                    for _ in range(100):
+                        states = {
+                            HEALTH.state(sup.sid)
+                            for sup in rset.supervisors.values()
+                        }
+                        if states & {"degraded", "quarantined"}:
+                            break
+                        await asyncio.sleep(0.1)
+                measured = await offer(GRAY_REQUESTS, 200)
+                results = await asyncio.gather(
+                    *(r.result(timeout=GRAY_BUDGET_S) for r in measured)
+                )
+                latencies = [r.latency_s for r in measured]
+                status = rset.status()
+                await rset.close()
+            finally:
+                for k, v in saved.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
+                HEALTH.min_samples = saved_min_samples
+                for ex in executors:
+                    await ex.close()
+            return {
+                "results": list(results),
+                "latencies": latencies,
+                "p99_s": percentile(latencies, 0.99),
+                "shed": shed,
+                "hedge": status.get("hedge", {}),
+                "health": {
+                    rid: {
+                        "score": view.get("health_score"),
+                        "state": view.get("health_state"),
+                    }
+                    for rid, view in status["replicas"].items()
+                },
+            }
+
+        async def gray_phase():
+            healthy = await gray_arm("healthy", False, False)
+            unhedged = await gray_arm("unhedged", True, False)
+            hedged = await gray_arm("hedged", True, True)
+            return healthy, unhedged, hedged
+
+        healthy_arm, unhedged_arm, hedged_arm = await asyncio.wait_for(
+            gray_phase(), GRAY_BUDGET_S
+        )
+        expected = [
+            [(200 + i) * 100 + j + 1 for j in range(GRAY_TOKENS)]
+            for i in range(GRAY_REQUESTS)
+        ]
+        byte_equal = (
+            healthy_arm["results"] == expected
+            and unhedged_arm["results"] == expected
+            and hedged_arm["results"] == expected
+        )
+        total_shed = (
+            healthy_arm["shed"] + unhedged_arm["shed"] + hedged_arm["shed"]
+        )
+        p99_floor = max(healthy_arm["p99_s"], GRAY_P99_FLOOR_S)
+        hedge_recovered = bool(
+            hedged_arm["p99_s"] <= GRAY_HEDGED_MAX * p99_floor
+        )
+        unhedged_degraded = bool(
+            unhedged_arm["p99_s"] >= GRAY_UNHEDGED_MIN * p99_floor
+        )
+        hedges_issued = int(hedged_arm["hedge"].get("issued") or 0)
+        summary["gray_failure_p99_healthy_s"] = round(
+            healthy_arm["p99_s"], 4
+        )
+        summary["gray_failure_p99_unhedged_s"] = round(
+            unhedged_arm["p99_s"], 4
+        )
+        summary["gray_failure_p99_hedged_s"] = round(hedged_arm["p99_s"], 4)
+        summary["gray_failure_hedge_p99_recovered"] = hedge_recovered
+        summary["gray_failure_unhedged_degraded"] = unhedged_degraded
+        summary["gray_failure_streams_byte_equal"] = byte_equal
+        summary["gray_failure_shed"] = total_shed
+        summary["gray_failure_hedges_issued"] = hedges_issued
+        summary["gray_failure_hedge_wins"] = int(
+            hedged_arm["hedge"].get("wins") or 0
+        )
+        emit({
+            "phase": "gray_failure",
+            "requests": GRAY_REQUESTS,
+            "warmup": GRAY_WARMUP,
+            "slow_s": GRAY_SLOW_S,
+            "p99_healthy_s": summary["gray_failure_p99_healthy_s"],
+            "p99_unhedged_s": summary["gray_failure_p99_unhedged_s"],
+            "p99_hedged_s": summary["gray_failure_p99_hedged_s"],
+            "hedged_max": GRAY_HEDGED_MAX,
+            "unhedged_min": GRAY_UNHEDGED_MIN,
+            "hedge_p99_recovered": hedge_recovered,
+            "unhedged_degraded": unhedged_degraded,
+            "streams_byte_equal": byte_equal,
+            "shed": total_shed,
+            "hedge": hedged_arm["hedge"],
+            "replica_health": hedged_arm["health"],
+            "introspection": introspection_view([
+                "covalent_tpu_health_score",
+                "covalent_tpu_health_transitions_total",
+                "covalent_tpu_serve_hedges_total",
+            ]),
+            **spread_stats(hedged_arm["latencies"], "gray_hedged_latency"),
+        })
+    except _PhaseSkipped:
+        emit({"phase": "gray_failure", "skipped": "BENCH_PHASES"})
+    except Exception as error:  # noqa: BLE001
+        emit({"phase": "gray_failure", "error": repr(error)})
 
     # ---- phase 2b-ter: disaggregated prefill/decode serving --------------
     # The SAME open-loop mixed short/long-prompt traffic through the SAME

@@ -1299,6 +1299,17 @@ class AgentClient:
             lambda c: c._serve_resumed.pop(key, None), timeout
         )
 
+    async def serve_cancel(self, sid: str, rid: str) -> None:
+        """Cancel one in-flight request on a session (fire-and-forget).
+
+        The hedging path calls this for the LOSING arm the moment the
+        winner's first token lands: the worker frees the decode lane and
+        finalizes the stream with ``error="cancelled"``.  No ack to wait
+        on — the cancel races completion by design, and either terminal
+        record settles the same waiter.
+        """
+        await self._send({"cmd": "serve_cancel", "id": sid, "rid": rid})
+
     # -- resident-mode profiling ---------------------------------------------
 
     async def profile_start(

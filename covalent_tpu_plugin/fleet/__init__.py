@@ -29,6 +29,7 @@ decoupled from execution):
 
 from .autoscale import AutoscaleController, PoolPolicy, ReplicaSetPolicy
 from .executor import FleetExecutor, default_scheduler, reset_default_scheduler
+from .health import HEALTH, HealthMonitor
 from .lease import GangLease
 from .pools import Pool, PoolRegistry, PoolSpec, parse_pool_specs
 from .queue import FairWorkQueue, QueueFullError, WorkItem
@@ -41,6 +42,8 @@ __all__ = [
     "FleetExecutor",
     "FleetScheduler",
     "GangLease",
+    "HEALTH",
+    "HealthMonitor",
     "LocalPoolAutoscaler",
     "Pool",
     "PoolPolicy",

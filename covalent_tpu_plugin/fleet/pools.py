@@ -33,6 +33,7 @@ from ..obs.metrics import REGISTRY
 from ..utils.config import get_config
 from ..utils.log import app_log
 from . import journal
+from .health import HEALTH, QUARANTINED
 
 POOL_SLOTS = REGISTRY.gauge(
     "covalent_tpu_pool_slots",
@@ -257,6 +258,73 @@ class Pool:
             state == "open" for state in self.breaker_states().values()
         )
 
+    def _worker_keys(self) -> list[str]:
+        """Addresses the health monitor keys this pool's workers by:
+        the breaker view's keys when the gang exposes them, else the
+        executor's static worker list."""
+        keys = list(self.breaker_states().keys())
+        if keys:
+            return keys
+        if self._executor is None:
+            return []
+        try:
+            return [str(w) for w in getattr(self._executor, "workers", [])]
+        except Exception:  # noqa: BLE001 - placement must not crash on a view
+            return []
+
+    def health_rank(self) -> int:
+        """Worst health rank across this pool's workers (0 healthy … 3
+        quarantined) — a gang launch is all-or-nothing, so the slowest
+        member's gray-failure grade IS the pool's placement grade."""
+        ranks = [HEALTH.rank(key) for key in self._worker_keys()]
+        return max(ranks) if ranks else 0
+
+    @property
+    def health_quarantined(self) -> bool:
+        """True when any worker is health-quarantined (gray-failing hard
+        enough to drain) — placement routes around the pool exactly as
+        it does for an OPEN breaker, but on *degradation* signals a
+        binary crash-stop breaker never sees."""
+        return self.health_rank() >= 3
+
+    def schedule_health_probes(self) -> None:
+        """Fire single-flight canary probes for quarantined workers.
+
+        The scheduler calls this on its blocked tick (the same cadence
+        that lets breaker cooldowns promote OPEN -> HALF_OPEN): each
+        quarantined worker whose probe dwell has elapsed gets ONE cheap
+        executor ping; success readmits it to PROBATION.  Executors
+        without a ``health_canary`` probe simply never quarantine-drain
+        this way (their workers only feed scores through serving)."""
+        if self._executor is None:
+            return
+        probe = getattr(self._executor, "health_canary", None)
+        if probe is None:
+            return
+        for key in self._worker_keys():
+            if HEALTH.state(key) != QUARANTINED or not HEALTH.allow_probe(key):
+                continue
+
+            async def _run(worker: str = key) -> None:
+                ok = False
+                try:
+                    ok = bool(await probe(worker))
+                finally:
+                    HEALTH.record_probe(worker, ok)
+
+            coro = _run()
+            try:
+                task = asyncio.ensure_future(coro)
+            except RuntimeError:
+                # No running loop (sync status path): close the unstarted
+                # coroutine and release the probe slot for the next tick.
+                coro.close()
+                HEALTH.record_probe(key, False)
+                continue
+            task.add_done_callback(
+                lambda t: None if t.cancelled() else t.exception()
+            )
+
     def holds_fn_digest(self, digest: str) -> bool:
         """Whether this pool's warm gang registered the electron's function
         digest (RPC dispatch) — placement affinity: a holding gang invokes
@@ -383,6 +451,7 @@ class Pool:
             "workers": list(self.spec.workers)
             or ([self.spec.tpu_name] if self.spec.tpu_name else ["local"]),
             "breakers": self.breaker_states(),
+            "health_rank": self.health_rank(),
         }
         if self._executor is not None:
             # RPC dispatch views (absent on stub executors): how many

@@ -148,6 +148,14 @@ async def recover(executor: Any, timeout_s: float = 120.0) -> RecoveryReport:
     # (rendezvous + fence-checked attach) and declares the new epoch on
     # every channel before this returns.
     lease = await asyncio.wait_for(executor.lease_gang(), timeout_s)
+    # Re-dialed workers start with NEUTRAL health: pre-crash scores and
+    # quarantines describe the dead incarnation's observations, and a
+    # stale quarantine would drain a worker that just proved itself by
+    # answering the re-dial.  Real traffic re-earns the grade.
+    from .health import HEALTH
+
+    for address in lease.addresses:
+        HEALTH.neutral(str(address))
 
     # -- 2. inventory every live channel.
     by_sidg: dict[str, tuple[Any, Any, str, dict]] = {}

@@ -344,8 +344,14 @@ class FleetScheduler:
             if placed:
                 continue
             # Backlog exists but nothing is placeable (all pools full or
-            # breaker-open): sleep a short tick so breaker cooldowns can
-            # promote OPEN -> HALF_OPEN; a slot release wakes us sooner.
+            # breaker-open/health-quarantined): sleep a short tick so
+            # breaker cooldowns can promote OPEN -> HALF_OPEN and canary
+            # probes can readmit quarantined workers; a slot release
+            # wakes us sooner.
+            for pool in self.registry.pools():
+                probes = getattr(pool, "schedule_health_probes", None)
+                if probes is not None:
+                    probes()
             try:
                 await asyncio.wait_for(self._wake.wait(), _BLOCKED_TICK_S)
             except asyncio.TimeoutError:
@@ -357,7 +363,9 @@ class FleetScheduler:
         """Whether ANY pool could take an electron right now (cheap: no
         ranking) — the guard that keeps DRR pops slot-backed."""
         return any(
-            pool.free_slots > 0 and not pool.breaker_open
+            pool.free_slots > 0
+            and not pool.breaker_open
+            and not pool.health_quarantined
             for pool in self.registry.pools()
         )
 
@@ -468,12 +476,29 @@ class FleetScheduler:
                 0 if pool.preemptible == spot_ok else 1,
                 0 if pool.warm else 1,
                 0 if pool.holds_fn_digest(digest) else 1,
+                # Gray-failure grade: a degraded (but not quarantined)
+                # pool still places, just after every healthier
+                # alternative — below affinity (a warm digest-holding
+                # gang beats a pristine cold one), above the bin-pack
+                # most-free tiebreak.
+                pool.health_rank(),
                 -pool.free_slots,
                 pool.name,
             )
 
         ranked = sorted(available, key=rank)
-        placeable = [pool for pool in ranked if not pool.breaker_open]
+        placeable = [
+            pool for pool in ranked
+            if not pool.breaker_open and not pool.health_quarantined
+        ]
+        for pool in ranked:
+            # A quarantined pool skipped while healthy peers absorb the
+            # traffic still needs its readmission canary — allow_probe's
+            # single-flight dwell gate keeps this a no-op almost always.
+            if pool not in placeable and pool.health_quarantined:
+                probes = getattr(pool, "schedule_health_probes", None)
+                if probes is not None:
+                    probes()
         if not placeable:
             return None, False
         # Rerouted means the quarantine CHANGED the decision: the pool we

@@ -1891,7 +1891,8 @@ _EPOCH = {"value": 0, "channel": 0}
 #: can look, not touch.
 _FENCED_CMDS = frozenset((
     "run", "register_fn", "invoke", "multi_invoke", "serve_open",
-    "serve_request", "serve_prefill", "serve_close", "serve_resume", "kill",
+    "serve_request", "serve_prefill", "serve_close", "serve_resume",
+    "serve_cancel", "kill",
 ))
 
 
@@ -1956,6 +1957,39 @@ def _refuse_stale(name: str, command: dict) -> None:
 #: sid -> live _ServeSession; read by the heartbeat payload so a serving
 #: worker's beats carry slot occupancy.
 _SERVE_SESSIONS: dict = {}
+
+
+def _gray_chaos_from_env() -> dict | None:
+    """Worker-side gray-fault injection spec from ``COVALENT_TPU_CHAOS``.
+
+    The transport-level ``ChaosTransport`` gates dispatcher-side ops, but
+    a serving brownout has to live where the latency lives: in the decode
+    loop.  This parses only the gray keys (``seed``, ``jitter``,
+    ``p_slow``, ``slow_factor``) from the same spec — unknown keys are
+    *ignored* here (they are the transport's business, validated there) —
+    and returns a seeded plan dict, or None when no gray mode is set.
+    """
+    import random as random_mod
+
+    spec = os.environ.get("COVALENT_TPU_CHAOS", "").strip()
+    if not spec:
+        return None
+    vals = {"seed": 0.0, "jitter": 0.0, "p_slow": 0.0, "slow_factor": 10.0}
+    for token in spec.split(","):
+        key, sep, value = token.strip().partition("=")
+        if sep and key.strip() in vals:
+            try:
+                vals[key.strip()] = float(value)
+            except ValueError:
+                pass
+    if vals["jitter"] <= 0 and vals["p_slow"] <= 0:
+        return None
+    return {
+        "rng": random_mod.Random(int(vals["seed"])),
+        "jitter": vals["jitter"],
+        "p_slow": vals["p_slow"],
+        "slow_s": vals["slow_factor"] * max(vals["jitter"], 0.01),
+    }
 
 
 def _serve_occupancy() -> dict:
@@ -2027,6 +2061,17 @@ class _ServeSession:
         #: queued-but-unadmitted request ("pending") from one this worker
         #: never saw ("unknown") at resume time.
         self.submitted: set = set()
+        #: rids a ``serve_cancel`` asked to kill, drained on the session
+        #: thread (running lane -> engine cancel + terminal record;
+        #: queued-only -> skipped at admission).  The hedging loser-
+        #: cancel path frees decode lanes through here.
+        self.cancels: set = set()
+        self._cancel_lock = threading.Lock()
+        self._cancelled_pending: set = set()
+        #: Worker-side gray chaos (seeded slow tail / jitter on decode
+        #: steps), parsed from COVALENT_TPU_CHAOS after the task env is
+        #: applied — how a bench brownouts ONE replica of a set.
+        self._gray = None
         self._history_lock = threading.Lock()
         self.slots = 1
         self.served = 0
@@ -2095,6 +2140,18 @@ class _ServeSession:
         # prefill replica is usually idle exactly when a prefill lands,
         # and the tick would tax every disaggregated request's TTFT.
         self.queue.put(None)
+
+    def cancel_request(self, rid: str) -> None:
+        """Ask the session thread to cancel one request (running or
+        queued).  Cheap and non-blocking: the terminal ``serve.token``
+        record (``error="cancelled"``) is emitted from the session
+        thread so it serializes with live chunks under the history
+        lock."""
+        if not rid:
+            return
+        with self._cancel_lock:
+            self.cancels.add(rid)
+        self.queue.put(None)  # wake an idle loop promptly
 
     def close(self) -> None:
         self._closed.set()
@@ -2370,6 +2427,17 @@ class _ServeSession:
             if command is None:
                 continue
             rid = str(command.get("rid") or "")
+            if rid in self._cancelled_pending:
+                # Cancelled while queued: never admit; terminal record so
+                # a resume finds "done" with the cancellation marker.
+                self._cancelled_pending.discard(rid)
+                with self._history_lock:
+                    self._emit_serve(
+                        "serve.token", rid=rid, idx=0, tokens=[],
+                        done=True, error="cancelled",
+                    )
+                    self._finish_history(rid, "cancelled")
+                continue
             deadline_s = command.get("deadline_s", self.default_deadline_s)
             try:
                 deadline_s = float(deadline_s or 0.0)
@@ -2442,6 +2510,43 @@ class _ServeSession:
                 cancel(rid)
             except BaseException:  # noqa: BLE001 - best-effort free
                 pass
+
+    def _drain_cancels(self) -> None:
+        """Apply queued ``serve_cancel`` requests on the session thread.
+
+        A running lane is cancelled mid-stream: engine lane freed, one
+        terminal ``serve.token`` (``done=True, error="cancelled"``)
+        emitted under the history lock, history moved to the finished
+        ring — exactly the deadline-reclaim shape, so a later resume
+        answers ``done`` with the cancellation marker.  A rid still
+        queued is remembered and skipped at admission.  An unknown rid
+        is a no-op (cancels are fire-and-forget and race completion).
+        """
+        with self._cancel_lock:
+            if not self.cancels:
+                return
+            rids = list(self.cancels)
+            self.cancels.clear()
+        for rid in rids:
+            state = self.running.get(rid)
+            if state is None:
+                if rid in self.submitted and rid not in self.finished:
+                    self._cancelled_pending.add(rid)
+                continue
+            self._cancel_lane(rid)
+            self._emit_span(
+                "serve.worker.decode", state.get("trace"),
+                state["t_admit"], rid=rid,
+                tokens=state["emitted"], error="cancelled",
+            )
+            with self._history_lock:
+                self._emit_serve(
+                    "serve.token", rid=rid, idx=state["emitted"],
+                    tokens=[], done=True, error="cancelled",
+                )
+                self.served += 1
+                self.running.pop(rid, None)
+                self._finish_history(rid, "cancelled")
 
     def _finish_history(self, rid: str, error: str = "") -> None:
         """Move one rid's history into the bounded finished ring.
@@ -2532,6 +2637,13 @@ class _ServeSession:
         attribution, not a measurement: lanes decode fused, so a
         per-request split of one wave is proportional by construction.
         """
+        gray = self._gray
+        if gray is not None:
+            # Seeded gray latency: the engine still answers — just late.
+            if gray["jitter"] > 0:
+                time.sleep(gray["rng"].random() * gray["jitter"])
+            if gray["p_slow"] > 0 and gray["rng"].random() < gray["p_slow"]:
+                time.sleep(gray["slow_s"])
         spec = bool(getattr(self._engine, "spec_active", False))
         t_step = time.monotonic()
         try:
@@ -2619,6 +2731,7 @@ class _ServeSession:
 
     def _loop(self) -> None:
         _apply_spec_env(self.spec)
+        self._gray = _gray_chaos_from_env()
         if not self._open_engine():
             # Failed open: mark closed so late requests reject cleanly
             # instead of queueing into a thread that already exited.
@@ -2630,6 +2743,7 @@ class _ServeSession:
             while not (self._closed.is_set()
                        and not self.running
                        and self.queue.empty()):
+                self._drain_cancels()
                 self._pump_prefill()
                 self._admit_waiting()
                 if self.running:
@@ -2751,6 +2865,20 @@ def _serve_close(command: dict, sessions: dict) -> None:
     session.close()
     # The session thread emits serve_closed after its drain; nothing to
     # block on here — the command loop must stay live.
+
+
+def _serve_cancel(command: dict, sessions: dict) -> None:
+    """Fire-and-forget cancellation of one in-flight request.
+
+    The hedging path uses this to free the losing replica's decode lane
+    the moment the winner's first token lands.  No waiter: an unknown
+    session or rid is a silent no-op (the cancel races completion by
+    design), so the only answer is the stream's own terminal record.
+    """
+    sid = str(command.get("id") or "")
+    session = sessions.get(sid)
+    if session is not None:
+        session.cancel_request(str(command.get("rid") or ""))
 
 
 def _serve_resume(command: dict, sessions: dict) -> None:
@@ -3119,6 +3247,8 @@ def serve_child() -> int:
                     opened.append(session)
             elif name == "serve_request":
                 _serve_request(command, sessions)
+            elif name == "serve_cancel":
+                _serve_cancel(command, sessions)
             elif name == "serve_resume":
                 _serve_resume(command, sessions)
             elif name == "serve_inventory":
@@ -3342,6 +3472,8 @@ def serve() -> int:
                     _serve_open(command, serve_sessions)
                 elif name == "serve_request":
                     _serve_request(command, serve_sessions)
+                elif name == "serve_cancel":
+                    _serve_cancel(command, serve_sessions)
                 elif name == "serve_prefill":
                     _serve_prefill(command, serve_sessions)
                 elif name == "serve_close":

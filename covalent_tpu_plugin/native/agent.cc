@@ -1233,7 +1233,7 @@ static bool is_fenced_cmd(const std::string& n) {
   return n == "run" || n == "register_fn" || n == "invoke" ||
          n == "serve_open" || n == "serve_request" ||
          n == "serve_prefill" || n == "serve_close" ||
-         n == "serve_resume" || n == "kill";
+         n == "serve_resume" || n == "serve_cancel" || n == "kill";
 }
 
 // Refuse a fenced command from a stale channel, in the SHAPE the caller's
@@ -1355,6 +1355,7 @@ static void handle_line(const std::string& line, bool& running) {
   else if (name == "serve_open") serve_open(cmd, line);
   else if (name == "serve_request") serve_forward(cmd, line + "\n", false);
   else if (name == "serve_resume") serve_forward(cmd, line + "\n", false);
+  else if (name == "serve_cancel") serve_forward(cmd, line + "\n", false);
   else if (name == "serve_prefill") serve_prefill_forward(cmd, line + "\n");
   else if (name == "serve_close") serve_forward(cmd, line + "\n", true);
   else if (name == "profile_start") profile_forward(cmd, line, false);

@@ -135,10 +135,32 @@ class HeartbeatMonitor:
             last = self._beats.get(key)
             if last is not None and seq is not None and seq <= last["seq"]:
                 return False
+            now = self._clock()
+            # Inter-arrival statistics (EWMA mean + variance) feed the
+            # jitter-adaptive stall threshold in :meth:`stalled` — a noisy
+            # scheduler that delivers beats erratically widens its own
+            # deadline instead of tripping a false stall.
+            gap_mean = gap_var = 0.0
+            gap_n = 0
+            if last is not None:
+                gap = now - last["at"]
+                gap_mean = last.get("gap_mean", 0.0)
+                gap_var = last.get("gap_var", 0.0)
+                gap_n = last.get("gap_n", 0)
+                if gap_n == 0:
+                    gap_mean = gap
+                else:
+                    dev = gap - gap_mean
+                    gap_mean += 0.3 * dev
+                    gap_var += 0.3 * (dev * dev - gap_var)
+                gap_n += 1
             self._beats[key] = {
-                "at": self._clock(),
+                "at": now,
                 "seq": seq if seq is not None else -1,
                 "hb": dict(heartbeat),
+                "gap_mean": gap_mean,
+                "gap_var": gap_var,
+                "gap_n": gap_n,
             }
         HEARTBEATS_TOTAL.labels(worker=worker).inc()
         step = heartbeat.get("step")
@@ -165,16 +187,50 @@ class HeartbeatMonitor:
                 if op == operation_id
             }
 
+    #: Beats observed before the adaptive threshold kicks in; below this
+    #: the configured ``stall_after`` applies unmodified.
+    ADAPTIVE_MIN_BEATS = 3
+    #: Standard deviations of inter-arrival jitter tolerated on top of
+    #: the observed cadence before silence reads as a stall.
+    ADAPTIVE_K = 4.0
+
+    def effective_stall_after(
+        self, operation_id: str, worker: str
+    ) -> float:
+        """Jitter-adaptive stall threshold for one worker.
+
+        The configured ``stall_after`` (3x the heartbeat interval by
+        default) is a *floor*, never a ceiling: once a worker has beaten
+        enough times to characterize its own cadence, the threshold grows
+        to ``3 x observed-mean-gap + K x observed-std`` so a worker whose
+        beats arrive erratically — loaded host, noisy scheduler, CI
+        machine — widens its own deadline instead of tripping a false
+        stall.  A genuinely wedged worker still trips: its silence keeps
+        growing while the learned statistics stay frozen.
+        """
+        with self._lock:
+            op = self._ops.get(operation_id)
+            configured = float(op["stall_after"]) if op else 0.0
+            entry = self._beats.get((operation_id, worker))
+        if configured <= 0:
+            return configured
+        if not entry or entry.get("gap_n", 0) < self.ADAPTIVE_MIN_BEATS:
+            return configured
+        std = max(0.0, entry.get("gap_var", 0.0)) ** 0.5
+        adaptive = 3.0 * entry.get("gap_mean", 0.0) + self.ADAPTIVE_K * std
+        return max(configured, adaptive)
+
     def stalled(self, operation_id: str) -> list[tuple[str, float]]:
         """``(worker, silence_s)`` for workers past their stall deadline.
 
-        Two ways to stall: a worker that beat and went silent for
-        ``stall_after``; and an *expected* worker (named in :meth:`watch`)
-        that never beat at all within the no-beat deadline
-        (``max(stall_after + interval, launch_slack)``).  An operation
-        whose expected set was not declared only gets the first kind, so a
-        task with heartbeats disabled is never killed by a detector it
-        cannot feed.
+        Two ways to stall: a worker that beat and went silent for its
+        jitter-adaptive threshold (:meth:`effective_stall_after` — floored
+        at the configured ``stall_after``); and an *expected* worker
+        (named in :meth:`watch`) that never beat at all within the
+        no-beat deadline (``max(stall_after + interval, launch_slack)``).
+        An operation whose expected set was not declared only gets the
+        first kind, so a task with heartbeats disabled is never killed by
+        a detector it cannot feed.
 
         This is a *suspicion*, not a verdict: the executor confirms
         against the worker's snapshot file before acting (and counts
@@ -191,7 +247,15 @@ class HeartbeatMonitor:
                 if o != operation_id:
                     continue
                 beaten.add(worker)
-                if now - entry["at"] < op["stall_after"]:
+                threshold = op["stall_after"]
+                if entry.get("gap_n", 0) >= self.ADAPTIVE_MIN_BEATS:
+                    std = max(0.0, entry.get("gap_var", 0.0)) ** 0.5
+                    threshold = max(
+                        threshold,
+                        3.0 * entry.get("gap_mean", 0.0)
+                        + self.ADAPTIVE_K * std,
+                    )
+                if now - entry["at"] < threshold:
                     continue
                 out.append((worker, round(now - entry["at"], 3)))
             silence = now - op["started"]

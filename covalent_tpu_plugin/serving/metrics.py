@@ -217,3 +217,18 @@ SERVE_DISAGG_REQUESTS_TOTAL = REGISTRY.counter(
     "Requests through a disaggregated set by road taken",
     ("path",),
 )
+
+# -- tail-latency hedging ----------------------------------------------------
+# The gray-failure defense's request plane: an idempotent request whose
+# TTFT exceeds the set's adaptive percentile is speculatively re-issued
+# on the next-healthiest replica.  ``outcome`` is a closed set:
+# ``launched`` (hedge sent), ``won`` (hedge arm fed the first token —
+# the primary was cancelled), ``lost`` (primary answered first — the
+# hedge was cancelled), ``budget`` (TTFT fired but the <5% budget was
+# spent), ``no_target`` (no healthier routable replica to hedge onto).
+
+SERVE_HEDGES_TOTAL = REGISTRY.counter(
+    "covalent_tpu_serve_hedges_total",
+    "Tail-latency hedge decisions by outcome",
+    ("outcome",),
+)

@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import asyncio
 import collections
+import os
 import time
 import uuid
 from typing import Any, Callable
@@ -51,11 +52,13 @@ import cloudpickle
 
 from ..cache import bytes_digest
 from ..fleet import journal as journal_mod
+from ..fleet.health import DEGRADED, HEALTH, QUARANTINED
 from ..fleet.queue import DEFAULT_TENANT, FairWorkQueue, QueueFullError, WorkItem
 from ..obs import events as obs_events
 from ..obs.trace import Span, record_span
 from ..utils.log import app_log
 from .metrics import (
+    SERVE_HEDGES_TOTAL,
     SERVE_REPLICAS,
     SERVE_ROUTER_DECISION_SECONDS,
     SERVE_ROUTER_DECISIONS_TOTAL,
@@ -87,11 +90,15 @@ class ReplicaView:
     fake fleets and a fake clock — no supervisor, no I/O.
     """
 
-    __slots__ = ("rid", "open", "alive", "load", "capacity")
+    __slots__ = (
+        "rid", "open", "alive", "load", "capacity", "health",
+        "degraded", "quarantined",
+    )
 
     def __init__(
         self, rid: str, *, open: bool, load: int, capacity: int,
-        alive: bool | None = None,
+        alive: bool | None = None, health: float = 1.0,
+        degraded: bool = False, quarantined: bool = False,
     ) -> None:
         self.rid = rid
         self.open = bool(open)
@@ -99,6 +106,14 @@ class ReplicaView:
         self.alive = bool(open if alive is None else alive)
         self.load = int(load)
         self.capacity = max(1, int(capacity))
+        #: continuous health score in [0, 1] (fleet.health).
+        self.health = float(health)
+        #: gray-degraded: routable as LAST RESORT only — a healthy
+        #: replica with headroom always wins over it.
+        self.degraded = bool(degraded)
+        #: quarantined: receives NO new traffic; sticky pins drain off it
+        #: (re-pin on next use) and only a canary probe readmits it.
+        self.quarantined = bool(quarantined)
 
 
 class ReplicaRouter:
@@ -240,10 +255,14 @@ class ReplicaRouter:
         deferral.  Returns ``(item, replica_id, outcome)`` per placement,
         ``outcome`` in ``{"sticky", "prefix_affinity", "least_loaded"}``.
         """
+        # Quarantined replicas get NO new traffic: they are excluded from
+        # headroom entirely (the canary probe path is their only road
+        # back), so every placement rule below — sticky, prefix, least-
+        # loaded — routes around them by construction.
         headroom = {
             rid: view.capacity - view.load
             for rid, view in views.items()
-            if view.open
+            if view.open and not view.quarantined
         }
         assigned: list[tuple[WorkItem, str, str]] = []
         if not headroom:
@@ -266,7 +285,10 @@ class ReplicaRouter:
                 pinned = self.sticky_target(sticky)
                 if pinned is not None:
                     view = views.get(pinned)
-                    if view is not None and view.alive:
+                    if (
+                        view is not None and view.alive
+                        and not view.quarantined
+                    ):
                         if headroom.get(pinned, 0) > 0:
                             target, outcome = pinned, "sticky"
                         else:
@@ -275,8 +297,11 @@ class ReplicaRouter:
                             # point of the pin) instead of re-placing.
                             deferred.append(item)
                             continue
-                    # else: the pin points at a dead replica — fall
-                    # through to a fresh placement and re-pin below.
+                    # else: the pin points at a dead OR quarantined
+                    # replica — fall through to a fresh placement and
+                    # re-pin below (the sticky drain: a browned-out
+                    # replica's pinned sessions move off it rather than
+                    # waiting out a reconnect that never comes).
             if target is None and prefix_key:
                 # Prefix affinity ranks BELOW sticky and above
                 # least-loaded, and unlike a pin it never defers: a warm
@@ -310,20 +335,28 @@ class ReplicaRouter:
     def _least_loaded(
         self, views: dict[str, ReplicaView], headroom: dict[str, int]
     ) -> str | None:
-        """The open replica with the most free lanes (ties rotate)."""
+        """The open replica with the most free lanes (ties rotate).
+
+        Health-aware: gray-degraded replicas are LAST-RESORT — they only
+        receive work when no healthy replica has headroom.  Routing a
+        request to a 10x-slower replica because it happens to be least
+        loaded is exactly the tail-latency trap this avoids.
+        """
         candidates = [
             rid for rid, free in headroom.items() if free > 0
         ]
         if not candidates:
             return None
+        healthy = [rid for rid in candidates if not views[rid].degraded]
+        pool = healthy or candidates
         # Effective load folds in this pump's own assignments (headroom
         # already decremented), so one burst spreads instead of piling
         # onto the momentarily-least-loaded replica.
         best = min(
-            views[rid].capacity - headroom[rid] for rid in candidates
+            views[rid].capacity - headroom[rid] for rid in pool
         )
         tied = [
-            rid for rid in candidates
+            rid for rid in pool
             if views[rid].capacity - headroom[rid] == best
         ]
         self._rr += 1
@@ -402,6 +435,30 @@ class ReplicaSet:
         #: recent router decision walls (the <1ms bench assertion reads
         #: the same numbers the histogram observes).
         self.decision_s: collections.deque = collections.deque(maxlen=4096)
+        # -- tail-latency hedging ------------------------------------------
+        # A deterministic (temperature=0), non-sticky request whose TTFT
+        # exceeds the set's adaptive percentile is speculatively re-issued
+        # on the next-healthiest replica; first token stream wins, the
+        # loser is cancelled through the exactly-once idx splice so the
+        # byte stream is identical either way.  Budgeted: hedges stay
+        # under COVALENT_TPU_HEDGE_BUDGET_PCT of issued requests.
+        self._hedge_enabled = os.environ.get(
+            "COVALENT_TPU_HEDGE", "on"
+        ).strip().lower() not in ("off", "0", "false", "disabled")
+        self._hedge_percentile = float(
+            os.environ.get("COVALENT_TPU_HEDGE_PERCENTILE", "95") or 95
+        )
+        self._hedge_min_s = float(
+            os.environ.get("COVALENT_TPU_HEDGE_MIN_S", "0.05") or 0.05
+        )
+        self._hedge_budget_pct = float(
+            os.environ.get("COVALENT_TPU_HEDGE_BUDGET_PCT", "5") or 5
+        )
+        #: recent time-to-first-token samples (both arms feed it).
+        self._ttft_ring: collections.deque = collections.deque(maxlen=512)
+        self._hedge_issued = 0
+        self._hedge_wins = 0
+        self._requests_issued = 0
 
     @staticmethod
     def _split_target(target: Any) -> tuple[Any, Any]:
@@ -477,14 +534,45 @@ class ReplicaSet:
             # before the worker would, so worker-side sheds only happen
             # to callers bypassing the set.
             capacity = max(1, sup.slots) + max(0, sup.queue_max)
+            st = HEALTH.state(sup.sid)
             views[rid] = ReplicaView(
                 rid,
                 open=sup.routable,
                 alive=sup.alive,
                 load=sup.in_flight,
                 capacity=capacity,
+                health=HEALTH.score(sup.sid),
+                degraded=(st == DEGRADED),
+                quarantined=(st == QUARANTINED),
             )
+            # Quarantined replicas only come back via a canary probe:
+            # allow_probe is single-flight with exponential dwell, so at
+            # most one cheap ping is in flight per quarantined replica.
+            if st == QUARANTINED and sup.alive and HEALTH.allow_probe(sup.sid):
+                self._spawn_canary(sup)
         return views
+
+    def _spawn_canary(self, sup: SessionSupervisor) -> None:
+        """Probe a quarantined replica with a cheap ping; report verdict."""
+
+        async def _probe() -> None:
+            ok = await sup.canary()
+            HEALTH.record_probe(sup.sid, ok)
+
+        try:
+            task = asyncio.ensure_future(_probe())
+        except RuntimeError:
+            # No running loop (sync status path) — release the probe slot
+            # so the next pump retries.
+            HEALTH.record_probe(sup.sid, False)
+            return
+        self._pump_tasks.add(task)
+        task.add_done_callback(
+            lambda t: (
+                self._pump_tasks.discard(t),
+                t.cancelled() or t.exception(),
+            )
+        )
 
     def status(self) -> dict[str, Any]:
         """The set's contribution to operator views (bench + smoke)."""
@@ -503,6 +591,12 @@ class ReplicaSet:
             "queued": self.router.queued,
             "sticky": self.router.sticky_count(),
             "router_decision_p50_ms": round(p50 * 1e3, 4),
+            "hedge": {
+                "enabled": self._hedge_enabled,
+                "issued": self._hedge_issued,
+                "wins": self._hedge_wins,
+                "threshold_s": round(self._hedge_threshold_s(), 4),
+            },
         }
 
     def _publish_replica_states(self) -> None:
@@ -754,6 +848,16 @@ class ReplicaSet:
         if id(item) not in placed:
             SERVE_ROUTER_DECISIONS_TOTAL.labels(outcome="queued").inc()
         await self._dispatch_assignments(assignments)
+        self._requests_issued += 1
+        if self._hedge_eligible(request):
+            task = asyncio.ensure_future(self._hedge_watch(request))
+            self._pump_tasks.add(task)
+            task.add_done_callback(
+                lambda t: (
+                    self._pump_tasks.discard(t),
+                    t.cancelled() or t.exception(),
+                )
+            )
         return request
 
     async def _prepare_request(self, request: ServeRequest) -> None:
@@ -825,6 +929,139 @@ class ReplicaSet:
             ))
             return
         self._schedule_pump()
+
+    # -- tail-latency hedging -----------------------------------------------
+
+    def _hedge_eligible(self, request: ServeRequest) -> bool:
+        """Only deterministic, un-pinned requests may hedge: a sampled
+        (temperature>0) stream would diverge between arms, and a sticky
+        request's KV/session locality belongs to its pinned replica."""
+        if not self._hedge_enabled or request.sticky:
+            return False
+        params = request.params or {}
+        if params.get("temperature"):
+            return False
+        return len([s for s in self._replicas.values() if s.alive]) > 1
+
+    def _hedge_threshold_s(self) -> float:
+        """Adaptive trigger: the set's recent TTFT percentile, floored at
+        COVALENT_TPU_HEDGE_MIN_S.  With too few samples the threshold is
+        deliberately conservative (1s) — warm-up latency is not a gray
+        failure."""
+        ring = sorted(self._ttft_ring)
+        if len(ring) < 8:
+            return max(self._hedge_min_s, 1.0)
+        k = min(
+            len(ring) - 1,
+            int(len(ring) * self._hedge_percentile / 100.0),
+        )
+        return max(self._hedge_min_s, ring[k])
+
+    async def _hedge_watch(self, request: ServeRequest) -> None:
+        """Arm the hedge timer for one request: if no first token lands
+        within the adaptive threshold, speculatively re-issue it on the
+        next-healthiest replica.  Both arms feed the TTFT ring."""
+        threshold = self._hedge_threshold_s()
+        t0 = time.monotonic()
+        try:
+            await asyncio.wait_for(request.first_token.wait(), threshold)
+        except asyncio.TimeoutError:
+            if not request.done and not self._closed:
+                await self._launch_hedge(request)
+        finally:
+            await request.first_token.wait()
+            self._ttft_ring.append(
+                request.ttft_s
+                if request.ttft_s is not None
+                else time.monotonic() - t0
+            )
+
+    async def _launch_hedge(self, request: ServeRequest) -> None:
+        """Issue the speculative second arm and arbitrate the winner.
+
+        The SAME ServeRequest is submitted to a second supervisor: both
+        arms feed one token buffer through the exactly-once idx splice,
+        so duplicate chunks drop and the stream is byte-identical no
+        matter which arm wins.  The first arm to deliver a token is the
+        winner (``request.served_by``); the loser's lane is released
+        with a fire-and-forget ``serve_cancel`` (``abandon``)."""
+        if self._hedge_issued + 1 > max(
+            1.0, self._requests_issued * self._hedge_budget_pct / 100.0
+        ):
+            SERVE_HEDGES_TOTAL.labels(outcome="budget").inc()
+            return
+        primary = next(
+            (
+                sup for sup in self._replicas.values()
+                if request.rid in sup._requests
+            ),
+            None,
+        )
+        views = self._views()
+        candidates = [
+            sup for rid, sup in self._replicas.items()
+            if sup.routable
+            and sup is not primary
+            and not views[rid].quarantined
+            and views[rid].capacity - views[rid].load > 0
+        ]
+        if not candidates:
+            SERVE_HEDGES_TOTAL.labels(outcome="no_target").inc()
+            return
+        candidates.sort(
+            key=lambda sup: (
+                HEALTH.rank(sup.sid),
+                -HEALTH.score(sup.sid),
+                sup.in_flight,
+            )
+        )
+        target = candidates[0]
+        request.hedged = True
+        self._hedge_issued += 1
+        SERVE_HEDGES_TOTAL.labels(outcome="launched").inc()
+        obs_events.emit(
+            "serve.hedge",
+            set=self.name,
+            rid=request.rid,
+            primary=(primary.sid if primary is not None else ""),
+            target=target.sid,
+        )
+        try:
+            await target.submit(
+                request, fail_on_error=False, wait_ready=False
+            )
+        except BaseException:
+            # The hedge arm failing to launch is not the request's
+            # problem — the primary is still streaming.
+            self._hedge_issued -= 1
+            SERVE_HEDGES_TOTAL.labels(outcome="no_target").inc()
+            return
+        await request.first_token.wait()
+        winner = request.served_by
+        if winner == target.sid:
+            self._hedge_wins += 1
+            SERVE_HEDGES_TOTAL.labels(outcome="won").inc()
+            if primary is not None:
+                primary.abandon(request.rid)
+                # The winner's TTFT lands on the winner's health record;
+                # the primary would otherwise accrue NO signal from a
+                # request that hedged away.  Charge it the censored
+                # observation (it had not delivered by now — a lower
+                # bound on its true TTFT) plus a straggler fault, so a
+                # replica losing hedge after hedge degrades instead of
+                # staying invisible to the health monitor.
+                if request.t_dispatched is not None:
+                    HEALTH.record_latency(
+                        primary.sid,
+                        time.monotonic() - request.t_dispatched,
+                        group=self.name,
+                    )
+                HEALTH.record_fault(
+                    primary.sid, label="hedge_lost", group=self.name
+                )
+        else:
+            SERVE_HEDGES_TOTAL.labels(outcome="lost").inc()
+            target.abandon(request.rid)
 
     # -- health hooks (supervisor callbacks, event-loop context) ------------
 

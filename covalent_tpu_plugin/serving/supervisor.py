@@ -37,6 +37,7 @@ from typing import Any, AsyncIterator, Callable
 from ..agent import HARNESS_BASENAME, AgentClient, AgentError
 from ..cache import bytes_digest, cas_path
 from ..fleet import journal as journal_mod
+from ..fleet.health import HEALTH
 from ..obs import events as obs_events
 from ..obs.trace import Span, context_of, record_span
 from ..resilience import FaultClass, RetryPolicy, classify_error
@@ -166,6 +167,15 @@ class ServeRequest:
         #: ``resumed_from + len(tokens)``, not ``len(tokens)`` alone.
         self.resumed_from = 0
         self.error: str = ""
+        #: sid of the supervisor whose stream fed this request's FIRST
+        #: fresh tokens.  With a hedge in flight two supervisors hold the
+        #: same request object; whichever feeds first is the winner and
+        #: the other arm is cancelled.  Duplicate chunks from the loser
+        #: splice to nothing, so the stream stays byte-equal regardless.
+        self.served_by = ""
+        #: True once a hedge copy of this request was issued (budget
+        #: accounting + at-most-one-hedge-per-request).
+        self.hedged = False
         self.t_submit = time.monotonic()
         self.t_first: float | None = None
         self.t_done: float | None = None
@@ -197,6 +207,9 @@ class ServeRequest:
             activate=False,
         ).__enter__()
         self._trace_done = False
+        #: set the moment the first fresh tokens (or any terminal) land —
+        #: the hedge watcher's TTFT deadline races this event.
+        self.first_token = asyncio.Event()
         self._chunks: asyncio.Queue = asyncio.Queue()
         self._done: asyncio.Future = asyncio.get_event_loop().create_future()
         # Unawaited failures must not warn at GC: a caller may only ever
@@ -246,11 +259,13 @@ class ServeRequest:
                 self.t_first = time.monotonic()
             self.tokens.extend(tokens)
             self._chunks.put_nowait(list(tokens))
+            self.first_token.set()
         if done:
             self.t_done = time.monotonic()
             self.error = error
             self._chunks.put_nowait(None)
             self._done.set_result(list(self.tokens))
+            self.first_token.set()
             self._finalize_trace()
 
     def _fail(self, err: BaseException) -> None:
@@ -259,6 +274,7 @@ class ServeRequest:
         self.t_done = time.monotonic()
         self._chunks.put_nowait(err)
         self._done.set_exception(err)
+        self.first_token.set()
         self.span.record_error(err)
         self._finalize_trace()
 
@@ -426,8 +442,17 @@ class SessionSupervisor:
         self._ready = asyncio.Event()
         self._supervisor: asyncio.Task | None = None
         self._counted_live = False
+        #: fire-and-forget wire tasks (hedge loser cancels) held here so
+        #: they are not collected mid-await.
+        self._bg_tasks: set = set()
 
     # -- identity / views ---------------------------------------------------
+
+    @property
+    def _health_group(self) -> str:
+        """Peer group for differential health scoring: the replica set
+        name when owned by one (peers = sibling replicas), else ''."""
+        return self.replica_of[0] if self.replica_of is not None else ""
 
     @property
     def state(self) -> str:
@@ -471,6 +496,8 @@ class SessionSupervisor:
         if self.replica_of is not None:
             view["replica_set"] = self.replica_of[0]
             view["replica"] = self.replica_of[1]
+        view["health_score"] = HEALTH.score(self.sid)
+        view["health_state"] = HEALTH.state(self.sid)
         for field in ("busy", "queued", "tokens_per_s", "tokens_total"):
             if field in self.stats:
                 view[field] = self.stats[field]
@@ -577,6 +604,11 @@ class SessionSupervisor:
                 set=self.replica_of[0], replica=self.replica_of[1]
             ).set(0)
         self._journal_binding()
+        # A re-adopted session starts at a NEUTRAL health score: the
+        # journal deliberately does not persist pre-crash scores, and a
+        # recovered fleet must never inherit a stale quarantine from its
+        # predecessor's (possibly fault-storm-polluted) view.
+        HEALTH.neutral(self.sid, group=self._health_group)
         self._supervisor = asyncio.ensure_future(self._supervise())
         self._ready.set()
         obs_events.emit(
@@ -1047,8 +1079,22 @@ class SessionSupervisor:
         # so callers see each token exactly once.
         fresh = tokens[have - idx:] if idx < have else tokens
         first = request.t_first is None and bool(fresh)
+        if first and not request.served_by:
+            # Hedge arbitration: the FIRST arm to feed fresh tokens wins
+            # the request; the replica set cancels the other arm.
+            request.served_by = self.sid
         done = bool(data.get("done"))
         error = str(data.get("error") or "")
+        if (
+            error == "cancelled"
+            and request.hedged
+            and request.served_by
+            and request.served_by != self.sid
+        ):
+            # The worker acked the cancel of a hedge-losing arm; the
+            # winning stream owns the request's terminal record.
+            self.abandon(rid)
+            return
         spec_s = data.get("spec_verify_s")
         if spec_s is not None:
             # Rides the final chunk from a speculative engine's harness;
@@ -1071,12 +1117,23 @@ class SessionSupervisor:
             SERVE_TTFT_SECONDS.observe(
                 request.ttft_s, trace_id=request.span.trace_id
             )
+            # Differential health feed: TTFT vs sibling replicas is the
+            # straggler signal a binary breaker never sees.
+            HEALTH.record_latency(
+                self.sid, request.ttft_s, group=self._health_group
+            )
         if done:
             outcome = "ok"
             if error == "deadline_exceeded":
                 outcome = "deadline"
             elif error:
                 outcome = "error"
+            if outcome == "ok":
+                HEALTH.record_success(self.sid, group=self._health_group)
+            elif outcome == "error":
+                HEALTH.record_fault(
+                    self.sid, label=error[:40], group=self._health_group
+                )
             self._finish(rid, outcome)
             if request.latency_s is not None:
                 SERVE_REQUEST_SECONDS.observe(
@@ -1093,6 +1150,7 @@ class SessionSupervisor:
             # Raced a dying generation; the reconnect replay will re-send
             # this request on the fresh session.
             return
+        HEALTH.record_fault(self.sid, label=code, group=self._health_group)
         self._finish(
             rid, "shed" if code == "serve_admission_shed" else "rejected"
         )
@@ -1118,6 +1176,10 @@ class SessionSupervisor:
         }
         SERVE_QUEUE_DEPTH.labels(session=self.sid).set(
             float(self.stats.get("queued") or 0)
+        )
+        HEALTH.record_queue_depth(
+            self.sid, float(self.stats.get("queued") or 0),
+            group=self._health_group,
         )
         SERVE_TOKENS_PER_S.labels(session=self.sid).set(
             float(self.stats.get("tokens_per_s") or 0.0)
@@ -1157,6 +1219,45 @@ class SessionSupervisor:
             )
             self._publish_in_flight()
             self._changed()
+
+    def abandon(self, rid: str) -> None:
+        """Drop one request ASSIGNMENT without failing the request object
+        or counting an outcome — the hedge-loser path: the same request
+        lives on (and completes) under the winning supervisor, so this
+        arm only releases its claim and frees the worker lane with a
+        fire-and-forget ``serve_cancel``.  Journaled as a ``stream_done``
+        so a successor dispatcher does not resume the dead arm."""
+        if self._requests.pop(rid, None) is None:
+            return
+        journal_mod.record(
+            "stream_done", sid=self.sid, rid=rid, outcome="hedge_abandoned",
+        )
+        self._publish_in_flight()
+        client, sid_g = self._client, self._sid_g
+        if client is not None and client.alive and not self._closed:
+            task = asyncio.ensure_future(client.serve_cancel(sid_g, rid))
+            self._bg_tasks.add(task)
+            task.add_done_callback(
+                lambda t: (
+                    self._bg_tasks.discard(t),
+                    None if t.cancelled() else t.exception(),
+                )
+            )
+        self._changed()
+
+    async def canary(self, timeout: float = 10.0) -> bool:
+        """Cheap readmission probe for a quarantined replica: one agent
+        ping round trip (no model work, no lane taken).  True means the
+        channel answers promptly — enough to readmit to PROBATION, where
+        real traffic re-earns (or re-loses) the health score."""
+        client = self._client
+        if client is None or not client.alive or self.state != "open":
+            return False
+        try:
+            await client.ping(timeout=timeout)
+            return True
+        except (AgentError, TransportError, asyncio.TimeoutError, OSError):
+            return False
 
     # -- warm handoff ---------------------------------------------------------
 
@@ -1307,7 +1408,11 @@ class SessionSupervisor:
             await self.executor._discard_workers(self._conns)
         except Exception:  # noqa: BLE001 - teardown is best-effort
             pass
-        fault, _label = classify_error(death)
+        fault, fault_label = classify_error(death)
+        HEALTH.record_fault(
+            self.sid, label=fault_label or fault.name.lower(),
+            group=self._health_group,
+        )
         failure: BaseException = death
         if fault is FaultClass.TRANSIENT:
             policy = RetryPolicy(
@@ -1445,6 +1550,7 @@ class SessionSupervisor:
             HISTORY.sample(force=True)
         except Exception:  # noqa: BLE001 - observability never fatal
             pass
+        HEALTH.drop(self.sid)
         SERVE_QUEUE_DEPTH.remove(session=self.sid)
         SERVE_TOKENS_PER_S.remove(session=self.sid)
         SERVE_PREFIX_HITS.remove(session=self.sid)

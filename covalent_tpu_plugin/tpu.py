@@ -70,6 +70,7 @@ from .cache import (
 )
 from .executor_base import RemoteExecutor
 from .fleet import journal as journal_mod
+from .fleet.health import HEALTH
 from .fleet.lease import GangLease
 from .obs import events as obs_events
 from .obs.flightrec import FLIGHT_RECORDER, ensure_flight_recorder
@@ -919,6 +920,7 @@ class TPUExecutor(RemoteExecutor):
             "serving": self.serve_sessions(),
             "in_flight": in_flight,
             "circuit_breakers": self._breakers.states(),
+            "health": HEALTH.snapshot(),
             "agents": {
                 address: (client.mode if client is not None else None)
                 for address, client in self._agents.items()
@@ -2083,6 +2085,12 @@ class TPUExecutor(RemoteExecutor):
         fresh = MONITOR.record(operation_id, worker, heartbeat)
         if not fresh:
             return
+        # Passive health feed: inter-arrival jitter on the SAME fresh
+        # beats the liveness monitor dedups — a worker whose cadence
+        # turns erratic loses health score before it ever misses one.
+        HEALTH.record_heartbeat(
+            worker, group=str(getattr(self, "tpu_name", "") or "")
+        )
         serve = heartbeat.get("serve")
         if isinstance(serve, dict):
             # A serving worker's beats carry its slot occupancy: surface
@@ -2489,6 +2497,9 @@ class TPUExecutor(RemoteExecutor):
             return i, code, sig
 
         waiters = [asyncio.ensure_future(exit_of(i)) for i in range(len(clients))]
+        #: worker index -> loop time its exit event landed (the gang
+        #: straggler differential reads these).
+        exit_at: dict[int, float] = {}
         try:
             addresses = self._worker_addresses()
             pending = set(waiters)
@@ -2541,17 +2552,19 @@ class TPUExecutor(RemoteExecutor):
                     except AgentError:
                         # Channel died, task lives on: resume by polling.
                         return await self._poll_all(conns, staged, pids)
+                    exit_at[i] = asyncio.get_running_loop().time()
                     if i == 0:
                         # Completion truth stays "result file exists", exactly
                         # like the polling path (reference: ssh.py:402-406).
                         status = await self.get_status(
                             conns[0], staged.remote_result_file, None
                         )
-                        return (
-                            TaskStatus.READY
-                            if status is TaskStatus.READY
-                            else TaskStatus.DEAD
-                        ), 0
+                        if status is TaskStatus.READY:
+                            self._note_gang_stragglers(
+                                op, addresses, exit_at
+                            )
+                            return TaskStatus.READY, 0
+                        return TaskStatus.DEAD, 0
                     if code != 0:
                         # Before blaming worker i, check whether worker 0
                         # already delivered (its exit event may just be in a
@@ -2568,6 +2581,94 @@ class TPUExecutor(RemoteExecutor):
         finally:
             for task in waiters:
                 task.cancel()
+
+    def _note_gang_stragglers(
+        self,
+        operation_id: str,
+        addresses: list[str],
+        exit_at: dict[int, float],
+    ) -> None:
+        """Differential straggler detection on a completed gang launch.
+
+        A gang is only as fast as its slowest worker; a worker whose
+        exit lags the gang median by more than
+        ``COVALENT_TPU_STRAGGLER_BUDGET_S`` (default 5s, ``0`` disables)
+        is gray-failing even though it finished.  Flagging it feeds the
+        health monitor (deprioritized in future placement) and, with
+        ``COVALENT_TPU_STRAGGLER_REDIAL`` on, evicts its pooled channel
+        so the next electron dials fresh instead of reusing a path that
+        may be the real culprit.
+        """
+        if len(exit_at) < 2:
+            return
+        try:
+            budget = float(
+                os.environ.get("COVALENT_TPU_STRAGGLER_BUDGET_S", "5") or 5
+            )
+        except ValueError:
+            budget = 5.0
+        if budget <= 0:
+            return
+        times = sorted(exit_at.values())
+        median = times[len(times) // 2]
+        slowest_i = max(exit_at, key=lambda i: exit_at[i])
+        differential = exit_at[slowest_i] - median
+        if differential <= budget:
+            return
+        worker = (
+            addresses[slowest_i]
+            if slowest_i < len(addresses)
+            else f"worker-{slowest_i}"
+        )
+        HEALTH.flag_straggler(
+            worker, differential, operation_id=operation_id,
+            gang_size=len(exit_at),
+        )
+        if os.environ.get(
+            "COVALENT_TPU_STRAGGLER_REDIAL", ""
+        ).strip().lower() in ("1", "on", "true", "yes"):
+            task = asyncio.ensure_future(self._redial_straggler(worker))
+            task.add_done_callback(
+                lambda t: None if t.cancelled() else t.exception()
+            )
+
+    async def health_canary(self, address: str) -> bool:
+        """Cheap gray-failure readmission probe for one worker: a single
+        agent ping round trip (no task, no slot).  The fleet scheduler
+        calls this through the pool while a worker is health-quarantined;
+        True readmits it to PROBATION where real traffic re-earns (or
+        re-loses) its score."""
+        client = self._agents.get(address)
+        if client is None or not client.alive:
+            return False
+        try:
+            await client.ping(timeout=10.0)
+            return True
+        except (AgentError, TransportError, asyncio.TimeoutError, OSError):
+            return False
+
+    async def _redial_straggler(self, address: str) -> None:
+        """Evict one straggling worker's pooled channel (eager redial).
+
+        Scoped single-address analog of :meth:`_discard_workers`: the
+        NEXT electron re-dials, re-preflights, and re-probes CAS on a
+        fresh channel — a slow transport path (degraded NIC, dying SSH
+        mux) stops taxing every subsequent gang.
+        """
+        await self._drain_cleanup_tasks()
+        key = self._pool_key(address)
+        discarded = await self._pool.discard(key)
+        client = self._agents.pop(address, None)
+        if client is not None:
+            await client.close()
+        self._preflighted.discard(key)
+        self._wire_codecs.pop(key, None)
+        self._cas.forget(key)
+        obs_events.emit(
+            "fleet.straggler_redial",
+            worker=address,
+            discarded=bool(discarded),
+        )
 
     async def get_status(
         self,

@@ -35,6 +35,15 @@ silently injecting nothing would fake a green resilience test):
   exactly the Cloud TPU preemption sequence.
 * ``preempt_grace``   — seconds between the SIGTERM notice and channel
   death (default 1.0).
+* ``jitter``          — seeded uniform extra latency in ``[0, jitter)``
+  seconds added per op (gray mode: the link is alive but noisy).
+* ``p_slow``          — probability an op hits the heavy tail: it sleeps
+  ``slow_factor × max(delay, jitter, 0.01)`` seconds instead of its
+  normal latency (gray mode: a browned-out worker, not a dead one).
+* ``slow_factor``     — tail multiplier for ``p_slow`` (default 10).
+* ``p_drop_op``       — probability a single op fails with a transport
+  error WITHOUT killing the channel (gray mode: lossy-but-alive; the
+  next op on the same transport works).
 * ``max_faults``      — process-wide budget across ALL injected faults.
 
 Every injected fault emits a ``chaos.fault`` event and increments
@@ -69,7 +78,10 @@ _INT_KEYS = (
     "seed", "connect_errors", "run_errors", "drop_after",
     "drop_match_skip", "truncate_uploads", "max_faults", "preempt_after",
 )
-_FLOAT_KEYS = ("delay", "p_connect_error", "p_run_error", "preempt_grace")
+_FLOAT_KEYS = (
+    "delay", "p_connect_error", "p_run_error", "preempt_grace",
+    "jitter", "p_slow", "slow_factor", "p_drop_op",
+)
 _STR_KEYS = ("drop_match",)
 
 
@@ -96,6 +108,10 @@ class ChaosPlan:
         max_faults: int = 0,
         preempt_after: int = 0,
         preempt_grace: float = 1.0,
+        jitter: float = 0.0,
+        p_slow: float = 0.0,
+        slow_factor: float = 10.0,
+        p_drop_op: float = 0.0,
     ) -> None:
         self.seed = int(seed)
         self.delay = float(delay)
@@ -110,9 +126,14 @@ class ChaosPlan:
         self.max_faults = int(max_faults)  # 0 = unbounded
         self.preempt_after = int(preempt_after)
         self.preempt_grace = float(preempt_grace)
+        self.jitter = float(jitter)
+        self.p_slow = float(p_slow)
+        self.slow_factor = float(slow_factor)
+        self.p_drop_op = float(p_drop_op)
         self.rng = random.Random(self.seed)
         self.faults_injected = 0
         self._match_seen = 0
+        self._jitter_announced = False
 
     @property
     def active(self) -> bool:
@@ -121,8 +142,13 @@ class ChaosPlan:
             self.delay > 0, self.connect_errors > 0, self.p_connect_error > 0,
             self.run_errors > 0, self.p_run_error > 0, self.drop_after > 0,
             self.drop_match, self.truncate_uploads > 0,
-            self.preempt_after > 0,
+            self.preempt_after > 0, self.jitter > 0, self.p_slow > 0,
+            self.p_drop_op > 0,
         ))
+
+    def slow_tail_s(self) -> float:
+        """Seconds the heavy tail sleeps when a ``p_slow`` fault fires."""
+        return self.slow_factor * max(self.delay, self.jitter, 0.01)
 
     def take_fault(self, kind: str, **detail: Any) -> bool:
         """Consume one unit of fault budget; False when the budget is spent."""
@@ -233,8 +259,33 @@ class ChaosTransport(Transport):
             )
         if self.plan.delay > 0:
             await asyncio.sleep(self.plan.delay)
+        if self.plan.jitter > 0:
+            # Gray noise: seeded uniform extra latency on every op.  One
+            # announcing fault (the first) rather than one per op — the
+            # budget is for discrete faults, not continuous noise.
+            if not self.plan._jitter_announced:
+                self.plan._jitter_announced = True
+                self.plan.take_fault(
+                    "jitter", address=self.address, jitter_s=self.plan.jitter
+                )
+            await asyncio.sleep(self.plan.rng.random() * self.plan.jitter)
         self.ops += 1
         plan = self.plan
+        if plan.p_slow > 0 and plan.rng.random() < plan.p_slow:
+            if plan.take_fault(
+                "slow", address=self.address, op=op,
+                slow_s=round(plan.slow_tail_s(), 3),
+            ):
+                # Heavy tail: the op completes, just brutally late — the
+                # gray failure a binary breaker never sees.
+                await asyncio.sleep(plan.slow_tail_s())
+        if plan.p_drop_op > 0 and plan.rng.random() < plan.p_drop_op:
+            if plan.take_fault("drop_op", address=self.address, op=op):
+                # Lossy-but-alive: THIS op fails, the channel survives.
+                raise TransportError(
+                    f"chaos: op {op} dropped on {self.address} "
+                    "(channel still alive)"
+                )
         if (
             plan.preempt_after
             and not self._preempted

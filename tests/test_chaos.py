@@ -385,3 +385,112 @@ def test_preempt_after_sigterm_then_grace_then_drop(tmp_path, run_async):
         if worker.poll() is None:
             worker.kill()
             worker.wait()
+
+
+# ---------------------------------------------------------------------------
+# gray modes: alive-but-degraded fault injection
+
+
+def test_gray_spec_parses_and_is_seed_deterministic():
+    """The gray keys ride the same spec grammar, and two plans with the
+    same seed replay the identical probabilistic fault sequence — a
+    flaky brownout is worthless as a regression fixture."""
+    from covalent_tpu_plugin.transport.chaos import plan_from_spec
+
+    plan = plan_from_spec(
+        "seed=11,jitter=0.02,p_slow=0.5,slow_factor=3,p_drop_op=0.1"
+    )
+    assert plan.seed == 11
+    assert plan.jitter == pytest.approx(0.02)
+    assert plan.p_slow == pytest.approx(0.5)
+    assert plan.slow_factor == pytest.approx(3.0)
+    assert plan.p_drop_op == pytest.approx(0.1)
+    assert plan.active
+    # slow tail = slow_factor x max(delay, jitter, 0.01).
+    assert plan.slow_tail_s() == pytest.approx(3 * 0.02)
+    twin = plan_from_spec(
+        "seed=11,jitter=0.02,p_slow=0.5,slow_factor=3,p_drop_op=0.1"
+    )
+    assert [plan.rng.random() for _ in range(16)] == [
+        twin.rng.random() for _ in range(16)
+    ]
+    with pytest.raises(ValueError):
+        plan_from_spec("jittery=0.02")  # typos fail loudly, not silently
+
+
+def test_gray_p_drop_op_fails_op_but_channel_survives(run_async):
+    """Lossy-but-alive: a dropped op raises, the NEXT op on the same
+    transport works — no channel death, no breaker trip by itself."""
+    from covalent_tpu_plugin.transport.base import TransportError
+    from covalent_tpu_plugin.transport.chaos import ChaosPlan, ChaosTransport
+
+    class Inner:
+        address = "fake-host"
+
+    plan = ChaosPlan(seed=3, p_drop_op=1.0, max_faults=1)
+    chaos = ChaosTransport(Inner(), plan)
+    faults_before = counter_value(
+        "covalent_tpu_chaos_faults_total", kind="drop_op"
+    )
+
+    async def flow():
+        with pytest.raises(TransportError):
+            await chaos._gate("run", "echo a")
+        assert not chaos.dead
+        # Budget spent: the channel keeps working from here on.
+        await chaos._gate("run", "echo b")
+        await chaos._gate("run", "echo c")
+
+    run_async(flow())
+    assert counter_value(
+        "covalent_tpu_chaos_faults_total", kind="drop_op"
+    ) == faults_before + 1
+
+
+def test_gray_p_slow_sleeps_heavy_tail_and_completes(run_async):
+    """The p_slow heavy tail delays the op (slow_factor x jitter floor)
+    without failing it — the brownout a binary breaker never sees."""
+    from covalent_tpu_plugin.transport.chaos import ChaosPlan, ChaosTransport
+
+    class Inner:
+        address = "fake-host"
+
+    plan = ChaosPlan(seed=5, p_slow=1.0, slow_factor=5, max_faults=1)
+    chaos = ChaosTransport(Inner(), plan)
+    assert plan.slow_tail_s() == pytest.approx(0.05)  # 5 x 0.01 floor
+
+    async def flow():
+        t0 = time.monotonic()
+        await chaos._gate("run", "echo slow")
+        return time.monotonic() - t0
+
+    elapsed = run_async(flow())
+    assert elapsed >= 0.05
+    assert not chaos.dead
+
+
+def test_worker_side_gray_plan_parses_only_gray_keys(monkeypatch):
+    """The harness's decode-loop brownout reads the SAME env spec but
+    only its gray keys: transport-only keys are ignored (not rejected —
+    they are the transport's to validate), and a spec with no gray mode
+    yields no plan at all."""
+    from covalent_tpu_plugin.harness import _gray_chaos_from_env
+
+    monkeypatch.setenv(
+        "COVALENT_TPU_CHAOS",
+        "seed=7,jitter=0.02,p_slow=0.6,slow_factor=40,drop_match=if test",
+    )
+    gray = _gray_chaos_from_env()
+    assert gray is not None
+    assert gray["jitter"] == pytest.approx(0.02)
+    assert gray["p_slow"] == pytest.approx(0.6)
+    assert gray["slow_s"] == pytest.approx(40 * 0.02)
+    # Seeded: two parses replay the same sequence.
+    twin = _gray_chaos_from_env()
+    assert [gray["rng"].random() for _ in range(8)] == [
+        twin["rng"].random() for _ in range(8)
+    ]
+    monkeypatch.setenv("COVALENT_TPU_CHAOS", "drop_match=if test -f")
+    assert _gray_chaos_from_env() is None
+    monkeypatch.delenv("COVALENT_TPU_CHAOS")
+    assert _gray_chaos_from_env() is None

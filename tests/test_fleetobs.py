@@ -112,6 +112,42 @@ def test_monitor_disabled_threshold_never_stalls():
     assert monitor.stalled("op") == []
 
 
+def test_monitor_jitter_adaptive_stall_threshold():
+    """A worker whose beats arrive erratically widens its own stall
+    deadline (3 x observed mean gap + K x std, floored at the configured
+    stall_after) instead of tripping a false stall; a steady beater keeps
+    the configured floor; and a genuinely wedged erratic worker still
+    trips once its silence outgrows the learned statistics."""
+    now = [0.0]
+    monitor = HeartbeatMonitor(clock=lambda: now[0])
+    monitor.watch("op", stall_after=2.0)
+    # Fewer than ADAPTIVE_MIN_BEATS gaps: the configured floor rules.
+    monitor.record("op", "steady", {"seq": 1})
+    assert monitor.effective_stall_after("op", "steady") == 2.0
+    for seq, gap in enumerate([0.5] * 6, start=2):
+        now[0] += gap
+        monitor.record("op", "steady", {"seq": seq})
+    # Steady cadence (0.5s gaps, ~zero std): 3 x 0.5 < 2.0 -> floor.
+    assert monitor.effective_stall_after("op", "steady") == 2.0
+    # An erratic-but-alive worker: gaps oscillating around 1.5s with
+    # ~1.4s swings learn a deadline well past the configured 2s.
+    monitor.record("op", "erratic", {"seq": 1})
+    for seq, gap in enumerate([0.1, 2.9, 0.2, 2.8, 0.1, 2.9], start=2):
+        now[0] += gap
+        monitor.record("op", "erratic", {"seq": seq})
+    widened = monitor.effective_stall_after("op", "erratic")
+    assert widened > 2.0
+    # Silence past the FLOOR but inside the widened deadline: no stall —
+    # this exact pattern used to false-positive under fixed thresholds.
+    now[0] += 2.5
+    assert "erratic" not in [w for w, _ in monitor.stalled("op")]
+    # Silence past the widened deadline: the detector still fires — the
+    # learned statistics freeze while the silence keeps growing.
+    now[0] += widened
+    assert "erratic" in [w for w, _ in monitor.stalled("op")]
+    monitor.forget("op")
+
+
 def test_worker_stalled_error_classification():
     fault, reason = classify_error(WorkerStalledError("silent"))
     assert fault is FaultClass.TRANSIENT

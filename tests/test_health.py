@@ -1,0 +1,333 @@
+"""Gray-failure health scoring: the fleet's continuous sense organ.
+
+Unit tier over :class:`~covalent_tpu_plugin.fleet.health.HealthMonitor`
+with an injected fake clock: differential (vs-group-median) latency
+scoring, heartbeat-jitter penalties, the four-state machine's full
+HEALTHY -> PROBATION -> DEGRADED -> QUARANTINED walk, canary readmission
+(single-flight, exponential dwell, probation-not-healthy on success),
+the crash-recovery neutral reset (the "no stale quarantines" regression),
+metric-series reaping, and the gang straggler differential detector on
+the executor.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from covalent_tpu_plugin.fleet.health import (
+    DEGRADED,
+    HEALTHY,
+    PROBATION,
+    PROBING,
+    QUARANTINED,
+    HealthMonitor,
+)
+from covalent_tpu_plugin.obs.metrics import REGISTRY
+
+
+class FakeClock:
+    def __init__(self, start: float = 1000.0) -> None:
+        self.now = start
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float) -> None:
+        self.now += dt
+
+
+def make_monitor(clock=None, min_samples=3, cooldown_s=10.0):
+    monitor = HealthMonitor(clock=clock or FakeClock())
+    monitor.min_samples = min_samples
+    monitor.cooldown_s = cooldown_s
+    return monitor
+
+
+def counter_value(name: str, **labels) -> float:
+    metric = REGISTRY.get(name)
+    if metric is None:
+        return 0.0
+    return sum(
+        c.value for lbls, c in metric._series()
+        if all(lbls.get(k) == v for k, v in labels.items())
+    )
+
+
+def gauge_series(name: str) -> dict[str, float]:
+    metric = REGISTRY.get(name)
+    if metric is None:
+        return {}
+    return {
+        dict(labels).get("target", ""): g.value
+        for labels, g in metric._series()
+    }
+
+
+# ---------------------------------------------------------------------------
+# scoring
+
+
+def test_differential_latency_scores_relative_to_group_median():
+    """A target 10x slower than its peer median scores low; the peers —
+    equally 'slow' in absolute terms on a slow pool — stay near 1.0.
+    Absolute latency is meaningless across heterogeneous fleets."""
+    monitor = make_monitor()
+    for _ in range(4):
+        monitor.record_latency("a", 0.1, group="g")
+        monitor.record_latency("b", 0.1, group="g")
+        monitor.record_latency("slow", 1.0, group="g")
+    assert monitor.score("a") == pytest.approx(1.0)
+    assert monitor.score("b") == pytest.approx(1.0)
+    # lat component = median(0.1) / ewma(1.0) = 0.1 -> heavily penalized.
+    assert monitor.score("slow") < 0.65
+    assert monitor.score("slow") == pytest.approx(
+        0.45 * 0.1 + 0.15 + 0.30 + 0.10, abs=0.02
+    )
+
+
+def test_ungrouped_target_is_not_latency_penalized():
+    """Without a peer group there is no median to differ from: latency
+    alone never dings a lone target (faults/jitter still can)."""
+    monitor = make_monitor()
+    for _ in range(6):
+        monitor.record_latency("lonely", 30.0)
+    assert monitor.score("lonely") == pytest.approx(1.0)
+    assert monitor.state("lonely") == HEALTHY
+
+
+def test_min_samples_gates_the_latency_judgment():
+    """Below min_samples the differential term stays neutral — one cold
+    first op must not probation a fresh replica."""
+    monitor = make_monitor(min_samples=5)
+    for _ in range(4):
+        monitor.record_latency("peer", 0.1, group="g")
+    monitor.record_latency("cold", 5.0, group="g")  # 1 sample < 5
+    assert monitor.score("cold") == pytest.approx(1.0)
+    assert monitor.state("cold") == HEALTHY
+
+
+def test_heartbeat_jitter_lowers_score():
+    """Erratic inter-arrival gaps (cv ~ 1) cost the jitter weight; a
+    steady beat costs nothing."""
+    clock = FakeClock()
+    monitor = make_monitor(clock=clock)
+    for _ in range(10):
+        clock.advance(1.0)
+        monitor.record_heartbeat("steady")
+    gaps = [0.1, 3.0, 0.1, 2.5, 0.2, 3.5, 0.1, 2.8, 0.15, 3.2]
+    for gap in gaps:
+        clock.advance(gap)
+        monitor.record_heartbeat("erratic")
+    snap = monitor.snapshot()
+    assert snap["steady"]["hb_jitter_cv"] == pytest.approx(0.0, abs=0.01)
+    assert snap["erratic"]["hb_jitter_cv"] > 0.5
+    assert monitor.score("steady") > monitor.score("erratic")
+
+
+def test_faults_decay_and_successes_heal():
+    monitor = make_monitor()
+    monitor.record_fault("w", label="rpc_channel")
+    after_one = monitor.score("w")
+    assert after_one == pytest.approx(1.0 - 0.30 * 0.34, abs=0.01)
+    for _ in range(5):
+        monitor.record_success("w")
+    assert monitor.score("w") == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# state machine
+
+
+def brown_out(monitor, clock, key="bad", peers=("a", "b")):
+    """Drive one target through the full gray decline: differential
+    latency -> PROBATION, sustained -> DEGRADED, faults on top ->
+    QUARANTINED.  Returns after quarantine."""
+    for _ in range(4):
+        for peer in peers:
+            monitor.record_latency(peer, 0.1, group="g")
+        monitor.record_latency(key, 1.0, group="g")
+    assert monitor.state(key) == PROBATION
+    # Probation graduates to degraded only when the low score SUSTAINS
+    # past cooldown/2 — a single spike never escalates.
+    clock.advance(monitor.cooldown_s / 2 + 0.1)
+    monitor.record_latency(key, 1.0, group="g")
+    assert monitor.state(key) == DEGRADED
+    for _ in range(3):
+        monitor.record_fault(key, label="worker_stalled")
+    assert monitor.state(key) == QUARANTINED
+
+
+def test_state_machine_walks_probation_degraded_quarantined():
+    clock = FakeClock()
+    monitor = make_monitor(clock=clock)
+    brown_out(monitor, clock)
+    assert monitor.rank("bad") == 3
+    assert monitor.quarantined("bad")
+    assert monitor.degraded("bad")
+    assert monitor.rank("a") == 0
+
+
+def test_probation_recovers_to_healthy_without_escalating():
+    """A transient dip that recovers before cooldown/2 goes straight
+    back to HEALTHY — no degraded detour, no quarantine."""
+    clock = FakeClock()
+    monitor = make_monitor(clock=clock)
+    for _ in range(4):
+        monitor.record_latency("a", 0.1, group="g")
+        monitor.record_latency("b", 0.1, group="g")
+        monitor.record_latency("dip", 1.0, group="g")
+    assert monitor.state("dip") == PROBATION
+    # Latency recovers: EWMA converges back toward the peer median.
+    for _ in range(20):
+        monitor.record_latency("dip", 0.1, group="g")
+    assert monitor.state("dip") == HEALTHY
+
+
+def test_quarantine_exits_only_through_the_canary():
+    """No passive signal readmits a quarantined target: successes and
+    fast latencies are ignored until a canary probe passes."""
+    clock = FakeClock()
+    monitor = make_monitor(clock=clock)
+    brown_out(monitor, clock)
+    for _ in range(10):
+        monitor.record_success("bad")
+        monitor.record_latency("bad", 0.05, group="g")
+    assert monitor.state("bad") == QUARANTINED
+
+
+def test_canary_single_flight_and_probation_readmission():
+    clock = FakeClock()
+    monitor = make_monitor(clock=clock)
+    brown_out(monitor, clock)
+    # Inside the dwell window: no probe yet.
+    assert not monitor.allow_probe("bad")
+    clock.advance(monitor.cooldown_s + 0.1)
+    assert monitor.allow_probe("bad")
+    assert monitor.state("bad") == PROBING
+    # Single-flight: a second prober in the same window is refused.
+    assert not monitor.allow_probe("bad")
+    monitor.record_probe("bad", ok=True)
+    # Canary ok readmits to PROBATION, not HEALTHY — the score must be
+    # re-earned by real traffic (signals were reset to neutral).
+    assert monitor.state("bad") == PROBATION
+    assert monitor.score("bad") == pytest.approx(1.0)
+    monitor.record_success("bad")
+    assert monitor.state("bad") == HEALTHY
+
+
+def test_failed_canary_requarantines_with_exponential_dwell():
+    clock = FakeClock()
+    monitor = make_monitor(clock=clock)
+    brown_out(monitor, clock)
+    clock.advance(monitor.cooldown_s + 0.1)
+    assert monitor.allow_probe("bad")
+    monitor.record_probe("bad", ok=False)
+    assert monitor.state("bad") == QUARANTINED
+    # Round 2: the dwell doubled — one cooldown is no longer enough.
+    clock.advance(monitor.cooldown_s + 0.1)
+    assert not monitor.allow_probe("bad")
+    clock.advance(monitor.cooldown_s)
+    assert monitor.allow_probe("bad")
+
+
+def test_neutral_clears_stale_quarantine():
+    """The crash-recovery regression: a re-adopted session / re-dialed
+    worker starts NEUTRAL — the restarted control plane must never
+    inherit the dead incarnation's quarantine verdicts."""
+    clock = FakeClock()
+    monitor = make_monitor(clock=clock)
+    brown_out(monitor, clock)
+    assert monitor.state("bad") == QUARANTINED
+    monitor.neutral("bad")
+    assert monitor.state("bad") == HEALTHY
+    assert monitor.score("bad") == pytest.approx(1.0)
+    assert monitor.rank("bad") == 0
+    # And the group memory is kept so differential scoring resumes.
+    assert monitor.snapshot()["bad"]["group"] == "g"
+
+
+def test_disabled_env_freezes_the_state_machine(monkeypatch):
+    monkeypatch.setenv("COVALENT_TPU_HEALTH", "off")
+    clock = FakeClock()
+    monitor = make_monitor(clock=clock)
+    for _ in range(4):
+        monitor.record_latency("a", 0.1, group="g")
+        monitor.record_latency("b", 0.1, group="g")
+        monitor.record_latency("bad", 5.0, group="g")
+    for _ in range(5):
+        monitor.record_fault("bad")
+    assert monitor.state("bad") == HEALTHY
+
+
+def test_transition_counter_and_state_gauge_move():
+    clock = FakeClock()
+    monitor = make_monitor(clock=clock)
+    before = counter_value(
+        "covalent_tpu_health_transitions_total", to="quarantined"
+    )
+    brown_out(monitor, clock, key="metricbad", peers=("ma", "mb"))
+    after = counter_value(
+        "covalent_tpu_health_transitions_total", to="quarantined"
+    )
+    assert after == before + 1
+    assert gauge_series("covalent_tpu_health_state")["metricbad"] == 3
+    monitor.reset()
+
+
+def test_drop_reaps_metric_series():
+    """A released target's score/state series must not haunt /metrics."""
+    monitor = make_monitor()
+    monitor.record_fault("ghost")
+    assert "ghost" in gauge_series("covalent_tpu_health_score")
+    monitor.drop("ghost")
+    assert "ghost" not in gauge_series("covalent_tpu_health_score")
+    assert "ghost" not in gauge_series("covalent_tpu_health_state")
+    assert monitor.state("ghost") == HEALTHY  # forgotten, not quarantined
+
+
+# ---------------------------------------------------------------------------
+# gang straggler detection (executor-side differential)
+
+
+def test_gang_straggler_flagged_and_fault_charged(monkeypatch):
+    from covalent_tpu_plugin.fleet.health import HEALTH
+    from covalent_tpu_plugin.tpu import TPUExecutor
+
+    monkeypatch.delenv("COVALENT_TPU_STRAGGLER_BUDGET_S", raising=False)
+    monkeypatch.delenv("COVALENT_TPU_STRAGGLER_REDIAL", raising=False)
+    HEALTH.drop("w2")
+    ex = TPUExecutor.__new__(TPUExecutor)  # detector needs no dial state
+    before = counter_value("covalent_tpu_stragglers_total", worker="w2")
+    ex._note_gang_stragglers(
+        "op-1", ["w0", "w1", "w2"], {0: 10.0, 1: 10.2, 2: 18.0}
+    )
+    # w2 exited 7.8s past the gang median (10.2) — over the 5s budget.
+    assert counter_value(
+        "covalent_tpu_stragglers_total", worker="w2"
+    ) == before + 1
+    assert HEALTH.snapshot()["w2"]["fault_score"] < 1.0
+    HEALTH.drop("w2")
+
+
+def test_gang_straggler_within_budget_not_flagged(monkeypatch):
+    from covalent_tpu_plugin.tpu import TPUExecutor
+
+    monkeypatch.setenv("COVALENT_TPU_STRAGGLER_BUDGET_S", "5")
+    ex = TPUExecutor.__new__(TPUExecutor)
+    before = counter_value("covalent_tpu_stragglers_total")
+    ex._note_gang_stragglers(
+        "op-2", ["w0", "w1"], {0: 10.0, 1: 14.0}  # 4s < 5s budget
+    )
+    assert counter_value("covalent_tpu_stragglers_total") == before
+
+
+def test_gang_straggler_budget_zero_disables(monkeypatch):
+    from covalent_tpu_plugin.tpu import TPUExecutor
+
+    monkeypatch.setenv("COVALENT_TPU_STRAGGLER_BUDGET_S", "0")
+    ex = TPUExecutor.__new__(TPUExecutor)
+    before = counter_value("covalent_tpu_stragglers_total")
+    ex._note_gang_stragglers(
+        "op-3", ["w0", "w1"], {0: 1.0, 1: 500.0}
+    )
+    assert counter_value("covalent_tpu_stragglers_total") == before

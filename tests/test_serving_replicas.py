@@ -678,3 +678,211 @@ def test_rank_targets_prefers_digest_affinity():
     rset._targets = [(cold, StubPool()), (cold, pool_holder)]
     rset._placements = {}
     assert rset._rank_targets()[0][1] is pool_holder
+
+
+# ---------------------------------------------------------------------------
+# gray-failure health routing + tail-latency hedging
+
+
+def test_router_degraded_replica_is_last_resort():
+    """A gray-degraded replica receives work only when every healthy
+    replica is out of headroom — least-loaded must never steer traffic
+    onto the browned-out replica just because it drained (slowly)."""
+    router = ReplicaRouter(clock=FakeClock())
+    views = {
+        "gray": ReplicaView(
+            "gray", open=True, load=0, capacity=4, degraded=True
+        ),
+        "busy": ReplicaView("busy", open=True, load=3, capacity=4),
+    }
+    router.submit(item())
+    [(_, replica, _)] = router.pump(views)
+    assert replica == "busy"
+    # Healthy capacity exhausted: the degraded replica is still better
+    # than shedding.
+    views["busy"] = ReplicaView("busy", open=True, load=4, capacity=4)
+    router.submit(item())
+    [(_, replica, _)] = router.pump(views)
+    assert replica == "gray"
+
+
+def test_router_quarantined_gets_no_traffic():
+    """Quarantined replicas are excluded from headroom entirely; with no
+    other lane the item defers rather than landing on one."""
+    router = ReplicaRouter(clock=FakeClock())
+    views = {
+        "q": ReplicaView(
+            "q", open=True, load=0, capacity=4, quarantined=True
+        ),
+    }
+    router.submit(item())
+    assert router.pump(views) == []
+    # The item survived the deferral and places once a healthy lane opens.
+    views["ok"] = ReplicaView("ok", open=True, load=0, capacity=4)
+    [(_, replica, _)] = router.pump(views)
+    assert replica == "ok"
+
+
+def test_router_sticky_drains_off_quarantined_replica():
+    """A sticky pin to a quarantined replica does NOT wait out the
+    quarantine (a reconnect that never comes): the request re-places on
+    a healthy replica and the pin moves with it."""
+    router = ReplicaRouter(clock=FakeClock())
+    router.pin("sess", "q")
+    views = {
+        "q": ReplicaView(
+            "q", open=True, load=0, capacity=4, alive=True,
+            quarantined=True,
+        ),
+        "ok": ReplicaView("ok", open=True, load=2, capacity=4),
+    }
+    router.submit(item(sticky="sess"))
+    [(_, replica, outcome)] = router.pump(views)
+    assert replica == "ok"
+    assert outcome == "least_loaded"
+    assert router.sticky_target("sess") == "ok"
+    # Merely-reconnecting (alive, not quarantined) still waits — the
+    # drain is a health verdict, not a liveness one.
+    router.pin("sess2", "down")
+    views["down"] = ReplicaView(
+        "down", open=False, load=0, capacity=4, alive=True
+    )
+    router.submit(item(sticky="sess2"))
+    assert router.pump(views) == []
+    assert router.sticky_target("sess2") == "down"
+
+
+def test_hedge_threshold_adapts_to_ttft_ring(monkeypatch):
+    """Below 8 samples the trigger is a conservative 1s; with a warm ring
+    it tracks the configured percentile, floored at HEDGE_MIN_S."""
+    from covalent_tpu_plugin.serving.replicas import ReplicaSet
+
+    monkeypatch.setenv("COVALENT_TPU_HEDGE_PERCENTILE", "90")
+    monkeypatch.setenv("COVALENT_TPU_HEDGE_MIN_S", "0.05")
+    rset = ReplicaSet.__new__(ReplicaSet)
+    rset._hedge_enabled = True
+    rset._hedge_percentile = 90.0
+    rset._hedge_min_s = 0.05
+    rset._ttft_ring = __import__("collections").deque(maxlen=512)
+    assert rset._hedge_threshold_s() == 1.0
+    for ttft in [0.1] * 18 + [0.9, 0.95]:
+        rset._ttft_ring.append(ttft)
+    # p90 over [0.1 x18, 0.9, 0.95] = 0.9.
+    assert rset._hedge_threshold_s() == pytest.approx(0.9)
+    # The floor wins when the fleet is uniformly fast.
+    rset._ttft_ring.clear()
+    rset._ttft_ring.extend([0.001] * 20)
+    assert rset._hedge_threshold_s() == pytest.approx(0.05)
+
+
+def test_hedge_exactly_once_byte_equal_loser_cancelled(
+    tmp_path, run_async, monkeypatch
+):
+    """End-to-end tail-latency hedge through real pool servers: one
+    replica browned out (first token delayed far past the 1s cold
+    threshold), its request speculatively re-issued on the healthy
+    replica, first token wins, the loser is abandoned mid-generation —
+    and the merged stream is byte-equal to the expected tokens, exactly
+    once, for EVERY request."""
+    from covalent_tpu_plugin.fleet.health import HEALTH
+    from covalent_tpu_plugin.serving.metrics import SERVE_HEDGES_TOTAL
+
+    monkeypatch.setenv("COVALENT_TPU_HEDGE", "on")
+    monkeypatch.setenv("COVALENT_TPU_HEDGE_BUDGET_PCT", "100")
+
+    def factory():
+        import os as os_mod
+        import time as time_mod
+
+        class Engine:
+            """Deterministic streams; under TEST_GRAY_SLOW the FIRST
+            chunk of every lane is held back 3s and later chunks trickle
+            — a brownout, not a crash."""
+
+            def __init__(self):
+                self.slots = 4
+                self.lanes = {}
+                self.ready_at = {}
+                self.slow = bool(os_mod.environ.get("TEST_GRAY_SLOW"))
+
+            def admit(self, rid, prompt, params):
+                base = int(prompt[-1])
+                cap = int((params or {}).get("max_new_tokens", 6))
+                self.lanes[rid] = [base + j + 1 for j in range(cap)]
+                self.ready_at[rid] = (
+                    time_mod.monotonic() + 3.0 if self.slow else 0.0
+                )
+
+            def step(self):
+                time_mod.sleep(0.03)
+                events = []
+                now = time_mod.monotonic()
+                for rid in list(self.lanes):
+                    if now < self.ready_at.get(rid, 0.0):
+                        continue
+                    chunk = self.lanes[rid][:2]
+                    self.lanes[rid] = self.lanes[rid][2:]
+                    if self.slow:  # trickle: stay mid-stream when losing
+                        self.ready_at[rid] = now + 0.4
+                    done = not self.lanes[rid]
+                    if done:
+                        del self.lanes[rid]
+                    events.append(
+                        {"rid": rid, "tokens": chunk, "done": done}
+                    )
+                return events
+
+            def cancel(self, rid):
+                self.lanes.pop(rid, None)
+                self.ready_at.pop(rid, None)
+
+        return Engine()
+
+    async def flow():
+        HEALTH.reset()
+        ex_slow = make_replica_executor(
+            tmp_path, "hslow", task_env={"TEST_GRAY_SLOW": "1"}
+        )
+        ex_fast = make_replica_executor(tmp_path, "hfast")
+        try:
+            rset = await open_replica_set(
+                [ex_slow, ex_fast], factory, name="hedge",
+                stats_interval_s=0.1,
+            )
+            # Two concurrent requests: the tie-break spread lands one on
+            # each replica, so exactly one stalls and hedges.
+            requests = [
+                await rset.request([100 * (i + 1)],
+                                   params={"max_new_tokens": 6})
+                for i in range(2)
+            ]
+            results = await asyncio.gather(
+                *(r.result(timeout=60) for r in requests)
+            )
+            status = rset.status()
+            hedged = [r for r in requests if r.hedged]
+            await rset.close()
+        finally:
+            await ex_slow.close()
+            await ex_fast.close()
+        return results, status, hedged
+
+    def won() -> float:
+        return sum(
+            c.value for labels, c in SERVE_HEDGES_TOTAL._series()
+            if labels.get("outcome") == "won"
+        )
+
+    before = won()
+    results, status, hedged = run_async(flow())
+    # Byte-equal, exactly once: the splice dropped every duplicate chunk
+    # the losing replica may have emitted before its cancel landed.
+    assert list(results) == [
+        [100 * (i + 1) + j + 1 for j in range(6)] for i in range(2)
+    ], results
+    assert len(hedged) == 1, [r.rid for r in hedged]
+    assert won() == before + 1
+    assert status["hedge"]["enabled"] is True
+    assert status["hedge"]["issued"] >= 1
+    assert status["hedge"]["wins"] >= 1
+    HEALTH.reset()
