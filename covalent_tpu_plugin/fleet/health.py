@@ -295,14 +295,17 @@ class HealthMonitor:
             return rec.state if rec is not None else HEALTHY
 
     def rank(self, key: str) -> int:
-        """Placement rank term: 0 healthy/probing, 1 probation, 2 degraded,
-        3 quarantined — lower sorts earlier."""
+        """Placement rank term: 0 healthy, 1 probation, 2 degraded/probing,
+        3 quarantined — lower sorts earlier.  PROBING stays down at the
+        degraded tier: a canary in flight is not a verdict, and full
+        traffic must not land on a still-suspect target during the probe
+        window (readmission to PROBATION is what restores priority)."""
         st = self.state(key)
-        if st in (HEALTHY, PROBING):
+        if st == HEALTHY:
             return 0
         if st == PROBATION:
             return 1
-        if st == DEGRADED:
+        if st in (DEGRADED, PROBING):
             return 2
         return 3
 
@@ -310,7 +313,7 @@ class HealthMonitor:
         return self.state(key) == QUARANTINED
 
     def degraded(self, key: str) -> bool:
-        return self.state(key) in (DEGRADED, QUARANTINED)
+        return self.state(key) in (DEGRADED, PROBING, QUARANTINED)
 
     # -- state machine -----------------------------------------------------
 
@@ -403,6 +406,27 @@ class HealthMonitor:
                 self._transition(key, rec, PROBATION, "canary ok")
             else:
                 self._transition(key, rec, QUARANTINED, "canary failed")
+
+    def release_probe(self, key: str) -> None:
+        """Release a probe slot WITHOUT a verdict — the canary never ran
+        (e.g. no event loop on a sync status path).  The target returns
+        to QUARANTINED with its prior dwell clock and quarantine round
+        intact: an un-run probe must neither readmit the target nor
+        lengthen its back-off the way a genuinely failed canary would."""
+        with self._lock:
+            rec = self._records.get(key)
+            if rec is None:
+                return
+            rec.probe_open = False
+            if rec.state != PROBING:
+                return
+            # _transition to QUARANTINED stamps a fresh quarantined_at and
+            # bumps the round; restore both — no probe ran, nothing was
+            # learned.
+            at, rnd = rec.quarantined_at, rec.quarantine_round
+            self._transition(key, rec, QUARANTINED, "probe released unrun")
+            rec.quarantined_at = at
+            rec.quarantine_round = rnd
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -317,9 +317,10 @@ class Pool:
                 task = asyncio.ensure_future(coro)
             except RuntimeError:
                 # No running loop (sync status path): close the unstarted
-                # coroutine and release the probe slot for the next tick.
+                # coroutine and release the probe slot — verdict-free, no
+                # probe ran — for the next tick.
                 coro.close()
-                HEALTH.record_probe(key, False)
+                HEALTH.release_probe(key)
                 continue
             task.add_done_callback(
                 lambda t: None if t.cancelled() else t.exception()

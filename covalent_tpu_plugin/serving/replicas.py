@@ -52,7 +52,7 @@ import cloudpickle
 
 from ..cache import bytes_digest
 from ..fleet import journal as journal_mod
-from ..fleet.health import DEGRADED, HEALTH, QUARANTINED
+from ..fleet.health import DEGRADED, HEALTH, PROBING, QUARANTINED
 from ..fleet.queue import DEFAULT_TENANT, FairWorkQueue, QueueFullError, WorkItem
 from ..obs import events as obs_events
 from ..obs.trace import Span, record_span
@@ -542,7 +542,11 @@ class ReplicaSet:
                 load=sup.in_flight,
                 capacity=capacity,
                 health=HEALTH.score(sup.sid),
-                degraded=(st == DEGRADED),
+                # PROBING counts as degraded too: the canary is in
+                # flight, not passed — the replica routes last-resort
+                # (able to take the probe plus overflow) until the
+                # verdict readmits it to PROBATION.
+                degraded=(st in (DEGRADED, PROBING)),
                 quarantined=(st == QUARANTINED),
             )
             # Quarantined replicas only come back via a canary probe:
@@ -563,8 +567,9 @@ class ReplicaSet:
             task = asyncio.ensure_future(_probe())
         except RuntimeError:
             # No running loop (sync status path) — release the probe slot
-            # so the next pump retries.
-            HEALTH.record_probe(sup.sid, False)
+            # WITHOUT a verdict so the next pump retries: no probe ran,
+            # so nothing may readmit OR lengthen the quarantine dwell.
+            HEALTH.release_probe(sup.sid)
             return
         self._pump_tasks.add(task)
         task.add_done_callback(

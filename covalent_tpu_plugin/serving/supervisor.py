@@ -176,6 +176,14 @@ class ServeRequest:
         #: True once a hedge copy of this request was issued (budget
         #: accounting + at-most-one-hedge-per-request).
         self.hedged = False
+        #: sid -> monotonic submit time for every supervisor currently
+        #: holding this request (a hedge puts TWO arms in flight).  A
+        #: terminal (reject, error, done) on one arm consults this to
+        #: decide whether another arm still owns the stream — and a
+        #: hedge winner's health feed reads its OWN dispatch time here,
+        #: not the original submit, so the winner is not charged the
+        #: primary's stall.
+        self.arms: dict[str, float] = {}
         self.t_submit = time.monotonic()
         self.t_first: float | None = None
         self.t_done: float | None = None
@@ -819,6 +827,7 @@ class SessionSupervisor:
                 request.t_dispatched = time.monotonic()
             request.span.set_attribute("sid", self.sid)
             self._requests[request.rid] = request
+            request.arms[self.sid] = time.monotonic()
             self._publish_in_flight()
             # Write-ahead: the intent is durable BEFORE the wire write,
             # so a dispatcher crash between the two replays the request
@@ -833,6 +842,7 @@ class SessionSupervisor:
                 await self._send_request(request)
             except BaseException:
                 self._requests.pop(request.rid, None)
+                request.arms.pop(self.sid, None)
                 self._publish_in_flight()
                 raise
         except BaseException as err:
@@ -857,6 +867,8 @@ class SessionSupervisor:
         keep the splice exactly-once across the move."""
         detached = list(self._requests.values())
         self._requests.clear()
+        for request in detached:
+            request.arms.pop(self.sid, None)
         self._publish_in_flight()
         return detached
 
@@ -1085,14 +1097,16 @@ class SessionSupervisor:
             request.served_by = self.sid
         done = bool(data.get("done"))
         error = str(data.get("error") or "")
-        if (
-            error == "cancelled"
-            and request.hedged
+        hedge_loser = bool(
+            request.hedged
             and request.served_by
             and request.served_by != self.sid
-        ):
-            # The worker acked the cancel of a hedge-losing arm; the
-            # winning stream owns the request's terminal record.
+        )
+        if hedge_loser and error:
+            # A terminal error on the hedge-losing arm — the cancel ack,
+            # or the loser dying mid-drain — must never fail (or even
+            # reach) the SHARED request: the winning stream owns the
+            # request's terminal record; this arm only releases its claim.
             self.abandon(rid)
             return
         spec_s = data.get("spec_verify_s")
@@ -1118,11 +1132,30 @@ class SessionSupervisor:
                 request.ttft_s, trace_id=request.span.trace_id
             )
             # Differential health feed: TTFT vs sibling replicas is the
-            # straggler signal a binary breaker never sees.
+            # straggler signal a binary breaker never sees.  For a hedged
+            # request this arm's latency is measured from its OWN
+            # dispatch: the caller-visible ttft_s includes the primary's
+            # stall plus the hedge threshold wait, and charging that to
+            # the healthy winner would pollute the very differential
+            # signal that routed around the straggler.
+            arm_lat = request.ttft_s
+            if request.hedged:
+                sent = request.arms.get(self.sid)
+                if sent is not None and request.t_first is not None:
+                    arm_lat = max(0.0, request.t_first - sent)
             HEALTH.record_latency(
-                self.sid, request.ttft_s, group=self._health_group
+                self.sid, arm_lat, group=self._health_group
             )
         if done:
+            if hedge_loser:
+                # The losing arm completed normally before its cancel
+                # drained: its chunks already spliced as duplicates and
+                # request._feed ignored the second done — but the outcome
+                # accounting (request counters, latency histogram, health
+                # credit) belongs to the winner alone.  Release the claim
+                # without counting anything.
+                self.abandon(rid)
+                return
             outcome = "ok"
             if error == "deadline_exceeded":
                 outcome = "deadline"
@@ -1151,6 +1184,17 @@ class SessionSupervisor:
             # this request on the fresh session.
             return
         HEALTH.record_fault(self.sid, label=code, group=self._health_group)
+        if request.hedged and request.served_by != self.sid and (
+            request.served_by or request.arms.keys() - {self.sid}
+        ):
+            # Hedge guard: a wire-level reject of one arm (e.g. the
+            # speculative copy shed under the same load that triggered
+            # the hedge) must not fail the SHARED request while the other
+            # arm still holds it — that arm owns the terminal.  The
+            # reject was still a real fault for THIS replica (recorded
+            # above); only the request survives it.
+            self.abandon(rid)
+            return
         self._finish(
             rid, "shed" if code == "serve_admission_shed" else "rejected"
         )
@@ -1210,7 +1254,9 @@ class SessionSupervisor:
                 ).set(float(value or 0))
 
     def _finish(self, rid: str, outcome: str) -> None:
-        if self._requests.pop(rid, None) is not None:
+        request = self._requests.pop(rid, None)
+        if request is not None:
+            request.arms.pop(self.sid, None)
             self.served += 1
             SERVE_REQUESTS_TOTAL.labels(outcome=outcome).inc()
             journal_mod.record(
@@ -1227,8 +1273,10 @@ class SessionSupervisor:
         arm only releases its claim and frees the worker lane with a
         fire-and-forget ``serve_cancel``.  Journaled as a ``stream_done``
         so a successor dispatcher does not resume the dead arm."""
-        if self._requests.pop(rid, None) is None:
+        request = self._requests.pop(rid, None)
+        if request is None:
             return
+        request.arms.pop(self.sid, None)
         journal_mod.record(
             "stream_done", sid=self.sid, rid=rid, outcome="hedge_abandoned",
         )

@@ -215,6 +215,49 @@ def test_canary_single_flight_and_probation_readmission():
     assert monitor.state("bad") == HEALTHY
 
 
+def test_probing_ranks_with_degraded_until_the_verdict():
+    """A canary in flight is not a verdict: the instant allow_probe flips
+    QUARANTINED -> PROBING the target must NOT become fully routable at
+    top priority — it ranks with DEGRADED (last-resort) for the whole
+    probe window, and only readmission to PROBATION restores priority."""
+    clock = FakeClock()
+    monitor = make_monitor(clock=clock)
+    brown_out(monitor, clock)
+    clock.advance(monitor.cooldown_s + 0.1)
+    assert monitor.allow_probe("bad")
+    assert monitor.state("bad") == PROBING
+    assert monitor.rank("bad") == 2
+    assert monitor.degraded("bad")
+    monitor.record_probe("bad", ok=True)
+    assert monitor.state("bad") == PROBATION
+    assert monitor.rank("bad") == 1
+    assert not monitor.degraded("bad")
+
+
+def test_release_probe_is_verdict_free():
+    """A probe slot released because the canary never RAN (no event loop
+    on a sync status path) must not count as a failed canary: the target
+    returns to QUARANTINED with its original dwell clock and round — the
+    next tick retries immediately instead of waiting out an exponentially
+    lengthened back-off the target never earned."""
+    clock = FakeClock()
+    monitor = make_monitor(clock=clock)
+    brown_out(monitor, clock)
+    clock.advance(monitor.cooldown_s + 0.1)
+    assert monitor.allow_probe("bad")
+    assert monitor.state("bad") == PROBING
+    monitor.release_probe("bad")
+    assert monitor.state("bad") == QUARANTINED
+    # Dwell clock untouched (already elapsed): the retry is immediate.
+    assert monitor.allow_probe("bad")
+    # A REAL failed canary still doubles the dwell from here (round 2).
+    monitor.record_probe("bad", ok=False)
+    clock.advance(monitor.cooldown_s + 0.1)
+    assert not monitor.allow_probe("bad")
+    clock.advance(monitor.cooldown_s)
+    assert monitor.allow_probe("bad")
+
+
 def test_failed_canary_requarantines_with_exponential_dwell():
     clock = FakeClock()
     monitor = make_monitor(clock=clock)

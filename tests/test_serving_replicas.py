@@ -18,6 +18,7 @@ Two tiers:
 
 import asyncio
 import sys
+import time
 
 import pytest
 
@@ -293,8 +294,127 @@ def test_token_stream_gap_fails_loud(run_async):
 
 
 # ---------------------------------------------------------------------------
-# Set integration: real pool servers, two replicas
+# Supervisor-level: hedge arbitration (shared-request terminal ownership)
 # ---------------------------------------------------------------------------
+
+
+class _DummyExecutor:
+    _serve_handles: dict = {}
+
+
+def _hedged_pair(rid):
+    """One ServeRequest held by two bare supervisors (a hedge in flight:
+    primary + speculative arm), wired exactly as submit() would wire it."""
+    primary = SessionSupervisor(_DummyExecutor(), sid=f"{rid}-primary")
+    hedge = SessionSupervisor(_DummyExecutor(), sid=f"{rid}-hedge")
+    request = ServeRequest(rid, [1], None, 0.0, "")
+    request.hedged = True
+    for sup in (primary, hedge):
+        sup._requests[rid] = request
+        request.arms[sup.sid] = time.monotonic()
+    return primary, hedge, request
+
+
+def test_hedge_arm_reject_releases_claim_without_failing_request(run_async):
+    """The speculative arm getting shed on the side-band (likely under
+    the SAME load that triggered the hedge) must not fail the shared
+    request while the primary still holds it — the reject only releases
+    the hedge arm's claim; the primary's stream completes normally."""
+    from covalent_tpu_plugin.fleet.health import HEALTH
+
+    async def flow():
+        primary, hedge, request = _hedged_pair("hrej")
+        hedge._on_reject({
+            "rid": "hrej", "code": "serve_admission_shed", "message": "full",
+        })
+        assert not request.done
+        assert "hrej" not in hedge._requests
+        primary._on_token({
+            "rid": "hrej", "idx": 0, "tokens": [5, 6], "done": True,
+        })
+        assert await request.result(timeout=1) == [5, 6]
+        assert request.served_by == primary.sid
+        # Both arms rejected IS terminal: nobody holds the rid anymore.
+        primary2, hedge2, request2 = _hedged_pair("hrej2")
+        hedge2._on_reject({"rid": "hrej2", "code": "serve_admission_shed"})
+        primary2._on_reject({"rid": "hrej2", "code": "serve_admission_shed"})
+        with pytest.raises(Exception, match="serve_admission_shed"):
+            await request2.result(timeout=1)
+        for sid in (
+            primary.sid, hedge.sid, primary2.sid, hedge2.sid,
+        ):
+            HEALTH.drop(sid)
+
+    run_async(flow())
+
+
+def test_hedge_loser_terminal_skips_outcome_accounting(run_async):
+    """A loser that completes normally before its cancel drains delivers
+    a byte-equal stream, but the outcome accounting (served counter,
+    health credit) belongs to the winner alone — and a loser dying with
+    a non-cancel error must not fail the winner's healthy stream."""
+    from covalent_tpu_plugin.fleet.health import HEALTH
+
+    async def flow():
+        primary, hedge, request = _hedged_pair("hwin")
+        # The hedge arm delivers the first fresh token: it is the winner.
+        hedge._on_token({"rid": "hwin", "idx": 0, "tokens": [5]})
+        assert request.served_by == hedge.sid
+        # The losing primary completes the FULL stream before its cancel
+        # drains: the tail still splices in (byte-equal), but the loser
+        # releases its claim without counting the outcome.
+        primary._on_token({
+            "rid": "hwin", "idx": 0, "tokens": [5, 6, 7], "done": True,
+        })
+        assert await request.result(timeout=1) == [5, 6, 7]
+        assert primary.served == 0
+        assert "hwin" not in primary._requests
+        # The winner's own terminal is the one that counts.
+        hedge._on_token({
+            "rid": "hwin", "idx": 1, "tokens": [6, 7], "done": True,
+        })
+        assert hedge.served == 1
+        # A loser erroring mid-drain never reaches the shared request.
+        primary2, hedge2, request2 = _hedged_pair("herr")
+        hedge2._on_token({"rid": "herr", "idx": 0, "tokens": [9]})
+        primary2._on_token({
+            "rid": "herr", "idx": 0, "tokens": [], "done": True,
+            "error": "worker_died",
+        })
+        assert not request2.done
+        hedge2._on_token({"rid": "herr", "idx": 1, "tokens": [], "done": True})
+        assert await request2.result(timeout=1) == [9]
+        for sid in (
+            primary.sid, hedge.sid, primary2.sid, hedge2.sid,
+        ):
+            HEALTH.drop(sid)
+
+    run_async(flow())
+
+
+def test_hedge_winner_health_latency_uses_own_dispatch(run_async):
+    """The winner's differential health sample is measured from ITS OWN
+    dispatch, not the original submit: charging the healthy winner the
+    primary's stall plus the hedge threshold wait would pollute the very
+    EWMA-vs-median signal that routed around the straggler."""
+    from covalent_tpu_plugin.fleet.health import HEALTH
+
+    async def flow():
+        sup = SessionSupervisor(_DummyExecutor(), sid="hlat-winner")
+        request = ServeRequest("hlat", [1], None, 0.0, "")
+        request.hedged = True
+        # The request was submitted 30s ago; the hedge arm dispatched it
+        # only 10ms ago (the primary spent the difference stalling).
+        request.t_submit = time.monotonic() - 30.0
+        sup._requests["hlat"] = request
+        request.arms[sup.sid] = time.monotonic() - 0.01
+        sup._on_token({"rid": "hlat", "idx": 0, "tokens": [1], "done": True})
+        snap = HEALTH.snapshot()["hlat-winner"]
+        assert snap["lat_samples"] == 1
+        assert snap["lat_ewma_s"] < 1.0, snap
+        HEALTH.drop("hlat-winner")
+
+    run_async(flow())
 
 
 def test_replica_set_streams_across_replicas(tmp_path, run_async):
