@@ -46,6 +46,7 @@ BENCH_PHASES = {
         "BENCH_PHASES",
         "overhead,obs_tax,fanout,cached_fanout,bundled_fanout,"
         "rpc_overhead,serve_traffic,serve_scale,serve_disagg,serve_spec,"
+        "serve_multilora,"
         "gray_failure,chaos_fanout,preemption_chaos,dispatcher_crash,"
         "sched_fanout,"
         "traffic_ramp,tpu",
@@ -150,6 +151,30 @@ SERVE_SPEC_SPEEDUP_MIN = float(
 )
 SERVE_SPEC_BUDGET_S = float(
     os.environ.get("BENCH_SERVE_SPEC_BUDGET_S", "240")
+)
+#: serve_multilora phase knobs: the SAME mixed multi-tenant load (a
+#: round-robin of MULTILORA_ADAPTERS distinct LoRA adapters over one
+#: shared base model) offered to ONE multiplexed engine (the adapter
+#: bank: every wave gathers each lane's adapter inside the compiled
+#: step, so all tenants co-batch) and to per-adapter single-tenant
+#: engines time-sharing the same device (each sees only its adapter's
+#: quarter of the traffic, so its batches run 1/N full and the device
+#: serializes N engines' decode waves).  SLOs: every stream byte-equal
+#: across arms (slot-0 identity / bank-gather exactness), aggregate
+#: multiplexed tokens/s >= MULTILORA_SPEEDUP_MIN x the single-tenant
+#: aggregate, and a mid-phase hot swap of one adapter finishes every
+#: in-flight stream on the OLD generation while new admissions decode
+#: the new one — zero drops, zero sheds.
+MULTILORA_ADAPTERS = int(os.environ.get("BENCH_MULTILORA_ADAPTERS", "4"))
+MULTILORA_REQUESTS = int(os.environ.get("BENCH_MULTILORA_REQUESTS", "32"))
+MULTILORA_TOKENS = int(os.environ.get("BENCH_MULTILORA_TOKENS", "32"))
+MULTILORA_RANK = int(os.environ.get("BENCH_MULTILORA_RANK", "4"))
+MULTILORA_LAYERS = int(os.environ.get("BENCH_MULTILORA_LAYERS", "4"))
+MULTILORA_SPEEDUP_MIN = float(
+    os.environ.get("BENCH_MULTILORA_SPEEDUP_MIN", "1.3")
+)
+MULTILORA_BUDGET_S = float(
+    os.environ.get("BENCH_MULTILORA_BUDGET_S", "240")
 )
 #: gray_failure phase knobs: three replica-set arms under the SAME
 #: open-loop load — healthy (3 good replicas), brownout-unhedged (one
@@ -4311,6 +4336,295 @@ async def main() -> None:
         emit({"phase": "serve_spec", "skipped": "BENCH_PHASES"})
     except Exception as error:  # noqa: BLE001
         emit({"phase": "serve_spec", "error": repr(error)})
+
+    # ---- phase 2b-iv: multi-adapter LoRA multiplexing inside the engine --
+    # One REAL ContinuousEngine hosting an adapter bank serves a mixed
+    # round-robin load over MULTILORA_ADAPTERS distinct LoRA adapters in
+    # co-batched decode waves, against per-adapter single-tenant engines
+    # time-sharing the same device.  Asserted: streams byte-equal across
+    # arms per request, the multiplexed aggregate tokens/s beats the
+    # single-tenant aggregate by >= MULTILORA_SPEEDUP_MIN, and a hot
+    # swap mid-stream drops nothing (the in-flight lane finishes on the
+    # old generation byte-equal; the next admission decodes the new).
+    try:
+        if "serve_multilora" not in BENCH_PHASES:
+            raise _PhaseSkipped
+
+        def multilora_probe(n_adapters, n_requests, cap, rank, n_layers):
+            # Runs INSIDE a worker process (the bench parent never
+            # imports jax).
+            import time as _time
+
+            import jax
+            import numpy as np
+            import jax.numpy as jnp
+
+            from covalent_tpu_plugin.models import (
+                TransformerConfig,
+                TransformerLM,
+            )
+            from covalent_tpu_plugin.models import lora as lora_mod
+            from covalent_tpu_plugin.models.serve import ContinuousEngine
+            from covalent_tpu_plugin.parallel.sharding import unbox
+
+            cfg = TransformerConfig(
+                vocab_size=64, d_model=64, n_layers=n_layers, n_heads=4,
+                d_ff=256, max_seq=96, dtype=jnp.float32,
+                attention="reference", scan_layers=False,
+            )
+            model = TransformerLM(cfg)
+            params = unbox(model.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+            )["params"])
+
+            def make_adapter(seed):
+                # A "fine-tuned" adapter: randomized nonzero lora_a AND
+                # lora_b (add_lora's fresh B is zero — the identity),
+                # so every adapter genuinely changes the argmax.
+                lmodel, filled = lora_mod.add_lora(
+                    model, params, rank=rank, alpha=16.0
+                )
+                mask = jax.tree_util.tree_leaves(
+                    lora_mod.lora_mask(filled)
+                )
+                leaves, treedef = jax.tree_util.tree_flatten(filled)
+                key = jax.random.PRNGKey(seed)
+                out = []
+                for leaf, m in zip(leaves, mask):
+                    if m:
+                        key, sub = jax.random.split(key)
+                        out.append(
+                            jax.random.normal(
+                                sub, leaf.shape, leaf.dtype
+                            ) * 0.05
+                        )
+                    else:
+                        out.append(leaf)
+                tuned = jax.tree_util.tree_unflatten(treedef, out)
+                return lmodel, tuned
+
+            lmodel = None
+            tuned, banks = [], {}
+            for i in range(n_adapters):
+                lmodel, tree = make_adapter(i + 1)
+                tuned.append(tree)
+                banks[f"a{i}"] = lora_mod.adapter_leaves(tree)
+            rng = np.random.default_rng(0)
+            requests = [
+                (
+                    f"a{i % n_adapters}",
+                    rng.integers(1, 64, 4 + i % 4).astype(np.int32),
+                )
+                for i in range(n_requests)
+            ]
+            slots = max(4, n_adapters * 2)
+
+            def drive(engine, subset):
+                streams, done = {}, set()
+                queue = [
+                    (f"r{i}", name, prompt)
+                    for i, (name, prompt) in enumerate(requests)
+                    if subset is None or name == subset
+                ]
+                pending = list(queue)
+                for _ in range(10000):
+                    while pending and engine.busy < engine.slots:
+                        rid, name, prompt = pending.pop(0)
+                        prm = {"max_new_tokens": cap}
+                        if subset is None:
+                            prm["adapter"] = name
+                        engine.admit(rid, prompt, prm)
+                        streams[rid] = []
+                    for event in engine.step():
+                        streams[event["rid"]].extend(event["tokens"])
+                        if event["done"]:
+                            done.add(event["rid"])
+                    if len(done) == len(queue) and not pending:
+                        break
+                return streams
+
+            def timed(engine, subset=None):
+                drive(engine, subset)   # cold compiles
+                drive(engine, subset)   # warm prefix-tree wave shapes
+                # Best-of-3: the min wall is the least-noise estimate on
+                # a shared CPU box (scheduler jitter only ever adds).
+                streams, best = None, float("inf")
+                for _ in range(3):
+                    t0 = _time.perf_counter()
+                    streams = drive(engine, subset)
+                    best = min(best, _time.perf_counter() - t0)
+                return streams, best
+
+            # Arm 1: ONE multiplexed engine, all adapters co-batched.
+            mux = ContinuousEngine(
+                model, params, max_batch=slots, sync_steps=4,
+                max_new_tokens=cap, length=cfg.max_seq - 4,
+                adapters=banks,
+            )
+            mux_streams, mux_wall = timed(mux)
+
+            # Arm 2: per-adapter single-tenant engines PARTITIONING the
+            # same slot budget (slots/N lanes each — dedicating a
+            # session per tenant statically splits the device's batch
+            # capacity, which is exactly the cost the bank removes),
+            # each timed on its own quarter of the load; the device
+            # time-shares them, so the aggregate wall is the sum.
+            single_streams, single_wall = {}, 0.0
+            for i in range(n_adapters):
+                engine = ContinuousEngine(
+                    lmodel, tuned[i],
+                    max_batch=max(1, slots // n_adapters), sync_steps=4,
+                    max_new_tokens=cap, length=cfg.max_seq - 4,
+                )
+                streams, wall = timed(engine, subset=f"a{i}")
+                single_streams.update(streams)
+                single_wall += wall
+                engine.close()
+            exact = all(
+                [int(t) for t in mux_streams[rid]]
+                == [int(t) for t in single_streams[rid]]
+                for rid in single_streams
+            )
+
+            # Hot swap mid-stream: admit on a0, swap a0's generation
+            # while the lane is mid-decode, admit again.  The in-flight
+            # stream finishes on the OLD weights; the new admission
+            # decodes the new — zero drops either side.
+            _, fresh = make_adapter(97)
+            old_oracle = mux_streams["r0"]
+            swap_prompt = requests[0][1]
+            mux.admit("swap_old", swap_prompt,
+                      {"max_new_tokens": cap, "adapter": "a0"})
+            swapped = {"swap_old": [], "swap_new": []}
+            for _ in range(2):      # a couple of waves in flight first
+                for event in mux.step():
+                    swapped[event["rid"]].extend(event["tokens"])
+            mux.attach_adapter("a0", lora_mod.adapter_leaves(fresh))
+            mux.admit("swap_new", swap_prompt,
+                      {"max_new_tokens": cap, "adapter": "a0"})
+            for _ in range(10000):
+                for event in mux.step():
+                    swapped[event["rid"]].extend(event["tokens"])
+                if not mux.busy:
+                    break
+            new_engine = ContinuousEngine(
+                lmodel, fresh, max_batch=slots, sync_steps=4,
+                max_new_tokens=cap, length=cfg.max_seq - 4,
+            )
+            new_engine.admit("swap_new", swap_prompt,
+                             {"max_new_tokens": cap})
+            new_oracle = []
+            for _ in range(10000):
+                for event in new_engine.step():
+                    new_oracle.extend(event["tokens"])
+                if not new_engine.busy:
+                    break
+            new_engine.close()
+            stats = dict(mux.stats)
+            mux.close()
+            total = sum(len(s) for s in mux_streams.values())
+            return {
+                "tokens": total,
+                "mux_wall_s": mux_wall,
+                "single_wall_s": single_wall,
+                "exact": bool(exact),
+                "swap_old_exact": swapped["swap_old"] == old_oracle,
+                "swap_new_exact": swapped["swap_new"] == new_oracle,
+                "swap_complete": (
+                    len(swapped["swap_old"]) == cap
+                    and len(swapped["swap_new"]) == cap
+                ),
+                "adapter_tokens": {
+                    key[len("adapter_tokens_"):]: int(v)
+                    for key, v in stats.items()
+                    if key.startswith("adapter_tokens_")
+                },
+                "swaps": int(stats.get("adapter_swaps", 0)),
+                "attaches": int(stats.get("adapter_attaches", 0)),
+                "prefix_blocked": int(
+                    stats.get("adapter_prefix_blocked", 0)
+                ),
+            }
+
+        multilora_ex = TPUExecutor(
+            transport="local",
+            cache_dir=f"{workdir}/cache_multilora",
+            remote_cache=f"{workdir}/remote_multilora",
+            python_path=sys.executable,
+            poll_freq=0.2,
+            use_agent="pool",
+            pool_preload="cloudpickle",
+            prewarm=False,
+            heartbeat_interval=0.0,
+            task_env={
+                "PYTHONPATH": repo_root + os.pathsep
+                + os.environ.get("PYTHONPATH", ""),
+            },
+        )
+        try:
+            probe = await asyncio.wait_for(
+                multilora_ex.run(
+                    multilora_probe,
+                    [MULTILORA_ADAPTERS, MULTILORA_REQUESTS,
+                     MULTILORA_TOKENS, MULTILORA_RANK,
+                     MULTILORA_LAYERS], {},
+                    {"dispatch_id": "multiloraprobe", "node_id": 0},
+                ),
+                MULTILORA_BUDGET_S,
+            )
+        finally:
+            await multilora_ex.close()
+        assert probe["exact"] is True, (
+            "multiplexed streams diverged from single-adapter oracles"
+        )
+        tps_mux = probe["tokens"] / max(probe["mux_wall_s"], 1e-9)
+        tps_single = probe["tokens"] / max(probe["single_wall_s"], 1e-9)
+        speedup = tps_mux / max(tps_single, 1e-9)
+        # "Zero drops" at engine level IS stream completion: both the
+        # in-flight lane (old generation) and the post-swap admission
+        # ran to their full caps, byte-equal to their oracles — nothing
+        # was cancelled, truncated, or re-decoded on the wrong weights.
+        zero_drops = bool(
+            probe["swap_old_exact"] and probe["swap_new_exact"]
+            and probe["swap_complete"]
+        )
+        summary["serve_multilora_tokens_per_s"] = round(tps_mux, 1)
+        summary["serve_multilora_tokens_per_s_single"] = round(
+            tps_single, 1
+        )
+        summary["serve_multilora_speedup"] = round(speedup, 3)
+        summary["serve_multilora_speedup_ok"] = bool(
+            speedup >= MULTILORA_SPEEDUP_MIN
+        )
+        summary["serve_multilora_exact"] = bool(probe["exact"])
+        summary["serve_multilora_swap_zero_drops"] = zero_drops
+        emit({
+            "phase": "serve_multilora",
+            "adapters": MULTILORA_ADAPTERS,
+            "requests": MULTILORA_REQUESTS,
+            "tokens_per_request": MULTILORA_TOKENS,
+            "rank": MULTILORA_RANK,
+            "tokens_per_s_mux": summary["serve_multilora_tokens_per_s"],
+            "tokens_per_s_single":
+                summary["serve_multilora_tokens_per_s_single"],
+            "speedup": summary["serve_multilora_speedup"],
+            "speedup_min": MULTILORA_SPEEDUP_MIN,
+            "speedup_ok": summary["serve_multilora_speedup_ok"],
+            "exact": summary["serve_multilora_exact"],
+            "swap_zero_drops": zero_drops,
+            "swap_old_exact": probe["swap_old_exact"],
+            "swap_new_exact": probe["swap_new_exact"],
+            "hot_swaps": probe["swaps"],
+            "attaches": probe["attaches"],
+            "adapter_tokens": probe["adapter_tokens"],
+            "prefix_blocked": probe["prefix_blocked"],
+            "wall_mux_s": round(probe["mux_wall_s"], 3),
+            "wall_single_s": round(probe["single_wall_s"], 3),
+        })
+    except _PhaseSkipped:
+        emit({"phase": "serve_multilora", "skipped": "BENCH_PHASES"})
+    except Exception as error:  # noqa: BLE001
+        emit({"phase": "serve_multilora", "error": repr(error)})
 
     # ---- phase 2c: recovery overhead under one injected channel death ----
     # A 4-electron fan-out through a ChaosTransport that kills exactly ONE

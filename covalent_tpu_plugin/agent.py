@@ -335,6 +335,10 @@ class AgentClient:
         self._serve_kv: dict[str, dict] = {}
         #: "sid/rid" -> pushed ``serve_resumed`` ack (recovery path).
         self._serve_resumed: dict[str, dict] = {}
+        #: "kind:sid/adapter" -> pushed ``serve_attached``/``serve_detached``
+        #: ack (the multi-adapter registry path; kind keeps an attach and a
+        #: detach of the same adapter from settling each other's waiter).
+        self._serve_attached: dict[str, dict] = {}
         #: "serve"/"task" -> latest pushed inventory answer (recovery path;
         #: one outstanding request per kind — the slot is cleared on send).
         self._inventories: dict[str, dict] = {}
@@ -549,6 +553,15 @@ class AgentClient:
                         while len(self._serve_resumed) > 1024:
                             self._serve_resumed.pop(
                                 next(iter(self._serve_resumed))
+                            )
+                    elif kind in ("serve_attached", "serve_detached"):
+                        self._serve_attached[
+                            f"{kind}:{task_id}/"
+                            f"{event.get('adapter') or ''}"
+                        ] = event
+                        while len(self._serve_attached) > 256:
+                            self._serve_attached.pop(
+                                next(iter(self._serve_attached))
                             )
                     elif kind == "serve_inventory":
                         self._inventories["serve"] = event
@@ -1299,6 +1312,68 @@ class AgentClient:
             lambda c: c._serve_resumed.pop(key, None), timeout
         )
 
+    async def serve_attach(
+        self,
+        sid: str,
+        adapter: str,
+        digest: str,
+        path: str,
+        timeout: float = 60.0,
+    ) -> dict:
+        """Splice a LoRA adapter bundle into a *running* session.
+
+        ``path`` names a CAS-staged bundle on the worker host and
+        ``digest`` its sha256 — the worker verifies bytes before the
+        engine touches them, so a torn stage refuses instead of serving
+        garbage.  Returns the ``serve_attached`` ack (content ``digest``
+        plus ``attach_s``).  Refusals raise :class:`AgentError`, carrying
+        the same permanence duck-tags as serve_open: an engine without an
+        adapter bank or a digest mismatch is deterministic and must not
+        burn gang retries.
+        """
+        return await self._serve_attach_rpc(
+            {
+                "cmd": "serve_attach", "id": sid, "adapter": str(adapter),
+                "digest": str(digest), "path": str(path),
+            },
+            timeout,
+        )
+
+    async def serve_detach(
+        self, sid: str, adapter: str, timeout: float = 30.0
+    ) -> dict:
+        """Remove a named adapter from a running session (its decode slot
+        frees once in-flight requests pinned to it drain)."""
+        return await self._serve_attach_rpc(
+            {"cmd": "serve_detach", "id": sid, "adapter": str(adapter)},
+            timeout,
+        )
+
+    async def _serve_attach_rpc(self, command: dict, timeout: float) -> dict:
+        name = str(command["cmd"])
+        sid, adapter = str(command["id"]), str(command["adapter"])
+        key = f"{name}ed:{sid}/{adapter}"
+        self._serve_attached.pop(key, None)
+        await self._send(command)
+
+        def settled(c: "AgentClient"):
+            return c._serve_attached.pop(key, None)
+
+        event = await self._wait(settled, timeout)
+        if event.get("code"):
+            failure = AgentError(
+                f"agent@{self.address}: {name} {adapter!r} on {sid} failed "
+                f"({event.get('code')}): {event.get('message')}"
+            )
+            if event.get("permanent"):
+                failure.fault_label = str(  # type: ignore[attr-defined]
+                    event.get("label")
+                    or f"serve_{event.get('code') or 'error'}"
+                )
+                failure.fault_transient = False  # type: ignore[attr-defined]
+            raise failure
+        return event
+
     async def serve_cancel(self, sid: str, rid: str) -> None:
         """Cancel one in-flight request on a session (fire-and-forget).
 
@@ -1408,6 +1483,11 @@ class AgentClient:
             k for k in self._serve_kv if k.startswith(f"{sid}/")
         ]:
             del self._serve_kv[key]
+        for key in [
+            k for k in self._serve_attached
+            if k.partition(":")[2].startswith(f"{sid}/")
+        ]:
+            del self._serve_attached[key]
 
     async def wait_dead(self) -> None:
         """Block until this channel dies, then raise :class:`AgentError`.

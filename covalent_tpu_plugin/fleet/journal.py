@@ -184,6 +184,20 @@ class JournalState:
                 entry.update(
                     {k: v for k, v in rec.items() if k not in ("t",)}
                 )
+        elif kind == "session_adapter":
+            sid = str(rec.get("sid") or "")
+            name = str(rec.get("adapter") or "")
+            if sid and name:
+                entry = self.sessions.setdefault(sid, {})
+                book = entry.setdefault("adapters", {})
+                if rec.get("detached"):
+                    book.pop(name, None)
+                else:
+                    book[name] = {
+                        "digest": str(rec.get("digest") or ""),
+                        "path": str(rec.get("path") or ""),
+                        "content": str(rec.get("content") or ""),
+                    }
         elif kind == "session_closed":
             sid = str(rec.get("sid") or "")
             self.sessions.pop(sid, None)

@@ -65,6 +65,13 @@ RECOVERY_STREAMS = REGISTRY.counter(
     "In-flight streams re-attached from journaled high-water marks",
     ("state",),
 )
+RECOVERY_ADAPTERS = REGISTRY.counter(
+    "covalent_tpu_recovery_adapters_total",
+    "Journaled LoRA adapters restored to re-adopted sessions "
+    "(resident: the worker still held it; attached: re-shipped from "
+    "the local CAS; error: restore failed)",
+    ("state",),
+)
 
 #: The last completed recovery pass, for the ``/status`` recovery
 #: section and the bench drill's assertions.  One dispatcher process
@@ -126,6 +133,7 @@ async def recover(executor: Any, timeout_s: float = 120.0) -> RecoveryReport:
         "adopted_sessions": [],
         "orphaned_sessions": [],
         "resumed_streams": [],
+        "reattached_adapters": [],
         "pending_tasks": sorted(tasks),
         "pools": dict(prior.get("pools") or {}),
         "pool_targets": dict(prior.get("pool_targets") or {}),
@@ -221,6 +229,45 @@ async def recover(executor: Any, timeout_s: float = 120.0) -> RecoveryReport:
         report["adopted_sessions"].append(sid)
         report.supervisors[sid] = supervisor
         RECOVERY_ADOPTED.inc()
+        # Restore journaled adapters BEFORE resuming streams: a resumed
+        # request naming an adapter the fresh engine view lacks would
+        # refuse.  The worker's inventory says which adapters survived
+        # in-engine (by content digest) — those are book-kept without
+        # re-shipping a byte; anything else re-attaches from the
+        # dispatcher-local CAS bundle the journal points at.
+        resident = (
+            entry.get("adapters")
+            if isinstance(entry.get("adapters"), dict) else {}
+        ) or {}
+        for aname, arec in dict(meta.get("adapters") or {}).items():
+            arec = arec if isinstance(arec, dict) else {}
+            content = str(arec.get("content") or "")
+            try:
+                if content and str(resident.get(aname) or "") == content:
+                    supervisor.note_adapter(
+                        aname,
+                        digest=str(arec.get("digest") or ""),
+                        path=str(arec.get("path") or ""),
+                        content=content,
+                    )
+                    state = "resident"
+                else:
+                    await supervisor.attach_adapter(
+                        aname,
+                        path=str(arec.get("path") or ""),
+                        digest=str(arec.get("digest") or ""),
+                    )
+                    state = "attached"
+            except Exception as err:  # noqa: BLE001 - keep recovering
+                app_log.warning(
+                    "recovery: adapter %r re-attach on %s failed: %r",
+                    aname, sid, err,
+                )
+                state = "error"
+            RECOVERY_ADAPTERS.labels(state=state).inc()
+            report["reattached_adapters"].append({
+                "sid": sid, "adapter": aname, "state": state,
+            })
         for key, srec in streams.items():
             ssid, _, rid = key.partition("\x00")
             if ssid != sid or not rid:
@@ -274,6 +321,7 @@ async def recover(executor: Any, timeout_s: float = 120.0) -> RecoveryReport:
         adopted=len(report["adopted_sessions"]),
         orphaned=len(report["orphaned_sessions"]),
         streams=len(report["resumed_streams"]),
+        adapters=len(report["reattached_adapters"]),
         duration_s=report["duration_s"],
     )
     app_log.info(

@@ -468,6 +468,20 @@ class FleetScheduler:
         spot_ok = bool(
             item is not None and item.task_metadata.get("spot_ok")
         )
+        # Serving-artifact affinity, the fn-digest rank's adapter analog:
+        # an electron (or replica placement) that declares the CAS
+        # digests of the adapter bundles it will attach prefers pools
+        # whose gangs already staged them — a LoRA fine-tune promoting
+        # into the live fleet re-attaches with zero staging round trips
+        # on a holding gang.  Neutral (same rank everywhere) when the
+        # item declares none.
+        adapter_digests: tuple = ()
+        if item is not None:
+            adapter_digests = tuple(
+                str(d)
+                for d in (item.task_metadata.get("adapter_digests") or ())
+                if d
+            )
 
         def rank(pool: Pool):
             return (
@@ -476,6 +490,9 @@ class FleetScheduler:
                 0 if pool.preemptible == spot_ok else 1,
                 0 if pool.warm else 1,
                 0 if pool.holds_fn_digest(digest) else 1,
+                0 if not adapter_digests or any(
+                    pool.holds_serve_digest(d) for d in adapter_digests
+                ) else 1,
                 # Gray-failure grade: a degraded (but not quarantined)
                 # pool still places, just after every healthier
                 # alternative — below affinity (a warm digest-holding

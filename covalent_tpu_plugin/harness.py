@@ -1892,7 +1892,7 @@ _EPOCH = {"value": 0, "channel": 0}
 _FENCED_CMDS = frozenset((
     "run", "register_fn", "invoke", "multi_invoke", "serve_open",
     "serve_request", "serve_prefill", "serve_close", "serve_resume",
-    "serve_cancel", "kill",
+    "serve_cancel", "serve_attach", "serve_detach", "kill",
 ))
 
 
@@ -1949,6 +1949,11 @@ def _refuse_stale(name: str, command: dict) -> None:
         _emit({"event": "serve_resumed", "id": sid,
                "rid": str(command.get("rid") or ""),
                "state": "refused", "code": "stale_epoch"})
+    elif name in ("serve_attach", "serve_detach"):
+        _emit({"event": name + "ed", "id": sid,
+               "adapter": str(command.get("adapter") or ""),
+               "code": "stale_epoch", "message": message,
+               "permanent": True})
     else:
         _emit({"event": "error", "id": sid, "code": "stale_epoch",
                "message": message})
@@ -2043,6 +2048,11 @@ class _ServeSession:
         #: serve_prefill commands awaiting the session thread (the
         #: disaggregated tier's prefill-only work: no decode lane taken).
         self.prefill_queue: "queue_mod.Queue" = queue_mod.Queue()
+        #: serve_attach/serve_detach commands awaiting the session thread
+        #: (adapter splices mutate engine state, so they serialize with
+        #: admission and decode on the one thread that owns the engine).
+        self.attach_queue: "queue_mod.Queue" = queue_mod.Queue()
+        self.attaches = 0
         #: rid -> {"deadline": abs_ts|None, "emitted": n, "t_admit": ts}
         self.running: dict = {}
         #: rid -> full emitted-token list for RUNNING lanes; the recovery
@@ -2140,6 +2150,24 @@ class _ServeSession:
         # prefill replica is usually idle exactly when a prefill lands,
         # and the tick would tax every disaggregated request's TTFT.
         self.queue.put(None)
+
+    def submit_attach(self, command: dict) -> None:
+        """Queue one serve_attach/serve_detach for the session thread.
+
+        Splices happen BETWEEN decode chunks on the engine's own thread
+        — live lanes never observe a half-written bank — and the answer
+        (``serve_attached``/``serve_detached``) is emitted from there so
+        it cannot reorder against the splice itself.
+        """
+        name = str(command.get("adapter") or "")
+        event = str(command.get("cmd") or "serve_attach") + "ed"
+        if self._closed.is_set():
+            _emit({"event": event, "id": self.sid, "adapter": name,
+                   "code": "unknown_session", "message": "session closed",
+                   "permanent": True})
+            return
+        self.attach_queue.put(dict(command))
+        self.queue.put(None)  # wake an idle loop promptly
 
     def cancel_request(self, rid: str) -> None:
         """Ask the session thread to cancel one request (running or
@@ -2281,6 +2309,78 @@ class _ServeSession:
             )
             self._emit_kv(rid, bytes(data))
 
+    def _pump_attach(self) -> None:
+        """Apply queued adapter splices on the session thread.
+
+        ``serve_attach`` loads a sha256-verified CAS bundle (the model
+        registry's wire form) and calls the engine's duck-typed
+        ``attach_adapter(name, payload)``; ``serve_detach`` retires a
+        name.  Failures answer with the same event carrying ``code`` /
+        ``message`` and a duck-typed ``permanent`` flag (an
+        ``AdapterUnsupported`` — bad geometry, full bank, reserved name
+        — must refuse ONCE, not burn retries), mirroring the open path's
+        fault classification.
+        """
+        import queue as queue_mod
+
+        while True:
+            try:
+                command = self.attach_queue.get_nowait()
+            except queue_mod.Empty:
+                return
+            verb = str(command.get("cmd") or "serve_attach")
+            event = verb + "ed"
+            name = str(command.get("adapter") or "")
+            t_attach = time.monotonic()
+
+            def _fail(code: str, err, permanent: bool = True,
+                      label: str = "") -> None:
+                _emit({"event": event, "id": self.sid, "adapter": name,
+                       "code": code, "message": repr(err),
+                       "permanent": bool(permanent),
+                       **({"label": label} if label else {})})
+
+            if verb == "serve_detach":
+                detach = getattr(self._engine, "detach_adapter", None)
+                if detach is None:
+                    _fail("unsupported",
+                          "engine has no detach_adapter surface")
+                    continue
+                try:
+                    detach(name)
+                except BaseException as err:  # noqa: BLE001 - refusals
+                    _fail("unknown_adapter", err)
+                    continue
+                _emit({"event": event, "id": self.sid, "adapter": name})
+                continue
+            attach = getattr(self._engine, "attach_adapter", None)
+            if attach is None:
+                _fail("unsupported", "engine has no attach_adapter surface")
+                continue
+            code, payload = _load_fn_payload(
+                str(command.get("path") or ""),
+                str(command.get("digest") or ""),
+            )
+            if code:
+                _fail(code, payload, permanent=(code == "digest_mismatch"))
+                continue
+            try:
+                digest = attach(name, payload)
+            except BaseException as err:  # noqa: BLE001 - engine refusals
+                label = getattr(err, "fault_label", "") or ""
+                permanent = bool(label) and not bool(
+                    getattr(err, "fault_transient", False)
+                )
+                _fail("attach_failed", err, permanent=permanent,
+                      label=label)
+                continue
+            self.attaches += 1
+            _emit({
+                "event": event, "id": self.sid, "adapter": name,
+                "digest": str(digest or ""),
+                "attach_s": round(time.monotonic() - t_attach, 6),
+            })
+
     def _resolve_kv(self, command: dict):
         """``(kv_bytes | None, verified)`` for a KV-attached request.
 
@@ -2336,11 +2436,15 @@ class _ServeSession:
                 if isinstance(value, (int, float)):
                     extra[key] = value
             # Per-decode-mode token counters ride through verbatim (the
-            # mode set is closed, so the key space is bounded).
+            # mode set is closed, so the key space is bounded), as do the
+            # adapter bank's lifecycle + per-adapter counters (bounded by
+            # COVALENT_TPU_SERVE_ADAPTERS_MAX; the dispatcher reaps the
+            # per-name series when the session closes).
             for key, value in engine_stats.items():
-                if key.startswith("mode_tokens_") and isinstance(
-                    value, (int, float)
-                ):
+                if (
+                    key.startswith("mode_tokens_")
+                    or key.startswith("adapter_")
+                ) and isinstance(value, (int, float)):
                     extra[key] = value
             # The accept rate is computed HERE (not on the dispatcher)
             # so any engine exposing the two counters — the real one or
@@ -2616,7 +2720,7 @@ class _ServeSession:
                       "error": entry.get("error") or ""}
                 for rid, entry in self.finished.items()
             }
-        return {
+        entry = {
             "sid": self.sid,
             "digest": self.digest,
             "slots": self.slots,
@@ -2625,6 +2729,15 @@ class _ServeSession:
             "running": running,
             "finished": finished,
         }
+        # Attached adapters (name -> content digest): the recovery path
+        # compares this against the journaled registry records to decide
+        # which re-attaches a re-adopted session still needs.
+        digests = getattr(self._engine, "adapter_digests", None)
+        if isinstance(digests, dict) and digests:
+            entry["adapters"] = {
+                str(k): str(v) for k, v in digests.items()
+            }
+        return entry
 
     def _pump_engine(self) -> None:
         """One decode chunk for every busy lane; stream fresh tokens.
@@ -2744,6 +2857,7 @@ class _ServeSession:
                        and not self.running
                        and self.queue.empty()):
                 self._drain_cancels()
+                self._pump_attach()
                 self._pump_prefill()
                 self._admit_waiting()
                 if self.running:
@@ -2853,6 +2967,20 @@ def _serve_prefill(command: dict, sessions: dict) -> None:
         })
         return
     session.submit_prefill(command)
+
+
+def _serve_attach(command: dict, sessions: dict) -> None:
+    """Route one adapter splice (attach or detach) to its session."""
+    sid = str(command.get("id") or "")
+    event = str(command.get("cmd") or "serve_attach") + "ed"
+    session = sessions.get(sid)
+    if session is None:
+        _emit({"event": event, "id": sid,
+               "adapter": str(command.get("adapter") or ""),
+               "code": "unknown_session",
+               "message": f"no open session {sid!r}", "permanent": True})
+        return
+    session.submit_attach(command)
 
 
 def _serve_close(command: dict, sessions: dict) -> None:
@@ -3255,6 +3383,8 @@ def serve_child() -> int:
                 _serve_inventory(sessions)
             elif name == "serve_prefill":
                 _serve_prefill(command, sessions)
+            elif name in ("serve_attach", "serve_detach"):
+                _serve_attach(command, sessions)
             elif name == "profile_start":
                 _profile_start(command)
             elif name == "profile_stop":
@@ -3476,6 +3606,8 @@ def serve() -> int:
                     _serve_cancel(command, serve_sessions)
                 elif name == "serve_prefill":
                     _serve_prefill(command, serve_sessions)
+                elif name in ("serve_attach", "serve_detach"):
+                    _serve_attach(command, serve_sessions)
                 elif name == "serve_close":
                     _serve_close(command, serve_sessions)
                 elif name == "profile_start":

@@ -199,6 +199,44 @@ def lora_mask(params) -> Any:
     return rec(params, False)
 
 
+def adapter_leaves(params) -> list:
+    """The ordered ``lora_a``/``lora_b`` leaves of a LoRA params tree —
+    the adapter's portable wire form.
+
+    Flatten order is the tree's canonical key-sorted DFS, which is
+    identical across the float and quantized twins of one architecture
+    (the extra ``scale`` leaves a quantized base declares are not
+    adapter leaves), so a list extracted from a float training tree
+    splices into any serving variant of the same geometry — the
+    multi-adapter bank (:class:`..serve.ContinuousEngine`) and the CAS
+    registry ship exactly this list.
+    """
+    params = unbox_params(params)
+    leaves = jax.tree_util.tree_leaves(params)
+    mask = jax.tree_util.tree_leaves(lora_mask(params))
+    picked = [leaf for leaf, m in zip(leaves, mask) if m]
+    if not picked:
+        raise ValueError(
+            "params tree has no lora_a/lora_b leaves (not a LoRA tree)"
+        )
+    return picked
+
+
+def adapter_digest(leaves) -> str:
+    """Content digest of an adapter's ordered leaf list (its CAS/registry
+    identity): sha256 over each leaf's shape, dtype, and bytes."""
+    import hashlib
+
+    import numpy as np
+
+    h = hashlib.sha256()
+    for leaf in leaves:
+        arr = np.asarray(leaf)
+        h.update(repr((tuple(arr.shape), str(arr.dtype))).encode())
+        h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()
+
+
 def lora_optimizer(inner, params):
     """Optax transform training ONLY the adapters; the base is frozen.
 

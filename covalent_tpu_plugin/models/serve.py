@@ -60,6 +60,7 @@ from __future__ import annotations
 import collections
 import functools
 import hashlib
+import os
 import pickle
 from typing import Any, Callable, Sequence
 
@@ -74,6 +75,10 @@ from .transformer import TransformerLM
 
 #: Wire format version of a serialized KV bundle (prefill_only's output).
 KV_BUNDLE_VERSION = 1
+
+#: Environment knob bounding the NAMED slots of a multi-adapter bank
+#: (the identity base rides an extra slot 0 on top of this).
+ADAPTERS_MAX_ENV = "COVALENT_TPU_SERVE_ADAPTERS_MAX"
 
 
 class RollingCacheUnsupported(ValueError):
@@ -90,6 +95,85 @@ class RollingCacheUnsupported(ValueError):
 
     fault_label = "serve_model_unsupported"
     fault_transient = False
+
+
+class AdapterUnsupported(ValueError):
+    """Typed refusal: this engine cannot host the requested adapter set.
+
+    Raised for deterministic construction/attach errors — a model that
+    already carries adapters (the quant.py contract: quantize the base
+    first, then attach — ``lora.quantize_then_lora``), a rank/shape
+    geometry that does not match the bank template, an exhausted bank.
+    Duck-tagged PERMANENT like :class:`RollingCacheUnsupported`, so the
+    dispatch layers refuse once instead of burning gang retries.
+    """
+
+    fault_label = "serve_model_unsupported"
+    fault_transient = False
+
+
+class _AdapterDecoder:
+    """Hashable decode-model wrapper resolving a per-lane adapter index
+    against a stacked adapter bank INSIDE the compiled programs.
+
+    With a bank configured, the serving state wraps each cache lane as
+    ``{"kv": <model cache>, "adapter": <int32 bank slot>}`` and the
+    params as ``{"base": [non-adapter leaves], "bank": [stacked adapter
+    leaves, each (n_slots, ...)]}``.  ``apply`` gathers every bank leaf
+    at the lane's slot (``jnp.take(leaf, idx, axis=0)`` — a batched
+    gather under the serving loop's vmap), reassembles the full LoRA
+    tree, and delegates to the wrapped decoder on the inner cache; the
+    adapter index rides the returned cache untouched.  The wrapper
+    hashes on ``(decoder, treedef, mask)``, so the jitted factory
+    caches (:func:`_make_run_steps` and friends) treat it exactly like
+    a plain decoder static — ONE compiled step serves every adapter,
+    and attaching a new adapter is a bank scatter, never a recompile.
+    """
+
+    __slots__ = ("decoder", "treedef", "mask")
+
+    def __init__(self, decoder, treedef, mask) -> None:
+        self.decoder = decoder
+        self.treedef = treedef
+        self.mask = tuple(bool(m) for m in mask)
+
+    @property
+    def config(self):
+        return self.decoder.config
+
+    def __eq__(self, other) -> bool:
+        return (
+            type(other) is _AdapterDecoder
+            and self.decoder == other.decoder
+            and self.treedef == other.treedef
+            and self.mask == other.mask
+        )
+
+    def __hash__(self) -> int:
+        return hash((self.decoder, self.treedef, self.mask))
+
+    def _merge(self, params, idx):
+        base = iter(params["base"])
+        bank = iter(params["bank"])
+        leaves = [
+            jnp.take(next(bank), idx, axis=0) if m else next(base)
+            for m in self.mask
+        ]
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
+    def apply(self, variables, tokens, mutable=()):
+        cache = variables["cache"]
+        merged = self._merge(variables["params"], cache["adapter"])
+        out = self.decoder.apply(
+            {"params": merged, "cache": cache["kv"]}, tokens,
+            mutable=mutable,
+        )
+        if mutable:
+            logits, mutated = out
+            return logits, {"cache": {
+                "kv": mutated["cache"], "adapter": cache["adapter"],
+            }}
+        return out
 
 
 def _require_plain_cache(config, what: str) -> None:
@@ -112,7 +196,8 @@ def _choose_tokens(logits, key, temperature, top_k):
 
 
 @functools.lru_cache(maxsize=64)
-def _make_admit(decoder, temperature, top_k, eos_token_id, batch, bucket, g):
+def _make_admit(decoder, temperature, top_k, eos_token_id, batch, bucket, g,
+                adapters=False):
     """One fused, donated admission wave: batch-prefill ``g`` prompts and
     scatter their cache lanes, buffer rows, and cursors in a SINGLE
     compiled call.
@@ -132,19 +217,27 @@ def _make_admit(decoder, temperature, top_k, eos_token_id, batch, bucket, g):
     cache rewind (models/speculative.py).  Rows whose ``slots`` entry is
     out of range (the group padded up to a power of two) are dropped by
     the scatters (``mode="drop"``), so padding never touches live state.
+
+    With ``adapters=True`` (a multi-adapter bank: the cache lanes are
+    ``{"kv": ..., "adapter": ...}`` wraps and ``decoder`` is an
+    :class:`_AdapterDecoder`) the wave takes one extra ``aidxs (g,)``
+    argument — each row's bank slot, written into its zero lane BEFORE
+    the prefill so the pass gathers that adapter's weights.  Mixed
+    adapters co-batch in one wave; the plain signature is untouched.
     """
 
-    @functools.partial(jax.jit, donate_argnums=(1,))
-    def admit_wave(params, state, rows, padded, plens, slots, caps_in,
-                   keys):
+    def _wave(params, state, rows, padded, plens, slots, caps_in, keys,
+              aidxs):
         # rows (g, length) full buffer rows; padded (g, bucket) prompt
         # tokens; plens/caps_in/slots (g,); keys (g, 2) admission keys.
         caches, buffer, pos, plen, row_cap, n_gen, done, rng = state
 
-        def lane_prefill(tokens, pl, key):
+        def lane_prefill(tokens, pl, key, aidx):
             zero = jax.tree_util.tree_map(
                 lambda c: jnp.zeros(c.shape[1:], c.dtype), caches
             )
+            if adapters:
+                zero = {**zero, "adapter": aidx}
             logits, mutated = decoder.apply(
                 {"params": params, "cache": zero}, tokens[None],
                 mutable=["cache"],
@@ -158,7 +251,8 @@ def _make_admit(decoder, temperature, top_k, eos_token_id, batch, bucket, g):
             )[0]
             return cache, first
 
-        new_lanes, firsts = jax.vmap(lane_prefill)(padded, plens, keys)
+        new_lanes, firsts = jax.vmap(lane_prefill)(padded, plens, keys,
+                                                   aidxs)
         caches = jax.tree_util.tree_map(
             lambda c, nl: c.at[slots].set(nl, mode="drop"),
             caches, new_lanes,
@@ -176,6 +270,21 @@ def _make_admit(decoder, temperature, top_k, eos_token_id, batch, bucket, g):
             fin = fin | (firsts == eos_token_id)
         done = done.at[slots].set(fin, mode="drop")
         return caches, buffer, pos, plen, row_cap, n_gen, done, rng
+
+    if adapters:
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def admit_wave(params, state, rows, padded, plens, slots, caps_in,
+                       keys, aidxs):
+            return _wave(params, state, rows, padded, plens, slots,
+                         caps_in, keys, aidxs)
+
+        return admit_wave
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def admit_wave(params, state, rows, padded, plens, slots, caps_in,
+                   keys):
+        return _wave(params, state, rows, padded, plens, slots, caps_in,
+                     keys, jnp.zeros((g,), jnp.int32))
 
     return admit_wave
 
@@ -437,14 +546,19 @@ def _tokens_digest(tokens: np.ndarray) -> str:
 class _PrefixEntry:
     """One cached KV lane: the exact tokens it prefilled, cursor parked
     at ``tokens.size``.  ``pinned`` marks the constructor-supplied
-    ``shared_prefix`` template, exempt from LRU eviction."""
+    ``shared_prefix`` template, exempt from LRU eviction.  ``aslot`` is
+    the adapter bank slot whose weights computed the lane (0 = base) —
+    a lane is only ever reused under the SAME adapter, because K/V from
+    another adapter's weights would silently corrupt the stream."""
 
-    __slots__ = ("tokens", "lane", "pinned")
+    __slots__ = ("tokens", "lane", "pinned", "aslot")
 
-    def __init__(self, tokens: np.ndarray, lane: Any, pinned: bool) -> None:
+    def __init__(self, tokens: np.ndarray, lane: Any, pinned: bool,
+                 aslot: int = 0) -> None:
         self.tokens = tokens
         self.lane = lane
         self.pinned = pinned
+        self.aslot = aslot
 
 
 @functools.lru_cache(maxsize=32)
@@ -901,6 +1015,29 @@ class ContinuousEngine:
     matching group — a mismatch raises, and the session harness degrades
     to a full prefill.  ``stats["mode_tokens_<mode>"]`` counts per-group
     output tokens.
+
+    **Multi-adapter bank (``adapters`` — batched LoRA multiplexing).**
+    ``adapters={name: lora_params}`` keeps the BASE weights resident
+    once and stacks every adapter's rank-r ``lora_a``/``lora_b`` leaves
+    into ``[n_slots, ...]`` bank arrays; each lane carries an int32 bank
+    slot in its cache tree and the compiled programs gather the lane's
+    adapter INSIDE the jit (:class:`_AdapterDecoder`) — one compiled
+    step serves every adapter, heterogeneous-adapter traffic co-batches
+    in the same fused decode and admission waves, and slot 0's zero-B
+    identity makes a base lane bit-equal to the plain engine.  A
+    request's ``params["adapter"]`` selects by name (unknown names
+    refuse cleanly); :meth:`attach_adapter` splices a new adapter — or
+    hot-swaps a live name with zero drops — into the RUNNING session
+    (bank scatter, never a recompile), bounded by
+    ``COVALENT_TPU_SERVE_ADAPTERS_MAX`` (default 8).  Composes with
+    ``decode_modes`` via ``quantize_then_lora`` semantics (each
+    quantized group attaches the same adapters over its quantized base;
+    refusals degrade to fp) and with the prefix tree / KV bundles via
+    adapter-scoped keys and name+digest fingerprints — cross-adapter
+    K/V reuse is structurally impossible.  Speculative decoding refuses
+    adapter banks (plain-loop fallback).  Per-adapter
+    ``stats["adapter_tokens_<name>"]`` / ``adapter_requests_<name>``
+    feed the serving metrics.
     """
 
     def __init__(
@@ -924,6 +1061,10 @@ class ContinuousEngine:
         draft_model: TransformerLM | None = None,
         draft_params: Any = None,
         draft_len: int = 4,
+        adapters: dict[str, Any] | None = None,
+        adapter_rank: int | None = None,
+        adapter_alpha: float = 16.0,
+        adapters_max: int | None = None,
     ) -> None:
         decoder = _decode_model(model)
         config = decoder.config
@@ -947,9 +1088,127 @@ class ContinuousEngine:
             raise ValueError(
                 f"length must be in [2, {config.max_seq}], got {self._length}"
             )
+        #: host-loop counters (created early: adapter installs seed their
+        #: per-name token keys here): prefix-tree hit/miss accounting,
+        #: the prefill positions each admission paid, the KV plane's
+        #: traffic, spec/mode refusals, and the adapter bank's lifecycle.
+        self.stats: dict[str, int] = {
+            "prefix_hits": 0, "prefix_misses": 0, "prefill_positions": 0,
+            "prefix_evictions": 0, "kv_admits": 0, "kv_exports": 0,
+            "spec_rounds": 0, "spec_proposed": 0, "spec_accepted": 0,
+            "spec_refusals": 0, "mode_refusals": 0,
+            "adapter_prefix_blocked": 0, "adapter_attaches": 0,
+            "adapter_detaches": 0, "adapter_swaps": 0,
+        }
+        #: prefix digest -> _PrefixEntry, oldest-insert first (LRU order
+        #: maintained by move_to_end on every hit).
+        self._prefix_tree: "collections.OrderedDict[str, _PrefixEntry]" = (
+            collections.OrderedDict()
+        )
+        self._prefix_cache_size = max(0, int(prefix_cache_size))
+        self._prefix_min = max(1, int(prefix_min_tokens))
+
+        # -- multi-adapter bank (batched LoRA multiplexing) ----------------
+        # One resident base plus up to adapters_max named rank-r adapters:
+        # the lora_a/lora_b leaves stack into [n_slots, ...] bank arrays,
+        # every lane carries an int32 bank slot, and the compiled
+        # programs gather each lane's adapter inside the jit
+        # (_AdapterDecoder) — rank-r GEMMs on top of the shared base
+        # pass, one compiled step for ALL adapters.  Slot 0 holds the
+        # zero-B identity adapter, so a base lane is bit-equal to the
+        # plain engine's.
+        self._bank: list | None = None
+        self._adapter_slot: dict[str, int] = {}
+        self._adapter_digests: dict[str, str] = {}
+        self._adapter_free: list[int] = []
+        self._adapter_retired: list[int] = []
+        self._slot_refs: list[int] = []
+        self._rid_adapter: dict[str, tuple[int, str]] = {}
+        self._adapter_rank = 0
+        self._adapter_alpha = float(adapter_alpha)
+        self._adapters_max = 0
+        if adapters is not None or adapter_rank is not None:
+            from .lora import add_lora, lora_mask
+
+            if getattr(config, "lora_rank", 0):
+                raise AdapterUnsupported(
+                    "the adapter bank needs the BASE model, and this one "
+                    f"already carries adapters (lora_rank="
+                    f"{config.lora_rank}) — serve the base and attach "
+                    "adapters on top (lora.quantize_then_lora order)"
+                )
+            adapters = {str(k): v for k, v in (adapters or {}).items()}
+            rank = adapter_rank
+            if rank is None:
+                if not adapters:
+                    raise AdapterUnsupported(
+                        "an empty bank needs adapter_rank to size its "
+                        "template"
+                    )
+                try:
+                    rank = int(np.asarray(self._adapter_payload_leaves(
+                        next(iter(adapters.values()))
+                    )[0]).shape[-1])
+                except (ValueError, IndexError, TypeError) as exc:
+                    raise AdapterUnsupported(
+                        f"cannot infer the adapter rank: {exc}"
+                    ) from exc
+            if int(rank) < 1:
+                raise AdapterUnsupported(
+                    f"adapter_rank must be >= 1, got {rank}"
+                )
+            limit = adapters_max
+            if limit is None:
+                limit = int(os.environ.get(ADAPTERS_MAX_ENV) or 8)
+            if int(limit) < max(1, len(adapters)):
+                raise AdapterUnsupported(
+                    f"{len(adapters)} adapters exceed the bank's "
+                    f"{limit} named slots ({ADAPTERS_MAX_ENV})"
+                )
+            try:
+                lmodel, filled = add_lora(
+                    model, params, rank=int(rank),
+                    alpha=float(adapter_alpha),
+                )
+            except ValueError as exc:
+                raise AdapterUnsupported(str(exc)) from exc
+            self._adapter_rank = int(rank)
+            self._adapters_max = int(limit)
+            leaves, lora_treedef = jax.tree_util.tree_flatten(filled)
+            mask = tuple(
+                bool(m)
+                for m in jax.tree_util.tree_leaves(lora_mask(filled))
+            )
+            self._bank_base = [
+                leaf for leaf, m in zip(leaves, mask) if not m
+            ]
+            template = [leaf for leaf, m in zip(leaves, mask) if m]
+            self._adapter_shapes = [
+                (tuple(leaf.shape), jnp.dtype(leaf.dtype))
+                for leaf in template
+            ]
+            n_slots = int(limit) + 1  # + the pinned identity at slot 0
+            self._bank = [
+                jnp.zeros((n_slots,) + leaf.shape, leaf.dtype).at[0].set(
+                    leaf
+                )
+                for leaf in template
+            ]
+            self._adapter_free = list(range(1, n_slots))
+            self._slot_refs = [0] * n_slots
+            decoder = _AdapterDecoder(
+                _decode_model(lmodel), lora_treedef, mask
+            )
+            for name, payload in adapters.items():
+                self._install_adapter(name, payload)
+            self.stats.setdefault("adapter_tokens_base", 0)
+
         self._decoder = decoder
         self._config = config
-        self._params = params
+        self._params = (
+            {"base": self._bank_base, "bank": self._bank}
+            if self._bank is not None else params
+        )
         self._temperature = float(temperature)
         self._top_k = top_k
         self._eos = eos_token_id
@@ -971,6 +1230,10 @@ class ContinuousEngine:
             ).copy(),
             lane,
         )
+        if self._bank is not None:
+            # Each lane's bank slot rides the cache tree itself, so the
+            # donated jitted programs carry it without signature changes.
+            caches = {"kv": caches, "adapter": jnp.zeros(batch, jnp.int32)}
         self._state = (
             caches,
             jnp.full((batch, self._length), self._pad, jnp.int32),
@@ -990,29 +1253,13 @@ class ContinuousEngine:
         self._slot_rid: list[str | None] = [None] * batch
         self._reported = [0] * batch
         self._rid_slot: dict[str, int] = {}
-        #: admissions awaiting a flush: (rid, tokens, cap).
-        self._pending: list[tuple[str, np.ndarray, int]] = []
+        #: admissions awaiting a flush: (rid, tokens, cap, bank slot).
+        self._pending: list[tuple[str, np.ndarray, int, int]] = []
         #: KV-bundle admissions awaiting a flush:
-        #: (rid, tokens, cap, first token, imported lane).
-        self._pending_kv: list[tuple[str, np.ndarray, int, int, Any]] = []
-        #: host-loop counters: prefix-tree hit/miss accounting, the
-        #: prefill positions each admission paid (full-prompt bucket on
-        #: the slow path, suffix bucket on a prefix hit) — the measurable
-        #: "prefill work" the serve bench arms assert shrinks — plus the
-        #: KV plane's export/import/eviction traffic.
-        self.stats: dict[str, int] = {
-            "prefix_hits": 0, "prefix_misses": 0, "prefill_positions": 0,
-            "prefix_evictions": 0, "kv_admits": 0, "kv_exports": 0,
-            "spec_rounds": 0, "spec_proposed": 0, "spec_accepted": 0,
-            "spec_refusals": 0, "mode_refusals": 0,
-        }
-        #: prefix digest -> _PrefixEntry, oldest-insert first (LRU order
-        #: maintained by move_to_end on every hit).
-        self._prefix_tree: "collections.OrderedDict[str, _PrefixEntry]" = (
-            collections.OrderedDict()
-        )
-        self._prefix_cache_size = max(0, int(prefix_cache_size))
-        self._prefix_min = max(1, int(prefix_min_tokens))
+        #: (rid, tokens, cap, first token, imported lane, bank slot).
+        self._pending_kv: list[
+            tuple[str, np.ndarray, int, int, Any, int]
+        ] = []
         #: canonical lane layout: the treedef every imported KV bundle is
         #: rebuilt against and the shape/dtype table it is validated by.
         lane_leaves, self._lane_treedef = jax.tree_util.tree_flatten(lane)
@@ -1037,8 +1284,10 @@ class ContinuousEngine:
             # instead of re-running the prefix positions, and LRU churn
             # can never evict it.
             zero = jax.tree_util.tree_map(jnp.zeros_like, lane)
+            if self._bank is not None:
+                zero = {"kv": zero, "adapter": jnp.zeros((), jnp.int32)}
             _logits, mutated = decoder.apply(
-                {"params": params, "cache": zero},
+                {"params": self._params, "cache": zero},
                 jnp.asarray(ptoks)[None],
                 mutable=["cache"],
             )
@@ -1068,7 +1317,13 @@ class ContinuousEngine:
             ddecoder = _decode_model(draft_model)
             dconfig = ddecoder.config
             reason = None
-            if self._temperature > 0:
+            if self._bank is not None:
+                reason = (
+                    "multi-adapter session (the draft-verify loop runs "
+                    "one shared draft; adapter banks fall back to the "
+                    "plain loop)"
+                )
+            elif self._temperature > 0:
                 reason = (
                     "sampled session (the continuous verify path is "
                     "greedy-only; use speculative_sample offline)"
@@ -1147,24 +1402,40 @@ class ContinuousEngine:
         for mode in modes:
             if mode == "fp":
                 continue
+            sub_kwargs: dict[str, Any] = {}
+            if self._bank is not None:
+                # quantize_then_lora composition: the twin quantizes the
+                # BASE model, then the sub-engine attaches the SAME
+                # adapter set on top — exactly lora.quantize_then_lora's
+                # order.  A variant the composition refuses (quantize_lm
+                # on MoE/scanned bases, adapter-template mismatch) is a
+                # recorded per-mode refusal with fp fallback, never an
+                # error.
+                sub_kwargs = dict(
+                    adapters=adapters,
+                    adapter_rank=self._adapter_rank,
+                    adapter_alpha=self._adapter_alpha,
+                    adapters_max=self._adapters_max,
+                )
             try:
                 sub_model, sub_params = mode_variant(model, params, mode)
+                sub = ContinuousEngine(
+                    sub_model, sub_params,
+                    max_batch=max_batch, temperature=temperature,
+                    top_k=top_k, rng=rng, eos_token_id=eos_token_id,
+                    pad_token_id=pad_token_id, sync_steps=sync_steps,
+                    max_new_tokens=max_new_tokens, length=self._length,
+                    shared_prefix=shared_prefix,
+                    prefix_cache_size=prefix_cache_size,
+                    prefix_min_tokens=prefix_min_tokens,
+                    draft_model=draft_model, draft_params=draft_params,
+                    draft_len=draft_len,
+                    **sub_kwargs,
+                )
             except ValueError as exc:
                 self._mode_refusal[mode] = str(exc)
                 self.stats["mode_refusals"] += 1
                 continue
-            sub = ContinuousEngine(
-                sub_model, sub_params,
-                max_batch=max_batch, temperature=temperature,
-                top_k=top_k, rng=rng, eos_token_id=eos_token_id,
-                pad_token_id=pad_token_id, sync_steps=sync_steps,
-                max_new_tokens=max_new_tokens, length=self._length,
-                shared_prefix=shared_prefix,
-                prefix_cache_size=prefix_cache_size,
-                prefix_min_tokens=prefix_min_tokens,
-                draft_model=draft_model, draft_params=draft_params,
-                draft_len=draft_len,
-            )
             sub._mode = mode
             self._subs[mode] = sub
             self._sub_stats_seen[mode] = {}
@@ -1234,7 +1505,198 @@ class ContinuousEngine:
             )
         if self.busy >= self.slots:
             raise RuntimeError("no free lane (all slots busy)")
-        self._pending.append((rid, tokens, cap))
+        aslot, aname = self._resolve_adapter(params)
+        if self._bank is not None:
+            self._rid_adapter[rid] = (aslot, aname)
+            self._slot_refs[aslot] += 1
+            key = f"adapter_requests_{aname}"
+            self.stats[key] = self.stats.get(key, 0) + 1
+        self._pending.append((rid, tokens, cap, aslot))
+
+    # -- multi-adapter bank surface ----------------------------------------
+
+    @staticmethod
+    def _adapter_payload_leaves(payload) -> list:
+        """Normalize an adapter payload to its ordered leaf list.
+
+        Accepts the CAS registry's bundle dict (``{"leaves": [...]}``),
+        a bare leaf list (the wire form), or a full LoRA params tree
+        (:func:`..lora.adapter_leaves` extracts the adapter leaves in
+        flatten order — identical across the float and quantized model
+        twins, which is what lets ONE trained adapter splice into every
+        decode-mode lane group).
+        """
+        if isinstance(payload, dict) and "leaves" in payload:
+            return list(payload["leaves"])
+        if isinstance(payload, (list, tuple)):
+            return list(payload)
+        from .lora import adapter_leaves
+
+        return adapter_leaves(payload)
+
+    def _install_adapter(self, name: str, payload) -> str:
+        """Write one adapter into a free bank slot; returns its digest.
+
+        A re-install under a live name is the zero-drop hot swap: the
+        NEW generation takes a fresh slot and the name repoints to it —
+        lanes already decoding keep gathering the old slot's weights
+        until they finish (the retired slot is only reclaimed once its
+        in-flight refcount drains), while every subsequent admission
+        resolves the new generation.  No lane is ever touched mid-wave.
+        """
+        if (
+            not name or name == "base"
+            or not all(ch.isalnum() or ch in "._-" for ch in name)
+        ):
+            raise AdapterUnsupported(
+                f"invalid adapter name {name!r} ('base' is reserved; "
+                "names are [A-Za-z0-9._-])"
+            )
+        try:
+            leaves = self._adapter_payload_leaves(payload)
+        except (ValueError, KeyError, TypeError) as exc:
+            raise AdapterUnsupported(
+                f"adapter {name!r} payload is not an adapter: {exc}"
+            ) from exc
+        if len(leaves) != len(self._adapter_shapes):
+            raise AdapterUnsupported(
+                f"adapter {name!r} has {len(leaves)} leaves; this bank's "
+                f"template has {len(self._adapter_shapes)}"
+            )
+        cast = []
+        for leaf, (shape, dtype) in zip(leaves, self._adapter_shapes):
+            arr = np.asarray(leaf)
+            if tuple(arr.shape) != shape:
+                raise AdapterUnsupported(
+                    f"adapter {name!r} leaf {tuple(arr.shape)} does not "
+                    f"match the bank template {shape} (rank/geometry "
+                    "mismatch)"
+                )
+            cast.append(arr.astype(dtype))
+        if isinstance(payload, dict) and payload.get("digest"):
+            digest = str(payload["digest"])
+        else:
+            from .lora import adapter_digest
+
+            digest = adapter_digest(cast)
+        self._reclaim_adapter_slots()
+        if not self._adapter_free:
+            raise AdapterUnsupported(
+                f"adapter bank is full ({self._adapters_max} named slots,"
+                f" {ADAPTERS_MAX_ENV}); detach one or raise the limit"
+            )
+        slot = self._adapter_free.pop(0)
+        for i, arr in enumerate(cast):
+            self._bank[i] = self._bank[i].at[slot].set(jnp.asarray(arr))
+        old = self._adapter_slot.get(name)
+        self._adapter_slot[name] = slot
+        self._adapter_digests[name] = digest
+        self.stats.setdefault(f"adapter_tokens_{name}", 0)
+        if old is not None:
+            self._adapter_retired.append(old)
+            self._purge_prefix_slot(old)
+            self.stats["adapter_swaps"] += 1
+        return digest
+
+    def attach_adapter(self, name: str, payload) -> str:
+        """Splice an adapter into the RUNNING session; returns its
+        digest.  Live traffic keeps decoding throughout — attachment is
+        a bank scatter plus a name-table write, never a recompile (the
+        compiled programs key on the bank's static shape).  Re-attaching
+        a live name hot-swaps it with zero drops (see
+        :meth:`_install_adapter`).  Propagates to every decode-mode lane
+        group, so a ``quality``-routed request finds the adapter in its
+        quantized group too (quantize_then_lora composition).
+        """
+        if self._bank is None:
+            raise AdapterUnsupported(
+                "this session hosts no adapter bank (construct the "
+                "engine with adapters= or adapter_rank=)"
+            )
+        digest = self._install_adapter(name, payload)
+        for sub in self._subs.values():
+            if sub._bank is not None:
+                sub.attach_adapter(name, payload)
+        self.stats["adapter_attaches"] += 1
+        return digest
+
+    def detach_adapter(self, name: str) -> None:
+        """Retire a named adapter: new requests refuse it immediately;
+        its bank slot is reclaimed once in-flight lanes drain."""
+        slot = self._adapter_slot.pop(name, None)
+        if slot is None:
+            raise ValueError(
+                f"unknown adapter {name!r}; attached: "
+                f"{sorted(self._adapter_slot) or 'none'}"
+            )
+        self._adapter_digests.pop(name, None)
+        self._adapter_retired.append(slot)
+        self._purge_prefix_slot(slot)
+        self._reclaim_adapter_slots()
+        for sub in self._subs.values():
+            if sub._bank is not None and name in sub._adapter_slot:
+                sub.detach_adapter(name)
+        self.stats["adapter_detaches"] += 1
+
+    @property
+    def adapters(self) -> tuple[str, ...]:
+        """Currently attached adapter names (insertion order)."""
+        return tuple(self._adapter_slot)
+
+    @property
+    def adapter_digests(self) -> dict[str, str]:
+        """name -> content digest of the attached generation."""
+        return dict(self._adapter_digests)
+
+    def _reclaim_adapter_slots(self) -> None:
+        """Return retired bank slots whose in-flight lanes drained."""
+        still = []
+        for slot in self._adapter_retired:
+            if self._slot_refs[slot] == 0:
+                self._adapter_free.append(slot)
+            else:
+                still.append(slot)
+        self._adapter_retired = still
+
+    def _purge_prefix_slot(self, aslot: int) -> None:
+        """Drop prefix-tree lanes computed under a retired bank slot —
+        their K/V embeds the OLD generation's weights."""
+        stale = [
+            d for d, e in self._prefix_tree.items() if e.aslot == aslot
+        ]
+        for d in stale:
+            del self._prefix_tree[d]
+
+    def _release_adapter(self, rid: str) -> None:
+        """Drop one request's hold on its bank slot (idempotent)."""
+        entry = self._rid_adapter.pop(rid, None)
+        if entry is not None and self._slot_refs:
+            slot = entry[0]
+            self._slot_refs[slot] = max(0, self._slot_refs[slot] - 1)
+
+    def _resolve_adapter(self, params: dict) -> tuple[int, str]:
+        """``params["adapter"]`` -> (bank slot, name); base is slot 0.
+
+        Unknown names raise :class:`ValueError` — the session REFUSES
+        the request cleanly instead of silently serving base weights.
+        """
+        name = str(params.get("adapter") or "")
+        if self._bank is None:
+            if name and name != "base":
+                raise ValueError(
+                    f"unknown adapter {name!r} (this session hosts no "
+                    "adapter bank)"
+                )
+            return 0, "base"
+        if not name or name == "base":
+            return 0, "base"
+        slot = self._adapter_slot.get(name)
+        if slot is None:
+            raise ValueError(
+                f"unknown adapter {name!r}; attached: "
+                f"{sorted(self._adapter_slot) or 'none'}"
+            )
+        return slot, name
 
     # -- disaggregated prefill/decode surface ------------------------------
 
@@ -1273,8 +1735,9 @@ class ContinuousEngine:
                 f"generation inside the session's static length "
                 f"({self._length})"
             )
+        aslot, aname = self._resolve_adapter(params)
         self._adm_key, key = jax.random.split(self._adm_key)
-        m, lane_m, _entry_digest = self._lookup_prefix(tokens)
+        m, lane_m, _entry_digest = self._lookup_prefix(tokens, aslot)
         if m:
             bucket = min(
                 1 << (int(tokens.size) - m - 1).bit_length(),
@@ -1306,6 +1769,11 @@ class ContinuousEngine:
                     for shape, dtype in self._lane_shapes
                 ],
             )
+            if self._bank is not None:
+                lane_zero = {
+                    "kv": lane_zero,
+                    "adapter": jnp.asarray(aslot, jnp.int32),
+                }
             fn = _make_lane_prefill(
                 self._decoder, self._temperature, self._top_k, int(bucket),
             )
@@ -1317,8 +1785,14 @@ class ContinuousEngine:
                 self.stats["prefix_misses"] += 1
         self.stats["prefill_positions"] += bucket
         self.stats["kv_exports"] += 1
-        self._insert_prefix(tokens, lambda: lane)
-        leaves = jax.tree_util.tree_leaves(lane)
+        self._insert_prefix(tokens, lambda: lane, aslot=aslot)
+        # The bank slot index is ENGINE-LOCAL — the wire form carries the
+        # adapter NAME + content digest, and the importer re-wraps the
+        # inner lane with ITS local slot (refusing a name it does not
+        # host, or a digest from a superseded generation).
+        leaves = jax.tree_util.tree_leaves(
+            lane["kv"] if self._bank is not None else lane
+        )
         bundle = {
             "v": KV_BUNDLE_VERSION,
             "prompt": [int(t) for t in tokens],
@@ -1329,6 +1803,8 @@ class ContinuousEngine:
             "top_k": self._top_k,
             "eos": self._eos,
             "quant": self._mode,
+            "adapter": "" if aname == "base" else aname,
+            "adapter_digest": self._adapter_digests.get(aname, ""),
             "leaves": [np.asarray(leaf) for leaf in leaves],
         }
         return pickle.dumps(bundle, protocol=4)
@@ -1415,6 +1891,24 @@ class ContinuousEngine:
             )
         if self.busy >= self.slots:
             raise RuntimeError("no free lane (all slots busy)")
+        aname = str(bundle.get("adapter") or "")
+        if aname and self._bank is None:
+            raise ValueError(
+                f"KV bundle was prefilled under adapter {aname!r} and "
+                "this session hosts no adapter bank"
+            )
+        aslot, alabel = self._resolve_adapter(
+            {"adapter": aname} if aname else {}
+        )
+        if aname:
+            want = str(bundle.get("adapter_digest") or "")
+            have = self._adapter_digests.get(alabel, "")
+            if want and have and want != have:
+                raise ValueError(
+                    f"KV bundle adapter digest {want[:12]} does not match "
+                    f"the attached {aname!r} generation {have[:12]} "
+                    "(stale bundle after a hot swap)"
+                )
         leaves = bundle.get("leaves")
         if not isinstance(leaves, (list, tuple)) or len(leaves) != len(
             self._lane_shapes
@@ -1434,8 +1928,14 @@ class ContinuousEngine:
                 )
             imported.append(jnp.asarray(arr))
         lane = jax.tree_util.tree_unflatten(self._lane_treedef, imported)
+        if self._bank is not None:
+            lane = {"kv": lane, "adapter": jnp.asarray(aslot, jnp.int32)}
+            self._rid_adapter[rid] = (aslot, alabel)
+            self._slot_refs[aslot] += 1
+            key = f"adapter_requests_{alabel}"
+            self.stats[key] = self.stats.get(key, 0) + 1
         first = int(bundle.get("first") or 0)
-        self._pending_kv.append((rid, tokens, cap, first, lane))
+        self._pending_kv.append((rid, tokens, cap, first, lane, aslot))
         self.stats["kv_admits"] += 1
 
     def step(self) -> list[dict]:
@@ -1450,6 +1950,8 @@ class ContinuousEngine:
         per-mode token counters plus the groups' own stats fold into
         :attr:`stats` here, so one dict stays the whole session's view.
         """
+        if self._bank is not None:
+            self._reclaim_adapter_slots()
         events = self._step_local()
         fresh = sum(len(ev["tokens"]) for ev in events)
         if fresh:
@@ -1517,10 +2019,15 @@ class ContinuousEngine:
                     "tokens": [int(t) for t in fresh],
                     "done": finished,
                 })
+            if self._bank is not None and fresh.size:
+                aname = self._rid_adapter.get(rid, (0, "base"))[1]
+                key = f"adapter_tokens_{aname}"
+                self.stats[key] = self.stats.get(key, 0) + int(fresh.size)
             self._reported[slot] += int(fresh.size)
             if finished:
                 self._slot_rid[slot] = None
                 self._rid_slot.pop(rid, None)
+                self._release_adapter(rid)
         return events
 
     def cancel(self, rid: str) -> None:
@@ -1538,6 +2045,7 @@ class ContinuousEngine:
             return
         self._pending = [p for p in self._pending if p[0] != rid]
         self._pending_kv = [p for p in self._pending_kv if p[0] != rid]
+        self._release_adapter(rid)
         slot = self._rid_slot.pop(rid, None)
         if slot is None:
             return
@@ -1557,6 +2065,8 @@ class ContinuousEngine:
         self._prefix_tree.clear()
         self._rid_slot.clear()
         self._slot_rid = [None] * self.slots
+        self._rid_adapter.clear()
+        self._slot_refs = [0] * len(self._slot_refs)
         for sub in self._subs.values():
             sub.close()
         self._rid_mode.clear()
@@ -1585,7 +2095,7 @@ class ContinuousEngine:
     # -- internals ---------------------------------------------------------
 
     def _lookup_prefix(
-        self, tokens: np.ndarray
+        self, tokens: np.ndarray, aslot: int = 0
     ) -> tuple[int, Any, str]:
         """``(m, lane, entry_digest)`` of the deepest cached prefix
         usable for ``tokens`` — ``(0, None, "")`` when none qualifies.
@@ -1598,19 +2108,35 @@ class ContinuousEngine:
         cursor hold stale K/V that stays dead until the suffix pass
         overwrites it, the same exactness argument the pad positions
         ride.  Touches the winning entry's LRU slot; counts nothing
-        (callers own the hit/miss stats).
+        (callers own the hit/miss stats) EXCEPT the adapter fence:
+        entries are scoped to the bank slot whose weights computed them,
+        so a cross-adapter prompt match never reuses another adapter's
+        K/V — the admission degrades to a full prefill (byte-equal, just
+        slower) and ``stats["adapter_prefix_blocked"]`` counts the
+        would-have-hit.
         """
         best_m, best_digest, best_entry = 0, "", None
+        blocked = False
         limit_all = int(tokens.size) - 1
         for digest, entry in self._prefix_tree.items():
             limit = min(int(entry.tokens.size), limit_all)
-            if limit <= best_m or limit < self._prefix_min:
+            if limit < self._prefix_min:
+                continue
+            if entry.aslot != aslot:
+                eq = entry.tokens[:limit] == tokens[:limit]
+                m = limit if bool(eq.all()) else int(np.argmin(eq))
+                if m >= self._prefix_min:
+                    blocked = True
+                continue
+            if limit <= best_m:
                 continue
             eq = entry.tokens[:limit] == tokens[:limit]
             m = limit if bool(eq.all()) else int(np.argmin(eq))
             if m >= self._prefix_min and m > best_m:
                 best_m, best_digest, best_entry = m, digest, entry
         if best_entry is None:
+            if blocked:
+                self.stats["adapter_prefix_blocked"] += 1
             return 0, None, ""
         self._prefix_tree.move_to_end(best_digest)
         lane = best_entry.lane
@@ -1620,26 +2146,29 @@ class ContinuousEngine:
 
     def _insert_prefix(
         self, tokens: np.ndarray, lane_fn: Callable[[], Any],
-        pinned: bool = False,
+        pinned: bool = False, aslot: int = 0,
     ) -> None:
         """Cache one prefilled lane under its token digest (LRU-bounded).
 
         ``lane_fn`` defers the (device-gather) lane materialization until
         the entry is known to be fresh and cacheable; pinned entries
         (the constructor's ``shared_prefix``) never count against the
-        bound and never evict.
+        bound and never evict.  The key is scoped by the adapter bank
+        slot (``aslot``), so the same prompt under two adapters is two
+        entries — cross-adapter reuse is structurally impossible.
         """
         if not pinned and (
             self._prefix_cache_size <= 0
             or int(tokens.size) < self._prefix_min + 1
         ):
             return
-        digest = _tokens_digest(tokens)
+        digest = f"{int(aslot)}:{_tokens_digest(tokens)}"
         if digest in self._prefix_tree:
             self._prefix_tree.move_to_end(digest)
             return
         self._prefix_tree[digest] = _PrefixEntry(
-            np.array(tokens, np.int32, copy=True), lane_fn(), pinned
+            np.array(tokens, np.int32, copy=True), lane_fn(), pinned,
+            int(aslot),
         )
         unpinned = [
             d for d, e in self._prefix_tree.items() if not e.pinned
@@ -1666,18 +2195,21 @@ class ContinuousEngine:
         if not (self._pending or self._pending_kv):
             return
         free = [s for s in range(self.slots) if self._slot_rid[s] is None]
-        picked: list[tuple[int, np.ndarray, int, Any, int]] = []
-        #: (entry digest, m, bucket) -> (lane, [(slot, tokens, cap, key)])
+        picked: list[tuple[int, np.ndarray, int, Any, int, int]] = []
+        #: (entry digest, m, bucket) ->
+        #:   (lane, [(slot, tokens, cap, key, aslot)]) — entry digests
+        #: are adapter-scoped, so a group is adapter-homogeneous and the
+        #: reused lane already carries the right bank slot.
         picked_prefix: dict[tuple[str, int, int], tuple[Any, list]] = {}
-        picked_kv: list[tuple[int, np.ndarray, int, int, Any]] = []
+        picked_kv: list[tuple[int, np.ndarray, int, int, Any, int]] = []
         while self._pending and free:
-            rid, tokens, cap = self._pending.pop(0)
+            rid, tokens, cap, aslot = self._pending.pop(0)
             slot = free.pop(0)
             self._slot_rid[slot] = rid
             self._rid_slot[rid] = slot
             self._reported[slot] = 0
             self._adm_key, key = jax.random.split(self._adm_key)
-            m, lane_m, entry_digest = self._lookup_prefix(tokens)
+            m, lane_m, entry_digest = self._lookup_prefix(tokens, aslot)
             if m:
                 # Pad K/V land at cache slots >= m + suffix length, so
                 # the bucket is capped to what fits BEYOND the reused
@@ -1691,7 +2223,7 @@ class ContinuousEngine:
                 lane_g, group = picked_prefix.setdefault(
                     (entry_digest, m, bucket), (lane_m, [])
                 )
-                group.append((slot, tokens, cap, key))
+                group.append((slot, tokens, cap, key, aslot))
             else:
                 bucket = min(
                     1 << (int(tokens.size) - 1).bit_length(),
@@ -1700,14 +2232,14 @@ class ContinuousEngine:
                 if self._prefix_tree:
                     self.stats["prefix_misses"] += 1
                 self.stats["prefill_positions"] += bucket
-                picked.append((slot, tokens, cap, key, bucket))
+                picked.append((slot, tokens, cap, key, bucket, aslot))
         while self._pending_kv and free:
-            rid, tokens, cap, first, lane = self._pending_kv.pop(0)
+            rid, tokens, cap, first, lane, aslot = self._pending_kv.pop(0)
             slot = free.pop(0)
             self._slot_rid[slot] = rid
             self._rid_slot[rid] = slot
             self._reported[slot] = 0
-            picked_kv.append((slot, tokens, cap, first, lane))
+            picked_kv.append((slot, tokens, cap, first, lane, aslot))
         for bucket in sorted({p[4] for p in picked}):
             group = [p for p in picked if p[4] == bucket]
             g = 1 << (len(group) - 1).bit_length()
@@ -1716,23 +2248,29 @@ class ContinuousEngine:
             plens = np.ones(g, np.int32)
             slots = np.full(g, self.slots, np.int32)  # OOB rows dropped
             caps_in = np.ones(g, np.int32)
+            aidxs = np.zeros(g, np.int32)
             keys = [jax.random.PRNGKey(0)] * g
-            for r, (slot, tokens, cap, key, _) in enumerate(group):
+            for r, (slot, tokens, cap, key, _, aslot) in enumerate(group):
                 rows[r, : tokens.size] = tokens
                 padded[r, : tokens.size] = tokens
                 plens[r] = tokens.size
                 slots[r] = slot
                 caps_in[r] = cap
+                aidxs[r] = aslot
                 keys[r] = key
             wave = _make_admit(
                 self._decoder, self._temperature, self._top_k, self._eos,
                 int(self.slots), int(bucket), int(g),
+                adapters=self._bank is not None,
             )
-            self._state = wave(
+            args = [
                 self._params, self._state, jnp.asarray(rows),
                 jnp.asarray(padded), jnp.asarray(plens),
                 jnp.asarray(slots), jnp.asarray(caps_in), jnp.stack(keys),
-            )
+            ]
+            if self._bank is not None:
+                args.append(jnp.asarray(aidxs))
+            self._state = wave(*args)
         for (_entry, m, bucket), (lane_m, group) in picked_prefix.items():
             g = 1 << (len(group) - 1).bit_length()
             rows = np.full((g, self._length), self._pad, np.int32)
@@ -1741,7 +2279,7 @@ class ContinuousEngine:
             slots = np.full(g, self.slots, np.int32)  # OOB rows dropped
             caps_in = np.ones(g, np.int32)
             keys = [jax.random.PRNGKey(0)] * g
-            for r, (slot, tokens, cap, key) in enumerate(group):
+            for r, (slot, tokens, cap, key, _aslot) in enumerate(group):
                 suffix = tokens[m:]
                 rows[r, : tokens.size] = tokens
                 padded[r, : suffix.size] = suffix
@@ -1768,7 +2306,7 @@ class ContinuousEngine:
             caps_in = np.ones(g, np.int32)
             lanes = [p[4] for p in picked_kv]
             lanes += [lanes[0]] * (g - len(lanes))  # padded rows drop
-            for r, (slot, tokens, cap, first, _lane) in enumerate(
+            for r, (slot, tokens, cap, first, _lane, _aslot) in enumerate(
                 picked_kv
             ):
                 rows[r, : tokens.size] = tokens
@@ -1798,7 +2336,7 @@ class ContinuousEngine:
                 + [
                     (slot, tokens)
                     for _key, (_lane, group) in picked_prefix.items()
-                    for slot, tokens, _cap, _k in group
+                    for slot, tokens, *_rest in group
                 ]
                 + [(slot, tokens) for slot, tokens, *_ in picked_kv]
             )
@@ -1833,20 +2371,21 @@ class ContinuousEngine:
         if self._prefix_cache_size > 0:
             state = self._state
             candidates = [
-                (slot, tokens) for slot, tokens, *_ in picked
+                (p[0], p[1], p[5]) for p in picked
             ] + [
-                (slot, tokens)
+                (slot, tokens, aslot)
                 for _, (_lane, group) in picked_prefix.items()
-                for slot, tokens, _cap, _key in group
+                for slot, tokens, _cap, _key, aslot in group
             ] + [
-                (slot, tokens) for slot, tokens, *_ in picked_kv
+                (p[0], p[1], p[5]) for p in picked_kv
             ]
-            for slot, tokens in candidates:
+            for slot, tokens, aslot in candidates:
                 self._insert_prefix(
                     tokens,
                     lambda slot=slot: jax.tree_util.tree_map(
                         lambda c: c[slot], state[0]
                     ),
+                    aslot=aslot,
                 )
 
 

@@ -934,6 +934,36 @@ static void serve_prefill_forward(const Json& cmd,
   }
 }
 
+// serve_attach / serve_detach waiters settle on serve_attached /
+// serve_detached events keyed (id, adapter) — an unknown session or a
+// torn pipe must answer in that shape immediately, same rationale as
+// serve_prefill_forward above.
+static void serve_attach_forward(const Json& cmd, const std::string& name,
+                                 const std::string& payload) {
+  const Json* id_field = cmd.get("id");
+  const std::string sid =
+      (id_field && id_field->type == Json::Str) ? id_field->s : "";
+  const Json* a = cmd.get("adapter");
+  const std::string adapter =
+      (a && a->type == Json::Str) ? a->s : "";
+  auto it = g_serve_children.find(sid);
+  if (it == g_serve_children.end()) {
+    emit("{\"event\":\"" + name + "ed\",\"id\":\"" + json_escape(sid) +
+         "\",\"adapter\":\"" + json_escape(adapter) +
+         "\",\"code\":\"unknown_session\",\"message\":\"no open session\","
+         "\"permanent\":true}");
+    return;
+  }
+  if (!write_all(it->second.stdin_fd, payload)) {
+    close(it->second.stdin_fd);
+    g_serve_children.erase(it);
+    emit("{\"event\":\"" + name + "ed\",\"id\":\"" + json_escape(sid) +
+         "\",\"adapter\":\"" + json_escape(adapter) +
+         "\",\"code\":\"runner_exited\",\"message\":\"serve runner pipe "
+         "broken\"}");
+  }
+}
+
 // Resident-mode profiling: the native agent holds no Python/jax runtime of
 // its own — the resident state worth profiling lives in its serve-child
 // session runners.  profile_start/profile_stop forward verbatim into a live
@@ -1233,7 +1263,8 @@ static bool is_fenced_cmd(const std::string& n) {
   return n == "run" || n == "register_fn" || n == "invoke" ||
          n == "serve_open" || n == "serve_request" ||
          n == "serve_prefill" || n == "serve_close" ||
-         n == "serve_resume" || n == "serve_cancel" || n == "kill";
+         n == "serve_resume" || n == "serve_cancel" ||
+         n == "serve_attach" || n == "serve_detach" || n == "kill";
 }
 
 // Refuse a fenced command from a stale channel, in the SHAPE the caller's
@@ -1266,6 +1297,13 @@ static bool fence_refuse(const std::string& name, const Json& cmd) {
     emit("{\"event\":\"serve_resumed\",\"id\":\"" + json_escape(id) +
          "\",\"rid\":\"" + json_escape(rid) +
          "\",\"state\":\"refused\",\"code\":\"stale_epoch\"}");
+  } else if (name == "serve_attach" || name == "serve_detach") {
+    const Json* a = cmd.get("adapter");
+    emit("{\"event\":\"" + name + "ed\",\"id\":\"" + json_escape(id) +
+         "\",\"adapter\":\"" +
+         json_escape(a && a->type == Json::Str ? a->s : "") +
+         "\",\"code\":\"stale_epoch\",\"message\":\"" +
+         json_escape(message) + "\",\"permanent\":true}");
   } else if (name == "register_fn") {
     const Json* d = cmd.get("digest");
     emit("{\"event\":\"register_error\",\"digest\":\"" +
@@ -1357,6 +1395,8 @@ static void handle_line(const std::string& line, bool& running) {
   else if (name == "serve_resume") serve_forward(cmd, line + "\n", false);
   else if (name == "serve_cancel") serve_forward(cmd, line + "\n", false);
   else if (name == "serve_prefill") serve_prefill_forward(cmd, line + "\n");
+  else if (name == "serve_attach" || name == "serve_detach")
+    serve_attach_forward(cmd, name, line + "\n");
   else if (name == "serve_close") serve_forward(cmd, line + "\n", true);
   else if (name == "profile_start") profile_forward(cmd, line, false);
   else if (name == "profile_stop") profile_forward(cmd, line, true);
@@ -1405,6 +1445,8 @@ static void handle_frame(const std::string& header, const std::string& raw,
     serve_forward(cmd, raw, false);
   } else if (name == "serve_prefill") {
     serve_prefill_forward(cmd, raw);
+  } else if (name == "serve_attach" || name == "serve_detach") {
+    serve_attach_forward(cmd, name, raw);
   } else if (name == "serve_close") {
     serve_forward(cmd, raw, true);
   } else {

@@ -55,6 +55,12 @@ from .metrics import (
     SERVE_TTFT_SECONDS,
     SERVE_WORKER_SLOTS,
 )
+from .registry import (
+    AdapterRegistry,
+    adapter_content_digest,
+    pack_adapter,
+    unpack_adapter,
+)
 from .replicas import (
     ReplicaRouter,
     ReplicaSet,
@@ -64,7 +70,11 @@ from .replicas import (
 from .supervisor import SessionSupervisor
 
 __all__ = [
+    "AdapterRegistry",
     "DisaggregatedSet",
+    "adapter_content_digest",
+    "pack_adapter",
+    "unpack_adapter",
     "ServeError",
     "ServeHandle",
     "ServeRequest",

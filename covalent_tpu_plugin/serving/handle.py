@@ -195,6 +195,39 @@ class ServeHandle:
         )
         return await self._sup.submit(request)
 
+    # -- multi-adapter registry ---------------------------------------------
+
+    @property
+    def adapters(self) -> dict[str, str]:
+        """name -> content digest of every adapter attached here."""
+        return self._sup.adapters
+
+    async def attach_adapter(
+        self,
+        name: str,
+        payload: Any = None,
+        *,
+        path: str = "",
+        digest: str = "",
+        rank: int | None = None,
+        alpha: float = 16.0,
+        timeout_s: float | None = None,
+    ) -> dict:
+        """Splice a named LoRA adapter into the RUNNING session (no
+        restart, no recompile; a re-attach of an existing name is a hot
+        swap — in-flight requests finish on the old generation).  See
+        :meth:`~.supervisor.SessionSupervisor.attach_adapter`."""
+        return await self._sup.attach_adapter(
+            name, payload, path=path, digest=digest, rank=rank,
+            alpha=alpha, timeout_s=timeout_s,
+        )
+
+    async def detach_adapter(
+        self, name: str, timeout_s: float = 30.0
+    ) -> dict:
+        """Remove a named adapter from the running session."""
+        return await self._sup.detach_adapter(name, timeout_s=timeout_s)
+
     # -- close --------------------------------------------------------------
 
     async def close(self, timeout: float = 30.0) -> dict:

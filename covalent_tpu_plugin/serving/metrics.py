@@ -182,6 +182,51 @@ SERVE_MODE_TOKENS = REGISTRY.gauge(
     ("session", "mode"),
 )
 
+# -- multi-adapter serving ---------------------------------------------------
+# One engine, N LoRA adapters (PR 20): per-adapter traffic series are
+# fed by the engine's adapter_* stats counters through the worker stats
+# backhaul.  Unlike the decode-mode set, the ``adapter`` label set is
+# OPEN (operators name adapters) — the supervisor therefore tracks which
+# (session, adapter) pairs it created and ``_drop_live`` reaps exactly
+# those, never enumerating.  Attach latency is dispatcher-measured wall
+# time: CAS stage + wire round trip + engine splice.
+
+SERVE_ADAPTERS = REGISTRY.gauge(
+    "covalent_tpu_serve_adapters",
+    "LoRA adapters currently attached per serving session",
+    ("session",),
+)
+
+SERVE_ADAPTER_TOKENS = REGISTRY.gauge(
+    "covalent_tpu_serve_adapter_tokens",
+    "Output tokens per serving session by adapter lane "
+    "(cumulative; 'base' is the un-adapted lane)",
+    ("session", "adapter"),
+)
+
+SERVE_ADAPTER_REQUESTS_TOTAL = REGISTRY.gauge(
+    "covalent_tpu_serve_adapter_requests_total",
+    "Requests admitted per serving session by adapter "
+    "(cumulative engine counter, gauge-backed so the worker restates "
+    "it on every stats tick)",
+    ("session", "adapter"),
+)
+
+SERVE_ADAPTER_ATTACHES_TOTAL = REGISTRY.counter(
+    "covalent_tpu_serve_adapter_attaches_total",
+    "Adapter attach/detach operations by outcome",
+    ("op", "outcome"),
+)
+
+SERVE_ADAPTER_ATTACH_SECONDS = REGISTRY.histogram(
+    "covalent_tpu_serve_adapter_attach_seconds",
+    "Live adapter attach wall time: CAS stage -> engine splice ack",
+    buckets=(
+        0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+        30.0,
+    ),
+)
+
 # -- disaggregated prefill/decode -------------------------------------------
 # The KV transfer plane: prefill replicas package admission prefill as
 # content-addressed KV bundles; decode replicas import them and go

@@ -164,6 +164,16 @@ class ReplicaRouter:
             collections.OrderedDict()
         )
         self._prefix_sites_max = 1024
+        #: adapter name -> replica ids whose engine holds that adapter
+        #: resident.  Unlike prefix affinity this is a CONSTRAINT when
+        #: known: a replica without the adapter refuses the request
+        #: outright, so placement restricts to residents (and defers
+        #: when no resident has headroom) rather than merely preferring
+        #: them.  An adapter the router has no sites for places
+        #: unconstrained — the attach-to-all default, or a caller
+        #: naming an unknown adapter (the worker's clean refusal is the
+        #: right answer there, not a router stall).
+        self._adapter_sites: dict[str, set[str]] = {}
         #: rotation cursor for exact load ties, so equal replicas share.
         self._rr = 0
 
@@ -217,6 +227,10 @@ class ReplicaRouter:
             if rid == replica_id
         ]:
             del self._prefix_sites[key]
+        for name in list(self._adapter_sites):
+            self._adapter_sites[name].discard(replica_id)
+            if not self._adapter_sites[name]:
+                del self._adapter_sites[name]
 
     def record_prefix_site(self, prefix_key: str, replica_id: str) -> None:
         """Remember which replica last warmed ``prefix_key`` (bounded)."""
@@ -229,6 +243,27 @@ class ReplicaRouter:
 
     def prefix_site(self, prefix_key: str) -> str | None:
         return self._prefix_sites.get(prefix_key)
+
+    def record_adapter_site(self, adapter: str, replica_id: str) -> None:
+        """Mark ``replica_id``'s engine as holding ``adapter`` resident."""
+        if adapter:
+            self._adapter_sites.setdefault(adapter, set()).add(replica_id)
+
+    def drop_adapter_site(
+        self, adapter: str, replica_id: str | None = None
+    ) -> None:
+        """Forget residency — one replica's, or (default) everywhere."""
+        if replica_id is None:
+            self._adapter_sites.pop(adapter, None)
+            return
+        sites = self._adapter_sites.get(adapter)
+        if sites is not None:
+            sites.discard(replica_id)
+            if not sites:
+                del self._adapter_sites[adapter]
+
+    def adapter_sites(self, adapter: str) -> set[str]:
+        return set(self._adapter_sites.get(adapter) or ())
 
     # -- admission + placement ----------------------------------------------
 
@@ -279,6 +314,16 @@ class ReplicaRouter:
                 break
             sticky = str(item.task_metadata.get("sticky") or "")
             prefix_key = str(item.task_metadata.get("prefix_key") or "")
+            adapter = str(item.task_metadata.get("adapter") or "")
+            # Residency constraint: when the router KNOWS where this
+            # request's adapter lives, only those replicas are eligible
+            # — anywhere else refuses it outright (unknown_adapter).
+            sites = self._adapter_sites.get(adapter) if adapter else None
+            constrained = bool(sites)
+
+            def _eligible(rid: str) -> bool:
+                return not constrained or rid in sites
+
             target = None
             outcome = "least_loaded"
             if sticky:
@@ -288,6 +333,11 @@ class ReplicaRouter:
                     if (
                         view is not None and view.alive
                         and not view.quarantined
+                        # A pin at a replica WITHOUT the adapter falls
+                        # through to a fresh (resident) placement and
+                        # re-pins there: waiting on the pinned replica
+                        # would wait for a refusal.
+                        and _eligible(pinned)
                     ):
                         if headroom.get(pinned, 0) > 0:
                             target, outcome = pinned, "sticky"
@@ -307,15 +357,30 @@ class ReplicaRouter:
                 # least-loaded, and unlike a pin it never defers: a warm
                 # prefix tree is worth steering toward, not waiting on.
                 site = self.prefix_site(prefix_key)
-                if site is not None and headroom.get(site, 0) > 0:
+                if (
+                    site is not None and headroom.get(site, 0) > 0
+                    and _eligible(site)
+                ):
                     view = views.get(site)
                     if view is not None and view.open:
                         target, outcome = site, "prefix_affinity"
             if target is None:
-                target = self._least_loaded(views, headroom)
+                pool = (
+                    {
+                        rid: free for rid, free in headroom.items()
+                        if rid in sites
+                    }
+                    if constrained else headroom
+                )
+                target = self._least_loaded(views, pool)
                 if target is None:
+                    # Constrained and no resident lane free: wait for
+                    # one (the adapter IS attached somewhere) rather
+                    # than burning the request on a certain refusal.
                     deferred.append(item)
                     continue
+                if constrained:
+                    outcome = "adapter_affinity"
                 if sticky:
                     self.pin(sticky, target)
             if outcome == "sticky":
@@ -595,6 +660,11 @@ class ReplicaSet:
             "reconnects": self.reconnects,
             "queued": self.router.queued,
             "sticky": self.router.sticky_count(),
+            **(
+                {"adapters": self.adapter_residency()}
+                if any(s.adapters for s in self._replicas.values())
+                else {}
+            ),
             "router_decision_p50_ms": round(p50 * 1e3, 4),
             "hedge": {
                 "enabled": self._hedge_enabled,
@@ -796,6 +866,7 @@ class ReplicaSet:
             task_metadata={
                 "request": request, "sticky": sticky,
                 "prefix_key": request.prefix_key,
+                "adapter": str((params or {}).get("adapter") or ""),
             },
             tenant=tenant or DEFAULT_TENANT,
         )
@@ -865,6 +936,103 @@ class ReplicaSet:
             )
         return request
 
+    # -- multi-adapter registry ---------------------------------------------
+
+    def adapter_residency(self) -> dict[str, list[str]]:
+        """adapter name -> replica ids whose engine holds it resident."""
+        residency: dict[str, list[str]] = {}
+        for rid, sup in self._replicas.items():
+            for name in sup.adapters:
+                residency.setdefault(name, []).append(rid)
+        return {name: sorted(rids) for name, rids in residency.items()}
+
+    async def attach_adapter(
+        self,
+        name: str,
+        payload: Any = None,
+        *,
+        path: str = "",
+        digest: str = "",
+        rank: int | None = None,
+        alpha: float = 16.0,
+        replicas: int = 0,
+        timeout_s: float | None = None,
+    ) -> dict[str, dict]:
+        """Attach a named adapter across the set, spread by load.
+
+        ``replicas=0`` (default) attaches everywhere — any replica can
+        then serve the adapter and routing stays unconstrained.
+        ``replicas=N`` attaches to only the N LEAST-LOADED open replicas
+        (capacity consolidation: a long-tail adapter does not need every
+        engine's bank slots), and the router learns the residency sites
+        so requests naming the adapter place onto — and wait for — the
+        replicas that actually hold it.  Returns replica id -> worker
+        ack; a replica that refuses (bank full) is skipped with its
+        error in the map, not fatal, as long as at least one attach
+        lands.
+        """
+        open_replicas = [
+            (rid, sup) for rid, sup in self._replicas.items()
+            if sup.routable
+        ]
+        if not open_replicas:
+            raise ServeError(
+                f"replica set {self.name} has no open replica to attach "
+                f"adapter {name!r} to"
+            )
+        open_replicas.sort(key=lambda pair: pair[1].in_flight)
+        count = int(replicas) if replicas else len(open_replicas)
+        chosen = open_replicas[:max(1, count)]
+        spread = bool(replicas) and len(chosen) < len(open_replicas)
+        acks: dict[str, dict] = {}
+        landed = 0
+        for rid, sup in chosen:
+            try:
+                acks[rid] = await sup.attach_adapter(
+                    name, payload, path=path, digest=digest, rank=rank,
+                    alpha=alpha, timeout_s=timeout_s,
+                )
+                landed += 1
+                if spread:
+                    self.router.record_adapter_site(str(name), rid)
+            except BaseException as err:
+                if isinstance(err, asyncio.CancelledError):
+                    raise
+                acks[rid] = {"error": repr(err)}
+                app_log.warning(
+                    "adapter %r attach on replica %s failed: %r",
+                    name, rid, err,
+                )
+        if not landed:
+            raise ServeError(
+                f"adapter {name!r} attached to no replica of {self.name}: "
+                f"{acks}"
+            )
+        if not spread:
+            # Resident everywhere that matters: lift any stale routing
+            # constraint from a previous partial attachment.
+            self.router.drop_adapter_site(str(name))
+        return acks
+
+    async def detach_adapter(
+        self, name: str, timeout_s: float = 30.0
+    ) -> dict[str, dict]:
+        """Detach a named adapter from every replica holding it."""
+        acks: dict[str, dict] = {}
+        for rid, sup in list(self._replicas.items()):
+            if name not in sup.adapters:
+                continue
+            try:
+                acks[rid] = await sup.detach_adapter(
+                    name, timeout_s=timeout_s
+                )
+            except BaseException as err:
+                if isinstance(err, asyncio.CancelledError):
+                    raise
+                acks[rid] = {"error": repr(err)}
+        self.router.drop_adapter_site(str(name))
+        return acks
+
     async def _prepare_request(self, request: ServeRequest) -> None:
         """Pre-dispatch hook: a disaggregated set runs the prefill tier
         here (attaching the KV bundle and prefix key) before the router
@@ -923,6 +1091,9 @@ class ReplicaSet:
             task_metadata={
                 "request": request, "sticky": sticky,
                 "prefix_key": request.prefix_key,
+                "adapter": str(
+                    (request.params or {}).get("adapter") or ""
+                ),
             },
             tenant=request.tenant or DEFAULT_TENANT,
         )

@@ -44,6 +44,11 @@ from ..resilience import FaultClass, RetryPolicy, classify_error
 from ..transport.base import TransportError
 from ..utils.log import app_log
 from .metrics import (
+    SERVE_ADAPTER_ATTACH_SECONDS,
+    SERVE_ADAPTER_ATTACHES_TOTAL,
+    SERVE_ADAPTER_REQUESTS_TOTAL,
+    SERVE_ADAPTER_TOKENS,
+    SERVE_ADAPTERS,
     SERVE_HANDOFFS_TOTAL,
     SERVE_MODE_TOKENS,
     SERVE_PREFILL_POSITIONS,
@@ -445,6 +450,15 @@ class SessionSupervisor:
         self._conns: list = []
         self._sid_g = ""
         self._requests: dict[str, ServeRequest] = {}
+        #: name -> adapter record ({digest, content, path, ...}) for every
+        #: adapter attached to THIS session, in attach order — the replay
+        #: set a reconnect/handoff re-splices into the fresh generation.
+        self._adapters: dict[str, dict] = {}
+        #: (session, adapter) metric series this supervisor created; the
+        #: adapter label set is OPEN (operators name adapters), so the
+        #: stale-series reap in :meth:`_drop_live` replays exactly this
+        #: set instead of enumerating.
+        self._adapter_series: set[str] = set()
         self._closed = False
         self._failed: BaseException | None = None
         self._ready = asyncio.Event()
@@ -504,6 +518,8 @@ class SessionSupervisor:
         if self.replica_of is not None:
             view["replica_set"] = self.replica_of[0]
             view["replica"] = self.replica_of[1]
+        if self._adapters:
+            view["adapters"] = self.adapters
         view["health_score"] = HEALTH.score(self.sid)
         view["health_state"] = HEALTH.state(self.sid)
         for field in ("busy", "queued", "tokens_per_s", "tokens_total"):
@@ -977,6 +993,258 @@ class SessionSupervisor:
             params=params, timeout=timeout_s, trace=trace,
         )
 
+    # -- multi-adapter registry (live attach / detach / replay) --------------
+
+    def _adapter_registry(self):
+        """The executor-scoped adapter book (built through the
+        executor's accessor when it has one, so every session on one
+        executor shares one registry; stub executors in tests get a
+        lazily attached instance)."""
+        accessor = getattr(self.executor, "adapter_registry", None)
+        if callable(accessor):
+            return accessor()
+        registry = getattr(self.executor, "_adapter_registry", None)
+        if registry is None:
+            from .registry import AdapterRegistry
+
+            registry = AdapterRegistry(self.executor.cache_dir)
+            self.executor._adapter_registry = registry
+        return registry
+
+    @property
+    def adapters(self) -> dict[str, str]:
+        """name -> content digest of every adapter attached here."""
+        return {
+            name: str(record.get("content") or "")
+            for name, record in self._adapters.items()
+        }
+
+    async def attach_adapter(
+        self,
+        name: str,
+        payload: Any = None,
+        *,
+        path: str = "",
+        digest: str = "",
+        rank: int | None = None,
+        alpha: float = 16.0,
+        timeout_s: float | None = None,
+    ) -> dict:
+        """Splice a named LoRA adapter into this RUNNING session.
+
+        Three sources, first match wins: ``payload`` (bundle bytes, a
+        bundle dict, or an ordered leaf list — packed and registered
+        here), ``path`` (a packed bundle file, e.g. a journaled CAS
+        path; ``digest`` cross-checks it when given), or the executor's
+        adapter registry by ``name``.  The bundle ships into the
+        worker's CAS sha256-verified, the engine splices it in between
+        decode waves (a re-attach of an existing name is a hot swap:
+        in-flight requests finish on the old generation), and the
+        attachment is journaled sync so a successor dispatcher
+        re-attaches it after a crash.  Returns the worker's ack
+        (content ``digest``, ``attach_s``).
+        """
+        await self._await_ready()
+        client = self._client
+        if client is None:
+            raise ServeError(f"session {self.sid} has no live runtime")
+        t0 = time.monotonic()
+        timeout = float(
+            timeout_s
+            if timeout_s is not None
+            else _env_number("COVALENT_TPU_SERVE_ATTACH_TIMEOUT_S", 60.0)
+        )
+        registry = self._adapter_registry()
+        if payload is not None:
+            record = await asyncio.to_thread(
+                registry.put, name, payload, rank, alpha
+            )
+        elif path:
+            data = await asyncio.to_thread(self._read_payload, path)
+            record = await asyncio.to_thread(registry.put, name, data)
+            if digest and record["digest"] != digest:
+                SERVE_ADAPTER_ATTACHES_TOTAL.labels(
+                    op="attach", outcome="digest_mismatch"
+                ).inc()
+                raise ServeError(
+                    f"adapter {name!r} bundle at {path} hashes to "
+                    f"{record['digest'][:12]}, journal says {digest[:12]} "
+                    "(torn or tampered artifact)"
+                )
+        else:
+            record = registry.get(name)
+            if record is None:
+                raise ServeError(
+                    f"no adapter {name!r} in the registry (register it, "
+                    "or pass payload=/path=)"
+                )
+        try:
+            remote = await self._stage_adapter(record)
+            ack = await client.serve_attach(
+                self._sid_g, name, record["digest"], remote,
+                timeout=timeout,
+            )
+        except BaseException as err:
+            SERVE_ADAPTER_ATTACHES_TOTAL.labels(
+                op="attach", outcome="error"
+            ).inc()
+            obs_events.emit(
+                "serve.adapter_attach_failed",
+                sid=self.sid, adapter=str(name), error=repr(err),
+            )
+            raise self._adapter_refusal(err, "attach", str(name))
+        elapsed = time.monotonic() - t0
+        record = dict(record)
+        record["content"] = str(
+            ack.get("digest") or record.get("content") or ""
+        )
+        self._adapters[str(name)] = record
+        SERVE_ADAPTER_ATTACHES_TOTAL.labels(op="attach", outcome="ok").inc()
+        SERVE_ADAPTER_ATTACH_SECONDS.observe(elapsed)
+        SERVE_ADAPTERS.labels(session=self.sid).set(
+            float(len(self._adapters))
+        )
+        journal_mod.record(
+            "session_adapter", sid=self.sid, adapter=str(name),
+            digest=record["digest"], path=record["path"],
+            content=record["content"], sync=True,
+        )
+        obs_events.emit(
+            "serve.adapter_attached",
+            sid=self.sid, adapter=str(name),
+            digest=record["content"], attach_s=round(elapsed, 4),
+        )
+        self._changed()
+        return ack
+
+    async def detach_adapter(
+        self, name: str, timeout_s: float = 30.0
+    ) -> dict:
+        """Remove a named adapter from the running session; its decode
+        slot frees once requests pinned to it drain.  Journaled sync so
+        recovery does not resurrect the detached name."""
+        await self._await_ready()
+        client = self._client
+        if client is None:
+            raise ServeError(f"session {self.sid} has no live runtime")
+        try:
+            ack = await client.serve_detach(
+                self._sid_g, name, timeout=timeout_s
+            )
+        except BaseException as err:
+            SERVE_ADAPTER_ATTACHES_TOTAL.labels(
+                op="detach", outcome="error"
+            ).inc()
+            raise self._adapter_refusal(err, "detach", str(name))
+        self._adapters.pop(str(name), None)
+        SERVE_ADAPTER_ATTACHES_TOTAL.labels(op="detach", outcome="ok").inc()
+        SERVE_ADAPTERS.labels(session=self.sid).set(
+            float(len(self._adapters))
+        )
+        journal_mod.record(
+            "session_adapter", sid=self.sid, adapter=str(name),
+            detached=True, sync=True,
+        )
+        obs_events.emit(
+            "serve.adapter_detached", sid=self.sid, adapter=str(name),
+        )
+        self._changed()
+        return ack
+
+    def _adapter_refusal(
+        self, err: BaseException, op: str, name: str
+    ) -> BaseException:
+        """A classified worker refusal (it carries a ``fault_label``)
+        becomes a :class:`ServeError` with the SAME duck tags, so
+        callers catch the serving tier's exception while
+        ``classify_error`` still sees the worker's permanence verdict.
+        Channel faults and cancellations pass through untouched — the
+        reconnect machinery owns those."""
+        label = str(getattr(err, "fault_label", "") or "")
+        if not label:
+            return err
+        wrapped = ServeError(
+            f"{op} of adapter {name!r} on {self.sid} refused: {err}"
+        )
+        wrapped.fault_label = label
+        wrapped.fault_transient = bool(
+            getattr(err, "fault_transient", True)
+        )
+        wrapped.__cause__ = err
+        return wrapped
+
+    def note_adapter(
+        self, name: str, *, digest: str, path: str, content: str = ""
+    ) -> None:
+        """Record an adapter that is ALREADY resident in the remote
+        engine (crash recovery: the worker held it through the
+        dispatcher's death) without re-shipping anything."""
+        self._adapters[str(name)] = {
+            "name": str(name), "digest": str(digest),
+            "path": str(path), "content": str(content),
+        }
+        SERVE_ADAPTERS.labels(session=self.sid).set(
+            float(len(self._adapters))
+        )
+        journal_mod.record(
+            "session_adapter", sid=self.sid, adapter=str(name),
+            digest=str(digest), path=str(path), content=str(content),
+            sync=True,
+        )
+
+    async def _stage_adapter(self, record: dict) -> str:
+        """Ship one packed bundle into this generation's worker CAS;
+        returns the remote path (digest-named, single-flighted — a
+        replay after reconnect onto the same worker is a present-set
+        hit, zero wire bytes)."""
+        executor = self.executor
+        conn = self._conns[0]
+        key = executor._pool_key(self.address)
+        digest = str(record["digest"])
+        remote = cas_path(executor.remote_cache, digest, ".lora")
+        await executor._cas.ensure(
+            key, conn, digest, str(record["path"]), remote,
+            codec=executor._codec_for(key, conn),
+            python_path=executor.python_path,
+        )
+        return remote
+
+    async def _replay_adapters(self) -> None:
+        """Re-splice every attached adapter into a FRESH generation
+        (reconnect / warm handoff): the new engine starts with an empty
+        bank, and a request naming an un-replayed adapter would refuse.
+        Per-adapter degrade: one failed replay logs and keeps going —
+        the other adapters (and the base lane) must not die with it.
+        """
+        client = self._client
+        if client is None or not self._adapters:
+            return
+        for name, record in list(self._adapters.items()):
+            try:
+                remote = await self._stage_adapter(record)
+                await client.serve_attach(
+                    self._sid_g, name, str(record["digest"]), remote,
+                    timeout=_env_number(
+                        "COVALENT_TPU_SERVE_ATTACH_TIMEOUT_S", 60.0
+                    ),
+                )
+            except asyncio.CancelledError:
+                raise
+            except BaseException as err:  # noqa: BLE001 - degrade per name
+                app_log.warning(
+                    "adapter %r replay onto %s generation %d failed: %r",
+                    name, self.sid, self.generation, err,
+                )
+                obs_events.emit(
+                    "serve.adapter_replay_failed",
+                    sid=self.sid, adapter=str(name), error=repr(err),
+                )
+
+    @staticmethod
+    def _read_payload(path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
     async def _await_ready(self) -> None:
         if self._closed:
             raise ServeError(f"session {self.sid} is closed")
@@ -1215,8 +1483,10 @@ class SessionSupervisor:
                 "spec_refusals", "spec_accept_rate", "mode_refusals",
             )
             # Per-lane token counters arrive as one key per configured
-            # mode; pass the family through rather than enumerating it.
+            # mode (and one per attached adapter); pass the families
+            # through rather than enumerating them.
             or k.startswith("mode_tokens_")
+            or k.startswith("adapter_")
         }
         SERVE_QUEUE_DEPTH.labels(session=self.sid).set(
             float(self.stats.get("queued") or 0)
@@ -1251,6 +1521,18 @@ class SessionSupervisor:
             if key.startswith("mode_tokens_"):
                 SERVE_MODE_TOKENS.labels(
                     session=self.sid, mode=key[len("mode_tokens_"):]
+                ).set(float(value or 0))
+            elif key.startswith("adapter_tokens_"):
+                adapter = key[len("adapter_tokens_"):]
+                self._adapter_series.add(adapter)
+                SERVE_ADAPTER_TOKENS.labels(
+                    session=self.sid, adapter=adapter
+                ).set(float(value or 0))
+            elif key.startswith("adapter_requests_"):
+                adapter = key[len("adapter_requests_"):]
+                self._adapter_series.add(adapter)
+                SERVE_ADAPTER_REQUESTS_TOTAL.labels(
+                    session=self.sid, adapter=adapter
                 ).set(float(value or 0))
 
     def _finish(self, rid: str, outcome: str) -> None:
@@ -1368,6 +1650,7 @@ class SessionSupervisor:
             self._adopt(binding)
             if old_client is not None:
                 old_client.unwatch_serve(old_sid)
+            await self._replay_adapters()
             await self._replay_in_flight()
             self.handoffs += 1
             SERVE_HANDOFFS_TOTAL.labels(outcome="ok").inc()
@@ -1495,6 +1778,7 @@ class SessionSupervisor:
                         generation=self.generation,
                         replayed=len(self._requests),
                     )
+                    await self._replay_adapters()
                     await self._replay_in_flight()
                     self._ready.set()
                     self._changed()
@@ -1607,6 +1891,17 @@ class SessionSupervisor:
         SERVE_SPEC_ACCEPT_RATE.remove(session=self.sid)
         for mode in _SERVING_MODES:
             SERVE_MODE_TOKENS.remove(session=self.sid, mode=mode)
+        # Adapter label set is OPEN — reap exactly the series this
+        # supervisor created (tracked in _on_stats), plus the per-session
+        # attachment gauge, so a churned multi-adapter session leaves no
+        # stale adapter series behind.
+        SERVE_ADAPTERS.remove(session=self.sid)
+        for adapter in self._adapter_series:
+            SERVE_ADAPTER_TOKENS.remove(session=self.sid, adapter=adapter)
+            SERVE_ADAPTER_REQUESTS_TOTAL.remove(
+                session=self.sid, adapter=adapter
+            )
+        self._adapter_series.clear()
         if self.replica_of is not None:
             SERVE_REPLICA_IN_FLIGHT.remove(
                 set=self.replica_of[0], replica=self.replica_of[1]

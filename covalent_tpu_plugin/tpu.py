@@ -845,6 +845,14 @@ class TPUExecutor(RemoteExecutor):
         #: (serving.open_session registers/deregisters; /status and the
         #: fleet pool view read it).
         self._serve_handles: dict[str, Any] = {}
+        #: executor-scoped CAS adapter registry (name -> packed LoRA
+        #: bundle record): sessions attach from it, the journal points
+        #: recovery's re-attach at its files, and the fleet scheduler's
+        #: adapter-digest affinity consults the staged CAS keys.  Lazily
+        #: built on first use (serving.registry import stays off the
+        #: electron-only hot path); SessionSupervisor._adapter_registry
+        #: creates it through this same attribute.
+        self._adapter_registry: Any = None
         #: fleet pool name this executor backs ("" standalone) — set by
         #: fleet.pools.Pool so per-pool metrics (prewarm cold-start
         #: durations) key on the pool operators actually scale.
@@ -951,6 +959,17 @@ class TPUExecutor(RemoteExecutor):
         """Distinct function digests registered across this executor's
         connections (the fleet ``/status`` per-pool counter)."""
         return len(self._fn_registry.digests())
+
+    def adapter_registry(self):
+        """The executor-scoped LoRA adapter registry (lazily built —
+        keeps ``serving.registry`` off the electron-only import path).
+        Register bundles here (``put``) and sessions opened on this
+        executor attach them by name."""
+        if self._adapter_registry is None:
+            from .serving.registry import AdapterRegistry
+
+            self._adapter_registry = AdapterRegistry(self.cache_dir)
+        return self._adapter_registry
 
     def holds_serve_digest(self, digest: str) -> bool:
         """Whether this executor's gang already staged the given CAS
